@@ -1,0 +1,37 @@
+"""spark_rapids_tpu: a TPU-native columnar SQL/ETL acceleration framework.
+
+A from-scratch, TPU-first re-design of the capabilities of the RAPIDS
+Accelerator for Apache Spark (reference: /root/reference, spark-rapids ~v0.3).
+The reference is a Spark plugin that rewrites SQL physical plans so supported
+operators run on GPU over cuDF columnar batches (reference
+sql-plugin/src/main/scala/com/nvidia/spark/rapids/GpuOverrides.scala).
+
+This framework is standalone: it provides
+  * a DataFrame API and logical planner (mini-Catalyst),
+  * a CPU columnar engine (Arrow/numpy) that doubles as the differential-test
+    oracle (mirrors the reference's CPU-Spark-as-oracle strategy,
+    tests/SparkQueryCompareTestSuite.scala:153-167),
+  * a plan-rewrite engine (`TpuOverrides`) that tags and replaces CPU physical
+    operators with TPU columnar operators, with per-op config keys, explain
+    output and automatic host<->device transitions (reference
+    GpuOverrides.scala:1991-2010, GpuTransitionOverrides.scala),
+  * TPU columnar kernels built on jax/XLA/Pallas over static-shape padded
+    batches with validity masks,
+  * a spill-tiered buffer catalog (HBM -> host -> disk; reference
+    RapidsBufferCatalog.scala) and device-occupancy semaphore,
+  * distributed exchange: hash/range/round-robin/single partitioning and a
+    mesh-collective shuffle over jax.sharding meshes (ICI all-to-all), plus a
+    local transport (reference shuffle-plugin/ UCX transport),
+  * Parquet/ORC/CSV scans and writers (Arrow host decode -> HBM),
+  * a Python-UDF bytecode compiler to expressions (reference udf-compiler/).
+"""
+
+import jax as _jax
+
+# SQL long/double semantics require 64-bit types (Spark LongType/DoubleType);
+# must be set before any jax computation.
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu.version import __version__
+
+__all__ = ["__version__"]

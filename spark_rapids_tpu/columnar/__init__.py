@@ -1,0 +1,4 @@
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+
+__all__ = ["DeviceColumn", "ColumnBatch", "round_capacity"]

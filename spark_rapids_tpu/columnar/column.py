@@ -1,0 +1,123 @@
+"""Device-resident column: the TPU analog of cuDF ColumnVector.
+
+Reference: GpuColumnVector.java:40 wraps a cuDF device ColumnVector inside a
+Spark ColumnarBatch column. Here a column is a pytree of jax arrays:
+
+* fixed-width types: ``data``  — jax array ``[capacity]`` (numpy dtype from
+  :mod:`spark_rapids_tpu.types`), ``validity`` — bool ``[capacity]``.
+* strings:           ``data``  — uint8 ``[capacity, max_len]`` padded UTF-8
+  bytes, ``lengths`` — int32 ``[capacity]``, ``validity`` as above.
+
+TPU-first design notes (why this is not cuDF's offsets+chars layout): XLA
+requires static shapes, so variable-width character buffers whose total size
+depends on the data would force a recompile per batch.  A padded byte matrix
+keeps every string op a dense vectorized kernel on the VPU (compare, slice,
+case-map) at the cost of padding; ``max_len`` is bucketed to powers of two to
+bound the number of compiled variants.
+
+Rows at index >= the owning batch's ``num_rows`` are *padding*: their
+validity is False and data is zeroed.  Invalid (null) rows also carry zeroed
+data so reductions can run unmasked and be corrected via validity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+__all__ = ["DeviceColumn", "round_string_width"]
+
+
+def round_string_width(n: int) -> int:
+    """Bucket a max string byte-length to a power of two (min 4)."""
+    c = 4
+    while c < n:
+        c <<= 1
+    return c
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceColumn:
+    """One column of a device batch. Immutable."""
+
+    __slots__ = ("data", "validity", "lengths", "dtype")
+
+    def __init__(self, data: jax.Array, validity: jax.Array,
+                 dtype: T.DataType, lengths: Optional[jax.Array] = None):
+        self.data = data
+        self.validity = validity
+        self.lengths = lengths
+        self.dtype = dtype
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = children
+            return cls(data, validity, dtype, lengths)
+        data, validity = children
+        return cls(data, validity, dtype)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+    @property
+    def max_len(self) -> int:
+        assert self.is_string
+        return self.data.shape[1]
+
+    def with_validity(self, validity: jax.Array) -> "DeviceColumn":
+        return DeviceColumn(self.data, validity, self.dtype, self.lengths)
+
+    # -- construction helpers ----------------------------------------------
+    @staticmethod
+    def from_numpy(data: np.ndarray, validity: np.ndarray | None,
+                   dtype: T.DataType, capacity: int) -> "DeviceColumn":
+        """Pad host numpy data to ``capacity`` and move to device."""
+        n = data.shape[0]
+        assert n <= capacity, (n, capacity)
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vfull = np.zeros(capacity, dtype=np.bool_)
+        vfull[:n] = validity
+        dfull = np.zeros((capacity,) + data.shape[1:], dtype=data.dtype)
+        dfull[:n] = data
+        # zero out null slots for deterministic padding semantics
+        dfull[:n][~validity] = 0
+        return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull), dtype)
+
+    @staticmethod
+    def strings_from_numpy(byte_matrix: np.ndarray, lengths: np.ndarray,
+                           validity: np.ndarray | None,
+                           capacity: int) -> "DeviceColumn":
+        n = byte_matrix.shape[0]
+        width = byte_matrix.shape[1] if byte_matrix.ndim == 2 else 4
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vfull = np.zeros(capacity, dtype=np.bool_)
+        vfull[:n] = validity
+        dfull = np.zeros((capacity, width), dtype=np.uint8)
+        lfull = np.zeros(capacity, dtype=np.int32)
+        if n:
+            dfull[:n] = byte_matrix
+            lfull[:n] = lengths
+            dfull[:n][~validity] = 0
+            lfull[:n][~validity] = 0
+        return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
+                            T.StringType(), jnp.asarray(lfull))

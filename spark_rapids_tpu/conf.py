@@ -1,0 +1,297 @@
+"""Typed, self-documenting configuration registry.
+
+TPU-native analog of the reference's RapidsConf (reference
+sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:30-1059):
+a builder-based registry of `spark.rapids.*` entries with docs, defaults,
+value checking and doc generation (`RapidsConf.help`, RapidsConf.scala:785),
+plus per-operator auto-generated enable keys
+(`spark.rapids.sql.expression.<Name>` etc., GpuOverrides.scala:132-137).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+__all__ = ["ConfEntry", "TpuConf", "register", "registered_entries", "help_text"]
+
+_BYTE_SUFFIXES = {
+    "b": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40,
+}
+
+
+def parse_bytes(v) -> int:
+    """Parse '512m', '2g', plain ints. Mirrors Spark byte-unit parsing used by
+    RapidsConf (reference RapidsConf.scala bytesConf entries, e.g. :364)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*(\d+)\s*([bkmgt]?)b?\s*", str(v).lower())
+    if not m:
+        raise ValueError(f"cannot parse byte size: {v!r}")
+    return int(m.group(1)) * _BYTE_SUFFIXES.get(m.group(2) or "b", 1)
+
+
+class ConfEntry:
+    def __init__(self, key: str, default: Any, doc: str, *,
+                 conv: Callable[[Any], Any] | None = None,
+                 check: Callable[[Any], bool] | None = None,
+                 check_doc: str = "", internal: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conv = conv
+        self.check = check
+        self.check_doc = check_doc
+        self.internal = internal
+
+    def get(self, settings: dict) -> Any:
+        if self.key in settings:
+            v = settings[self.key]
+            if self.conv is not None:
+                v = self.conv(v)
+            if self.check is not None and not self.check(v):
+                raise ValueError(f"{self.key}={v!r}: {self.check_doc}")
+            return v
+        return self.default
+
+
+_REGISTRY: dict[str, ConfEntry] = {}
+
+
+def register(entry: ConfEntry) -> ConfEntry:
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def registered_entries() -> dict[str, ConfEntry]:
+    return dict(_REGISTRY)
+
+
+def _bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1", "yes")
+
+
+def conf(key, default, doc, **kw):
+    return register(ConfEntry(key, default, doc, **kw))
+
+
+def bool_conf(key, default, doc, **kw):
+    return register(ConfEntry(key, default, doc, conv=_bool, **kw))
+
+
+def int_conf(key, default, doc, **kw):
+    return register(ConfEntry(key, default, doc, conv=int, **kw))
+
+
+def float_conf(key, default, doc, **kw):
+    return register(ConfEntry(key, default, doc, conv=float, **kw))
+
+
+def bytes_conf(key, default, doc, **kw):
+    return register(ConfEntry(key, parse_bytes(default), doc, conv=parse_bytes, **kw))
+
+
+# ---------------------------------------------------------------------------
+# Core entries — names mirror the reference where the concept matches.
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = bool_conf(
+    "spark.rapids.sql.enabled", True,
+    "Enable or disable TPU acceleration of SQL operators entirely. "
+    "(ref RapidsConf.scala ENABLE_SQL)")
+
+EXPLAIN = conf(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, ALL, or NOT_ON_TPU. (ref RapidsConf.scala:744)",
+    check=lambda v: v in ("NONE", "ALL", "NOT_ON_TPU"),
+    check_doc="must be NONE|ALL|NOT_ON_TPU")
+
+BATCH_SIZE_BYTES = bytes_conf(
+    "spark.rapids.sql.batchSizeBytes", 1 << 30,
+    "Target byte size for coalesced TPU batches; the CoalesceGoal target. "
+    "(ref RapidsConf.scala:364)")
+
+BATCH_CAPACITY_ROWS = int_conf(
+    "spark.rapids.sql.batchRowCapacity", 1 << 20,
+    "Default logical row capacity bucket for device batches. Batches are "
+    "padded up to power-of-two capacities for static-shape XLA compilation "
+    "(TPU-specific; no reference analog — cuDF supports dynamic shapes).")
+
+CONCURRENT_TPU_TASKS = int_conf(
+    "spark.rapids.sql.concurrentTpuTasks", 1,
+    "Number of tasks that can execute concurrently on the TPU chip. "
+    "(ref RapidsConf.scala:351 CONCURRENT_GPU_TASKS)")
+
+INCOMPATIBLE_OPS = bool_conf(
+    "spark.rapids.sql.incompatibleOps.enabled", False,
+    "Enable operators flagged as not bit-for-bit compatible with the CPU "
+    "engine. (ref RapidsConf.scala INCOMPATIBLE_OPS)")
+
+HAS_NANS = bool_conf(
+    "spark.rapids.sql.hasNans", True,
+    "Assume floating point data may contain NaNs; disables some ops whose "
+    "NaN semantics differ. (ref RapidsConf.scala HAS_NANS)")
+
+ALLOW_FLOAT_AGG = bool_conf(
+    "spark.rapids.sql.variableFloatAgg.enabled", True,
+    "Allow float aggregations whose result may differ in last-bit rounding "
+    "due to reduction order. (ref RapidsConf.scala ENABLE_FLOAT_AGG)")
+
+REPLACE_SORT_MERGE_JOIN = bool_conf(
+    "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
+    "Replace sort-merge joins with hash joins on TPU. "
+    "(ref RapidsConf.scala:450)")
+
+TEST_ENABLED = bool_conf(
+    "spark.rapids.sql.test.enabled", False,
+    "Test mode: assert the whole plan runs on the TPU. "
+    "(ref RapidsConf.scala TEST_CONF)", internal=True)
+
+TEST_ALLOWED_NONTPU = conf(
+    "spark.rapids.sql.test.allowedNonTpu", "",
+    "Comma separated exec names allowed on CPU in test mode.", internal=True)
+
+MAX_READER_BATCH_SIZE_ROWS = int_conf(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per scan batch. (ref RapidsConf.scala:370)")
+
+MAX_READER_BATCH_SIZE_BYTES = bytes_conf(
+    "spark.rapids.sql.reader.batchSizeBytes", 1 << 30,
+    "Soft cap on bytes per scan batch. (ref RapidsConf.scala:378)")
+
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.sql.format.parquet.reader.type", "COALESCING",
+    "Parquet reader mode: PERFILE, COALESCING or MULTITHREADED. "
+    "(ref RapidsConf.scala:510)",
+    check=lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED"),
+    check_doc="must be PERFILE|COALESCING|MULTITHREADED")
+
+MULTITHREAD_READ_NUM_THREADS = int_conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 4,
+    "Thread pool size for the multithreaded cloud reader. "
+    "(ref RapidsConf.scala:548)")
+
+HBM_ALLOC_FRACTION = float_conf(
+    "spark.rapids.memory.tpu.allocFraction", 0.75,
+    "Fraction of device HBM the buffer store may occupy before spilling. "
+    "(ref RapidsConf.scala gpu.allocFraction, docs/configs.md:33)")
+
+HOST_SPILL_STORAGE_SIZE = bytes_conf(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Bounded host memory for spilled device buffers before disk. "
+    "(ref RapidsConf.scala:330)")
+
+PINNED_POOL_SIZE = bytes_conf(
+    "spark.rapids.memory.pinnedPool.size", 0,
+    "Size of the native pinned host staging pool (0 disables). "
+    "(ref GpuDeviceManager.scala:264-270)")
+
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "Codec for shuffle partition buffers: none or lz4. "
+    "(ref RapidsConf.scala:729)",
+    check=lambda v: v in ("none", "lz4"), check_doc="must be none|lz4")
+
+SHUFFLE_TRANSPORT_CLASS = conf(
+    "spark.rapids.shuffle.transport.class",
+    "spark_rapids_tpu.shuffle.local.LocalShuffleTransport",
+    "Fully qualified class of the shuffle transport implementation, loaded "
+    "by reflection. (ref RapidsConf.scala:652, RapidsShuffleTransport.scala:638)")
+
+SHUFFLE_MAX_METADATA_SIZE = bytes_conf(
+    "spark.rapids.shuffle.maxMetadataSize", 1 << 20,
+    "Max size for shuffle metadata messages. (ref RapidsConf.scala shuffle)")
+
+SHUFFLE_PARTITIONS = int_conf(
+    "spark.sql.shuffle.partitions", 8,
+    "Number of shuffle partitions for exchanges (Spark's own knob; honored "
+    "here for parity).")
+
+UDF_COMPILER_ENABLED = bool_conf(
+    "spark.rapids.sql.udfCompiler.enabled", False,
+    "Compile Python UDF bytecode to native expressions when possible. "
+    "(ref udf-compiler Plugin.scala:29-35)")
+
+SPILL_ENABLED = bool_conf(
+    "spark.rapids.memory.spill.enabled", True,
+    "Enable HBM->host->disk spill of catalog-registered buffers. "
+    "(ref RapidsBufferCatalog.scala:128-142)")
+
+METRICS_ENABLED = bool_conf(
+    "spark.rapids.sql.metrics.enabled", True,
+    "Collect per-operator metrics (rows/batches/time). (ref GpuExec.scala:47-55)")
+
+
+class TpuConf:
+    """An immutable snapshot of settings, queried through typed entries.
+
+    Reference: `class RapidsConf` (RapidsConf.scala:894+). Per-operator enable
+    keys look like `spark.rapids.sql.expression.Add` and are checked via
+    :meth:`is_op_enabled` (ref GpuOverrides.scala confKey :132-137).
+    """
+
+    def __init__(self, settings: dict | None = None):
+        self.settings = dict(settings or {})
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self.settings)
+
+    # convenience properties mirroring RapidsConf accessors
+    @property
+    def sql_enabled(self) -> bool: return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str: return self.get(EXPLAIN)
+
+    @property
+    def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def batch_capacity_rows(self) -> int: return self.get(BATCH_CAPACITY_ROWS)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def incompatible_ops(self) -> bool: return self.get(INCOMPATIBLE_OPS)
+
+    @property
+    def has_nans(self) -> bool: return self.get(HAS_NANS)
+
+    @property
+    def test_enabled(self) -> bool: return self.get(TEST_ENABLED)
+
+    @property
+    def shuffle_partitions(self) -> int: return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def is_udf_compiler_enabled(self) -> bool: return self.get(UDF_COMPILER_ENABLED)
+
+    def is_op_enabled(self, op_conf_key: str, default: bool = True) -> bool:
+        v = self.settings.get(op_conf_key)
+        if v is None:
+            return default
+        return _bool(v)
+
+    def set(self, key: str, value) -> "TpuConf":
+        s = dict(self.settings)
+        s[key] = value
+        return TpuConf(s)
+
+
+def help_text(include_internal: bool = False) -> str:
+    """Generate markdown docs for all registered entries.
+
+    Reference: `RapidsConf.help` generates docs/configs.md (RapidsConf.scala:785).
+    """
+    lines = ["# spark_rapids_tpu configuration", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal and not include_internal:
+            continue
+        doc = e.doc.replace("\n", " ")
+        lines.append(f"| {e.key} | {e.default} | {doc} |")
+    return "\n".join(lines) + "\n"

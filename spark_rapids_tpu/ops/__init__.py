@@ -1,0 +1,17 @@
+"""Columnar kernel layer: the TPU analog of libcudf's Table operations.
+
+The reference calls into libcudf via JNI at a well-defined seam
+(``Table.filter``, ``Table.orderBy``, ``Table.groupBy().aggregate``,
+``Table.contiguousSplit``, ``Table.concatenate`` — see SURVEY §2.9).  This
+package supplies the same seam as jit-compilable functions over
+:class:`~spark_rapids_tpu.columnar.ColumnBatch`, lowered to XLA (with Pallas
+for irregular kernels), designed around static shapes + validity masks.
+"""
+from spark_rapids_tpu.ops.kernels import compact, take, concat_batches, slice_batch
+from spark_rapids_tpu.ops.sort import sort_batch, SortOrder
+from spark_rapids_tpu.ops.segmented import sorted_group_by
+
+__all__ = [
+    "compact", "take", "concat_batches", "slice_batch",
+    "sort_batch", "SortOrder", "sorted_group_by",
+]

@@ -1,0 +1,136 @@
+"""Core batch kernels: compaction (filter), gather, concat, slice.
+
+Reference seams: ``Table.filter`` (GpuFilterExec,
+basicPhysicalOperators.scala), ``Table.concatenate`` (ConcatAndConsumeAll,
+GpuCoalesceBatches.scala:40), batch slicing (limit.scala).
+
+TPU-first: filter does NOT change the array shape.  It computes a stable
+permutation that front-packs kept rows (argsort of the drop-flag; jax sorts
+are stable) and updates the traced ``num_rows`` scalar — everything stays
+inside one compiled program, no host sync on the data-dependent row count.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+__all__ = ["compact", "take", "concat_batches", "slice_batch", "gather_columns"]
+
+
+def _gather_column(col: DeviceColumn, perm: jax.Array,
+                   out_mask: jax.Array) -> DeviceColumn:
+    """Gather rows of ``col`` by ``perm`` then canonicalize padding by
+    ``out_mask`` (bool[capacity], True = real row)."""
+    validity = col.validity[perm] & out_mask
+    if col.is_string:
+        data = jnp.where(validity[:, None], col.data[perm], 0)
+        lengths = jnp.where(validity, col.lengths[perm], 0)
+        return DeviceColumn(data, validity, col.dtype, lengths)
+    data = jnp.where(validity, col.data[perm], jnp.zeros((), col.data.dtype))
+    return DeviceColumn(data, validity, col.dtype)
+
+
+def gather_columns(cols: Sequence[DeviceColumn], perm: jax.Array,
+                   new_count: jax.Array) -> list[DeviceColumn]:
+    cap = perm.shape[0]
+    out_mask = jnp.arange(cap, dtype=jnp.int32) < new_count
+    return [_gather_column(c, perm, out_mask) for c in cols]
+
+
+def compact(batch: ColumnBatch, keep: jax.Array) -> ColumnBatch:
+    """Filter: keep rows where ``keep`` (bool[capacity]) is True.
+
+    Order-preserving via stable argsort on the drop flag.  Padding and rows
+    beyond ``num_rows`` are always dropped.
+    """
+    keep = keep & batch.row_mask()
+    perm = jnp.argsort(~keep, stable=True)
+    new_count = jnp.sum(keep, dtype=jnp.int32)
+    cols = gather_columns(batch.columns, perm, new_count)
+    return ColumnBatch(cols, new_count, batch.schema)
+
+
+def take(batch: ColumnBatch, indices: jax.Array,
+         out_count: jax.Array) -> ColumnBatch:
+    """Gather rows at ``indices`` (int32[out_capacity]); entries at position
+    >= out_count are padding."""
+    cols = gather_columns(batch.columns, indices, out_count)
+    return ColumnBatch(cols, out_count, batch.schema)
+
+
+def slice_batch(batch: ColumnBatch, limit: jax.Array) -> ColumnBatch:
+    """Keep the first ``limit`` rows (GpuLocalLimit, limit.scala)."""
+    new_count = jnp.minimum(batch.num_rows, jnp.asarray(limit, jnp.int32))
+    mask = jnp.arange(batch.capacity, dtype=jnp.int32) < new_count
+    cols = []
+    for c in batch.columns:
+        validity = c.validity & mask
+        if c.is_string:
+            cols.append(DeviceColumn(jnp.where(validity[:, None], c.data, 0),
+                                     validity, c.dtype,
+                                     jnp.where(validity, c.lengths, 0)))
+        else:
+            cols.append(DeviceColumn(
+                jnp.where(validity, c.data, jnp.zeros((), c.data.dtype)),
+                validity, c.dtype))
+    return ColumnBatch(cols, new_count, batch.schema)
+
+
+def concat_batches(batches: Sequence[ColumnBatch],
+                   out_capacity: int | None = None) -> ColumnBatch:
+    """Concatenate batches (GpuCoalesceBatches / Table.concatenate).
+
+    Shapes are static: the output capacity is the pow2 bucket of the summed
+    input capacities unless given.  Rows are front-packed via compaction of
+    the concatenated row masks.
+    """
+    assert batches, "concat of zero batches"
+    schema = batches[0].schema
+    cap = out_capacity or round_capacity(sum(b.capacity for b in batches))
+    ncols = batches[0].num_columns
+    # per-column concat with per-batch real-row masks
+    masks = jnp.concatenate([b.row_mask() for b in batches])
+    total = sum(b.capacity for b in batches)
+    pad = cap - total
+    if pad < 0:
+        raise ValueError("out_capacity smaller than concatenated capacities")
+    if pad:
+        masks = jnp.concatenate([masks, jnp.zeros(pad, jnp.bool_)])
+    perm = jnp.argsort(~masks, stable=True)
+    new_count = jnp.sum(masks, dtype=jnp.int32)
+    out_mask = jnp.arange(cap, dtype=jnp.int32) < new_count
+    cols = []
+    for ci in range(ncols):
+        parts = [b.columns[ci] for b in batches]
+        dtype = parts[0].dtype
+        if parts[0].is_string:
+            w = max(p.max_len for p in parts)
+            datas = [jnp.pad(p.data, ((0, 0), (0, w - p.max_len))) for p in parts]
+            data = jnp.concatenate(datas)
+            lengths = jnp.concatenate([p.lengths for p in parts])
+            validity = jnp.concatenate([p.validity for p in parts])
+            if pad:
+                data = jnp.concatenate([data, jnp.zeros((pad, w), jnp.uint8)])
+                lengths = jnp.concatenate([lengths, jnp.zeros(pad, jnp.int32)])
+                validity = jnp.concatenate([validity, jnp.zeros(pad, jnp.bool_)])
+            validity = validity[perm] & out_mask
+            cols.append(DeviceColumn(jnp.where(validity[:, None], data[perm], 0),
+                                     validity, dtype,
+                                     jnp.where(validity, lengths[perm], 0)))
+        else:
+            data = jnp.concatenate([p.data for p in parts])
+            validity = jnp.concatenate([p.validity for p in parts])
+            if pad:
+                data = jnp.concatenate([data, jnp.zeros(pad, data.dtype)])
+                validity = jnp.concatenate([validity, jnp.zeros(pad, jnp.bool_)])
+            validity = validity[perm] & out_mask
+            cols.append(DeviceColumn(
+                jnp.where(validity, data[perm], jnp.zeros((), data.dtype)),
+                validity, dtype))
+    return ColumnBatch(cols, new_count, schema)

@@ -1,0 +1,232 @@
+"""Sort-based group-by aggregation (cuDF groupBy().aggregate analog).
+
+Reference: GpuHashAggregateExec computes cuDF hash-group-by per batch then
+merges (aggregate.scala:348-560).  XLA has no device hash tables, so the
+TPU-idiomatic design (SURVEY §7 "hard parts") is *sort-based*: sort rows by
+the grouping keys, mark segment boundaries, and reduce with XLA segment ops
+— fully static shapes, group count as a traced scalar.
+
+Null keys form their own group (Spark semantics); key equality treats
+null == null.  Padding rows are forced into one trailing segment whose
+output slot is canonicalized away.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.sort import SortOrder, sort_batch, normalize_floats
+
+__all__ = ["AggSpec", "sorted_group_by"]
+
+# supported aggregate ops (reference AggregateFunctions.scala:531 CudfAggregate)
+_AGG_OPS = ("sum", "count", "count_star", "min", "max", "avg", "first", "last")
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    op: str          # one of _AGG_OPS
+    child_index: int  # input column (ignored for count_star)
+
+    def result_type(self, input_type: T.DataType) -> T.DataType:
+        if self.op in ("count", "count_star"):
+            return T.LongType()
+        if self.op == "sum":
+            if input_type.integral:
+                return T.LongType()
+            return T.DoubleType()
+        if self.op == "avg":
+            return T.DoubleType()
+        return input_type
+
+
+def _cols_differ(col: DeviceColumn) -> jax.Array:
+    """bool[capacity]: row i's key differs from row i-1's (null==null)."""
+    v = col.validity
+    v_prev = jnp.roll(v, 1)
+    if col.is_string:
+        d_prev = jnp.roll(col.data, 1, axis=0)
+        data_diff = jnp.any(col.data != d_prev, axis=1) | \
+            (col.lengths != jnp.roll(col.lengths, 1))
+    elif col.dtype.fractional:
+        # group keys: NaN == NaN, -0.0 == 0.0 (Spark normalized semantics)
+        d = normalize_floats(col.data)
+        d_prev = jnp.roll(d, 1)
+        data_diff = (d != d_prev) & ~(jnp.isnan(d) & jnp.isnan(d_prev))
+    else:
+        data_diff = col.data != jnp.roll(col.data, 1)
+    return (v != v_prev) | (v & v_prev & data_diff)
+
+
+def sorted_group_by(batch: ColumnBatch, key_indices: list[int],
+                    aggs: list[AggSpec]) -> ColumnBatch:
+    """Group ``batch`` by key columns, computing ``aggs``.
+
+    Output schema: key columns (original names/types) then one column per
+    agg. Output capacity == input capacity; num_rows == number of groups.
+    Grand aggregates (no keys) produce exactly one row, even on empty input
+    (reference "reduction default-values path", aggregate.scala:514+).
+    """
+    cap = batch.capacity
+    if key_indices:
+        orders = [SortOrder(i, True, True) for i in key_indices]
+        sb = sort_batch(batch, orders)
+        real = sb.row_mask()
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        differ = jnp.zeros(cap, jnp.bool_)
+        for ki in key_indices:
+            differ = differ | _cols_differ(sb.columns[ki])
+        flag = (idx == 0) | (differ & real) | (idx == sb.num_rows)
+        # rows past the first padding row never set a new flag
+        flag = flag & (idx <= sb.num_rows)
+        seg_id = jnp.cumsum(flag.astype(jnp.int32)) - 1
+        num_groups = jnp.where(sb.num_rows > 0,
+                               seg_id[jnp.maximum(sb.num_rows - 1, 0)] + 1, 0)
+    else:
+        sb = batch
+        real = sb.row_mask()
+        seg_id = jnp.zeros(cap, jnp.int32)
+        num_groups = jnp.asarray(1, jnp.int32)  # grand aggregate: one row
+        flag = jnp.arange(cap, dtype=jnp.int32) == 0
+
+    out_mask = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    out_cols: list[DeviceColumn] = []
+    out_fields: list[T.StructField] = []
+
+    # --- key columns: value at each segment start -------------------------
+    for ki in key_indices:
+        col = sb.columns[ki]
+        pos = jnp.where(flag & real, seg_id, cap)  # scatter target (drop pad)
+        validity = jnp.zeros(cap, jnp.bool_).at[pos].set(col.validity, mode="drop")
+        validity = validity & out_mask
+        if col.is_string:
+            data = jnp.zeros((cap, col.max_len), jnp.uint8).at[pos].set(col.data, mode="drop")
+            lengths = jnp.zeros(cap, jnp.int32).at[pos].set(col.lengths, mode="drop")
+            out_cols.append(DeviceColumn(jnp.where(validity[:, None], data, 0),
+                                         validity, col.dtype,
+                                         jnp.where(validity, lengths, 0)))
+        else:
+            data = jnp.zeros(cap, col.data.dtype).at[pos].set(col.data, mode="drop")
+            out_cols.append(DeviceColumn(
+                jnp.where(validity, data, jnp.zeros((), data.dtype)),
+                validity, col.dtype))
+        out_fields.append(batch.schema.fields[ki])
+
+    # --- aggregates -------------------------------------------------------
+    seg_real_cnt = _seg_sum(real.astype(jnp.int64), seg_id, cap)
+    for spec in aggs:
+        col = sb.columns[spec.child_index] if spec.op != "count_star" else None
+        res_col, res_type = _compute_agg(spec, col, seg_id, real, cap,
+                                         out_mask, seg_real_cnt)
+        out_cols.append(res_col)
+        in_t = col.dtype if col is not None else T.LongType()
+        arg = "1" if spec.op == "count_star" else batch.schema.names[spec.child_index]
+        name = f"count({arg})" if spec.op == "count_star" else f"{spec.op}({arg})"
+        out_fields.append(T.StructField(name, spec.result_type(in_t)))
+
+    return ColumnBatch(out_cols, num_groups, T.Schema(out_fields))
+
+
+def _seg_sum(x, seg_id, cap):
+    return jax.ops.segment_sum(x, seg_id, num_segments=cap)
+
+
+def _compute_agg(spec: AggSpec, col: DeviceColumn | None, seg_id, real, cap,
+                 out_mask, seg_real_cnt):
+    op = spec.op
+    if op == "count_star":
+        validity = out_mask
+        return DeviceColumn(jnp.where(validity, seg_real_cnt, 0), validity,
+                            T.LongType()), T.LongType()
+
+    contributes = col.validity & real
+    cnt_valid = _seg_sum(contributes.astype(jnp.int64), seg_id, cap)
+
+    if op == "count":
+        validity = out_mask
+        return DeviceColumn(jnp.where(validity, cnt_valid, 0), validity,
+                            T.LongType()), T.LongType()
+
+    if op in ("sum", "avg"):
+        acc_dt = jnp.int64 if (col.dtype.integral and op == "sum") else jnp.float64
+        contrib = jnp.where(contributes, col.data.astype(acc_dt),
+                            jnp.zeros((), acc_dt))
+        s = _seg_sum(contrib, seg_id, cap)
+        if op == "avg":
+            data = s.astype(jnp.float64) / jnp.maximum(cnt_valid, 1).astype(jnp.float64)
+            rtype = T.DoubleType()
+        elif col.dtype.integral:
+            data, rtype = s, T.LongType()
+        else:
+            data, rtype = s.astype(jnp.float64), T.DoubleType()
+        validity = (cnt_valid > 0) & out_mask
+        return DeviceColumn(jnp.where(validity, data, jnp.zeros((), data.dtype)),
+                            validity, rtype), rtype
+
+    if op in ("min", "max"):
+        if col.dtype.fractional:
+            # Spark: NaN is the largest value; no 64-bit bitcasts on TPU, so
+            # mask NaNs to +/-inf identities and patch the all/any-NaN cases.
+            x = normalize_floats(col.data)
+            isnan = jnp.isnan(x)
+            nan_cnt = _seg_sum((contributes & isnan).astype(jnp.int32), seg_id, cap)
+            nonnan_cnt = _seg_sum((contributes & ~isnan).astype(jnp.int32), seg_id, cap)
+            if op == "min":
+                masked = jnp.where(contributes & ~isnan, x,
+                                   jnp.full((), jnp.inf, x.dtype))
+                r = jax.ops.segment_min(masked, seg_id, num_segments=cap)
+                # min is NaN only when every contributing value is NaN
+                data = jnp.where((nonnan_cnt == 0) & (nan_cnt > 0),
+                                 jnp.full((), jnp.nan, x.dtype), r)
+            else:
+                masked = jnp.where(contributes & ~isnan, x,
+                                   jnp.full((), -jnp.inf, x.dtype))
+                r = jax.ops.segment_max(masked, seg_id, num_segments=cap)
+                # max is NaN when any contributing value is NaN
+                data = jnp.where(nan_cnt > 0, jnp.full((), jnp.nan, x.dtype), r)
+        elif isinstance(col.dtype, T.StringType):
+            raise NotImplementedError("min/max over strings")
+        else:
+            info = jnp.iinfo(col.data.dtype) if col.data.dtype != jnp.bool_ else None
+            if col.data.dtype == jnp.bool_:
+                d = col.data.astype(jnp.int32)
+                ident = 1 if op == "min" else 0
+                masked = jnp.where(contributes, d, ident)
+                r = (jax.ops.segment_min if op == "min" else jax.ops.segment_max)(
+                    masked, seg_id, num_segments=cap)
+                data = r.astype(jnp.bool_)
+            else:
+                ident = info.max if op == "min" else info.min
+                masked = jnp.where(contributes, col.data, ident)
+                data = (jax.ops.segment_min if op == "min" else jax.ops.segment_max)(
+                    masked, seg_id, num_segments=cap)
+        validity = (cnt_valid > 0) & out_mask
+        zero = jnp.zeros((), data.dtype)
+        return DeviceColumn(jnp.where(validity, data, zero), validity,
+                            col.dtype), col.dtype
+
+    if op in ("first", "last"):
+        # index of first/last row (any validity) per segment — Spark default
+        # first/last have ignoreNulls=false
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        if op == "first":
+            masked_idx = jnp.where(real, idx, cap)
+            pick = jax.ops.segment_min(masked_idx, seg_id, num_segments=cap)
+        else:
+            masked_idx = jnp.where(real, idx, -1)
+            pick = jax.ops.segment_max(masked_idx, seg_id, num_segments=cap)
+        pick = jnp.clip(pick, 0, cap - 1)
+        validity = col.validity[pick] & out_mask & (seg_real_cnt > 0)
+        if col.is_string:
+            data = jnp.where(validity[:, None], col.data[pick], 0)
+            return DeviceColumn(data, validity, col.dtype,
+                                jnp.where(validity, col.lengths[pick], 0)), col.dtype
+        data = jnp.where(validity, col.data[pick], jnp.zeros((), col.data.dtype))
+        return DeviceColumn(data, validity, col.dtype), col.dtype
+
+    raise NotImplementedError(f"aggregate op {op}")

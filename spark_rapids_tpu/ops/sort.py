@@ -1,0 +1,116 @@
+"""Lexicographic sort with Spark null ordering (Table.orderBy analog).
+
+Reference: GpuSortExec.scala:51 / SortUtils.scala build cuDF orderBy args
+(ascending/descending, null ordering).  TPU-first design: one stable
+multi-operand ``lax.sort`` handles any mix of key types, directions and null
+orders.  Per key column the operands are:
+
+* a leading null-indicator byte (0/1 by nulls-first/last),
+* for floats: a NaN-indicator byte (Spark: NaN is the largest value; for
+  descending keys NaN must come first) followed by the value itself with
+  -0.0 normalized to +0.0 and NaN zeroed (ref NormalizeFloatingNumbers);
+  descending negates the value,
+* for integers/date/timestamp/bool: the value; descending uses bitwise NOT
+  (monotonic inversion with no overflow),
+* for strings: the padded byte matrix chunked into big-endian uint32 words
+  (zero padding makes prefixes sort first); descending inverts each word.
+
+A most-significant pad flag forces batch padding rows to sort last.
+
+Note: no 64-bit bitcasts anywhere — TPU v5e XLA does not implement
+bitcast-convert on 64-bit element types (verified empirically); s64/f64
+arithmetic and comparisons are supported (emulated).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.kernels import gather_columns
+
+__all__ = ["SortOrder", "sort_batch", "sort_permutation", "encode_key_operands",
+           "normalize_floats"]
+
+
+@dataclass(frozen=True)
+class SortOrder:
+    """One sort key: column index + direction + null ordering."""
+    child_index: int
+    ascending: bool = True
+    nulls_first: bool | None = None  # None = Spark default (first iff asc)
+
+    @property
+    def resolved_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending  # Spark: asc->nulls first, desc->nulls last
+        return self.nulls_first
+
+
+def normalize_floats(x: jax.Array) -> jax.Array:
+    """-0.0 -> +0.0 and NaN -> canonical NaN (ref NormalizeFloatingNumbers)."""
+    zero = jnp.zeros((), x.dtype)
+    x = jnp.where(x == zero, zero, x)
+    return jnp.where(jnp.isnan(x), jnp.full((), jnp.nan, x.dtype), x)
+
+
+def string_key_words(col: DeviceColumn) -> list[jax.Array]:
+    """Padded byte matrix -> list of big-endian uint32 word operands."""
+    w = col.max_len
+    nwords = (w + 3) // 4
+    padded = col.data if w % 4 == 0 else \
+        jnp.pad(col.data, ((0, 0), (0, 4 * nwords - w)))
+    b = padded.reshape(col.capacity, nwords, 4).astype(jnp.uint32)
+    words = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    return [words[:, i] for i in range(nwords)]
+
+
+def encode_key_operands(col: DeviceColumn, ascending: bool = True) -> list[jax.Array]:
+    """Encode a column's values into sort operands (see module docstring)."""
+    dt = col.dtype
+    if isinstance(dt, T.StringType):
+        # lengths break ties between strings differing only by trailing NULs
+        words = string_key_words(col) + [col.lengths]
+        return words if ascending else [~wd for wd in words]
+    if isinstance(dt, T.BooleanType):
+        v = col.data.astype(jnp.int32)
+        return [v] if ascending else [~v]
+    if dt.fractional:
+        x = normalize_floats(col.data)
+        isnan = jnp.isnan(x)
+        # NaN largest: asc -> NaN flag sorts last; desc -> first
+        nan_key = jnp.where(isnan, jnp.uint8(1 if ascending else 0),
+                            jnp.uint8(0 if ascending else 1))
+        v = jnp.where(isnan, jnp.zeros((), x.dtype), x)
+        return [nan_key, v if ascending else -v]
+    # integral / date / timestamp
+    return [col.data] if ascending else [~col.data]
+
+
+def sort_permutation(batch: ColumnBatch, orders: list[SortOrder]) -> jax.Array:
+    """Return the permutation (int32[capacity]) that sorts the batch."""
+    cap = batch.capacity
+    real = batch.row_mask()
+    operands: list[jax.Array] = [(~real).astype(jnp.uint8)]  # padding last
+    for o in orders:
+        col = batch.columns[o.child_index]
+        null_ind = jnp.where(col.validity,
+                             jnp.uint8(1 if o.resolved_nulls_first else 0),
+                             jnp.uint8(0 if o.resolved_nulls_first else 1))
+        operands.append(null_ind)
+        operands.extend(encode_key_operands(col, o.ascending))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    nk = len(operands)
+    sorted_ops = lax.sort(operands + [iota], num_keys=nk, is_stable=True)
+    return sorted_ops[-1]
+
+
+def sort_batch(batch: ColumnBatch, orders: list[SortOrder]) -> ColumnBatch:
+    perm = sort_permutation(batch, orders)
+    cols = gather_columns(batch.columns, perm, batch.num_rows)
+    return ColumnBatch(cols, batch.num_rows, batch.schema)

@@ -1,0 +1,232 @@
+"""SQL data types and the Spark<->Arrow<->jax dtype mapping.
+
+Mirrors the type surface the reference supports on GPU (reference
+sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:172-187:
+bool/byte/short/int/long/float/double/date/timestamp/string).  Decimal and
+nested types are not supported by the reference v0.3 plugin and are likewise
+unsupported here (they tag as will-not-work and fall back to CPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "DataType", "BooleanType", "ByteType", "ShortType", "IntegerType",
+    "LongType", "FloatType", "DoubleType", "StringType", "DateType",
+    "TimestampType", "NullType", "all_types", "from_arrow", "to_arrow",
+]
+
+
+class DataType:
+    """Base class for SQL data types. Instances are singletons."""
+
+    #: numpy dtype of the physical device representation (None for STRING).
+    np_dtype: np.dtype | None = None
+    #: short name used in schemas / explain output
+    name: str = "datatype"
+    #: True for int8/16/32/64
+    integral: bool = False
+    #: True for float32/64
+    fractional: bool = False
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @property
+    def numeric(self) -> bool:
+        return self.integral or self.fractional
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+    name = "boolean"
+
+
+class ByteType(DataType):
+    np_dtype = np.dtype(np.int8)
+    name = "byte"
+    integral = True
+
+
+class ShortType(DataType):
+    np_dtype = np.dtype(np.int16)
+    name = "short"
+    integral = True
+
+
+class IntegerType(DataType):
+    np_dtype = np.dtype(np.int32)
+    name = "int"
+    integral = True
+
+
+class LongType(DataType):
+    np_dtype = np.dtype(np.int64)
+    name = "long"
+    integral = True
+
+
+class FloatType(DataType):
+    np_dtype = np.dtype(np.float32)
+    name = "float"
+    fractional = True
+
+
+class DoubleType(DataType):
+    np_dtype = np.dtype(np.float64)
+    name = "double"
+    fractional = True
+
+
+class StringType(DataType):
+    # device repr: padded uint8 byte matrix + int32 lengths (see columnar/column.py)
+    np_dtype = None
+    name = "string"
+
+
+class DateType(DataType):
+    """Days since unix epoch, int32 (Arrow date32)."""
+    np_dtype = np.dtype(np.int32)
+    name = "date"
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch, int64 (Arrow timestamp[us], like Spark)."""
+    np_dtype = np.dtype(np.int64)
+    name = "timestamp"
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.bool_)
+    name = "null"
+
+
+def all_types() -> list[DataType]:
+    return [BooleanType(), ByteType(), ShortType(), IntegerType(), LongType(),
+            FloatType(), DoubleType(), StringType(), DateType(), TimestampType()]
+
+
+_INTEGRAL_RANK = {ByteType(): 0, ShortType(): 1, IntegerType(): 2, LongType(): 3}
+_FRACTIONAL_RANK = {FloatType(): 4, DoubleType(): 5}
+
+
+def numeric_promote(a: DataType, b: DataType) -> DataType:
+    """Spark-style numeric type promotion for binary arithmetic."""
+    order = {**_INTEGRAL_RANK, **_FRACTIONAL_RANK}
+    if a not in order or b not in order:
+        raise TypeError(f"cannot promote {a} and {b}")
+    return a if order[a] >= order[b] else b
+
+
+# ---------------------------------------------------------------------------
+# Arrow interop
+# ---------------------------------------------------------------------------
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+    m = {
+        BooleanType(): pa.bool_(), ByteType(): pa.int8(), ShortType(): pa.int16(),
+        IntegerType(): pa.int32(), LongType(): pa.int64(), FloatType(): pa.float32(),
+        DoubleType(): pa.float64(), StringType(): pa.string(),
+        DateType(): pa.date32(), TimestampType(): pa.timestamp("us"),
+    }
+    return m[dt]
+
+
+def from_arrow(at) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BooleanType()
+    if pa.types.is_int8(at):
+        return ByteType()
+    if pa.types.is_int16(at):
+        return ShortType()
+    if pa.types.is_int32(at):
+        return IntegerType()
+    if pa.types.is_int64(at):
+        return LongType()
+    if pa.types.is_float32(at):
+        return FloatType()
+    if pa.types.is_float64(at):
+        return DoubleType()
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return StringType()
+    if pa.types.is_date32(at):
+        return DateType()
+    if pa.types.is_timestamp(at):
+        return TimestampType()
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+class StructField:
+    __slots__ = ("name", "data_type", "nullable")
+
+    def __init__(self, name: str, data_type: DataType, nullable: bool = True):
+        self.name = name
+        self.data_type = data_type
+        self.nullable = nullable
+
+    def __repr__(self):
+        return f"{self.name}:{self.data_type.name}{'?' if self.nullable else ''}"
+
+    def __eq__(self, other):
+        return (isinstance(other, StructField) and self.name == other.name
+                and self.data_type == other.data_type and self.nullable == other.nullable)
+
+
+class Schema:
+    """An ordered list of named, typed, nullable fields."""
+
+    def __init__(self, fields: list[StructField]):
+        self.fields = list(fields)
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.schema([pa.field(f.name, to_arrow(f.data_type), f.nullable)
+                          for f in self.fields])
+
+    @staticmethod
+    def from_arrow(aschema) -> "Schema":
+        return Schema([StructField(f.name, from_arrow(f.type), f.nullable)
+                       for f in aschema])

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's local-mode test strategy (docs/testing.md:42-66):
+no cluster needed; multi-device behavior is tested on virtual devices.
+"""
+import os
+
+# must be set before jax import; force CPU (the shell may point JAX at a
+# real TPU via JAX_PLATFORMS=axon — tests always run on the virtual mesh)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
