@@ -105,19 +105,8 @@ class ColumnBatch:
                 w = (string_widths or {}).get(field.name)
                 bm, lens = _strings_to_matrix(arr, w)
                 cols.append(DeviceColumn.strings_from_numpy(bm, lens, validity, cap))
-            elif isinstance(field.data_type, T.BooleanType):
-                data = np.asarray(arr.fill_null(False), dtype=np.bool_)
-                cols.append(DeviceColumn.from_numpy(data, validity, field.data_type, cap))
             else:
-                npdt = field.data_type.np_dtype
-                if isinstance(field.data_type, T.TimestampType):
-                    # normalize to microseconds before extracting raw ticks
-                    data = arr.cast(pa.timestamp("us")).cast(pa.int64()) \
-                        .fill_null(0).to_numpy(zero_copy_only=False).astype(np.int64)
-                elif isinstance(field.data_type, T.DateType):
-                    data = arr.cast(pa.int32()).fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
-                else:
-                    data = arr.fill_null(0).to_numpy(zero_copy_only=False).astype(npdt)
+                data = T.arrow_fixed_to_numpy(arr, field.data_type)
                 cols.append(DeviceColumn.from_numpy(data, validity, field.data_type, cap))
         return ColumnBatch(cols, jnp.asarray(n, dtype=jnp.int32), schema)
 

@@ -1,0 +1,22 @@
+"""Expression IR with dual evaluation: CPU oracle (numpy) and TPU (jax).
+
+The reference implements ~150 GPU expressions as ``GpuExpression.columnarEval``
+over cuDF columns (reference GpuExpressions.scala:380 and the registry
+GpuOverrides.scala:537-1660).  Here each expression node carries ONE kernel
+written against a backend-neutral array namespace (numpy | jax.numpy), so the
+CPU oracle and the TPU path share semantics by construction; only
+string/variable-width ops branch per backend (object arrays on host, padded
+byte matrices on device).
+"""
+from spark_rapids_tpu.expr.core import (
+    Expression, Literal, BoundReference, UnresolvedAttribute, Alias,
+    col, lit, bind, eval_host, eval_device, EvalCtx, Val,
+)
+from spark_rapids_tpu.expr import arithmetic, predicates, conditional, cast  # noqa: F401
+from spark_rapids_tpu.expr import strings, datetime_ops, math_ops, hashing  # noqa: F401
+from spark_rapids_tpu.expr import aggregates  # noqa: F401
+
+__all__ = [
+    "Expression", "Literal", "BoundReference", "UnresolvedAttribute", "Alias",
+    "col", "lit", "bind", "eval_host", "eval_device", "EvalCtx", "Val",
+]

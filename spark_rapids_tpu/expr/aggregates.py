@@ -1,0 +1,246 @@
+"""Aggregate function expressions (reference AggregateFunctions.scala:531).
+
+Declarative nodes: they do not evaluate elementwise.  The aggregate execs
+(CPU oracle and TPU) lower each into the reference's three-phase shape
+(aggregate.scala update/merge/final aggregates):
+
+* ``update_ops``  — per-batch segmented ops over the input column(s);
+* ``merge_ops``   — ops combining partial results across batches/partitions;
+* ``final_expr``  — expression over the intermediate columns producing the
+  result (e.g. Average = sum / count with double division, null on 0 count).
+
+The intermediate layout is one column per update op.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, BoundReference, Literal
+
+__all__ = ["AggregateFunction", "Sum", "Count", "CountStar", "Min", "Max",
+           "Average", "First", "Last", "is_aggregate", "has_aggregate"]
+
+
+class AggregateFunction(Expression):
+    """Base for aggregate functions. ``children[0]`` is the input (absent
+    for COUNT(*))."""
+
+    #: segmented op names for the update phase, one intermediate column each
+    update_ops: tuple[str, ...] = ()
+    #: op names merging intermediates (same arity as update_ops)
+    merge_ops: tuple[str, ...] = ()
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def input(self) -> Expression:
+        return self.children[0]
+
+    def intermediate_types(self) -> list[T.DataType]:
+        raise NotImplementedError
+
+    def final_expr(self, offsets: list[int]) -> Expression:
+        """Expression over intermediate columns bound at ``offsets``."""
+        raise NotImplementedError
+
+    def _eval(self, vals, ctx):
+        raise TypeError(f"{self.sql_name} must be planned by an aggregate "
+                        "exec, not evaluated elementwise")
+
+
+def is_aggregate(e: Expression) -> bool:
+    return isinstance(e, AggregateFunction)
+
+
+def has_aggregate(e: Expression) -> bool:
+    if is_aggregate(e):
+        return True
+    return any(has_aggregate(c) for c in e.children)
+
+
+class Sum(AggregateFunction):
+    """Spark Sum: long for integral input, double for fractional; null on
+    empty/all-null input; integral overflow wraps (non-ANSI)."""
+    sql_name = "Sum"
+    update_ops = ("sum",)
+    merge_ops = ("sum",)
+
+    @property
+    def dtype(self):
+        return T.LongType() if self.input.dtype.integral else T.DoubleType()
+
+    @property
+    def nullable(self):
+        return True
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        t = self.input.dtype
+        if t.integral and not isinstance(t, T.LongType):
+            return Sum(Cast(self.input, T.LongType()))
+        if isinstance(t, T.FloatType):
+            return Sum(Cast(self.input, T.DoubleType()))
+        if not t.numeric:
+            raise TypeError(f"sum over {t}")
+        return self
+
+    def intermediate_types(self):
+        return [self.dtype]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], self.dtype, True)
+
+
+class Count(AggregateFunction):
+    sql_name = "Count"
+    update_ops = ("count",)
+    merge_ops = ("sum",)
+
+    @property
+    def dtype(self):
+        return T.LongType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def intermediate_types(self):
+        return [T.LongType()]
+
+    def final_expr(self, offsets):
+        from spark_rapids_tpu.expr.conditional import Coalesce
+        return Coalesce(BoundReference(offsets[0], T.LongType(), True),
+                        Literal(0, T.LongType()))
+
+
+class CountStar(Count):
+    sql_name = "CountStar"
+    update_ops = ("count_star",)
+
+    def __init__(self):
+        self.children = ()
+
+    @property
+    def input(self):
+        return None
+
+    def with_new_children(self, children):
+        return self
+
+    def __repr__(self):
+        return "count(*)"
+
+
+class Min(AggregateFunction):
+    sql_name = "Min"
+    update_ops = ("min",)
+    merge_ops = ("min",)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    def intermediate_types(self):
+        return [self.dtype]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], self.dtype, True)
+
+
+class Max(AggregateFunction):
+    sql_name = "Max"
+    update_ops = ("max",)
+    merge_ops = ("max",)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    def intermediate_types(self):
+        return [self.dtype]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], self.dtype, True)
+
+
+class Average(AggregateFunction):
+    """Spark Average: double result = sum/count, null when count == 0."""
+    sql_name = "Average"
+    update_ops = ("sum", "count")
+    merge_ops = ("sum", "sum")
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        t = self.input.dtype
+        if not t.numeric:
+            raise TypeError(f"avg over {t}")
+        if not isinstance(t, T.DoubleType):
+            return Average(Cast(self.input, T.DoubleType()))
+        return self
+
+    def intermediate_types(self):
+        return [T.DoubleType(), T.LongType()]
+
+    def final_expr(self, offsets):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        from spark_rapids_tpu.expr.cast import Cast
+        s = BoundReference(offsets[0], T.DoubleType(), True)
+        c = BoundReference(offsets[1], T.LongType(), True)
+        # Divide yields null when count == 0 (DivModLike) — exactly Spark avg
+        return Divide(s, Cast(c, T.DoubleType()))
+
+
+class First(AggregateFunction):
+    sql_name = "First"
+    update_ops = ("first",)
+    merge_ops = ("first",)
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.ignore_nulls = ignore_nulls
+        if ignore_nulls:
+            self.update_ops = ("first_non_null",)
+            self.merge_ops = ("first_non_null",)
+
+    def with_new_children(self, children):
+        return First(children[0], self.ignore_nulls)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    def intermediate_types(self):
+        return [self.dtype]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], self.dtype, True)
+
+
+class Last(AggregateFunction):
+    sql_name = "Last"
+    update_ops = ("last",)
+    merge_ops = ("last",)
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.ignore_nulls = ignore_nulls
+        if ignore_nulls:
+            self.update_ops = ("last_non_null",)
+            self.merge_ops = ("last_non_null",)
+
+    def with_new_children(self, children):
+        return Last(children[0], self.ignore_nulls)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    def intermediate_types(self):
+        return [self.dtype]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], self.dtype, True)

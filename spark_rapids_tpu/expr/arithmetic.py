@@ -1,0 +1,244 @@
+"""Arithmetic expressions (reference arithmetic.scala, 417 LoC).
+
+Spark semantics implemented exactly (non-ANSI mode, like the reference's
+default):
+
+* integer add/sub/mul/neg/abs wrap (Java two's-complement; numpy and XLA
+  both wrap, so the shared kernel is just the operator);
+* ``/`` (Divide) coerces both sides to double and yields NULL when the
+  divisor is zero (Spark DivModLike);
+* ``%`` (Remainder) follows the dividend's sign (Java ``%``): ``fmod``;
+* ``div`` (IntegralDivide) truncates toward zero and yields long.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, Val, EvalCtx, Literal
+
+__all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
+           "Remainder", "UnaryMinus", "Abs", "Least", "Greatest",
+           "coerce_pair"]
+
+
+def coerce_pair(left: Expression, right: Expression,
+                target: T.DataType | None = None):
+    """Insert casts so both sides share a numeric type (Spark promotion)."""
+    from spark_rapids_tpu.expr.cast import Cast
+    lt, rt = left.dtype, right.dtype
+    if target is None:
+        if isinstance(lt, T.NullType):
+            target = rt
+        elif isinstance(rt, T.NullType):
+            target = lt
+        elif lt == rt:
+            target = lt
+        elif lt.numeric and rt.numeric:
+            target = T.numeric_promote(lt, rt)
+        else:
+            raise TypeError(f"cannot coerce {lt} with {rt}")
+    if lt != target:
+        left = Cast(left, target)
+    if rt != target:
+        right = Cast(right, target)
+    return left, right
+
+
+class BinaryArithmetic(Expression):
+    """Binary numeric op: validity = AND of child validities."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def coerced(self):
+        l, r = coerce_pair(*self.children)
+        if not l.dtype.numeric:
+            raise TypeError(f"{self.sql_name} requires numeric, got {l.dtype}")
+        return type(self)(l, r)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a, b = vals
+        validity = a.validity & b.validity
+        data = self._op(a.data, b.data, ctx.xp)
+        return ctx.canonical(data, validity, self.dtype)
+
+
+class Add(BinaryArithmetic):
+    sql_name = "Add"
+
+    def _op(self, a, b, xp):
+        return a + b
+
+
+class Subtract(BinaryArithmetic):
+    sql_name = "Subtract"
+
+    def _op(self, a, b, xp):
+        return a - b
+
+
+class Multiply(BinaryArithmetic):
+    sql_name = "Multiply"
+
+    def _op(self, a, b, xp):
+        return a * b
+
+
+class _DivModLike(BinaryArithmetic):
+    """Spark DivModLike: NULL when divisor is zero."""
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a, b = vals
+        xp = ctx.xp
+        zero = xp.zeros((), b.data.dtype)
+        nonzero = b.data != zero
+        validity = a.validity & b.validity & nonzero
+        one = xp.ones((), b.data.dtype)
+        safe_b = xp.where(nonzero, b.data, one)
+        data = self._op(a.data, safe_b, xp)
+        return ctx.canonical(data, validity, self.dtype)
+
+
+class Divide(_DivModLike):
+    sql_name = "Divide"
+
+    def coerced(self):
+        l, r = coerce_pair(*self.children, target=T.DoubleType())
+        return Divide(l, r)
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def _op(self, a, b, xp):
+        return a / b
+
+
+class IntegralDivide(_DivModLike):
+    sql_name = "IntegralDivide"
+
+    def coerced(self):
+        l, r = coerce_pair(*self.children, target=T.LongType())
+        return IntegralDivide(l, r)
+
+    @property
+    def dtype(self):
+        return T.LongType()
+
+    def _op(self, a, b, xp):
+        # truncate toward zero (Java integer division); xp floor-divides,
+        # so bump the quotient by one when signs differ and there is a
+        # nonzero remainder
+        q = a // b
+        r = a - q * b
+        adjust = (r != 0) & ((a < 0) != (b < 0))
+        return q + adjust.astype(q.dtype)
+
+
+class Remainder(_DivModLike):
+    sql_name = "Remainder"
+
+    def _op(self, a, b, xp):
+        if self.dtype.fractional:
+            return xp.fmod(a, b)
+        # Java %: sign of dividend. xp.mod follows divisor; fix up.
+        m = a % b
+        wrong = (m != 0) & ((m < 0) != (a < 0))
+        return m - xp.where(wrong, b, b - b)
+
+
+class UnaryMinus(Expression):
+    sql_name = "UnaryMinus"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def coerced(self):
+        if not self.children[0].dtype.numeric:
+            raise TypeError("UnaryMinus requires numeric")
+        return self
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if a.data.dtype.kind == "u":
+            data = -a.data
+        else:
+            data = ctx.xp.negative(a.data)
+        return ctx.canonical(data, a.validity, self.dtype)
+
+
+class Abs(Expression):
+    sql_name = "Abs"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        return ctx.canonical(ctx.xp.abs(a.data), a.validity, self.dtype)
+
+
+class _LeastGreatest(Expression):
+    """Spark Least/Greatest: skip nulls; NaN is greatest; null only if all
+    inputs null."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_new_children(self, children):
+        return type(self)(*children)
+
+    def coerced(self):
+        target = self.children[0].dtype
+        for c in self.children[1:]:
+            if c.dtype != target and c.dtype.numeric and target.numeric:
+                target = T.numeric_promote(target, c.dtype)
+        from spark_rapids_tpu.expr.cast import Cast
+        kids = [c if c.dtype == target else Cast(c, target)
+                for c in self.children]
+        return type(self)(*kids)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _eval(self, vals, ctx):
+        xp = ctx.xp
+        acc = vals[0]
+        data, validity = acc.data, acc.validity
+        for v in vals[1:]:
+            both = validity & v.validity
+            pick_new = xp.where(both, self._better(v.data, data, xp),
+                                v.validity & ~validity)
+            data = xp.where(pick_new, v.data, data)
+            validity = validity | v.validity
+        return ctx.canonical(data, validity, self.dtype)
+
+
+class Least(_LeastGreatest):
+    sql_name = "Least"
+
+    def _better(self, new, cur, xp):
+        if self.dtype.fractional:
+            return (new < cur) | (xp.isnan(cur) & ~xp.isnan(new))
+        return new < cur
+
+
+class Greatest(_LeastGreatest):
+    sql_name = "Greatest"
+
+    def _better(self, new, cur, xp):
+        if self.dtype.fractional:
+            return (new > cur) | (xp.isnan(new) & ~xp.isnan(cur))
+        return new > cur

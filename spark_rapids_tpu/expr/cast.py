@@ -1,0 +1,225 @@
+"""Cast (reference GpuCast.scala, 861 LoC — ansi off, default mode).
+
+Java/Spark non-ANSI conversion semantics:
+
+* int -> narrower int: two's-complement bit truncation;
+* float/double -> integral: truncate toward zero, NaN -> 0, saturate to the
+  target's 64-bit range first then bit-narrow (JLS 5.1.3);
+* numeric <-> boolean: ``x != 0`` / ``1|0``;
+* date <-> timestamp: days*86_400e6 micros (UTC session timezone — the
+  reference flags timezone-sensitive casts the same way,
+  GpuOverrides tagging for timeZoneId);
+* string conversions run on host only (the planner keeps Cast-to/from-string
+  off-device for now, like the reference gates string casts behind
+  spark.rapids.sql.castStringToFloat.enabled etc., RapidsConf.scala:461-492).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
+
+__all__ = ["Cast", "java_double_str"]
+
+_MICROS_PER_DAY = 86_400_000_000
+
+
+def java_double_str(x: float, float32: bool = False) -> str:
+    """Format like Java Double.toString (decimal in [1e-3, 1e7), else
+    scientific with 'E')."""
+    if np.isnan(x):
+        return "NaN"
+    if np.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0:
+        return "-0.0" if np.signbit(x) else "0.0"
+    ax = abs(x)
+    if 1e-3 <= ax < 1e7:
+        s = repr(float(np.float32(x))) if float32 else repr(float(x))
+        if "e" in s or "E" in s:
+            # python switched to sci inside java's decimal window; expand
+            s = f"{float(x):f}".rstrip("0")
+            if s.endswith("."):
+                s += "0"
+        elif "." not in s:
+            s += ".0"
+        return s
+    m, e = f"{ax:E}".split("E")
+    m = m.rstrip("0").rstrip(".")
+    if "." not in m:
+        m += ".0"
+    exp = int(e)
+    return ("-" if x < 0 else "") + f"{m}E{exp}"
+
+
+class Cast(Expression):
+    sql_name = "Cast"
+
+    def __init__(self, child: Expression, to: T.DataType):
+        self.children = (child,)
+        self.to = to
+
+    def with_new_children(self, children):
+        return Cast(children[0], self.to)
+
+    @property
+    def dtype(self):
+        return self.to
+
+    @property
+    def child_type(self) -> T.DataType:
+        return self.children[0].dtype
+
+    @property
+    def device_supported(self) -> bool:
+        return not (isinstance(self.to, T.StringType)
+                    ^ isinstance(self.child_type, T.StringType)) \
+            or isinstance(self.child_type, T.NullType)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} as {self.to.name})"
+
+    # ------------------------------------------------------------------
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        src, dst = a.dtype, self.to
+        xp = ctx.xp
+        if isinstance(src, T.NullType):
+            return ctx.const(None, dst)
+        if src == dst:
+            return a
+        if isinstance(src, T.StringType) or isinstance(dst, T.StringType):
+            if ctx.is_device:
+                raise NotImplementedError(
+                    "string casts are host-only; the planner must not "
+                    "schedule them on device")
+            return self._eval_string_host(a, ctx)
+        validity = a.validity
+        d = a.data
+        if isinstance(src, T.BooleanType):
+            data = d.astype(dst.np_dtype)
+        elif isinstance(dst, T.BooleanType):
+            data = d != xp.zeros((), d.dtype)
+        elif isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            data = d.astype(np.int64) * _MICROS_PER_DAY
+        elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            data = (d // _MICROS_PER_DAY).astype(np.int32)
+        elif isinstance(src, (T.DateType, T.TimestampType)) \
+                or isinstance(dst, (T.DateType, T.TimestampType)):
+            # numeric <-> date/timestamp: reinterpret the raw ticks
+            # (Spark: timestamp->long is seconds; keep that)
+            if isinstance(src, T.TimestampType) and dst.integral:
+                data = (d // 1_000_000).astype(dst.np_dtype)
+            elif isinstance(src, T.TimestampType) and dst.fractional:
+                data = (d.astype(np.float64) / 1e6).astype(dst.np_dtype)
+            elif isinstance(dst, T.TimestampType) and src.integral:
+                data = d.astype(np.int64) * 1_000_000
+            elif isinstance(dst, T.TimestampType) and src.fractional:
+                data = (d * 1e6).astype(np.int64)
+            elif isinstance(src, T.DateType):
+                data = d.astype(dst.np_dtype)
+            else:
+                data = d.astype(np.int32)
+        elif dst.integral and src.fractional:
+            data = self._float_to_int(xp, d, dst)
+        else:
+            data = d.astype(dst.np_dtype)
+        return ctx.canonical(data, validity, dst)
+
+    @staticmethod
+    def _float_to_int(xp, d, dst: T.DataType):
+        """JLS 5.1.3 (d2i/d2l): trunc toward zero, NaN->0, saturate at the
+        int range for byte/short/int (then bit-narrow, like Scala .toByte)
+        or at the long range for long.
+
+        TPU notes (verified on v5e): trunc(inf) emulates to NaN and
+        f64->s32 conversion is off-by-one at the boundary, so non-finite
+        values are masked out first and all conversions go through s64
+        (exact on TPU) with integer-domain clamping.
+        """
+        finite = xp.isfinite(d)
+        t = xp.trunc(xp.where(finite, d, xp.zeros((), d.dtype)))
+        hi = np.float64(2.0 ** 63)
+        big_pos = d >= hi          # includes +inf; NaN compares false
+        big_neg = d <= -hi
+        t = xp.clip(t, -hi, hi)
+        with np.errstate(invalid="ignore"):
+            as64 = t.astype(np.int64)
+        as64 = xp.where(big_pos, np.int64(2 ** 63 - 1), as64)
+        as64 = xp.where(big_neg, np.int64(-(2 ** 63)), as64)
+        if isinstance(dst, T.LongType):
+            return as64
+        as64 = xp.clip(as64, np.int64(-(2 ** 31)), np.int64(2 ** 31 - 1))
+        return as64.astype(np.int32).astype(dst.np_dtype)
+
+    # ------------------------------------------------------------------
+    # host-only string paths (oracle)
+    # ------------------------------------------------------------------
+    def _eval_string_host(self, a: Val, ctx: EvalCtx):
+        src, dst = a.dtype, self.to
+        n = ctx.capacity
+        if isinstance(dst, T.StringType):
+            out = np.empty(n, dtype=object)
+            validity = a.validity.copy()
+            for i in range(n):
+                if not validity[i]:
+                    out[i] = None
+                    continue
+                out[i] = self._value_to_string(a.data[i], src)
+            return Val(out, validity, None, dst)
+        # string -> typed
+        out_np = np.zeros(n, dtype=dst.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            if not a.validity[i]:
+                continue
+            v = self._string_to_value(a.data[i], dst)
+            if v is not None:
+                out_np[i] = v
+                validity[i] = True
+        return Val(out_np, validity, None, dst)
+
+    @staticmethod
+    def _value_to_string(v, src: T.DataType) -> str:
+        import datetime as _dt
+        if isinstance(src, T.BooleanType):
+            return "true" if v else "false"
+        if isinstance(src, T.FloatType):
+            return java_double_str(float(v), float32=True)
+        if isinstance(src, T.DoubleType):
+            return java_double_str(float(v))
+        if isinstance(src, T.DateType):
+            return (_dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))).isoformat()
+        if isinstance(src, T.TimestampType):
+            ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(v))
+            s = ts.strftime("%Y-%m-%d %H:%M:%S")
+            if ts.microsecond:
+                s += f".{ts.microsecond:06d}".rstrip("0")
+            return s
+        return str(int(v))
+
+    @staticmethod
+    def _string_to_value(s: str, dst: T.DataType):
+        import datetime as _dt
+        s = s.strip()
+        try:
+            if isinstance(dst, T.BooleanType):
+                ls = s.lower()
+                if ls in ("t", "true", "y", "yes", "1"):
+                    return True
+                if ls in ("f", "false", "n", "no", "0"):
+                    return False
+                return None
+            if dst.integral:
+                return np.dtype(dst.np_dtype).type(int(s))
+            if dst.fractional:
+                return np.dtype(dst.np_dtype).type(float(s))
+            if isinstance(dst, T.DateType):
+                return (_dt.date.fromisoformat(s[:10]) - _dt.date(1970, 1, 1)).days
+            if isinstance(dst, T.TimestampType):
+                ts = _dt.datetime.fromisoformat(s.replace(" ", "T"))
+                return int((ts - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        except (ValueError, OverflowError):
+            return None
+        return None

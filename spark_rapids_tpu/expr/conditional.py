@@ -1,0 +1,160 @@
+"""Conditional expressions: If, CaseWhen, Coalesce, Nvl/NullIf.
+
+Reference: conditionalExpressions.scala (233 LoC), nullExpressions.scala
+(287 LoC).  Columnar evaluation computes all branches and selects — the
+same strategy the reference uses on GPU.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
+
+__all__ = ["If", "CaseWhen", "Coalesce"]
+
+
+def _select(pred_data, t: Val, f: Val, dtype, ctx: EvalCtx) -> Val:
+    """where(pred, t, f) handling string matrices on device."""
+    xp = ctx.xp
+    validity = xp.where(pred_data, t.validity, f.validity)
+    if isinstance(dtype, T.StringType) and ctx.is_device:
+        td, fd = t.data, f.data
+        wt, wf = td.shape[1], fd.shape[1]
+        w = max(wt, wf)
+        if wt < w:
+            td = xp.pad(td, ((0, 0), (0, w - wt)))
+        if wf < w:
+            fd = xp.pad(fd, ((0, 0), (0, w - wf)))
+        data = xp.where(pred_data[:, None], td, fd)
+        lengths = xp.where(pred_data, t.lengths, f.lengths)
+        return ctx.canonical(data, validity, dtype, lengths)
+    data = xp.where(pred_data, t.data, f.data)
+    return ctx.canonical(data, validity, dtype, None)
+
+
+def _common_type(types: list[T.DataType]) -> T.DataType:
+    target = None
+    for t in types:
+        if isinstance(t, T.NullType):
+            continue
+        if target is None or t == target:
+            target = t
+        elif t.numeric and target.numeric:
+            target = T.numeric_promote(target, t)
+        else:
+            raise TypeError(f"no common type for {types}")
+    return target if target is not None else T.NullType()
+
+
+class If(Expression):
+    sql_name = "If"
+
+    def __init__(self, pred: Expression, t: Expression, f: Expression):
+        self.children = (pred, t, f)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        p, t, f = self.children
+        target = _common_type([t.dtype, f.dtype])
+        if t.dtype != target:
+            t = Cast(t, target)
+        if f.dtype != target:
+            f = Cast(f, target)
+        return If(p, t, f)
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    def _eval(self, vals, ctx):
+        p, t, f = vals
+        cond = p.data & p.validity  # null predicate -> false branch
+        return _select(cond, t, f, self.dtype, ctx)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] [ELSE e] END.
+
+    Children layout: [c1, v1, c2, v2, ..., (else)] — odd count means an
+    else branch is present.
+    """
+    sql_name = "CaseWhen"
+
+    def __init__(self, branches: list[tuple[Expression, Expression]],
+                 else_value: Expression | None = None):
+        kids = []
+        for c, v in branches:
+            kids += [c, v]
+        if else_value is not None:
+            kids.append(else_value)
+        self.children = tuple(kids)
+        self._nbranches = len(branches)
+        self._has_else = else_value is not None
+
+    def _split(self, seq):
+        branches = [(seq[2 * i], seq[2 * i + 1]) for i in range(self._nbranches)]
+        els = seq[-1] if self._has_else else None
+        return branches, els
+
+    def with_new_children(self, children):
+        b, e = self._split(list(children))
+        return CaseWhen(b, e)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        branches, els = self._split(list(self.children))
+        vals = [v for _, v in branches] + ([els] if els is not None else [])
+        target = _common_type([v.dtype for v in vals])
+        branches = [(c, v if v.dtype == target else Cast(v, target))
+                    for c, v in branches]
+        if els is not None and els.dtype != target:
+            els = Cast(els, target)
+        return CaseWhen(branches, els)
+
+    @property
+    def dtype(self):
+        branches, els = self._split(list(self.children))
+        for _, v in branches:
+            if not isinstance(v.dtype, T.NullType):
+                return v.dtype
+        return els.dtype if els is not None else T.NullType()
+
+    def _eval(self, vals, ctx):
+        branches, els = self._split(vals)
+        xp = ctx.xp
+        if els is not None:
+            result = els
+        else:
+            result = ctx.const(None, self.dtype)
+        # fold right-to-left so the first matching branch wins
+        for cond, val in reversed(branches):
+            pred = cond.data & cond.validity
+            result = _select(pred, val, result, self.dtype, ctx)
+        return result
+
+
+class Coalesce(Expression):
+    """First non-null argument."""
+    sql_name = "Coalesce"
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_new_children(self, children):
+        return Coalesce(*children)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        target = _common_type([c.dtype for c in self.children])
+        kids = [c if c.dtype == target else Cast(c, target)
+                for c in self.children]
+        return Coalesce(*kids)
+
+    @property
+    def dtype(self):
+        return _common_type([c.dtype for c in self.children])
+
+    def _eval(self, vals, ctx):
+        result = vals[-1]
+        for v in reversed(vals[:-1]):
+            result = _select(v.validity, v, result, self.dtype, ctx)
+        return result

@@ -1,0 +1,489 @@
+"""Expression tree core: nodes, binding, and the shared eval machinery.
+
+Reference analog: GpuExpressions.scala (base traits), GpuBoundAttribute.scala
+(binding named attributes to column ordinals), literals.scala,
+namedExpressions.scala.  Unlike the reference (which piggybacks on Catalyst
+for analysis), this framework is standalone, so name resolution and numeric
+type coercion live here (`bind`).
+
+Evaluation model: an expression evaluates over a list of input ``Val``s (one
+per input column) in an ``EvalCtx`` that says which backend is active:
+
+* host oracle — numpy arrays, no padding (capacity == num_rows);
+* device — jax arrays of static ``capacity`` with a traced row mask; the
+  same kernel code runs under ``jax.jit``.
+
+Both paths share null semantics: a ``Val`` is (data, validity); binary ops
+AND the validities unless the op defines otherwise (three-valued logic for
+And/Or, null-safe equality, ...).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+__all__ = [
+    "Val", "EvalCtx", "Expression", "Literal", "BoundReference",
+    "UnresolvedAttribute", "Alias", "col", "lit", "bind",
+    "eval_host", "eval_device",
+]
+
+
+@dataclass
+class Val:
+    """Backend-neutral column value flowing through expression eval.
+
+    data:     numpy or jax array. For strings: host -> object ndarray of str,
+              device -> uint8[capacity, width] padded byte matrix.
+    validity: bool array [capacity].
+    lengths:  device strings only, int32[capacity]; None on host.
+    dtype:    SQL type.
+    """
+    data: Any
+    validity: Any
+    lengths: Any
+    dtype: T.DataType
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+
+class EvalCtx:
+    """Evaluation context: backend namespace + batch geometry."""
+
+    def __init__(self, xp, is_device: bool, capacity: int, row_mask):
+        self.xp = xp                  # numpy or jax.numpy
+        self.is_device = is_device
+        self.capacity = capacity      # == num_rows on host
+        self.row_mask = row_mask      # bool[capacity]: True = real row
+
+    def const(self, value, dtype: T.DataType) -> Val:
+        """Broadcast a python scalar (or None) to a full-capacity Val."""
+        xp = self.xp
+        if value is None:
+            validity = xp.zeros(self.capacity, dtype=bool)
+            if isinstance(dtype, T.StringType):
+                return self._const_string("", validity)
+            npdt = dtype.np_dtype
+            return Val(xp.zeros(self.capacity, dtype=npdt), validity, None, dtype)
+        validity = self.row_mask
+        if isinstance(dtype, T.StringType):
+            return self._const_string(str(value), validity)
+        npdt = dtype.np_dtype
+        data = xp.full(self.capacity, value, dtype=npdt)
+        data = xp.where(validity, data, xp.zeros((), npdt))
+        return Val(data, validity, None, dtype)
+
+    def _const_string(self, s: str, validity) -> Val:
+        xp = self.xp
+        if not self.is_device:
+            data = np.full(self.capacity, s, dtype=object)
+            return Val(data, validity, None, T.StringType())
+        from spark_rapids_tpu.columnar.column import round_string_width
+        bs = s.encode("utf-8")
+        w = round_string_width(max(len(bs), 1))
+        row = np.zeros(w, dtype=np.uint8)
+        row[:len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+        data = xp.broadcast_to(xp.asarray(row), (self.capacity, w))
+        data = xp.where(validity[:, None], data, 0)
+        lengths = xp.where(validity, len(bs), 0).astype("int32")
+        return Val(data, validity, lengths, T.StringType())
+
+    def canonical(self, data, validity, dtype: T.DataType, lengths=None) -> Val:
+        """Zero data at invalid slots (padding discipline, see columnar/)."""
+        xp = self.xp
+        if isinstance(dtype, T.StringType) and self.is_device:
+            data = xp.where(validity[:, None], data, 0)
+            lengths = xp.where(validity, lengths, 0)
+            return Val(data, validity, lengths, dtype)
+        if isinstance(dtype, T.StringType):
+            return Val(data, validity, None, dtype)
+        data = xp.where(validity, data, xp.zeros((), data.dtype))
+        return Val(data, validity, None, dtype)
+
+
+class Expression:
+    """Base expression node. Immutable; children in ``self.children``."""
+
+    children: tuple["Expression", ...] = ()
+    #: explain/registry name (reference: expression class name in
+    #: GpuOverrides registry keys, e.g. spark.rapids.sql.expression.Add)
+    sql_name: str = "Expression"
+
+    # -- analysis ----------------------------------------------------------
+    @property
+    def dtype(self) -> T.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    #: False when this node can only run on the host oracle (the planner's
+    #: tagging pass checks the whole tree; reference: RapidsMeta
+    #: willNotWorkOnGpu, RapidsMeta.scala:66-300)
+    @property
+    def device_supported(self) -> bool:
+        return True
+
+    def with_new_children(self, children: Sequence["Expression"]) -> "Expression":
+        """Rebuild this node with new children (default: positional ctor)."""
+        return type(self)(*children)
+
+    def coerced(self) -> "Expression":
+        """Hook: insert casts after children are bound (type coercion)."""
+        return self
+
+    # -- evaluation --------------------------------------------------------
+    def eval(self, inputs: list[Val], ctx: EvalCtx) -> Val:
+        child_vals = [c.eval(inputs, ctx) for c in self.children]
+        return self._eval(child_vals, ctx)
+
+    def _eval(self, vals: list[Val], ctx: EvalCtx) -> Val:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- tree utilities ----------------------------------------------------
+    def transform_up(self, fn) -> "Expression":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self if all(a is b for a, b in zip(new_children, self.children)) \
+            else self.with_new_children(new_children)
+        return fn(node)
+
+    def references(self) -> set[str]:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def __repr__(self) -> str:
+        if self.children:
+            return f"{self.sql_name}({', '.join(map(repr, self.children))})"
+        return self.sql_name
+
+    # -- builder sugar (DataFrame column API) ------------------------------
+    def _bin(self, other, cls, flip=False):
+        other = other if isinstance(other, Expression) else Literal.infer(other)
+        return cls(other, self) if flip else cls(self, other)
+
+    def __add__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Add
+        return self._bin(o, Add)
+
+    def __radd__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Add
+        return self._bin(o, Add, flip=True)
+
+    def __sub__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+        return self._bin(o, Subtract)
+
+    def __rsub__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+        return self._bin(o, Subtract, flip=True)
+
+    def __mul__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+        return self._bin(o, Multiply)
+
+    def __rmul__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+        return self._bin(o, Multiply, flip=True)
+
+    def __truediv__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        return self._bin(o, Divide)
+
+    def __rtruediv__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        return self._bin(o, Divide, flip=True)
+
+    def __mod__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Remainder
+        return self._bin(o, Remainder)
+
+    def __neg__(self):
+        from spark_rapids_tpu.expr.arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __eq__(self, o):  # noqa: A003 - expression DSL, not identity
+        from spark_rapids_tpu.expr.predicates import EqualTo
+        return self._bin(o, EqualTo)
+
+    def __ne__(self, o):
+        from spark_rapids_tpu.expr.predicates import EqualTo, Not
+        return Not(self._bin(o, EqualTo))
+
+    def __lt__(self, o):
+        from spark_rapids_tpu.expr.predicates import LessThan
+        return self._bin(o, LessThan)
+
+    def __le__(self, o):
+        from spark_rapids_tpu.expr.predicates import LessThanOrEqual
+        return self._bin(o, LessThanOrEqual)
+
+    def __gt__(self, o):
+        from spark_rapids_tpu.expr.predicates import GreaterThan
+        return self._bin(o, GreaterThan)
+
+    def __ge__(self, o):
+        from spark_rapids_tpu.expr.predicates import GreaterThanOrEqual
+        return self._bin(o, GreaterThanOrEqual)
+
+    def __and__(self, o):
+        from spark_rapids_tpu.expr.predicates import And
+        return self._bin(o, And)
+
+    def __or__(self, o):
+        from spark_rapids_tpu.expr.predicates import Or
+        return self._bin(o, Or)
+
+    def __invert__(self):
+        from spark_rapids_tpu.expr.predicates import Not
+        return Not(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, dt: T.DataType) -> "Expression":
+        from spark_rapids_tpu.expr.cast import Cast
+        return Cast(self, dt)
+
+    def is_null(self) -> "Expression":
+        from spark_rapids_tpu.expr.predicates import IsNull
+        return IsNull(self)
+
+    def is_not_null(self) -> "Expression":
+        from spark_rapids_tpu.expr.predicates import IsNotNull
+        return IsNotNull(self)
+
+    def isin(self, *values) -> "Expression":
+        from spark_rapids_tpu.expr.predicates import In
+        return In(self, [v if isinstance(v, Expression) else Literal.infer(v)
+                         for v in values])
+
+    def substr(self, pos, length) -> "Expression":
+        from spark_rapids_tpu.expr.strings import Substring
+        return Substring(self, Literal.infer(pos), Literal.infer(length))
+
+    def startswith(self, s) -> "Expression":
+        from spark_rapids_tpu.expr.strings import StartsWith
+        return self._bin(s, StartsWith)
+
+    def endswith(self, s) -> "Expression":
+        from spark_rapids_tpu.expr.strings import EndsWith
+        return self._bin(s, EndsWith)
+
+    def contains(self, s) -> "Expression":
+        from spark_rapids_tpu.expr.strings import Contains
+        return self._bin(s, Contains)
+
+    def like(self, pattern: str) -> "Expression":
+        from spark_rapids_tpu.expr.strings import Like
+        return Like(self, pattern)
+
+
+class Literal(Expression):
+    sql_name = "Literal"
+
+    def __init__(self, value, dtype: T.DataType):
+        self.value = value
+        self._dtype = dtype
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def with_new_children(self, children):
+        return self
+
+    @staticmethod
+    def infer(v) -> "Literal":
+        if isinstance(v, Literal):
+            return v
+        if v is None:
+            return Literal(None, T.NullType())
+        if isinstance(v, bool):
+            return Literal(v, T.BooleanType())
+        if isinstance(v, int):
+            # Spark python ints become LongType unless they fit... Spark
+            # literalizes python int as LongType; keep that.
+            return Literal(v, T.LongType())
+        if isinstance(v, float):
+            return Literal(v, T.DoubleType())
+        if isinstance(v, str):
+            return Literal(v, T.StringType())
+        if isinstance(v, np.integer):
+            return Literal(int(v), T.LongType())
+        if isinstance(v, np.floating):
+            return Literal(float(v), T.DoubleType())
+        import datetime as _dt
+        if isinstance(v, _dt.datetime):
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=v.tzinfo or _dt.timezone.utc)
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dt.timezone.utc)
+            micros = int((v - epoch).total_seconds() * 1_000_000)
+            return Literal(micros, T.TimestampType())
+        if isinstance(v, _dt.date):
+            days = (v - _dt.date(1970, 1, 1)).days
+            return Literal(days, T.DateType())
+        raise TypeError(f"cannot create literal from {type(v)}")
+
+    def eval(self, inputs, ctx):
+        return ctx.const(self.value, self._dtype)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class BoundReference(Expression):
+    """Resolved input-column reference (reference GpuBoundAttribute.scala)."""
+    sql_name = "BoundReference"
+
+    def __init__(self, index: int, dtype: T.DataType, nullable: bool = True,
+                 name: str = ""):
+        self.index = index
+        self._dtype = dtype
+        self._nullable = nullable
+        self.name = name
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def with_new_children(self, children):
+        return self
+
+    def references(self):
+        return {self.name} if self.name else set()
+
+    def eval(self, inputs, ctx):
+        return inputs[self.index]
+
+    def __repr__(self):
+        return f"#{self.index}:{self.name or self._dtype.name}"
+
+
+class UnresolvedAttribute(Expression):
+    """Named column before binding (`col("x")`)."""
+    sql_name = "UnresolvedAttribute"
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @property
+    def dtype(self):
+        raise TypeError(f"unresolved attribute {self.name!r} has no dtype; "
+                        "bind() against a schema first")
+
+    def with_new_children(self, children):
+        return self
+
+    def references(self):
+        return {self.name}
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class Alias(Expression):
+    sql_name = "Alias"
+
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def with_new_children(self, children):
+        return Alias(children[0], self.name)
+
+    def _eval(self, vals, ctx):
+        return vals[0]
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.name}"
+
+
+def col(name: str) -> UnresolvedAttribute:
+    return UnresolvedAttribute(name)
+
+
+def lit(v) -> Literal:
+    return Literal.infer(v)
+
+
+def output_name(e: Expression) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    if isinstance(e, UnresolvedAttribute):
+        return e.name
+    if isinstance(e, BoundReference) and e.name:
+        return e.name
+    return repr(e)
+
+
+# ---------------------------------------------------------------------------
+# Binding & coercion (the standalone analog of Catalyst analysis)
+# ---------------------------------------------------------------------------
+
+def bind(expr: Expression, schema: T.Schema) -> Expression:
+    """Resolve names to BoundReferences against ``schema``, then run type
+    coercion bottom-up (inserting Casts).  Returns a fully-typed tree."""
+
+    def resolve(node: Expression) -> Expression:
+        if isinstance(node, UnresolvedAttribute):
+            i = schema.index_of(node.name)
+            f = schema.fields[i]
+            return BoundReference(i, f.data_type, f.nullable, f.name)
+        return node.coerced()
+
+    return expr.transform_up(resolve)
+
+
+def eval_host(expr: Expression, batch) -> "HostColumn":
+    """Evaluate a bound expression over a HostBatch -> HostColumn."""
+    from spark_rapids_tpu.host.batch import HostColumn
+    n = batch.num_rows
+    ctx = EvalCtx(np, False, n, np.ones(n, dtype=np.bool_))
+    inputs = [Val(c.data, c.validity, None, c.dtype) for c in batch.columns]
+    v = expr.eval(inputs, ctx)
+    if v.is_string:
+        return HostColumn(np.where(v.validity, v.data, None), v.validity, v.dtype)
+    return HostColumn(np.asarray(v.data), np.asarray(v.validity), v.dtype)
+
+
+def eval_device(expr: Expression, batch) -> "DeviceColumn":
+    """Evaluate a bound expression over a ColumnBatch -> DeviceColumn.
+
+    Jit-safe: call inside a jitted program over the batch pytree.
+    """
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    ctx = EvalCtx(jnp, True, batch.capacity, batch.row_mask())
+    inputs = [Val(c.data, c.validity, c.lengths, c.dtype)
+              for c in batch.columns]
+    v = expr.eval(inputs, ctx)
+    v = ctx.canonical(v.data, v.validity, v.dtype, v.lengths)
+    return DeviceColumn(v.data, v.validity, v.dtype, v.lengths)

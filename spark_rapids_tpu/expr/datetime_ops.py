@@ -1,0 +1,229 @@
+"""Date/time expressions (reference datetimeExpressions.scala, 575 LoC).
+
+Dates are int32 days since epoch, timestamps int64 micros (UTC session
+timezone — the reference likewise only supports UTC-safe operations and
+tags the rest off-GPU).  Civil-date decomposition uses the days-from-civil
+algorithm (Howard Hinnant) as pure integer ops so the same kernel runs on
+numpy and under jax.jit on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx
+
+__all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
+           "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
+           "DateDiff", "ToDate"]
+
+_MICROS_PER_DAY = 86_400_000_000
+
+
+def civil_from_days(z, xp):
+    """days-since-epoch -> (year, month, day), vectorized integer math."""
+    z = z.astype(np.int64) + 719468
+    # numpy/jax `//` is floor division, so no trunc-division adjustment
+    era = z // 146097
+    doe = z - era * 146097                                    # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)           # [0, 365]
+    mp = (5 * doy + 2) // 153                                 # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                         # [1, 31]
+    m = xp.where(mp < 10, mp + 3, mp - 9)                     # [1, 12]
+    y = xp.where(m <= 2, y + 1, y)
+    return y.astype(np.int32), m.astype(np.int32), d.astype(np.int32)
+
+
+class _DateExtract(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        if isinstance(c.dtype, T.TimestampType):
+            return type(self)(Cast(c, T.DateType()))
+        if isinstance(c.dtype, T.StringType):
+            return type(self)(Cast(c, T.DateType()))
+        return self
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        y, m, d = civil_from_days(a.data, ctx.xp)
+        return ctx.canonical(self._pick(y, m, d, a.data, ctx.xp),
+                             a.validity, T.IntegerType())
+
+
+class Year(_DateExtract):
+    sql_name = "Year"
+
+    def _pick(self, y, m, d, days, xp):
+        return y
+
+
+class Month(_DateExtract):
+    sql_name = "Month"
+
+    def _pick(self, y, m, d, days, xp):
+        return m
+
+
+class DayOfMonth(_DateExtract):
+    sql_name = "DayOfMonth"
+
+    def _pick(self, y, m, d, days, xp):
+        return d
+
+
+class DayOfWeek(_DateExtract):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+    sql_name = "DayOfWeek"
+
+    def _pick(self, y, m, d, days, xp):
+        # 1970-01-01 was a Thursday (dow 5 in Spark's 1=Sunday scheme)
+        return ((days.astype(np.int64) + 4) % 7 + 1).astype(np.int32)
+
+
+class DayOfYear(_DateExtract):
+    sql_name = "DayOfYear"
+
+    def _pick(self, y, m, d, days, xp):
+        jan1 = days_from_civil(y, xp.ones_like(m), xp.ones_like(d), xp)
+        return (days.astype(np.int64) - jan1 + 1).astype(np.int32)
+
+
+class Quarter(_DateExtract):
+    sql_name = "Quarter"
+
+    def _pick(self, y, m, d, days, xp):
+        return (m - 1) // 3 + 1
+
+
+def days_from_civil(y, m, d, xp):
+    """(year, month, day) -> days since epoch (Hinnant days_from_civil)."""
+    y = y.astype(np.int64)
+    m = m.astype(np.int64)
+    d = d.astype(np.int64)
+    y = y - (m <= 2)
+    era = y // 400  # floor division
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class _TimeExtract(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        micros_in_day = a.data - (a.data // _MICROS_PER_DAY) * _MICROS_PER_DAY
+        secs = micros_in_day // 1_000_000
+        return ctx.canonical(self._pick(secs, ctx.xp).astype(np.int32),
+                             a.validity, T.IntegerType())
+
+
+class Hour(_TimeExtract):
+    sql_name = "Hour"
+
+    def _pick(self, secs, xp):
+        return secs // 3600
+
+
+class Minute(_TimeExtract):
+    sql_name = "Minute"
+
+    def _pick(self, secs, xp):
+        return (secs // 60) % 60
+
+
+class Second(_TimeExtract):
+    sql_name = "Second"
+
+    def _pick(self, secs, xp):
+        return secs % 60
+
+
+class DateAdd(Expression):
+    sql_name = "DateAdd"
+
+    def __init__(self, start: Expression, days: Expression):
+        self.children = (start, days)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        data = (a.data + b.data.astype(np.int32)).astype(np.int32)
+        return ctx.canonical(data, validity, T.DateType())
+
+
+class DateSub(Expression):
+    sql_name = "DateSub"
+
+    def __init__(self, start: Expression, days: Expression):
+        self.children = (start, days)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        data = (a.data - b.data.astype(np.int32)).astype(np.int32)
+        return ctx.canonical(data, validity, T.DateType())
+
+
+class DateDiff(Expression):
+    """datediff(end, start) in days, IntegerType."""
+    sql_name = "DateDiff"
+
+    def __init__(self, end: Expression, start: Expression):
+        self.children = (end, start)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        kids = [Cast(c, T.DateType()) if not isinstance(c.dtype, T.DateType)
+                else c for c in self.children]
+        return DateDiff(*kids)
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        return ctx.canonical((a.data - b.data).astype(np.int32), validity,
+                             T.IntegerType())
+
+
+class ToDate(Expression):
+    sql_name = "ToDate"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        return Cast(self.children[0], T.DateType())
+
+    @property
+    def dtype(self):
+        return T.DateType()

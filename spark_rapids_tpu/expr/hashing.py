@@ -1,0 +1,257 @@
+"""Spark-compatible Murmur3 (x86_32, seed 42) hash.
+
+Reference: HashFunctions.scala (GpuMurmur3Hash) — the hash behind
+GpuHashPartitioning (GpuHashPartitioning.scala: cudf murmur3 % n).  Bit-exact
+parity with Spark's Murmur3Hash is what makes a CPU-written shuffle readable
+by the TPU side and vice versa, and makes differential partitioning tests
+possible, so this implements org.apache.spark.sql.catalyst.expressions
+.Murmur3Hash exactly:
+
+* int/date/bool/byte/short -> hashInt of the 32-bit value;
+* long/timestamp -> hashLong; float -> hashInt(bits), double ->
+  hashLong(bits), with -0.0 normalized to 0.0;
+* string -> hashUnsafeBytes over UTF-8: 4-byte little-endian blocks, then
+  remaining bytes one at a time as *signed* ints;
+* null -> passes the running seed through unchanged;
+* multiple columns chain: h = hash(col_i, h).
+
+All arithmetic is uint32 with wraparound, identical under numpy and XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
+
+__all__ = ["Murmur3Hash", "murmur3_val", "DEFAULT_SEED"]
+
+DEFAULT_SEED = 42
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(5)
+_MX = np.uint32(0xE6546B64)
+
+
+def _u32(x, xp):
+    return x.astype(np.uint32)
+
+
+def _rotl(x, n, xp):
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _mix_k1(k1, xp):
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15, xp)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1, xp):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13, xp)
+    return h1 * _M5 + _MX
+
+
+def _fmix(h1, length, xp):
+    h1 = h1 ^ np.uint32(length) if np.isscalar(length) else h1 ^ length.astype(np.uint32)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def _hash_int(i32, seed_u32, xp):
+    """i32: int32-valued array; seed: uint32 array."""
+    k1 = _mix_k1(i32.astype(np.uint32), xp)
+    h1 = _mix_h1(seed_u32, k1, xp)
+    return _fmix(h1, 4, xp)
+
+
+def _hash_long(i64, seed_u32, xp):
+    low = (i64 & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    high = ((i64 >> np.int64(32)) & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    h1 = _mix_h1(seed_u32, _mix_k1(low, xp), xp)
+    h1 = _mix_h1(h1, _mix_k1(high, xp), xp)
+    return _fmix(h1, 8, xp)
+
+
+def _float_bits(f32, xp):
+    # normalize -0.0 to 0.0 (Spark); NaN: Java floatToIntBits canonical NaN
+    zero = xp.zeros((), f32.dtype)
+    f32 = xp.where(f32 == zero, zero, f32)
+    bits = f32.view(np.int32) if xp is np else _jax_bitcast(f32, np.int32)
+    canonical = np.int32(0x7FC00000)
+    return xp.where(xp.isnan(f32), canonical, bits)
+
+
+def _double_bits(f64, xp):
+    zero = xp.zeros((), f64.dtype)
+    f64 = xp.where(f64 == zero, zero, f64)
+    if xp is np:
+        bits = f64.view(np.int64)
+    else:
+        # TPU XLA lacks 64-bit bitcast (see ops/sort.py note): split via
+        # float64 -> two float32 halves is lossy; instead bitcast through
+        # uint32 pairs using jax's dtype view on device buffers is not
+        # traceable, so decompose arithmetically: Java doubleToLongBits is
+        # sign/exponent/mantissa packing.
+        bits = _jax_double_bits(f64)
+    canonical = np.int64(0x7FF8000000000000)
+    return xp.where(xp.isnan(f64), canonical, bits)
+
+
+def _jax_bitcast(x, dt):
+    import jax.lax as lax
+    return lax.bitcast_convert_type(x, dt)
+
+
+    # exact power-of-two tables (host-built). TPU v5e XLA implements neither
+    # 64-bit bitcast-convert nor frexp/ldexp, so the decomposition uses
+    # searchsorted over exact boundaries + exact power-of-two multiplies.
+
+
+_POW2_BOUNDS = 2.0 ** np.arange(-1022, 1024)          # 2^e, e in [-1022,1023]
+_POW2_INV = 2.0 ** np.arange(-512, 513).astype(np.float64)  # normal range
+
+
+def _jax_double_bits(f64):
+    """doubleToLongBits without 64-bit bitcast / frexp / ldexp: find the
+    exponent by binary search over exact 2^e boundaries, recover the
+    mantissa with two exact power-of-two multiplies (each factor normal, so
+    no subnormal flush), and repack as int64."""
+    import jax.numpy as jnp
+    # caller (_double_bits) has already normalized -0.0 to +0.0, so a plain
+    # comparison gives the sign (jnp.signbit lowers to a 64-bit bitcast,
+    # unsupported on TPU)
+    sign = f64 < 0
+    af = jnp.abs(f64)
+    inf = jnp.isinf(af)
+    zero = af == 0
+    nan = jnp.isnan(af)
+    safe = jnp.where(inf | zero | nan, jnp.float64(1.0), af)
+    bounds = jnp.asarray(_POW2_BOUNDS)
+    idx = jnp.clip(jnp.searchsorted(bounds, safe, side="right") - 1, 0,
+                   len(_POW2_BOUNDS) - 1)
+    e = idx.astype(np.int64) - 1022
+    # split 2^-e into two normal-range factors so every multiply is exact
+    e1 = e // 2
+    e2 = e - e1
+    inv = jnp.asarray(_POW2_INV)
+    m1 = (safe * inv[(-e1 + 512).astype(np.int32)]) \
+        * inv[(-e2 + 512).astype(np.int32)]          # in [1, 2)
+    mant = ((m1 - 1.0) * np.float64(2.0 ** 52)).astype(np.int64)
+    biased = e + 1023
+    # subnormal input: biased exponent 0, mantissa = af * 2^1074 (staged as
+    # two exact multiplies to stay in range)
+    is_sub = af < np.float64(2.0 ** -1022)
+    sub_mant = ((af * np.float64(2.0 ** 537)) * np.float64(2.0 ** 537)) \
+        .astype(np.int64)
+    mant = jnp.where(is_sub, sub_mant, mant)
+    biased = jnp.where(is_sub, 0, biased)
+    bits = (biased << np.int64(52)) | mant
+    bits = jnp.where(zero, np.int64(0), bits)
+    bits = jnp.where(inf, np.int64(0x7FF0000000000000), bits)
+    return jnp.where(sign, bits | np.int64(-0x8000000000000000), bits)
+
+
+def _hash_string_host(data, validity, seed_u32):
+    out = seed_u32.copy()
+    for i in range(len(data)):
+        if not validity[i]:
+            continue
+        bs = data[i].encode("utf-8")
+        h = np.uint32(out[i])
+        n = len(bs)
+        na = n - n % 4
+        with np.errstate(over="ignore"):
+            for j in range(0, na, 4):
+                block = np.uint32(int.from_bytes(bs[j:j + 4], "little"))
+                h = _mix_h1(h, _mix_k1(block, np), np)
+            for j in range(na, n):
+                b = bs[j]
+                sb = np.uint32(b if b < 128 else b - 256)  # signed byte
+                h = _mix_h1(h, _mix_k1(sb, np), np)
+            out[i] = _fmix(h, np.uint32(n), np)
+    return out
+
+
+def _hash_string_device(data, lengths, seed_u32, xp):
+    """Vectorized over the padded byte matrix: fold blocks (each row uses
+    only its first len//4 blocks), then up to 3 tail bytes."""
+    n, w = data.shape
+    nblocks_row = lengths // 4
+    tail_len = lengths % 4
+    h = seed_u32
+    d32 = data.astype(np.uint32)
+    nblocks = w // 4
+    for j in range(nblocks):
+        b = (d32[:, 4 * j]
+             | (d32[:, 4 * j + 1] << np.uint32(8))
+             | (d32[:, 4 * j + 2] << np.uint32(16))
+             | (d32[:, 4 * j + 3] << np.uint32(24)))
+        mixed = _mix_h1(h, _mix_k1(b, xp), xp)
+        h = xp.where(j < nblocks_row, mixed, h)
+    base = (nblocks_row * 4).astype(np.int32)
+    for t in range(3):
+        idx = xp.clip(base + t, 0, w - 1)
+        byte = xp.take_along_axis(data, idx[:, None], axis=1)[:, 0]
+        signed = xp.where(byte < 128, byte.astype(np.int32),
+                          byte.astype(np.int32) - 256)
+        mixed = _mix_h1(h, _mix_k1(signed.astype(np.uint32), xp), xp)
+        h = xp.where(t < tail_len, mixed, h)
+    return _fmix(h, lengths.astype(np.uint32), xp)
+
+
+def murmur3_val(v: Val, seed_u32, ctx: EvalCtx):
+    """Hash one column into the running seed array (uint32[capacity])."""
+    xp = ctx.xp
+    dt = v.dtype
+    if isinstance(dt, T.StringType):
+        if ctx.is_device:
+            h = _hash_string_device(v.data, v.lengths, seed_u32, xp)
+        else:
+            h = _hash_string_host(v.data, v.validity, seed_u32)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = _hash_long(v.data, seed_u32, xp)
+    elif isinstance(dt, T.DoubleType):
+        h = _hash_long(_double_bits(v.data, xp), seed_u32, xp)
+    elif isinstance(dt, T.FloatType):
+        h = _hash_int(_float_bits(v.data, xp), seed_u32, xp)
+    elif isinstance(dt, T.BooleanType):
+        h = _hash_int(v.data.astype(np.int32), seed_u32, xp)
+    else:  # byte/short/int/date
+        h = _hash_int(v.data.astype(np.int32), seed_u32, xp)
+    # null columns pass the seed through
+    return xp.where(v.validity, h, seed_u32)
+
+
+class Murmur3Hash(Expression):
+    """hash(c1, c2, ...) -> IntegerType, seed 42."""
+    sql_name = "Murmur3Hash"
+
+    def __init__(self, *children: Expression, seed: int = DEFAULT_SEED):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_new_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        xp = ctx.xp
+        h = xp.full(ctx.capacity, np.uint32(self.seed), dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            for v in vals:
+                h = murmur3_val(v, h, ctx)
+        return ctx.canonical(h.astype(np.int32), ctx.row_mask,
+                             T.IntegerType())

@@ -1,0 +1,347 @@
+"""Math expressions (reference mathExpressions.scala, 361 LoC).
+
+Spark semantics: unary math fns take/return double; ``log``-family returns
+NULL for non-positive input (non-ANSI); ``floor``/``ceil`` on double return
+LongType; ``round`` is HALF_UP (not banker's rounding).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Literal
+
+__all__ = ["Sqrt", "Exp", "Log", "Log10", "Log2", "Log1p", "Expm1", "Pow",
+           "Floor", "Ceil", "Round", "Signum", "Sin", "Cos", "Tan", "Asin",
+           "Acos", "Atan", "Atan2", "Sinh", "Cosh", "Tanh", "ToDegrees",
+           "ToRadians", "Rint", "Cbrt"]
+
+
+class _UnaryDouble(Expression):
+    """double -> double elementwise fn."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        if not isinstance(c.dtype, T.DoubleType):
+            return type(self)(Cast(c, T.DoubleType()))
+        return self
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        return ctx.canonical(self._fn(a.data, ctx.xp), a.validity,
+                             T.DoubleType())
+
+
+class Sqrt(_UnaryDouble):
+    sql_name = "Sqrt"
+
+    def _fn(self, d, xp):
+        with np.errstate(invalid="ignore"):
+            return xp.sqrt(d)  # negative -> NaN (Java Math.sqrt)
+
+
+class Exp(_UnaryDouble):
+    sql_name = "Exp"
+
+    def _fn(self, d, xp):
+        return xp.exp(d)
+
+
+class Expm1(_UnaryDouble):
+    sql_name = "Expm1"
+
+    def _fn(self, d, xp):
+        return xp.expm1(d)
+
+
+class _LogLike(_UnaryDouble):
+    """NULL for input <= 0 (Spark non-ANSI log)."""
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        ok = a.data > 0
+        validity = a.validity & ok
+        safe = xp.where(ok, a.data, xp.ones((), a.data.dtype))
+        out = self._fn(safe, xp)
+        # log(+inf) = +inf (TPU's emulated f64 log yields NaN on inf)
+        out = xp.where(xp.isinf(safe), safe, out)
+        return ctx.canonical(out, validity, T.DoubleType())
+
+
+class Log(_LogLike):
+    sql_name = "Log"
+
+    def _fn(self, d, xp):
+        return xp.log(d)
+
+
+class Log10(_LogLike):
+    sql_name = "Log10"
+
+    def _fn(self, d, xp):
+        return xp.log10(d)
+
+
+class Log2(_LogLike):
+    sql_name = "Log2"
+
+    def _fn(self, d, xp):
+        return xp.log2(d)
+
+
+class Log1p(_UnaryDouble):
+    """NULL for input <= -1."""
+    sql_name = "Log1p"
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        ok = a.data > -1
+        validity = a.validity & ok
+        safe = xp.where(ok, a.data, xp.zeros((), a.data.dtype))
+        return ctx.canonical(xp.log1p(safe), validity, T.DoubleType())
+
+
+class Pow(Expression):
+    sql_name = "Pow"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        kids = [c if isinstance(c.dtype, T.DoubleType)
+                else Cast(c, T.DoubleType()) for c in self.children]
+        return Pow(*kids)
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        with np.errstate(invalid="ignore"):
+            data = ctx.xp.power(a.data, b.data)
+        return ctx.canonical(data, validity, T.DoubleType())
+
+
+class Atan2(Expression):
+    sql_name = "Atan2"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        kids = [c if isinstance(c.dtype, T.DoubleType)
+                else Cast(c, T.DoubleType()) for c in self.children]
+        return Atan2(*kids)
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        return ctx.canonical(ctx.xp.arctan2(a.data, b.data), validity,
+                             T.DoubleType())
+
+
+class _FloorCeil(Expression):
+    """floor/ceil: LongType result for double input; identity for integral."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        if isinstance(c.dtype, T.FloatType):
+            return type(self)(Cast(c, T.DoubleType()))
+        return self
+
+    @property
+    def dtype(self):
+        return T.LongType() if self.children[0].dtype.fractional \
+            else self.children[0].dtype
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if a.dtype.integral:
+            return a
+        data = self._fn(a.data, ctx.xp)
+        from spark_rapids_tpu.expr.cast import Cast as _C
+        data = _C._float_to_int(ctx.xp, data, T.LongType())
+        return ctx.canonical(data, a.validity, T.LongType())
+
+
+class Floor(_FloorCeil):
+    sql_name = "Floor"
+
+    def _fn(self, d, xp):
+        return xp.floor(d)
+
+
+class Ceil(_FloorCeil):
+    sql_name = "Ceil"
+
+    def _fn(self, d, xp):
+        return xp.ceil(d)
+
+
+class Round(Expression):
+    """round(x, scale): HALF_UP (Spark), scale must be a literal int."""
+    sql_name = "Round"
+
+    def __init__(self, child: Expression, scale: Expression | int = 0):
+        if not isinstance(scale, Expression):
+            scale = Literal(int(scale), T.IntegerType())
+        self.children = (child, scale)
+
+    @property
+    def scale(self) -> int:
+        s = self.children[1]
+        assert isinstance(s, Literal), "round scale must be literal"
+        return int(s.value)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        s = self.scale
+        if a.dtype.integral:
+            if s >= 0:
+                return a
+            p = 10 ** (-s)
+            half = p // 2
+            sign = xp.where(a.data < 0, -1, 1).astype(a.data.dtype)
+            mag = xp.abs(a.data)
+            data = ((mag + half) // p * p * sign).astype(a.data.dtype)
+            return ctx.canonical(data, a.validity, a.dtype)
+        p = np.float64(10.0 ** s)
+        mag = xp.abs(a.data)
+        # beyond 2^53 (2^24 for f32) there is no fractional part: identity.
+        # Also keeps mag*p inside the representable range (TPU f64 emulation
+        # overflows earlier than native f64).
+        exact = np.float64(2.0 ** 53) if a.data.dtype.itemsize == 8 \
+            else np.float64(2.0 ** 24)
+        safe_mag = xp.where(mag >= exact, xp.zeros((), a.data.dtype), mag)
+        r = xp.floor(safe_mag * p + 0.5) / p
+        data = (xp.where(a.data < 0, -r, r)).astype(a.data.dtype)
+        data = xp.where(xp.isnan(a.data) | xp.isinf(a.data) | (mag >= exact),
+                        a.data, data)
+        return ctx.canonical(data, a.validity, a.dtype)
+
+
+class Signum(_UnaryDouble):
+    sql_name = "Signum"
+
+    def _fn(self, d, xp):
+        return xp.sign(d)
+
+
+class Sin(_UnaryDouble):
+    sql_name = "Sin"
+
+    def _fn(self, d, xp):
+        return xp.sin(d)
+
+
+class Cos(_UnaryDouble):
+    sql_name = "Cos"
+
+    def _fn(self, d, xp):
+        return xp.cos(d)
+
+
+class Tan(_UnaryDouble):
+    sql_name = "Tan"
+
+    def _fn(self, d, xp):
+        return xp.tan(d)
+
+
+class Asin(_UnaryDouble):
+    sql_name = "Asin"
+
+    def _fn(self, d, xp):
+        with np.errstate(invalid="ignore"):
+            return xp.arcsin(d)
+
+
+class Acos(_UnaryDouble):
+    sql_name = "Acos"
+
+    def _fn(self, d, xp):
+        with np.errstate(invalid="ignore"):
+            return xp.arccos(d)
+
+
+class Atan(_UnaryDouble):
+    sql_name = "Atan"
+
+    def _fn(self, d, xp):
+        return xp.arctan(d)
+
+
+class Sinh(_UnaryDouble):
+    sql_name = "Sinh"
+
+    def _fn(self, d, xp):
+        return xp.sinh(d)
+
+
+class Cosh(_UnaryDouble):
+    sql_name = "Cosh"
+
+    def _fn(self, d, xp):
+        return xp.cosh(d)
+
+
+class Tanh(_UnaryDouble):
+    sql_name = "Tanh"
+
+    def _fn(self, d, xp):
+        return xp.tanh(d)
+
+
+class ToDegrees(_UnaryDouble):
+    sql_name = "ToDegrees"
+
+    def _fn(self, d, xp):
+        return xp.degrees(d)
+
+
+class ToRadians(_UnaryDouble):
+    sql_name = "ToRadians"
+
+    def _fn(self, d, xp):
+        return xp.radians(d)
+
+
+class Rint(_UnaryDouble):
+    sql_name = "Rint"
+
+    def _fn(self, d, xp):
+        return xp.round(d)  # half-even, like Java Math.rint
+
+
+class Cbrt(_UnaryDouble):
+    sql_name = "Cbrt"
+
+    def _fn(self, d, xp):
+        return xp.cbrt(d)

@@ -1,0 +1,306 @@
+"""Predicates: comparisons, boolean logic, null tests, IN.
+
+Reference: predicates.scala (629 LoC), nullExpressions.scala.
+
+Spark float semantics (docs/compatibility.md in the reference; Spark NaN
+semantics): NaN = NaN is TRUE, NaN is larger than any other value, and
+-0.0 == 0.0.  Three-valued logic for AND/OR.  String comparisons are
+byte-lexicographic (UTF-8 order == code-point order).
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, Val, EvalCtx, Literal
+from spark_rapids_tpu.expr.arithmetic import coerce_pair
+
+__all__ = ["EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
+           "GreaterThan", "GreaterThanOrEqual", "And", "Or", "Not",
+           "IsNull", "IsNotNull", "IsNan", "In"]
+
+
+# -- shared comparison kernels (Spark total order for floats) ---------------
+
+def compare_eq(a: Val, b: Val, ctx: EvalCtx):
+    xp = ctx.xp
+    if a.is_string:
+        return _string_eq(a, b, ctx)
+    if a.dtype.fractional:
+        return (a.data == b.data) | (xp.isnan(a.data) & xp.isnan(b.data))
+    return a.data == b.data
+
+
+def compare_lt(a: Val, b: Val, ctx: EvalCtx):
+    xp = ctx.xp
+    if a.is_string:
+        return _string_lt(a, b, ctx)
+    if a.dtype.fractional:
+        # NaN is the largest value: a < b iff (a<b) or (b is NaN and a isn't)
+        return (a.data < b.data) | (xp.isnan(b.data) & ~xp.isnan(a.data))
+    return a.data < b.data
+
+
+def _string_pair_device(a: Val, b: Val, ctx: EvalCtx):
+    """Pad both byte matrices to a common width."""
+    xp = ctx.xp
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    w = max(wa, wb)
+    da = xp.pad(a.data, ((0, 0), (0, w - wa))) if wa < w else a.data
+    db = xp.pad(b.data, ((0, 0), (0, w - wb))) if wb < w else b.data
+    return da, db
+
+
+def _string_eq(a: Val, b: Val, ctx: EvalCtx):
+    if not ctx.is_device:
+        import numpy as np
+        return np.array([x == y for x, y in zip(a.data, b.data)], dtype=bool)
+    xp = ctx.xp
+    da, db = _string_pair_device(a, b, ctx)
+    return xp.all(da == db, axis=1) & (a.lengths == b.lengths)
+
+
+def _string_lt(a: Val, b: Val, ctx: EvalCtx):
+    if not ctx.is_device:
+        import numpy as np
+        return np.array([(x or "") < (y or "") for x, y in zip(a.data, b.data)],
+                        dtype=bool)
+    xp = ctx.xp
+    da, db = _string_pair_device(a, b, ctx)
+    # first differing byte decides; zero padding makes prefixes sort first.
+    # Identical byte matrices fall back to a length compare so strings with
+    # trailing NUL bytes (indistinguishable from padding) still order as
+    # prefix < longer, matching the host oracle.
+    diff = da != db
+    has_diff = xp.any(diff, axis=1)
+    first = xp.argmax(diff, axis=1)
+    ab = xp.take_along_axis(da, first[:, None], axis=1)[:, 0]
+    bb = xp.take_along_axis(db, first[:, None], axis=1)[:, 0]
+    return xp.where(has_diff, ab < bb, a.lengths < b.lengths)
+
+
+class BinaryComparison(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def coerced(self):
+        l, r = coerce_pair(*self.children)
+        return type(self)(l, r)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a, b = vals
+        validity = a.validity & b.validity
+        return ctx.canonical(self._cmp(a, b, ctx), validity, T.BooleanType())
+
+
+class EqualTo(BinaryComparison):
+    sql_name = "EqualTo"
+
+    def _cmp(self, a, b, ctx):
+        return compare_eq(a, b, ctx)
+
+
+class LessThan(BinaryComparison):
+    sql_name = "LessThan"
+
+    def _cmp(self, a, b, ctx):
+        return compare_lt(a, b, ctx)
+
+
+class GreaterThan(BinaryComparison):
+    sql_name = "GreaterThan"
+
+    def _cmp(self, a, b, ctx):
+        return compare_lt(b, a, ctx)
+
+
+class LessThanOrEqual(BinaryComparison):
+    sql_name = "LessThanOrEqual"
+
+    def _cmp(self, a, b, ctx):
+        return compare_lt(a, b, ctx) | compare_eq(a, b, ctx)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    sql_name = "GreaterThanOrEqual"
+
+    def _cmp(self, a, b, ctx):
+        return compare_lt(b, a, ctx) | compare_eq(a, b, ctx)
+
+
+class EqualNullSafe(BinaryComparison):
+    sql_name = "EqualNullSafe"
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a, b = vals
+        both_valid = a.validity & b.validity
+        both_null = ~a.validity & ~b.validity & ctx.row_mask
+        data = (both_valid & compare_eq(a, b, ctx)) | both_null
+        return ctx.canonical(data, ctx.row_mask, T.BooleanType())
+
+
+class And(Expression):
+    """Three-valued AND: F & x = F; T & NULL = NULL."""
+    sql_name = "And"
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        data = a.data & b.data
+        validity = (a.validity & b.validity) | (a.validity & ~a.data) | \
+            (b.validity & ~b.data)
+        return ctx.canonical(data, validity, T.BooleanType())
+
+
+class Or(Expression):
+    """Three-valued OR: T | x = T; F | NULL = NULL."""
+    sql_name = "Or"
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        data = a.data | b.data
+        validity = (a.validity & b.validity) | (a.validity & a.data) | \
+            (b.validity & b.data)
+        return ctx.canonical(data, validity, T.BooleanType())
+
+
+class Not(Expression):
+    sql_name = "Not"
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        return ctx.canonical(~a.data, a.validity, T.BooleanType())
+
+
+class IsNull(Expression):
+    sql_name = "IsNull"
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        return ctx.canonical(~a.validity & ctx.row_mask, ctx.row_mask,
+                             T.BooleanType())
+
+
+class IsNotNull(Expression):
+    sql_name = "IsNotNull"
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        return ctx.canonical(a.validity & ctx.row_mask, ctx.row_mask,
+                             T.BooleanType())
+
+
+class IsNan(Expression):
+    """Spark IsNaN: false for null input (not null)."""
+    sql_name = "IsNaN"
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if not a.dtype.fractional:
+            return ctx.const(False, T.BooleanType())
+        data = ctx.xp.isnan(a.data) & a.validity
+        return ctx.canonical(data, ctx.row_mask, T.BooleanType())
+
+
+class In(Expression):
+    """Spark In: NULL if the value is null, or if there is no match and the
+    list contains a null.  Children: (value, item0, item1, ...)."""
+    sql_name = "In"
+
+    def __init__(self, value: Expression, items: list[Expression]):
+        self.children = (value,) + tuple(items)
+
+    def with_new_children(self, children):
+        return In(children[0], list(children[1:]))
+
+    def coerced(self):
+        # Spark promotes the value AND the list to a common wider type —
+        # narrowing the items instead would wrap and create false matches
+        from spark_rapids_tpu.expr.cast import Cast
+        target = self.children[0].dtype
+        for i in self.children[1:]:
+            it = i.dtype
+            if isinstance(it, T.NullType) or it == target:
+                continue
+            if it.numeric and target.numeric:
+                target = T.numeric_promote(target, it)
+            else:
+                raise TypeError(f"IN: cannot compare {target} with {it}")
+        kids = [c if c.dtype == target or isinstance(c.dtype, T.NullType)
+                else Cast(c, target) for c in self.children]
+        return In(kids[0], kids[1:])
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        matched = xp.zeros(ctx.capacity, dtype=bool)
+        any_null_item = xp.zeros(ctx.capacity, dtype=bool)
+        for iv in vals[1:]:
+            matched = matched | (compare_eq(a, iv, ctx) & iv.validity
+                                 & a.validity)
+            any_null_item = any_null_item | ~iv.validity
+        validity = a.validity & ctx.row_mask & (matched | ~any_null_item)
+        return ctx.canonical(matched, validity, T.BooleanType())

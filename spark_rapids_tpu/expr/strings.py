@@ -1,0 +1,451 @@
+"""String expressions (reference stringFunctions.scala, 898 LoC).
+
+Device representation is a padded uint8 byte matrix + lengths (see
+columnar/column.py).  Kernels are dense VPU-friendly ops:
+
+* Length / Substring are UTF-8 *character* correct (continuation-byte
+  masks + cumulative character counts) matching Spark;
+* Upper/Lower are ASCII-only on device (flagged incompat in the planner,
+  like the reference's incompat string ops);
+* Like supports the prefix/suffix/contains patterns on device; general
+  patterns are host-only (the reference likewise gates regex behind shims,
+  Spark300Shims.scala:235).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val, Literal
+from spark_rapids_tpu.expr.predicates import _string_pair_device
+
+__all__ = ["Upper", "Lower", "Length", "Substring", "Concat", "StartsWith",
+           "EndsWith", "Contains", "Like", "StringTrim", "StringTrimLeft",
+           "StringTrimRight", "StringReplace"]
+
+
+def _char_starts(data, lengths, xp):
+    """bool[n,w]: byte j is the start of a character and inside the string."""
+    w = data.shape[1]
+    in_range = xp.arange(w, dtype=np.int32)[None, :] < lengths[:, None]
+    return ((data & 0xC0) != 0x80) & in_range
+
+
+class _StringUnary(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if not ctx.is_device:
+            out = np.empty(ctx.capacity, dtype=object)
+            for i in range(ctx.capacity):
+                out[i] = self._host_one(a.data[i]) if a.validity[i] else None
+            return Val(out, a.validity, None, T.StringType())
+        data, lengths = self._device(a, ctx)
+        return ctx.canonical(data, a.validity, T.StringType(), lengths)
+
+
+class Upper(_StringUnary):
+    sql_name = "Upper"
+    #: ASCII-only on device (host oracle is full unicode) — incompat
+    incompat = True
+
+    def _host_one(self, s):
+        return s.upper()
+
+    def _device(self, a, ctx):
+        xp = ctx.xp
+        is_lower = (a.data >= ord("a")) & (a.data <= ord("z"))
+        return xp.where(is_lower, a.data - 32, a.data), a.lengths
+
+
+class Lower(_StringUnary):
+    sql_name = "Lower"
+    incompat = True
+
+    def _host_one(self, s):
+        return s.lower()
+
+    def _device(self, a, ctx):
+        xp = ctx.xp
+        is_upper = (a.data >= ord("A")) & (a.data <= ord("Z"))
+        return xp.where(is_upper, a.data + 32, a.data), a.lengths
+
+
+class _TrimBase(_StringUnary):
+    _left = True
+    _right = True
+
+    def _host_one(self, s):
+        if self._left and self._right:
+            return s.strip(" ")
+        return s.lstrip(" ") if self._left else s.rstrip(" ")
+
+    def _device(self, a, ctx):
+        xp = ctx.xp
+        w = a.data.shape[1]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        in_range = j < a.lengths[:, None]
+        nonspace = (a.data != 32) & in_range
+        any_ns = xp.any(nonspace, axis=1)
+        first = xp.where(any_ns, xp.argmax(nonspace, axis=1), 0) \
+            if self._left else xp.zeros_like(a.lengths)
+        last_rev = xp.argmax(nonspace[:, ::-1], axis=1)
+        last = xp.where(any_ns, w - 1 - last_rev, -1) \
+            if self._right else a.lengths - 1
+        new_len = xp.where(any_ns, xp.maximum(last - first + 1, 0), 0)
+        new_len = new_len.astype(np.int32)
+        idx = first[:, None] + xp.arange(w, dtype=np.int32)[None, :]
+        idx = xp.clip(idx, 0, w - 1)
+        shifted = xp.take_along_axis(a.data, idx, axis=1)
+        keep = xp.arange(w, dtype=np.int32)[None, :] < new_len[:, None]
+        return xp.where(keep, shifted, 0), new_len
+
+
+class StringTrim(_TrimBase):
+    sql_name = "StringTrim"
+
+
+class StringTrimLeft(_TrimBase):
+    sql_name = "StringTrimLeft"
+    _right = False
+
+
+class StringTrimRight(_TrimBase):
+    sql_name = "StringTrimRight"
+    _left = False
+
+
+class Length(Expression):
+    """Character count (Spark length), IntegerType."""
+    sql_name = "Length"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if not ctx.is_device:
+            data = np.array([len(s) if v else 0
+                             for s, v in zip(a.data, a.validity)], np.int32)
+            return ctx.canonical(data, a.validity, T.IntegerType())
+        starts = _char_starts(a.data, a.lengths, ctx.xp)
+        data = ctx.xp.sum(starts, axis=1).astype(np.int32)
+        return ctx.canonical(data, a.validity, T.IntegerType())
+
+
+class Substring(Expression):
+    """Spark substring(str, pos, len): 1-based, pos<=0 counts 0/from-end,
+    character-indexed; out-of-range yields '' (not null)."""
+    sql_name = "Substring"
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.children = (child, pos, length)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    def _eval(self, vals, ctx):
+        a, pos, length = vals
+        if not ctx.is_device:
+            out = np.empty(ctx.capacity, dtype=object)
+            validity = a.validity & pos.validity & length.validity
+            for i in range(ctx.capacity):
+                if not validity[i]:
+                    out[i] = None
+                    continue
+                out[i] = _substr_host(a.data[i], int(pos.data[i]),
+                                      int(length.data[i]))
+            return Val(out, validity, None, T.StringType())
+        return self._device(a, pos, length, ctx)
+
+    def _device(self, a, pos, length, ctx):
+        xp = ctx.xp
+        w = a.data.shape[1]
+        validity = a.validity & pos.validity & length.validity
+        starts = _char_starts(a.data, a.lengths, xp)
+        nchars = xp.sum(starts, axis=1).astype(np.int32)
+        p = pos.data.astype(np.int32)
+        ln = xp.maximum(length.data.astype(np.int32), 0)
+        # resolve 1-based / negative positions to 0-based char index
+        start_char = xp.where(p > 0, p - 1, xp.where(p < 0, nchars + p, 0))
+        neg_clip = xp.where(p < 0, xp.maximum(ln + xp.minimum(nchars + p, 0), 0), ln)
+        start_char = xp.clip(start_char, 0, nchars)
+        end_char = xp.clip(start_char + neg_clip, 0, nchars)
+        # byte offset of char k: position of the (k+1)-th start; k==nchars -> len
+        cs = xp.cumsum(starts.astype(np.int32), axis=1)
+        def byte_of(k):
+            hit = (cs == (k + 1)[:, None]) & starts
+            found = xp.any(hit, axis=1)
+            return xp.where(found, xp.argmax(hit, axis=1).astype(np.int32),
+                            a.lengths)
+        sb = byte_of(start_char)
+        eb = byte_of(end_char)
+        new_len = xp.maximum(eb - sb, 0).astype(np.int32)
+        idx = xp.clip(sb[:, None] + xp.arange(w, dtype=np.int32)[None, :],
+                      0, w - 1)
+        shifted = xp.take_along_axis(a.data, idx, axis=1)
+        keep = xp.arange(w, dtype=np.int32)[None, :] < new_len[:, None]
+        data = xp.where(keep, shifted, 0)
+        return ctx.canonical(data, validity, T.StringType(), new_len)
+
+
+def _substr_host(s: str, pos: int, ln: int) -> str:
+    if ln <= 0:
+        return ""
+    n = len(s)
+    if pos > 0:
+        start = pos - 1
+    elif pos < 0:
+        start = n + pos
+    else:
+        start = 0
+    end = start + ln
+    if start < 0:
+        start = 0
+    return s[start:end] if start < n else ""
+
+
+class Concat(Expression):
+    """concat(s1, s2, ...): null if any input null (Spark concat)."""
+    sql_name = "Concat"
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_new_children(self, children):
+        return Concat(*children)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    def _eval(self, vals, ctx):
+        xp = ctx.xp
+        validity = vals[0].validity
+        for v in vals[1:]:
+            validity = validity & v.validity
+        if not ctx.is_device:
+            out = np.empty(ctx.capacity, dtype=object)
+            for i in range(ctx.capacity):
+                out[i] = "".join(v.data[i] for v in vals) if validity[i] else None
+            return Val(out, validity, None, T.StringType())
+        acc = vals[0]
+        data, lengths = acc.data, acc.lengths
+        for v in vals[1:]:
+            data, lengths = _concat2_device(data, lengths, v.data, v.lengths, xp)
+        return ctx.canonical(data, validity, T.StringType(), lengths)
+
+
+def _concat2_device(da, la, db, lb, xp):
+    from spark_rapids_tpu.columnar.column import round_string_width
+    wa, wb = da.shape[1], db.shape[1]
+    w = round_string_width(wa + wb)
+    n = da.shape[0]
+    j = xp.arange(w, dtype=np.int32)[None, :]
+    from_a = j < la[:, None]
+    ia = xp.broadcast_to(xp.clip(j, 0, wa - 1), (n, w))
+    ib = xp.clip(j - la[:, None], 0, wb - 1)
+    av = xp.take_along_axis(da, ia, axis=1)
+    bv = xp.take_along_axis(db, ib, axis=1)
+    new_len = la + lb
+    keep = j < new_len[:, None]
+    return xp.where(keep, xp.where(from_a, av, bv), 0), new_len
+
+
+class _StringPredicate(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        validity = a.validity & b.validity
+        if not ctx.is_device:
+            data = np.array([self._host_one(x, y) if va and vb else False
+                             for x, y, va, vb in
+                             zip(a.data, b.data, a.validity, b.validity)], bool)
+            return ctx.canonical(data, validity, T.BooleanType())
+        return ctx.canonical(self._device(a, b, ctx), validity,
+                             T.BooleanType())
+
+
+class StartsWith(_StringPredicate):
+    sql_name = "StartsWith"
+
+    def _host_one(self, x, y):
+        return x.startswith(y)
+
+    def _device(self, a, b, ctx):
+        xp = ctx.xp
+        da, db = _string_pair_device(a, b, ctx)
+        w = da.shape[1]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        within = j < b.lengths[:, None]
+        match = xp.all(~within | (da == db), axis=1)
+        return match & (a.lengths >= b.lengths)
+
+
+class EndsWith(_StringPredicate):
+    sql_name = "EndsWith"
+
+    def _host_one(self, x, y):
+        return x.endswith(y)
+
+    def _device(self, a, b, ctx):
+        xp = ctx.xp
+        w = max(a.data.shape[1], b.data.shape[1])
+        da, db = _string_pair_device(a, b, ctx)
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        shift = (a.lengths - b.lengths)[:, None]
+        idx = xp.clip(j + shift, 0, w - 1)
+        tail = xp.take_along_axis(da, idx, axis=1)
+        within = j < b.lengths[:, None]
+        match = xp.all(~within | (tail == db), axis=1)
+        return match & (a.lengths >= b.lengths)
+
+
+class Contains(_StringPredicate):
+    sql_name = "Contains"
+
+    def _host_one(self, x, y):
+        return y in x
+
+    def _device(self, a, b, ctx):
+        xp = ctx.xp
+        da, db = _string_pair_device(a, b, ctx)
+        w = da.shape[1]
+        n = da.shape[0]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        within = j < b.lengths[:, None]
+        found = xp.zeros(n, dtype=bool)
+        # slide the needle over every start offset (static unroll over width;
+        # VPU-dense compare per shift)
+        for s in range(w):
+            idx = xp.clip(j + s, 0, w - 1)
+            win = xp.take_along_axis(da, idx, axis=1)
+            m = xp.all(~within | (win == db), axis=1)
+            found = found | (m & (s + b.lengths <= a.lengths))
+        return found
+
+
+class Like(Expression):
+    """SQL LIKE. Device path handles the common shapes
+    (%x, x%, %x%, exact); general patterns are host-only."""
+    sql_name = "Like"
+
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        self.children = (child,)
+        self.pattern = pattern
+        self.escape = escape
+
+    def with_new_children(self, children):
+        return Like(children[0], self.pattern, self.escape)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    @property
+    def device_supported(self):
+        return self._simple_shape() is not None
+
+    def _simple_shape(self):
+        """(kind, needle) for %-only patterns without _ or escapes."""
+        p = self.pattern
+        if "_" in p or self.escape in p:
+            return None
+        body = p.strip("%")
+        if "%" in body:
+            return None
+        if p.startswith("%") and p.endswith("%") and len(p) >= 2:
+            return ("contains", body)
+        if p.endswith("%"):
+            return ("prefix", body)
+        if p.startswith("%"):
+            return ("suffix", body)
+        return ("equals", body)
+
+    def _regex(self):
+        import re
+        out = []
+        i = 0
+        p = self.pattern
+        while i < len(p):
+            c = p[i]
+            if c == self.escape and i + 1 < len(p):
+                out.append(re.escape(p[i + 1]))
+                i += 2
+                continue
+            if c == "%":
+                out.append(".*")
+            elif c == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(c))
+            i += 1
+        return re.compile("(?s)^" + "".join(out) + "$")
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if not ctx.is_device:
+            rx = self._regex()
+            data = np.array([bool(rx.match(s)) if v else False
+                             for s, v in zip(a.data, a.validity)], bool)
+            return ctx.canonical(data, a.validity, T.BooleanType())
+        shape = self._simple_shape()
+        if shape is None:
+            raise NotImplementedError("general LIKE is host-only")
+        kind, needle = shape
+        nv = ctx.const(needle, T.StringType())
+        cls = {"contains": Contains, "prefix": StartsWith,
+               "suffix": EndsWith}.get(kind)
+        if cls is None:  # equals
+            from spark_rapids_tpu.expr.predicates import _string_eq
+            data = _string_eq(a, nv, ctx)
+        else:
+            data = cls(None, None)._device(a, nv, ctx)
+        return ctx.canonical(data, a.validity, T.BooleanType())
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search — host-only for
+    now (device literal replace lands with the breadth pass)."""
+    sql_name = "StringReplace"
+
+    def __init__(self, child: Expression, search: Expression,
+                 replace: Expression):
+        self.children = (child, search, replace)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        a, s, r = vals
+        validity = a.validity & s.validity & r.validity
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            if validity[i]:
+                out[i] = a.data[i].replace(s.data[i], r.data[i]) \
+                    if s.data[i] else a.data[i]
+            else:
+                out[i] = None
+        return Val(out, validity, None, T.StringType())

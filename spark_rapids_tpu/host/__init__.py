@@ -1,0 +1,3 @@
+from spark_rapids_tpu.host.batch import HostColumn, HostBatch
+
+__all__ = ["HostColumn", "HostBatch"]

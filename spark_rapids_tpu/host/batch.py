@@ -1,0 +1,234 @@
+"""Host-side columnar batch: the CPU engine's data representation.
+
+This plays two roles, mirroring the reference architecture:
+
+* the CPU *oracle* engine operates on these (the reference uses CPU Spark
+  itself as the differential-test oracle,
+  tests/SparkQueryCompareTestSuite.scala:153-167 — here the CPU engine is
+  part of the framework, since we are standalone);
+* the host staging format for device transfer (reference
+  RapidsHostColumnVector.java, HostColumnarToGpu.scala).
+
+Representation: numpy ``data`` + bool ``validity`` per column.  Strings use
+numpy ``object`` arrays of ``str`` (exact semantics beat packing on the
+oracle path); dates are int32 days since epoch, timestamps int64 micros —
+the same physical encoding the device uses
+(:mod:`spark_rapids_tpu.columnar.column`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+__all__ = ["HostColumn", "HostBatch"]
+
+
+@dataclass(frozen=True)
+class HostColumn:
+    """One host column. ``data`` entries at invalid slots are unspecified
+    (kept zeroed / None by constructors for determinism)."""
+
+    data: np.ndarray        # object ndarray for strings, else typed ndarray
+    validity: np.ndarray    # bool ndarray, same length
+    dtype: T.DataType
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+    @staticmethod
+    def from_values(values: Sequence, dtype: T.DataType) -> "HostColumn":
+        """Build from a python sequence; ``None`` entries become nulls.
+        date/datetime values convert to days/micros since epoch."""
+        import datetime as _dt
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if isinstance(dtype, T.StringType):
+            data = np.array([v if v is not None else None for v in values],
+                            dtype=object)
+        else:
+            npdt = dtype.np_dtype
+            data = np.zeros(n, dtype=npdt)
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                if isinstance(v, _dt.datetime):
+                    if v.tzinfo is None:
+                        v = v.replace(tzinfo=_dt.timezone.utc)
+                    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                    v = round((v - epoch).total_seconds() * 1e6)
+                elif isinstance(v, _dt.date):
+                    v = (v - _dt.date(1970, 1, 1)).days
+                data[i] = v
+        return HostColumn(data, validity, dtype)
+
+    @staticmethod
+    def from_numpy(data: np.ndarray, validity: np.ndarray | None,
+                   dtype: T.DataType) -> "HostColumn":
+        if validity is None:
+            validity = np.ones(len(data), dtype=np.bool_)
+        return HostColumn(data, validity, dtype)
+
+    def to_list(self) -> list:
+        """Python values with None for nulls (test/collect surface);
+        date/timestamp come back as datetime.date / datetime.datetime."""
+        import datetime as _dt
+        is_date = isinstance(self.dtype, T.DateType)
+        is_ts = isinstance(self.dtype, T.TimestampType)
+        out = []
+        for i in range(len(self.data)):
+            if not self.validity[i]:
+                out.append(None)
+            elif self.is_string:
+                out.append(self.data[i])
+            elif is_date:
+                out.append(_dt.date(1970, 1, 1)
+                           + _dt.timedelta(days=int(self.data[i])))
+            elif is_ts:
+                out.append(_dt.datetime(1970, 1, 1)
+                           + _dt.timedelta(microseconds=int(self.data[i])))
+            else:
+                out.append(self.data[i].item())
+        return out
+
+    def take(self, indices: np.ndarray) -> "HostColumn":
+        return HostColumn(self.data[indices], self.validity[indices], self.dtype)
+
+    def filter(self, mask: np.ndarray) -> "HostColumn":
+        return HostColumn(self.data[mask], self.validity[mask], self.dtype)
+
+
+class HostBatch:
+    """A host columnar batch with a schema."""
+
+    __slots__ = ("columns", "schema")
+
+    def __init__(self, columns: Sequence[HostColumn], schema: T.Schema):
+        self.columns = tuple(columns)
+        self.schema = schema
+        if columns:
+            n = len(columns[0])
+            assert all(len(c) == n for c in columns), "ragged batch"
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> HostColumn:
+        return self.columns[i]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_pydict(data: dict, schema: T.Schema) -> "HostBatch":
+        cols = [HostColumn.from_values(data[f.name], f.data_type)
+                for f in schema]
+        return HostBatch(cols, schema)
+
+    def to_pydict(self) -> dict:
+        return {f.name: c.to_list()
+                for f, c in zip(self.schema, self.columns)}
+
+    def to_rows(self) -> list[tuple]:
+        cols = [c.to_list() for c in self.columns]
+        return list(zip(*cols)) if cols else []
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_arrow(rb) -> "HostBatch":
+        import pyarrow as pa
+        schema = T.Schema.from_arrow(rb.schema)
+        n = rb.num_rows
+        cols = []
+        for i, field in enumerate(schema):
+            arr = rb.column(i)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            if arr.null_count == 0:
+                validity = np.ones(n, dtype=np.bool_)
+            else:
+                validity = np.asarray(arr.is_valid(), dtype=np.bool_)
+            dt = field.data_type
+            if isinstance(dt, T.StringType):
+                data = np.array(arr.to_pylist(), dtype=object)
+            else:
+                data = T.arrow_fixed_to_numpy(arr, dt)
+            cols.append(HostColumn(data, validity, dt))
+        return HostBatch(cols, schema)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        arrays = []
+        for f, c in zip(self.schema, self.columns):
+            mask = ~c.validity
+            at = T.to_arrow(f.data_type)
+            if c.is_string:
+                py = [None if m else v for v, m in zip(c.data, mask)]
+                arrays.append(pa.array(py, type=pa.string()))
+            elif isinstance(f.data_type, (T.DateType, T.TimestampType)):
+                base = pa.array(c.data, mask=mask)
+                arrays.append(base.cast(at))
+            else:
+                arrays.append(pa.array(c.data, type=at, mask=mask))
+        return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
+
+    # ------------------------------------------------------------------
+    def to_device(self, capacity: int | None = None,
+                  string_widths: dict | None = None):
+        """H2D: build a ColumnBatch (via Arrow staging)."""
+        from spark_rapids_tpu.columnar.batch import ColumnBatch
+        return ColumnBatch.from_arrow(self.to_arrow(), capacity=capacity,
+                                      string_widths=string_widths)
+
+    @staticmethod
+    def from_device(batch) -> "HostBatch":
+        """D2H: materialize a ColumnBatch on host."""
+        return HostBatch.from_arrow(batch.to_arrow())
+
+    # ------------------------------------------------------------------
+    def take(self, indices: np.ndarray) -> "HostBatch":
+        return HostBatch([c.take(indices) for c in self.columns], self.schema)
+
+    def filter(self, mask: np.ndarray) -> "HostBatch":
+        return HostBatch([c.filter(mask) for c in self.columns], self.schema)
+
+    def slice(self, start: int, length: int) -> "HostBatch":
+        idx = np.arange(start, min(start + length, self.num_rows))
+        return self.take(idx)
+
+    @staticmethod
+    def concat(batches: Sequence["HostBatch"]) -> "HostBatch":
+        assert batches
+        schema = batches[0].schema
+        cols = []
+        for ci in range(batches[0].num_columns):
+            parts = [b.columns[ci] for b in batches]
+            if parts[0].is_string:
+                data = np.concatenate([p.data for p in parts]) if parts else \
+                    np.zeros(0, object)
+            else:
+                data = np.concatenate([p.data for p in parts])
+            validity = np.concatenate([p.validity for p in parts])
+            cols.append(HostColumn(data, validity, parts[0].dtype))
+        return HostBatch(cols, schema)
+
+    @staticmethod
+    def empty(schema: T.Schema) -> "HostBatch":
+        cols = []
+        for f in schema:
+            if isinstance(f.data_type, T.StringType):
+                data = np.zeros(0, dtype=object)
+            else:
+                data = np.zeros(0, dtype=f.data_type.np_dtype)
+            cols.append(HostColumn(data, np.zeros(0, np.bool_), f.data_type))
+        return HostBatch(cols, schema)

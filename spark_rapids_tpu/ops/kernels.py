@@ -67,18 +67,8 @@ def take(batch: ColumnBatch, indices: jax.Array,
 def slice_batch(batch: ColumnBatch, limit: jax.Array) -> ColumnBatch:
     """Keep the first ``limit`` rows (GpuLocalLimit, limit.scala)."""
     new_count = jnp.minimum(batch.num_rows, jnp.asarray(limit, jnp.int32))
-    mask = jnp.arange(batch.capacity, dtype=jnp.int32) < new_count
-    cols = []
-    for c in batch.columns:
-        validity = c.validity & mask
-        if c.is_string:
-            cols.append(DeviceColumn(jnp.where(validity[:, None], c.data, 0),
-                                     validity, c.dtype,
-                                     jnp.where(validity, c.lengths, 0)))
-        else:
-            cols.append(DeviceColumn(
-                jnp.where(validity, c.data, jnp.zeros((), c.data.dtype)),
-                validity, c.dtype))
+    identity = jnp.arange(batch.capacity, dtype=jnp.int32)
+    cols = gather_columns(batch.columns, identity, new_count)
     return ColumnBatch(cols, new_count, batch.schema)
 
 

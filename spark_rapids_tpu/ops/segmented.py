@@ -25,7 +25,8 @@ from spark_rapids_tpu.ops.sort import SortOrder, sort_batch, normalize_floats
 __all__ = ["AggSpec", "sorted_group_by"]
 
 # supported aggregate ops (reference AggregateFunctions.scala:531 CudfAggregate)
-_AGG_OPS = ("sum", "count", "count_star", "min", "max", "avg", "first", "last")
+_AGG_OPS = ("sum", "count", "count_star", "min", "max", "avg", "first", "last",
+            "first_non_null", "last_non_null")
 
 
 @dataclass(frozen=True)
@@ -210,18 +211,22 @@ def _compute_agg(spec: AggSpec, col: DeviceColumn | None, seg_id, real, cap,
         return DeviceColumn(jnp.where(validity, data, zero), validity,
                             col.dtype), col.dtype
 
-    if op in ("first", "last"):
-        # index of first/last row (any validity) per segment — Spark default
-        # first/last have ignoreNulls=false
+    if op in ("first", "last", "first_non_null", "last_non_null"):
+        # index of first/last row per segment; *_non_null picks among valid
+        # rows only (Spark first/last ignoreNulls=true), plain variants use
+        # row position regardless of validity (ignoreNulls=false default)
+        ignore_nulls = op.endswith("non_null")
+        eligible = contributes if ignore_nulls else real
         idx = jnp.arange(cap, dtype=jnp.int32)
-        if op == "first":
-            masked_idx = jnp.where(real, idx, cap)
+        if op.startswith("first"):
+            masked_idx = jnp.where(eligible, idx, cap)
             pick = jax.ops.segment_min(masked_idx, seg_id, num_segments=cap)
         else:
-            masked_idx = jnp.where(real, idx, -1)
+            masked_idx = jnp.where(eligible, idx, -1)
             pick = jax.ops.segment_max(masked_idx, seg_id, num_segments=cap)
         pick = jnp.clip(pick, 0, cap - 1)
-        validity = col.validity[pick] & out_mask & (seg_real_cnt > 0)
+        has_eligible = cnt_valid > 0 if ignore_nulls else seg_real_cnt > 0
+        validity = col.validity[pick] & out_mask & has_eligible
         if col.is_string:
             data = jnp.where(validity[:, None], col.data[pick], 0)
             return DeviceColumn(data, validity, col.dtype,

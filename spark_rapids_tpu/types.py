@@ -171,6 +171,23 @@ def from_arrow(at) -> DataType:
     raise TypeError(f"unsupported arrow type {at}")
 
 
+def arrow_fixed_to_numpy(arr, dt: DataType) -> "np.ndarray":
+    """Extract a fixed-width Arrow array as numpy in the framework's
+    physical encoding (date=int32 days, timestamp=int64 micros, nulls
+    zero-filled).  Shared by the host oracle batch and the device batch so
+    the two paths cannot diverge."""
+    import pyarrow as pa
+    if isinstance(dt, TimestampType):
+        return arr.cast(pa.timestamp("us")).cast(pa.int64()) \
+            .fill_null(0).to_numpy(zero_copy_only=False).astype(np.int64)
+    if isinstance(dt, DateType):
+        return arr.cast(pa.int32()).fill_null(0) \
+            .to_numpy(zero_copy_only=False).astype(np.int32)
+    if isinstance(dt, BooleanType):
+        return np.asarray(arr.fill_null(False), dtype=np.bool_)
+    return arr.fill_null(0).to_numpy(zero_copy_only=False).astype(dt.np_dtype)
+
+
 class StructField:
     __slots__ = ("name", "data_type", "nullable")
 

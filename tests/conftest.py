@@ -5,13 +5,18 @@ no cluster needed; multi-device behavior is tested on virtual devices.
 """
 import os
 
-# must be set before jax import; force CPU (the shell may point JAX at a
-# real TPU via JAX_PLATFORMS=axon — tests always run on the virtual mesh)
+# force CPU (the shell points JAX at a real TPU via JAX_PLATFORMS=axon, and
+# a sitecustomize may import jax before us — so set the env var AND update
+# the config after import)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

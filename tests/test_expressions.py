@@ -1,0 +1,466 @@
+"""Differential tests: every expression evaluated on the CPU oracle (numpy)
+and on the device path (jax, jitted) must agree exactly.
+
+Mirrors the reference's CPU-vs-GPU golden comparison strategy
+(tests/SparkQueryCompareTestSuite.scala:153-167).
+"""
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (col, lit, bind, eval_host, eval_device)
+from spark_rapids_tpu.expr import arithmetic as A
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import conditional as C
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr import datetime_ops as D
+from spark_rapids_tpu.expr import math_ops as M
+from spark_rapids_tpu.expr.cast import Cast
+from spark_rapids_tpu.expr.hashing import Murmur3Hash
+from spark_rapids_tpu.host.batch import HostBatch
+
+
+def schema(**kw):
+    return T.Schema([T.StructField(k, v) for k, v in kw.items()])
+
+
+INT_SCHEMA = schema(a=T.IntegerType(), b=T.IntegerType())
+INT_DATA = {"a": [1, None, 3, -7, 2147483647, 0, -2147483648],
+            "b": [2, 5, None, 3, 1, 0, -1]}
+
+DBL_SCHEMA = schema(x=T.DoubleType(), y=T.DoubleType())
+DBL_DATA = {"x": [1.5, None, float("nan"), -0.0, float("inf"), 2.0, -3.5],
+            "y": [0.5, 2.0, 1.0, 0.0, float("nan"), None, 2.0]}
+
+STR_SCHEMA = schema(s=T.StringType(), t=T.StringType())
+STR_DATA = {"s": ["hello", "", None, "Hello World", "abc", "  pad  ", "héllo"],
+            "t": ["he", "x", "y", "World", None, "pad", "llo"]}
+
+
+def run_both(expr, data, sch, approx=False):
+    """Bind, eval on host and device, compare, return host list."""
+    hb = HostBatch.from_pydict(data, sch)
+    bound = bind(expr, sch)
+    hres = eval_host(bound, hb).to_list()
+
+    import jax
+    from spark_rapids_tpu.expr.core import eval_device as _ed
+    db = hb.to_device()
+
+    @jax.jit
+    def f(b):
+        return _ed(bound, b)
+
+    dcol = f(db)
+    from spark_rapids_tpu.columnar.batch import ColumnBatch
+    out = ColumnBatch([dcol], db.num_rows, schema(r=bound.dtype))
+    dres = HostBatch.from_device(out).columns[0].to_list()
+    assert len(hres) == len(dres)
+    for i, (h, d) in enumerate(zip(hres, dres)):
+        if h is None or d is None:
+            assert h is None and d is None, (i, h, d)
+        elif isinstance(h, float):
+            if math.isnan(h):
+                assert math.isnan(d), (i, h, d)
+            elif approx:
+                assert d == pytest.approx(h, rel=1e-12), (i, h, d)
+            else:
+                assert h == d or (h == 0 and d == 0), (i, h, d)
+        else:
+            assert h == d, (i, h, d)
+    return hres
+
+
+class TestArithmetic:
+    def test_add_nulls_and_wrap(self):
+        r = run_both(col("a") + col("b"), INT_DATA, INT_SCHEMA)
+        assert r[0] == 3 and r[1] is None and r[2] is None
+        assert r[4] == -2147483648  # int32 wraparound like Java
+
+    def test_subtract_multiply(self):
+        run_both(col("a") - col("b") * lit(2).cast(T.IntegerType()),
+                 INT_DATA, INT_SCHEMA)
+
+    def test_divide_null_on_zero(self):
+        r = run_both(col("a") / col("b"), INT_DATA, INT_SCHEMA)
+        assert r[0] == 0.5
+        assert r[5] is None  # 0 / 0 -> NULL (Spark DivModLike)
+        r2 = run_both(col("x") / col("y"), DBL_DATA, DBL_SCHEMA)
+        assert r2[3] is None  # -0.0 / 0.0 -> NULL even for doubles
+
+    def test_remainder_sign_of_dividend(self):
+        r = run_both(col("a") % col("b"), INT_DATA, INT_SCHEMA)
+        assert r[3] == -1  # -7 % 3 == -1 (Java), not 2 (python)
+        assert r[5] is None
+
+    def test_integral_divide_truncates(self):
+        r = run_both(A.IntegralDivide(col("a"), col("b")), INT_DATA, INT_SCHEMA)
+        assert r[3] == -2  # -7 div 3 == -2 (trunc), not -3 (floor)
+
+    def test_unary(self):
+        r = run_both(-col("a"), INT_DATA, INT_SCHEMA)
+        assert r[3] == 7
+        r = run_both(A.Abs(col("a")), INT_DATA, INT_SCHEMA)
+        assert r[6] == -2147483648  # Java Math.abs(MIN_VALUE) wraps
+
+    def test_least_greatest(self):
+        r = run_both(A.Least(col("a"), col("b")), INT_DATA, INT_SCHEMA)
+        assert r[0] == 1 and r[1] == 5 and r[2] == 3
+        r = run_both(A.Greatest(col("x"), col("y")), DBL_DATA, DBL_SCHEMA)
+        assert math.isnan(r[2])  # NaN is greatest
+
+
+class TestPredicates:
+    def test_comparisons_int(self):
+        r = run_both(col("a") < col("b"), INT_DATA, INT_SCHEMA)
+        assert r[0] is True and r[1] is None and r[2] is None
+
+    def test_nan_semantics(self):
+        # Spark: NaN == NaN is true; NaN greater than everything
+        r = run_both(col("x") == col("x"), DBL_DATA, DBL_SCHEMA)
+        assert r[2] is True
+        r = run_both(col("x") > col("y"), DBL_DATA, DBL_SCHEMA)
+        assert r[2] is True     # NaN > 1.0
+        assert r[4] is False    # inf > NaN is false
+        r = run_both(col("x") <= col("y"), DBL_DATA, DBL_SCHEMA)
+        assert r[4] is True     # inf <= NaN
+
+    def test_negative_zero(self):
+        r = run_both(col("x") == col("y"), DBL_DATA, DBL_SCHEMA)
+        assert r[3] is True  # -0.0 == 0.0
+
+    def test_three_valued_logic(self):
+        e = (col("a") > lit(0).cast(T.IntegerType())) & (col("b") > lit(0).cast(T.IntegerType()))
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[1] is None   # null & true -> null
+        e = (col("a") < lit(0)) & (col("b") > lit(0))
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[1] is None   # null & true -> null
+        assert r[2] is False  # false & null -> false (a=3 not < 0)
+        e = P.Or(col("a").is_null(), col("b").is_null())
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[1] is True and r[0] is False
+
+    def test_null_safe_eq(self):
+        r = run_both(P.EqualNullSafe(col("a"), col("b")), INT_DATA, INT_SCHEMA)
+        assert r[1] is False and r[0] is False
+        d = {"a": [None, 1], "b": [None, 1]}
+        r = run_both(P.EqualNullSafe(col("a"), col("b")), d, INT_SCHEMA)
+        assert r == [True, True]
+
+    def test_in(self):
+        r = run_both(col("a").isin(1, 3, 99), INT_DATA, INT_SCHEMA)
+        assert r[0] is True and r[2] is True and r[3] is False and r[1] is None
+        r = run_both(col("a").isin(1, None), INT_DATA, INT_SCHEMA)
+        assert r[0] is True and r[3] is None  # no match + null item -> NULL
+
+    def test_null_tests(self):
+        r = run_both(col("a").is_null(), INT_DATA, INT_SCHEMA)
+        assert r == [False, True, False, False, False, False, False]
+        r = run_both(P.IsNan(col("x")), DBL_DATA, DBL_SCHEMA)
+        assert r[2] is True and r[1] is False  # IsNaN(null) -> false
+
+    def test_in_promotes_not_narrows(self):
+        # items wider than the value type must promote both sides, not wrap
+        sch = schema(a=T.ByteType(), b=T.ByteType())
+        d = {"a": [0, 1, None], "b": [0, 0, 0]}
+        r = run_both(col("a").isin(256), d, sch)
+        assert r == [False, False, None]
+
+    def test_string_trailing_nul_orders_as_prefix(self):
+        d = {"s": ["a", "a\x00b", "a"], "t": ["a\x00", "a\x00", "a"]}
+        r = run_both(col("s") < col("t"), d, STR_SCHEMA)
+        assert r == [True, False, False]
+
+    def test_string_compare(self):
+        r = run_both(col("s") < col("t"), STR_DATA, STR_SCHEMA)
+        assert r[0] is False  # "hello" < "he" false
+        assert r[1] is True   # "" < "x"
+        r = run_both(col("s") == col("s"), STR_DATA, STR_SCHEMA)
+        assert r[0] is True and r[2] is None
+
+
+class TestConditional:
+    def test_if(self):
+        e = C.If(col("a") > col("b"), col("a"), col("b"))
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[0] == 2 and r[1] == 5  # null pred -> else branch
+
+    def test_case_when(self):
+        e = C.CaseWhen([(col("a") > lit(0), lit("pos")),
+                        (col("a") < lit(0), lit("neg"))], lit("zero"))
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[0] == "pos" and r[3] == "neg" and r[5] == "zero"
+        assert r[1] == "zero"  # null falls to else
+
+    def test_coalesce(self):
+        e = C.Coalesce(col("a"), col("b"), lit(-1))
+        r = run_both(e, INT_DATA, INT_SCHEMA)
+        assert r[1] == 5 and r[2] == 3 and r[0] == 1
+
+
+class TestCast:
+    def test_long_to_int_wraps(self):
+        sch = schema(v=T.LongType())
+        d = {"v": [2**31, -2**31 - 1, 5, None]}
+        r = run_both(Cast(col("v"), T.IntegerType()), d, sch)
+        assert r == [-2147483648, 2147483647, 5, None]
+
+    def test_double_to_int_saturates(self):
+        sch = schema(v=T.DoubleType())
+        d = {"v": [1e20, -1e20, 2.9, -2.9, float("nan"), None]}
+        r = run_both(Cast(col("v"), T.IntegerType()), d, sch)
+        assert r == [2147483647, -2147483648, 2, -2, 0, None]
+        r = run_both(Cast(col("v"), T.LongType()), d, sch)
+        assert r[0] == 9223372036854775807 and r[4] == 0
+
+    def test_numeric_bool(self):
+        sch = schema(v=T.IntegerType())
+        d = {"v": [0, 1, -5, None]}
+        r = run_both(Cast(col("v"), T.BooleanType()), d, sch)
+        assert r == [False, True, True, None]
+
+    def test_date_timestamp(self):
+        sch = schema(v=T.DateType())
+        d = {"v": [dt.date(2020, 3, 1), dt.date(1969, 12, 31), None]}
+        r = run_both(Cast(col("v"), T.TimestampType()), d, sch)
+        assert r[1] == dt.datetime(1969, 12, 31, 0, 0)
+
+    def test_string_casts_host_only(self):
+        sch = schema(v=T.StringType())
+        hb = HostBatch.from_pydict({"v": [" 42 ", "abc", "1.5", None]}, sch)
+        bound = bind(Cast(col("v"), T.IntegerType()), sch)
+        assert not bound.device_supported
+        r = eval_host(bound, hb).to_list()
+        assert r == [42, None, None, None]
+        bound = bind(Cast(col("v"), T.DoubleType()), sch)
+        assert eval_host(bound, hb).to_list() == [42.0, None, 1.5, None]
+
+    def test_double_to_string_java_format(self):
+        from spark_rapids_tpu.expr.cast import java_double_str
+        assert java_double_str(1.0) == "1.0"
+        assert java_double_str(1e7) == "1.0E7"
+        assert java_double_str(0.001) == "0.001"
+        assert java_double_str(1e-4) == "1.0E-4"
+        assert java_double_str(float("nan")) == "NaN"
+        assert java_double_str(float("-inf")) == "-Infinity"
+
+
+class TestStrings:
+    def test_upper_lower(self):
+        r = run_both(S.Upper(col("s")),
+                     {"s": ["abc", "aBc", None], "t": ["", "", ""]}, STR_SCHEMA)
+        assert r == ["ABC", "ABC", None]
+        run_both(S.Lower(col("s")),
+                 {"s": ["ABC", "aBc", None], "t": ["", "", ""]}, STR_SCHEMA)
+
+    def test_length_chars_not_bytes(self):
+        r = run_both(S.Length(col("s")), STR_DATA, STR_SCHEMA)
+        assert r[0] == 5 and r[1] == 0 and r[2] is None
+        assert r[6] == 5  # "héllo" is 5 chars (6 utf-8 bytes)
+
+    def test_substring(self):
+        e = col("s").substr(2, 3)
+        r = run_both(e, STR_DATA, STR_SCHEMA)
+        assert r[0] == "ell" and r[1] == "" and r[2] is None
+        assert r[6] == "éll"  # char-indexed through multibyte
+        r = run_both(col("s").substr(-3, 2), STR_DATA, STR_SCHEMA)
+        assert r[0] == "ll"
+        r = run_both(col("s").substr(0, 2), STR_DATA, STR_SCHEMA)
+        assert r[0] == "he"
+
+    def test_concat(self):
+        r = run_both(S.Concat(col("s"), lit("_"), col("t")), STR_DATA, STR_SCHEMA)
+        assert r[0] == "hello_he" and r[2] is None and r[4] is None
+
+    def test_predicates(self):
+        r = run_both(col("s").startswith(col("t")), STR_DATA, STR_SCHEMA)
+        assert r[0] is True and r[3] is False
+        r = run_both(col("s").endswith(col("t")), STR_DATA, STR_SCHEMA)
+        assert r[3] is True and r[6] is True
+        r = run_both(col("s").contains(col("t")), STR_DATA, STR_SCHEMA)
+        assert r[0] is True and r[3] is True and r[1] is False
+
+    def test_like(self):
+        r = run_both(col("s").like("he%"), STR_DATA, STR_SCHEMA)
+        assert r[0] is True and r[3] is False
+        r = run_both(col("s").like("%World"), STR_DATA, STR_SCHEMA)
+        assert r[3] is True
+        r = run_both(col("s").like("%llo%"), STR_DATA, STR_SCHEMA)
+        assert r[0] is True
+        # general pattern: host-only
+        e = bind(col("s").like("h_llo"), STR_SCHEMA)
+        assert not e.device_supported
+        hb = HostBatch.from_pydict(STR_DATA, STR_SCHEMA)
+        assert eval_host(e, hb).to_list()[0] is True
+
+    def test_trim(self):
+        r = run_both(S.StringTrim(col("s")), STR_DATA, STR_SCHEMA)
+        assert r[5] == "pad"
+        r = run_both(S.StringTrimLeft(col("s")), STR_DATA, STR_SCHEMA)
+        assert r[5] == "pad  "
+        r = run_both(S.StringTrimRight(col("s")), STR_DATA, STR_SCHEMA)
+        assert r[5] == "  pad"
+
+
+class TestDatetime:
+    SCH = schema(d=T.DateType(), n=T.IntegerType())
+    DATES = [dt.date(2020, 2, 29), dt.date(1969, 7, 20), dt.date(2000, 1, 1),
+             dt.date(1582, 10, 15), dt.date(2038, 1, 19), None]
+    DATA = {"d": DATES, "n": [1, 2, 3, 4, 5, 6]}
+
+    def test_extract_fields(self):
+        r = run_both(D.Year(col("d")), self.DATA, self.SCH)
+        assert r == [2020, 1969, 2000, 1582, 2038, None]
+        r = run_both(D.Month(col("d")), self.DATA, self.SCH)
+        assert r == [2, 7, 1, 10, 1, None]
+        r = run_both(D.DayOfMonth(col("d")), self.DATA, self.SCH)
+        assert r == [29, 20, 1, 15, 19, None]
+
+    def test_dow_doy_quarter(self):
+        r = run_both(D.DayOfWeek(col("d")), self.DATA, self.SCH)
+        # 2020-02-29 was a Saturday -> 7 in Spark's 1=Sunday scheme
+        assert r[0] == 7
+        r = run_both(D.DayOfYear(col("d")), self.DATA, self.SCH)
+        assert r[0] == 60 and r[2] == 1
+        r = run_both(D.Quarter(col("d")), self.DATA, self.SCH)
+        assert r == [1, 3, 1, 4, 1, None]
+
+    def test_date_arith(self):
+        r = run_both(D.DateAdd(col("d"), col("n")), self.DATA, self.SCH)
+        assert r[0] == dt.date(2020, 3, 1)
+        r = run_both(D.DateSub(col("d"), col("n")), self.DATA, self.SCH)
+        assert r[2] == dt.date(1999, 12, 29)
+        r = run_both(D.DateDiff(col("d"), col("d")), self.DATA, self.SCH)
+        assert r[0] == 0
+
+    def test_time_extract(self):
+        sch = schema(ts=T.TimestampType())
+        d = {"ts": [dt.datetime(2020, 5, 4, 13, 45, 59),
+                    dt.datetime(1969, 12, 31, 23, 0, 1), None]}
+        assert run_both(D.Hour(col("ts")), d, sch) == [13, 23, None]
+        assert run_both(D.Minute(col("ts")), d, sch) == [45, 0, None]
+        assert run_both(D.Second(col("ts")), d, sch) == [59, 1, None]
+
+
+class TestMath:
+    def test_floor_ceil_long(self):
+        e = M.Floor(col("x"))
+        sch = DBL_SCHEMA
+        d = {"x": [1.7, -1.2, None, 0.0, 1e18, -2.5, 3.0],
+             "y": [0.0] * 7}
+        r = run_both(e, d, sch)
+        assert r[0] == 1 and r[1] == -2 and r[2] is None
+        assert isinstance(r[0], int)  # LongType result
+        r = run_both(M.Ceil(col("x")), d, sch)
+        assert r[0] == 2 and r[1] == -1
+
+    def test_round_half_up(self):
+        sch = schema(x=T.DoubleType())
+        d = {"x": [2.5, 3.5, -2.5, 1.25, None]}
+        r = run_both(M.Round(col("x"), 0), d, sch)
+        assert r[0] == 3.0 and r[1] == 4.0 and r[2] == -3.0  # HALF_UP
+        r = run_both(M.Round(col("x"), 1), d, sch)
+        assert r[3] == 1.3
+
+    def test_log_null_nonpositive(self):
+        sch = schema(x=T.DoubleType())
+        d = {"x": [math.e, 0.0, -1.0, None]}
+        r = run_both(M.Log(col("x")), d, sch, approx=True)
+        assert r[0] == pytest.approx(1.0) and r[1] is None and r[2] is None
+
+    def test_misc(self):
+        sch = schema(x=T.DoubleType())
+        d = {"x": [4.0, -4.0, 0.25, None]}
+        r = run_both(M.Sqrt(col("x")), d, sch)
+        assert r[0] == 2.0 and math.isnan(r[1])
+        run_both(M.Exp(col("x")), d, sch, approx=True)
+        run_both(M.Pow(col("x"), lit(2.0)), d, sch, approx=True)
+        run_both(M.Signum(col("x")), d, sch)
+        run_both(M.Sin(col("x")), d, sch, approx=True)
+        run_both(M.Tanh(col("x")), d, sch, approx=True)
+
+
+def _ref_murmur3_bytes(data: bytes, seed: int) -> int:
+    """Independent reference: murmur3 x86_32 with Spark's per-byte tail."""
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+    def mixk1(k1):
+        k1 = (k1 * 0xCC9E2D51) & 0xFFFFFFFF
+        k1 = rotl(k1, 15)
+        return (k1 * 0x1B873593) & 0xFFFFFFFF
+
+    def mixh1(h1, k1):
+        h1 ^= k1
+        h1 = rotl(h1, 13)
+        return (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+
+    h1 = seed & 0xFFFFFFFF
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        h1 = mixh1(h1, mixk1(int.from_bytes(data[i:i + 4], "little")))
+    for i in range(n - n % 4, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256
+        h1 = mixh1(h1, mixk1(b & 0xFFFFFFFF))
+    h1 ^= n
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1 - 2**32 if h1 >= 2**31 else h1
+
+
+class TestMurmur3:
+    def test_int_matches_reference(self):
+        sch = schema(v=T.IntegerType())
+        vals = [0, 1, -1, 42, 2147483647, None]
+        r = run_both(Murmur3Hash(col("v")), {"v": vals}, sch)
+        for v, h in zip(vals, r):
+            if v is None:
+                assert h == 42  # null passes seed through
+            else:
+                exp = _ref_murmur3_bytes(
+                    int(np.int32(v)).to_bytes(4, "little", signed=True), 42)
+                assert h == exp, v
+
+    def test_long_double(self):
+        sch = schema(v=T.LongType())
+        vals = [0, 1, -1, 2**40, None]
+        r = run_both(Murmur3Hash(col("v")), {"v": vals}, sch)
+        for v, h in zip(vals, r):
+            if v is not None:
+                exp = _ref_murmur3_bytes(
+                    int(np.int64(v)).to_bytes(8, "little", signed=True), 42)
+                assert h == exp, v
+        sch = schema(v=T.DoubleType())
+        vals = [1.5, -0.0, 3.14159, float("nan"), None]
+        r = run_both(Murmur3Hash(col("v")), {"v": vals}, sch)
+        import struct
+        for v, h in zip(vals, r):
+            if v is not None:
+                norm = 0.0 if v == 0 else v
+                bits = struct.pack("<d", norm) if not math.isnan(norm) \
+                    else (0x7FF8000000000000).to_bytes(8, "little")
+                assert h == _ref_murmur3_bytes(bits, 42), v
+
+    def test_string(self):
+        sch = schema(v=T.StringType())
+        vals = ["", "a", "abcd", "abcde", "hello world", "héllo", None]
+        r = run_both(Murmur3Hash(col("v")), {"v": vals}, sch)
+        for v, h in zip(vals, r):
+            if v is not None:
+                assert h == _ref_murmur3_bytes(v.encode("utf-8"), 42), v
+
+    def test_multi_column_chaining(self):
+        sch = schema(a=T.IntegerType(), b=T.StringType())
+        d = {"a": [1, 2, None], "b": ["x", None, "y"]}
+        r = run_both(Murmur3Hash(col("a"), col("b")), d, sch)
+        seed0 = _ref_murmur3_bytes((1).to_bytes(4, "little"), 42)
+        assert r[0] == _ref_murmur3_bytes(b"x", seed0 & 0xFFFFFFFF)
