@@ -1,0 +1,20 @@
+"""Physical execution operators (the reference's GpuExec layer, §2.4)."""
+from spark_rapids_tpu.exec.core import (CoalesceGoal, ExecCtx, PlanNode,
+                                        RequireSingleBatch, TargetSize,
+                                        collect, collect_device, collect_host,
+                                        device_to_host, host_to_device)
+from spark_rapids_tpu.exec.basic import (FilterExec, GlobalLimitExec,
+                                         LocalLimitExec, LocalScanExec,
+                                         ProjectExec, RangeExec, UnionExec)
+from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+from spark_rapids_tpu.exec.sortexec import (CoalesceBatchesExec, SortExec,
+                                            resolve_orders)
+
+__all__ = [
+    "CoalesceGoal", "ExecCtx", "PlanNode", "RequireSingleBatch", "TargetSize",
+    "collect", "collect_device", "collect_host", "device_to_host",
+    "host_to_device",
+    "FilterExec", "GlobalLimitExec", "LocalLimitExec", "LocalScanExec",
+    "ProjectExec", "RangeExec", "UnionExec",
+    "HashAggregateExec", "CoalesceBatchesExec", "SortExec", "resolve_orders",
+]

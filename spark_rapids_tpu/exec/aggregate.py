@@ -1,0 +1,310 @@
+"""Hash-aggregate exec with partial/final/complete modes.
+
+Reference: aggregate.scala (GpuHashAggregateExec, ``doExecuteColumnar``
+:348-560): per input batch compute a groupby aggregate, then iteratively
+concatenate with the running result and merge-aggregate; final projection
+over the aggregation buffer.  The device kernel here is sort-based
+(:mod:`spark_rapids_tpu.ops.segmented`, the TPU-idiomatic substitute for
+cuDF's hash groupby — see SURVEY.md §7 hard parts).
+
+Modes mirror Spark's aggregate modes:
+* ``complete`` — one exec does update + cross-batch merge + result;
+* ``partial``  — update only, emits the aggregation buffer (keys +
+  intermediates) for an exchange;
+* ``final``    — consumes buffer batches, merges across them, projects
+  results.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.expr.core import (Alias, BoundReference, Expression,
+                                        bind, eval_device, eval_host,
+                                        output_name)
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops.segmented import AggSpec, sorted_group_by
+
+__all__ = ["HashAggregateExec"]
+
+
+def _strip_alias(e: Expression) -> Expression:
+    return e.children[0] if isinstance(e, Alias) else e
+
+
+class HashAggregateExec(PlanNode):
+    """Group-by aggregation.
+
+    ``group_exprs``: grouping expressions (resolved against child schema).
+    ``result_exprs``: output expressions over group keys and aggregate
+    functions (e.g. ``(Sum(col("x")) / CountStar()).alias("r")``).
+    """
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 result_exprs: Sequence[Expression], child: PlanNode,
+                 mode: str = "complete"):
+        if mode == "final":
+            raise ValueError("use HashAggregateExec.final_from_partial()")
+        assert mode in ("complete", "partial")
+        super().__init__([child])
+        self.mode = mode
+        child_schema = child.output_schema
+
+        self._group_bound = [bind(_strip_alias(g), child_schema)
+                             for g in group_exprs]
+        self._group_names = [output_name(g) for g in group_exprs]
+        self._result_raw = list(result_exprs)
+        self._result_bound = [bind(r, child_schema) for r in self._result_raw]
+
+        # collect distinct aggregate functions (structural identity)
+        self._aggs: list[AggregateFunction] = []
+        seen: dict[str, int] = {}
+        for r in self._result_bound:
+            for a in _collect_aggs(r):
+                key = repr(a)
+                if key not in seen:
+                    seen[key] = len(self._aggs)
+                    self._aggs.append(a)
+        self._agg_index = seen
+
+        # pre-projection layout: [group keys..., one col per agg input]
+        self._pre_exprs: list[Expression] = list(self._group_bound)
+        self._agg_input_col: list[int | None] = []
+        for a in self._aggs:
+            if a.input is None:
+                self._agg_input_col.append(None)
+            else:
+                self._agg_input_col.append(len(self._pre_exprs))
+                self._pre_exprs.append(a.input)
+        if not self._pre_exprs:
+            # rows-only aggregation (e.g. bare COUNT(*)): a zero-column
+            # batch would lose its row count, so project a dummy literal
+            # (reference: JustRowsColumnarBatch, SpillableColumnarBatch.scala)
+            from spark_rapids_tpu.expr.core import Literal
+            self._pre_exprs.append(Literal(1, T.ByteType()))
+        k = len(self._group_bound)
+        self._pre_schema = T.Schema(
+            [T.StructField(n, g.dtype, True)
+             for n, g in zip(self._group_names, self._group_bound)]
+            + [T.StructField(f"_agg_in_{i}", e.dtype, True)
+               for i, e in enumerate(self._pre_exprs[k:])])
+
+        # update specs + buffer layout
+        self._update_specs: list[AggSpec] = []
+        self._agg_offsets: list[list[int]] = []
+        buf_fields = list(self._pre_schema.fields[:k])
+        for a, ci in zip(self._aggs, self._agg_input_col):
+            offs = []
+            for op, it in zip(a.update_ops, a.intermediate_types()):
+                offs.append(k + len(self._update_specs))
+                self._update_specs.append(AggSpec(op, ci if ci is not None else 0))
+                buf_fields.append(T.StructField(
+                    f"_buf_{len(buf_fields) - k}", it, True))
+            self._agg_offsets.append(offs)
+        self._buffer_schema = T.Schema(buf_fields)
+
+        # merge specs operate over buffer columns
+        self._merge_specs: list[AggSpec] = []
+        for a, offs in zip(self._aggs, self._agg_offsets):
+            for op, off in zip(a.merge_ops, offs):
+                self._merge_specs.append(AggSpec(op, off))
+
+        # result projection over the buffer batch
+        self._final_exprs = [self._to_buffer_space(r, b)
+                             for r, b in zip(self._result_raw,
+                                             self._result_bound)]
+        self._output_schema = (
+            self._buffer_schema if mode == "partial" else T.Schema(
+                [T.StructField(output_name(r), b.dtype, True)
+                 for r, b in zip(self._result_raw, self._final_exprs)]))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def final_from_partial(cls, partial: "HashAggregateExec",
+                           child: PlanNode) -> "HashAggregateExec":
+        """Build the final-mode exec consuming ``partial``'s buffer output
+        (typically through an exchange)."""
+        self = object.__new__(cls)
+        PlanNode.__init__(self, [child])
+        self.mode = "final"
+        for attr in ("_group_bound", "_group_names", "_result_raw",
+                     "_result_bound", "_aggs", "_agg_index", "_pre_exprs",
+                     "_agg_input_col", "_pre_schema", "_update_specs",
+                     "_agg_offsets", "_buffer_schema", "_merge_specs",
+                     "_final_exprs"):
+            setattr(self, attr, getattr(partial, attr))
+        self._output_schema = T.Schema(
+            [T.StructField(output_name(r), b.dtype, True)
+             for r, b in zip(self._result_raw, self._final_exprs)])
+        return self
+
+    def _to_buffer_space(self, raw: Expression, bound: Expression) -> Expression:
+        """Rewrite a bound result expression to evaluate over the buffer
+        batch: aggs -> final_expr(offsets), group exprs -> key refs."""
+        group_reprs = {repr(g): i for i, g in enumerate(self._group_bound)}
+
+        def rewrite(node: Expression) -> Expression:
+            if isinstance(node, AggregateFunction):
+                i = self._agg_index[repr(node)]
+                return self._aggs[i].final_expr(self._agg_offsets[i])
+            r = repr(node)
+            if r in group_reprs:
+                i = group_reprs[r]
+                f = self._buffer_schema.fields[i]
+                return BoundReference(i, f.data_type, True, f.name)
+            return node
+
+        return _rewrite_topdown(bound, rewrite)
+
+    # ------------------------------------------------------------------
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._output_schema
+
+    @property
+    def output_batching(self):
+        return RequireSingleBatch
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        # complete mode is a whole-input aggregation: collapse partitions
+        # (partial/final run per partition; the exchange between them owns
+        # cross-partition movement, as in Spark's planner).
+        if self.mode == "complete":
+            return 1
+        return self.children[0].num_partitions(ctx)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child = self.children[0]
+        if self.mode == "complete":
+            child_it = (b for cpid in range(child.num_partitions(ctx))
+                        for b in child.partition_iter(ctx, cpid))
+        else:
+            child_it = child.partition_iter(ctx, pid)
+        key_idx = list(range(len(self._group_bound)))
+        if ctx.is_device:
+            yield from self._run_device(child_it, key_idx)
+        else:
+            yield from self._run_host(child_it, key_idx)
+
+    # -- device path (reference aggregate.scala:427-485 concat+merge loop) --
+    def _run_device(self, child_it, key_idx) -> Iterator[ColumnBatch]:
+        running: ColumnBatch | None = None
+        saw_input = False
+        for b in child_it:
+            saw_input = True
+            if self.mode == "final":
+                part = _relabel_d(b, self._buffer_schema)
+            else:
+                cols = [eval_device(e, b) for e in self._pre_exprs]
+                pre = ColumnBatch(cols, b.num_rows, self._pre_schema)
+                part = _relabel_d(
+                    sorted_group_by(pre, key_idx, self._update_specs),
+                    self._buffer_schema)
+            if running is None:
+                running = part
+            else:
+                cat = dk.concat_batches([running, part])
+                cat = _relabel_d(cat, self._buffer_schema)
+                running = _relabel_d(
+                    sorted_group_by(cat, key_idx, self._merge_specs),
+                    self._buffer_schema)
+        if running is None:
+            if key_idx or self.mode == "partial":
+                return  # no groups / nothing to emit
+            # grand aggregate on empty input: default-values row
+            # (reference aggregate.scala reduction default path :514+)
+            from spark_rapids_tpu.exec.core import host_to_device
+            empty = _empty_host(self._pre_schema)
+            pre = host_to_device(empty)
+            running = _relabel_d(
+                sorted_group_by(pre, key_idx, self._update_specs),
+                self._buffer_schema)
+        if self.mode == "partial":
+            yield running
+        else:
+            cols = [eval_device(e, running) for e in self._final_exprs]
+            yield ColumnBatch(cols, running.num_rows, self._output_schema)
+
+    # -- host oracle path --------------------------------------------------
+    def _run_host(self, child_it, key_idx) -> Iterator[HostBatch]:
+        parts: list[HostBatch] = []
+        for b in child_it:
+            if self.mode == "final":
+                parts.append(_relabel_h(b, self._buffer_schema))
+            else:
+                cols = [eval_host(e, b) for e in self._pre_exprs]
+                pre = HostBatch(cols, self._pre_schema)
+                parts.append(_relabel_h(
+                    hk.host_group_by(pre, key_idx, self._update_specs),
+                    self._buffer_schema))
+        if not parts:
+            if key_idx or self.mode == "partial":
+                return
+            parts = [_relabel_h(
+                hk.host_group_by(_empty_host(self._pre_schema), key_idx,
+                                 self._update_specs), self._buffer_schema)]
+        running = parts[0] if len(parts) == 1 else _relabel_h(
+            hk.host_group_by(hk.host_concat(parts), key_idx,
+                             self._merge_specs), self._buffer_schema)
+        if self.mode == "partial":
+            yield running
+        else:
+            cols = [eval_host(e, running) for e in self._final_exprs]
+            yield HostBatch(cols, self._output_schema)
+
+    def node_desc(self) -> str:
+        return (f"HashAggregateExec[{self.mode}, keys={self._group_names}, "
+                f"out={self._output_schema.names}]")
+
+
+# ---------------------------------------------------------------------------
+
+def _collect_aggs(e: Expression) -> list[AggregateFunction]:
+    if isinstance(e, AggregateFunction):
+        return [e]
+    out: list[AggregateFunction] = []
+    for c in e.children:
+        out.extend(_collect_aggs(c))
+    return out
+
+
+def _rewrite_topdown(e: Expression, fn) -> Expression:
+    new = fn(e)
+    if new is not e:
+        return new
+    children = [_rewrite_topdown(c, fn) for c in e.children]
+    if all(a is b for a, b in zip(children, e.children)):
+        return e
+    return e.with_new_children(children)
+
+
+def _relabel_d(b: ColumnBatch, schema: T.Schema) -> ColumnBatch:
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    cols = [DeviceColumn(c.data, c.validity, f.data_type, c.lengths)
+            for c, f in zip(b.columns, schema)]
+    return ColumnBatch(cols, b.num_rows, schema)
+
+
+def _relabel_h(b: HostBatch, schema: T.Schema) -> HostBatch:
+    from spark_rapids_tpu.host.batch import HostColumn
+    cols = [HostColumn(c.data, c.validity, f.data_type)
+            for c, f in zip(b.columns, schema)]
+    return HostBatch(cols, schema)
+
+
+def _empty_host(schema: T.Schema) -> HostBatch:
+    import numpy as np
+    from spark_rapids_tpu.host.batch import HostColumn
+    cols = []
+    for f in schema:
+        if isinstance(f.data_type, T.StringType):
+            data = np.empty(0, dtype=object)
+        else:
+            data = np.zeros(0, dtype=f.data_type.np_dtype)
+        cols.append(HostColumn(data, np.zeros(0, np.bool_), f.data_type))
+    return HostBatch(cols, schema)

@@ -1,0 +1,262 @@
+"""Basic physical operators: scan(local), project, filter, range, union,
+limits.
+
+Reference: basicPhysicalOperators.scala (GpuProjectExec ~:40, GpuFilterExec
+~:150, GpuRangeExec ~:200, GpuUnionExec), limit.scala (GpuLocalLimitExec,
+GpuGlobalLimitExec, GpuCollectLimitExec).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.core import (ExecCtx, PlanNode, host_to_device)
+from spark_rapids_tpu.expr.core import (Expression, bind, eval_device,
+                                        eval_host, output_name)
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops import kernels as dk
+
+__all__ = ["LocalScanExec", "ProjectExec", "FilterExec", "RangeExec",
+           "UnionExec", "LocalLimitExec", "GlobalLimitExec"]
+
+
+class LocalScanExec(PlanNode):
+    """Scan over in-memory host batches, split into partitions.
+
+    The leaf for tests and local pipelines (file scans live in
+    spark_rapids_tpu.io).  On the device backend each host batch is
+    transferred H2D (reference HostColumnarToGpu.scala).
+    """
+
+    def __init__(self, batches: Sequence[HostBatch], schema: T.Schema,
+                 partitions: int = 1):
+        super().__init__([])
+        self._batches = list(batches)
+        self._schema = schema
+        self._parts = max(partitions, 1)
+
+    @staticmethod
+    def from_pydict(data: dict[str, list], schema: T.Schema,
+                    partitions: int = 1, rows_per_batch: int | None = None
+                    ) -> "LocalScanExec":
+        cols = [HostColumn.from_values(data[f.name], f.data_type)
+                for f in schema]
+        hb = HostBatch(cols, schema)
+        n = hb.num_rows
+        rpb = rows_per_batch or max(n, 1)
+        batches = [hk.host_slice(hb, i, i + rpb) for i in range(0, n, rpb)] \
+            if n else [hb]
+        return LocalScanExec(batches, schema, partitions)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self._parts
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        mine = [b for i, b in enumerate(self._batches)
+                if i % self._parts == pid]
+        for hb in mine:
+            if ctx.is_device:
+                yield host_to_device(hb)
+            else:
+                yield hb
+
+    def node_desc(self) -> str:
+        return f"LocalScanExec[{self._schema.names}]"
+
+
+class ProjectExec(PlanNode):
+    """Evaluate bound expressions per batch (GpuProjectExec.project)."""
+
+    def __init__(self, exprs: Sequence[Expression], child: PlanNode):
+        super().__init__([child])
+        self._raw = list(exprs)
+        self._bound = [bind(e, child.output_schema) for e in self._raw]
+        self._schema = T.Schema([
+            T.StructField(output_name(r), b.dtype)
+            for r, b in zip(self._raw, self._bound)])
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child_it = self.children[0].partition_iter(ctx, pid)
+        if ctx.is_device:
+            for b in child_it:
+                cols = [eval_device(e, b) for e in self._bound]
+                yield ColumnBatch(cols, b.num_rows, self._schema)
+        else:
+            for b in child_it:
+                cols = [eval_host(e, b) for e in self._bound]
+                yield HostBatch(cols, self._schema)
+
+    def node_desc(self) -> str:
+        return f"ProjectExec[{self._schema.names}]"
+
+
+class FilterExec(PlanNode):
+    """Boolean condition -> compact kept rows (GpuFilterExec:
+    Table.filter via front-packing permutation on device)."""
+
+    def __init__(self, condition: Expression, child: PlanNode):
+        super().__init__([child])
+        self._cond = bind(condition, child.output_schema)
+        assert isinstance(self._cond.dtype, T.BooleanType), \
+            f"filter condition must be boolean, got {self._cond.dtype}"
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child_it = self.children[0].partition_iter(ctx, pid)
+        if ctx.is_device:
+            for b in child_it:
+                c = eval_device(self._cond, b)
+                keep = c.data & c.validity  # null -> drop (SQL WHERE)
+                yield dk.compact(b, keep)
+        else:
+            for b in child_it:
+                c = eval_host(self._cond, b)
+                keep = c.data.astype(np.bool_) & c.validity
+                yield hk.host_filter(b, keep)
+
+
+class RangeExec(PlanNode):
+    """Generate [start, end) step sequences on device (GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 partitions: int = 1, name: str = "id",
+                 rows_per_batch: int = 1 << 20):
+        super().__init__([])
+        self._start, self._end, self._step = start, end, step
+        self._parts = partitions
+        self._rpb = rows_per_batch
+        self._schema = T.Schema([T.StructField(name, T.LongType())])
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self._parts
+
+    def _partition_bounds(self, pid: int) -> tuple[int, int]:
+        total = max(0, -(-(self._end - self._start) // self._step))
+        per = -(-total // self._parts)
+        lo, hi = pid * per, min((pid + 1) * per, total)
+        return lo, max(hi, lo)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        import jax.numpy as jnp
+        lo, hi = self._partition_bounds(pid)
+        for off in range(lo, hi, self._rpb) if hi > lo else []:
+            cnt = min(self._rpb, hi - off)
+            vals = (np.arange(off, off + cnt, dtype=np.int64) * self._step
+                    + self._start)
+            validity = np.ones(cnt, np.bool_)
+            if ctx.is_device:
+                cap = round_capacity(cnt)
+                col = DeviceColumn.from_numpy(vals, validity, T.LongType(), cap)
+                yield ColumnBatch([col], jnp.asarray(cnt, jnp.int32),
+                                  self._schema)
+            else:
+                yield HostBatch([HostColumn(vals, validity, T.LongType())],
+                                self._schema)
+
+
+class UnionExec(PlanNode):
+    """Concatenate children's partitions (GpuUnionExec): output partitions
+    are the children's partitions back to back."""
+
+    def __init__(self, children: Sequence[PlanNode]):
+        super().__init__(children)
+        s0 = children[0].output_schema
+        for c in children[1:]:
+            assert [f.data_type for f in c.output_schema] == \
+                [f.data_type for f in s0], "union schema mismatch"
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return sum(c.num_partitions(ctx) for c in self.children)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        for c in self.children:
+            np_ = c.num_partitions(ctx)
+            if pid < np_:
+                for b in c.partition_iter(ctx, pid):
+                    yield _relabel(b, self.output_schema)
+                return
+            pid -= np_
+        raise IndexError("partition out of range")
+
+
+def _relabel(b, schema: T.Schema):
+    if isinstance(b, HostBatch):
+        cols = [HostColumn(c.data, c.validity, f.data_type)
+                for c, f in zip(b.columns, schema)]
+        return HostBatch(cols, schema)
+    return ColumnBatch(b.columns, b.num_rows, schema)
+
+
+def _limited(ctx: ExecCtx, it: Iterator, remaining: int) -> Iterator:
+    """Yield batches sliced to at most ``remaining`` total rows."""
+    for b in it:
+        if remaining <= 0:
+            return
+        if ctx.is_device:
+            b = dk.slice_batch(b, remaining)
+            remaining -= b.host_num_rows()
+        else:
+            b = hk.host_slice(b, 0, remaining)
+            remaining -= b.num_rows
+        yield b
+
+
+class LocalLimitExec(PlanNode):
+    """Per-partition limit (GpuLocalLimitExec, limit.scala)."""
+
+    def __init__(self, limit: int, child: PlanNode):
+        super().__init__([child])
+        self._limit = limit
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from _limited(ctx, self.children[0].partition_iter(ctx, pid),
+                            self._limit)
+
+
+class GlobalLimitExec(PlanNode):
+    """Whole-query limit: single output partition (GpuGlobalLimitExec)."""
+
+    def __init__(self, limit: int, child: PlanNode):
+        super().__init__([child])
+        self._limit = limit
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return 1
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child = self.children[0]
+        all_parts = (b for cpid in range(child.num_partitions(ctx))
+                     for b in child.partition_iter(ctx, cpid))
+        yield from _limited(ctx, all_parts, self._limit)

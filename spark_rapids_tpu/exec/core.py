@@ -1,0 +1,258 @@
+"""Physical plan node base + execution context.
+
+TPU analog of the reference's ``GpuExec`` layer (reference
+sql-plugin/src/main/scala/com/nvidia/spark/rapids/GpuExec.scala:65-137):
+a columnar physical operator produces, per partition, an iterator of
+batches.  Where the reference rides Spark's RDD machinery
+(``doExecuteColumnar(): RDD[ColumnarBatch]``), this standalone engine
+models the same contract directly: ``num_partitions`` + per-partition
+batch iterators, with exchanges as stage barriers.
+
+Every node runs on two backends:
+* ``device`` — ColumnBatch (jax, jit-compiled kernels), the TPU path;
+* ``host``   — HostBatch (numpy), the CPU oracle used for differential
+  testing (reference SparkQueryCompareTestSuite.scala:153-167) and as the
+  CPU baseline for benchmarks.
+
+Metrics mirror GpuMetricNames (GpuExec.scala:27-56): numOutputRows,
+numOutputBatches, totalTime per operator.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.host.batch import HostBatch
+
+__all__ = [
+    "ExecCtx", "PlanNode", "CoalesceGoal", "TargetSize", "RequireSingleBatch",
+    "collect", "collect_host", "collect_device", "Metrics",
+]
+
+
+# ---------------------------------------------------------------------------
+# Batching contracts (reference CoalesceGoal algebra,
+# GpuCoalesceBatches.scala:94-130)
+# ---------------------------------------------------------------------------
+
+class CoalesceGoal:
+    def max_with(self, other: "CoalesceGoal") -> "CoalesceGoal":
+        if isinstance(self, RequireSingleBatchT) or \
+                isinstance(other, RequireSingleBatchT):
+            return RequireSingleBatch
+        assert isinstance(self, TargetSize) and isinstance(other, TargetSize)
+        return self if self.size >= other.size else other
+
+    def satisfies(self, other: "CoalesceGoal") -> bool:
+        if isinstance(other, RequireSingleBatchT):
+            return isinstance(self, RequireSingleBatchT)
+        return True
+
+
+@dataclass(frozen=True)
+class TargetSize(CoalesceGoal):
+    size: int
+
+
+class RequireSingleBatchT(CoalesceGoal):
+    def __repr__(self):
+        return "RequireSingleBatch"
+
+
+RequireSingleBatch = RequireSingleBatchT()
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+class Metrics:
+    """Per-operator metric map (reference GpuMetricNames)."""
+
+    def __init__(self):
+        self.values: dict[str, float] = {}
+
+    def add(self, name: str, v: float):
+        self.values[name] = self.values.get(name, 0.0) + v
+
+    def __getitem__(self, name: str) -> float:
+        return self.values.get(name, 0.0)
+
+
+@dataclass
+class ExecCtx:
+    """Execution context: backend selection + conf + metrics sink."""
+
+    backend: str = "device"          # "device" | "host"
+    conf: TpuConf = field(default_factory=lambda: TpuConf({}))
+    metrics: dict[str, Metrics] = field(default_factory=dict)
+
+    def metrics_for(self, node: "PlanNode") -> Metrics:
+        key = f"{type(node).__name__}@{id(node):x}"
+        if key not in self.metrics:
+            self.metrics[key] = Metrics()
+        return self.metrics[key]
+
+    @property
+    def is_device(self) -> bool:
+        return self.backend == "device"
+
+
+# ---------------------------------------------------------------------------
+# Plan node
+# ---------------------------------------------------------------------------
+
+class PlanNode:
+    """Base physical operator.
+
+    Subclasses implement ``partition_iter`` producing batches for one
+    partition on the active backend. ``output_schema`` is the operator's
+    output schema; ``children`` its inputs.
+    """
+
+    def __init__(self, children: Sequence["PlanNode"]):
+        self.children = tuple(children)
+
+    # -- contract ----------------------------------------------------------
+    @property
+    def output_schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        if self.children:
+            return self.children[0].num_partitions(ctx)
+        return 1
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        raise NotImplementedError
+
+    # -- batching contracts (reference GpuExec.scala:71-86) ----------------
+    @property
+    def children_coalesce_goal(self) -> list[CoalesceGoal | None]:
+        return [None] * len(self.children)
+
+    @property
+    def output_batching(self) -> CoalesceGoal | None:
+        return None
+
+    # -- execution helpers -------------------------------------------------
+    def execute(self, ctx: ExecCtx) -> Iterator:
+        """All partitions' batches, in partition order, with output
+        metrics recorded for this (root) node."""
+        for pid in range(self.num_partitions(ctx)):
+            yield from self.timed_iter(ctx, self.partition_iter(ctx, pid))
+
+    def timed_iter(self, ctx: ExecCtx, it: Iterator) -> Iterator:
+        """Wrap an iterator with totalTime / output metrics."""
+        m = ctx.metrics_for(self)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            m.add("totalTime", time.perf_counter() - t0)
+            m.add("numOutputBatches", 1)
+            yield batch
+
+    # -- plan introspection ------------------------------------------------
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.node_desc() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def node_desc(self) -> str:
+        return type(self).__name__
+
+
+# ---------------------------------------------------------------------------
+# Collect surface
+# ---------------------------------------------------------------------------
+
+def _rows_from_host(b: HostBatch) -> list[tuple]:
+    cols = [c.to_list() for c in b.columns]
+    return list(zip(*cols)) if cols else [()] * b.num_rows
+
+
+def collect_host(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
+    """Run on the CPU oracle; rows as python tuples."""
+    ctx = ExecCtx(backend="host", conf=conf or TpuConf({}))
+    out: list[tuple] = []
+    for b in plan.execute(ctx):
+        out.extend(_rows_from_host(b))
+    return out
+
+
+def collect_device(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
+    """Run on the TPU path; rows as python tuples (D2H at the end only)."""
+    ctx = ExecCtx(backend="device", conf=conf or TpuConf({}))
+    out: list[tuple] = []
+    for b in plan.execute(ctx):
+        hb = device_to_host(b)
+        out.extend(_rows_from_host(hb))
+    return out
+
+
+def collect(plan: PlanNode, backend: str = "device",
+            conf: TpuConf | None = None) -> list[tuple]:
+    if backend == "host":
+        return collect_host(plan, conf)
+    return collect_device(plan, conf)
+
+
+def device_to_host(b: ColumnBatch) -> HostBatch:
+    """D2H: ColumnBatch -> HostBatch (reference GpuColumnarToRowExec /
+    GpuBringBackToHost transition)."""
+    import jax
+    import numpy as np
+    from spark_rapids_tpu.host.batch import HostColumn
+    n = b.host_num_rows()
+    host = jax.device_get([(c.data, c.validity, c.lengths) for c in b.columns])
+    cols = []
+    for f, (data, validity, lengths) in zip(b.schema, host):
+        v = np.asarray(validity[:n], dtype=np.bool_)
+        if isinstance(f.data_type, T.StringType):
+            bm = np.asarray(data[:n])
+            ln = np.asarray(lengths[:n])
+            py = np.empty(n, dtype=object)
+            for i in range(n):
+                py[i] = bytes(bm[i, :ln[i]]).decode("utf-8", "replace") \
+                    if v[i] else None
+            cols.append(HostColumn(py, v, f.data_type))
+        else:
+            cols.append(HostColumn(np.asarray(data[:n]), v, f.data_type))
+    return HostBatch(cols, b.schema)
+
+
+def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
+    """H2D: HostBatch -> ColumnBatch (reference HostColumnarToGpu)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import round_capacity
+    from spark_rapids_tpu.columnar.column import (DeviceColumn,
+                                                  round_string_width)
+    n = b.num_rows
+    cap = capacity or round_capacity(max(n, 1))
+    cols = []
+    for f, col in zip(b.schema, b.columns):
+        if isinstance(f.data_type, T.StringType):
+            enc = [(x.encode("utf-8") if x is not None else b"")
+                   for x in col.data]
+            maxw = max((len(e) for e in enc), default=1)
+            w = round_string_width(max(maxw, 1))
+            bm = np.zeros((n, w), dtype=np.uint8)
+            lens = np.zeros(n, dtype=np.int32)
+            for i, e in enumerate(enc):
+                bm[i, :len(e)] = np.frombuffer(e, dtype=np.uint8)
+                lens[i] = len(e)
+            cols.append(DeviceColumn.strings_from_numpy(
+                bm, lens, col.validity, cap))
+        else:
+            cols.append(DeviceColumn.from_numpy(
+                col.data, col.validity, f.data_type, cap))
+    return ColumnBatch(cols, jnp.asarray(n, dtype=jnp.int32), b.schema)

@@ -1,0 +1,145 @@
+"""Sort and coalesce execs.
+
+Reference: GpuSortExec.scala:51 (cuDF ``Table.orderBy`` per batch;
+``RequireSingleBatch`` goal for a total sort), GpuCoalesceBatches.scala
+(AbstractGpuCoalesceIterator :132 — concatenates small batches up to a
+``CoalesceGoal``).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import (CoalesceGoal, ExecCtx, PlanNode,
+                                        RequireSingleBatch, RequireSingleBatchT,
+                                        TargetSize)
+from spark_rapids_tpu.expr.core import Expression, bind
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops.sort import SortOrder, sort_batch
+
+__all__ = ["SortExec", "CoalesceBatchesExec", "resolve_orders"]
+
+
+def resolve_orders(orders: Sequence, schema: T.Schema) -> list[SortOrder]:
+    """Accept SortOrder or (expr|name, ascending[, nulls_first]) tuples and
+    resolve to column-index SortOrders. Sort keys must be plain columns
+    (pre-project computed keys, as Spark's planner does)."""
+    out: list[SortOrder] = []
+    for o in orders:
+        if isinstance(o, SortOrder):
+            out.append(o)
+            continue
+        name, *rest = o if isinstance(o, tuple) else (o,)
+        if isinstance(name, Expression):
+            b = bind(name, schema)
+            from spark_rapids_tpu.expr.core import BoundReference
+            assert isinstance(b, BoundReference), \
+                "sort keys must be column references; project first"
+            idx = b.index
+        else:
+            idx = schema.index_of(name)
+        asc = rest[0] if rest else True
+        nf = rest[1] if len(rest) > 1 else None
+        out.append(SortOrder(idx, asc, nf))
+    return out
+
+
+class SortExec(PlanNode):
+    """Sort each partition. With ``global_sort`` the input is first
+    coalesced to a single batch per partition (reference: GpuSortExec's
+    RequireSingleBatch child goal for total ordering; cross-partition
+    ordering is the exchange's job via range partitioning)."""
+
+    def __init__(self, orders: Sequence, child: PlanNode,
+                 global_sort: bool = False):
+        super().__init__([child])
+        self._orders = resolve_orders(orders, child.output_schema)
+        self._global = global_sort
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    @property
+    def children_coalesce_goal(self) -> list[CoalesceGoal | None]:
+        return [RequireSingleBatch if self._global else None]
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child_it = self.children[0].partition_iter(ctx, pid)
+        if ctx.is_device:
+            batches = list(child_it)
+            if not batches:
+                return
+            b = batches[0] if len(batches) == 1 else dk.concat_batches(batches)
+            yield sort_batch(b, self._orders)
+        else:
+            batches = list(child_it)
+            if not batches:
+                return
+            b = batches[0] if len(batches) == 1 else hk.host_concat(batches)
+            yield hk.host_sort(b, self._orders)
+
+    def node_desc(self) -> str:
+        return f"SortExec[{self._orders}]"
+
+
+class CoalesceBatchesExec(PlanNode):
+    """Concatenate small batches up to the goal (GpuCoalesceBatches)."""
+
+    def __init__(self, goal: CoalesceGoal, child: PlanNode):
+        super().__init__([child])
+        self._goal = goal
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    @property
+    def output_batching(self) -> CoalesceGoal:
+        return self._goal
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child_it = self.children[0].partition_iter(ctx, pid)
+        if isinstance(self._goal, RequireSingleBatchT):
+            batches = list(child_it)
+            if not batches:
+                return
+            if len(batches) == 1:
+                yield batches[0]
+            elif ctx.is_device:
+                yield dk.concat_batches(batches)
+            else:
+                yield hk.host_concat(batches)
+            return
+        assert isinstance(self._goal, TargetSize)
+        target = self._goal.size
+        pending: list = []
+        pending_bytes = 0
+        for b in child_it:
+            sz = b.device_size_bytes() if ctx.is_device else _host_bytes(b)
+            if pending and pending_bytes + sz > target:
+                yield self._flush(ctx, pending)
+                pending, pending_bytes = [], 0
+            pending.append(b)
+            pending_bytes += sz
+        if pending:
+            yield self._flush(ctx, pending)
+
+    def _flush(self, ctx: ExecCtx, batches: list):
+        if len(batches) == 1:
+            return batches[0]
+        return dk.concat_batches(batches) if ctx.is_device \
+            else hk.host_concat(batches)
+
+
+def _host_bytes(b: HostBatch) -> int:
+    total = 0
+    for c in b.columns:
+        if c.data.dtype == object:
+            total += sum(len(x) for x in c.data if x is not None) + len(c.data)
+        else:
+            total += c.data.nbytes
+        total += c.validity.nbytes
+    return total

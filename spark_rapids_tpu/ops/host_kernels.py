@@ -1,0 +1,247 @@
+"""Host (CPU oracle) batch kernels: sort, group-by, filter, concat, slice.
+
+The reference uses CPU Spark itself as the differential-test oracle
+(tests/SparkQueryCompareTestSuite.scala:153-167,
+integration_tests asserts.py:290 ``assert_gpu_and_cpu_are_equal_collect``).
+This framework is standalone, so the CPU engine lives here: numpy-vectorized
+implementations with exactly Spark's ordering/equality semantics (null
+ordering, NaN largest + NaN==NaN for keys, -0.0==0.0).  These also serve as
+the CPU baseline that `bench.py` compares the TPU path against.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+from spark_rapids_tpu.ops.segmented import AggSpec
+from spark_rapids_tpu.ops.sort import SortOrder
+
+__all__ = [
+    "host_sort_permutation", "host_sort", "host_filter", "host_concat",
+    "host_slice", "host_group_by", "host_take",
+]
+
+
+def _f64_sortable_bits(x: np.ndarray) -> np.ndarray:
+    """IEEE754 -> uint64 total order (NaN above +inf, -0.0 == +0.0)."""
+    x = x.astype(np.float64)
+    x = np.where(x == 0.0, 0.0, x)                  # -0.0 -> +0.0
+    x = np.where(np.isnan(x), np.float64("nan"), x)  # canonical NaN
+    bits = x.view(np.uint64).copy()
+    neg = bits >> np.uint64(63) != 0
+    bits = np.where(neg, ~bits, bits | np.uint64(1) << np.uint64(63))
+    # canonical NaN (0x7ff8...) encodes above +inf already via the flip
+    return bits
+
+
+def _key_codes(col: HostColumn, ascending: bool,
+               nulls_first: bool) -> list[np.ndarray]:
+    """Encode a column as sortable integer key arrays (most-significant
+    first).  Null indicator precedes the value key."""
+    v = col.validity
+    null_key = np.where(v, np.uint8(1 if nulls_first else 0),
+                        np.uint8(0 if nulls_first else 1))
+    dt = col.dtype
+    if isinstance(dt, T.StringType):
+        s = np.array(["" if x is None else x for x in col.data], dtype=str)
+        _, codes = np.unique(s, return_inverse=True)
+        codes = codes.astype(np.int64)
+        val = codes if ascending else -codes
+    elif dt.fractional:
+        bits = _f64_sortable_bits(col.data)
+        val = bits if ascending else ~bits
+    else:
+        u = col.data.astype(np.int64).view(np.uint64) ^ (np.uint64(1) << np.uint64(63))
+        val = u if ascending else ~u
+    val = np.where(v, val, np.zeros((), val.dtype))
+    return [null_key, val]
+
+
+def host_sort_permutation(batch: HostBatch,
+                          orders: Sequence[SortOrder]) -> np.ndarray:
+    """Stable permutation sorting the batch by ``orders``."""
+    keys: list[np.ndarray] = []
+    for o in orders:
+        keys.extend(_key_codes(batch.columns[o.child_index], o.ascending,
+                               o.resolved_nulls_first))
+    if not keys:
+        return np.arange(batch.num_rows)
+    # np.lexsort: LAST key is primary -> reverse
+    return np.lexsort(list(reversed(keys)))
+
+
+def host_sort(batch: HostBatch, orders: Sequence[SortOrder]) -> HostBatch:
+    perm = host_sort_permutation(batch, orders)
+    return HostBatch([c.take(perm) for c in batch.columns], batch.schema)
+
+
+def host_take(batch: HostBatch, indices: np.ndarray) -> HostBatch:
+    return HostBatch([c.take(indices) for c in batch.columns], batch.schema)
+
+
+def host_filter(batch: HostBatch, mask: np.ndarray) -> HostBatch:
+    return HostBatch([c.filter(mask) for c in batch.columns], batch.schema)
+
+
+def host_slice(batch: HostBatch, start: int, end: int) -> HostBatch:
+    idx = np.arange(max(start, 0), min(end, batch.num_rows))
+    return host_take(batch, idx)
+
+
+def host_concat(batches: Sequence[HostBatch]) -> HostBatch:
+    assert batches, "empty concat"
+    schema = batches[0].schema
+    cols = []
+    for i, f in enumerate(schema):
+        data = np.concatenate([b.columns[i].data for b in batches])
+        validity = np.concatenate([b.columns[i].validity for b in batches])
+        cols.append(HostColumn(data, validity, f.data_type))
+    return HostBatch(cols, schema)
+
+
+# ---------------------------------------------------------------------------
+# group-by (oracle analog of ops.segmented.sorted_group_by)
+# ---------------------------------------------------------------------------
+
+def _group_codes(col: HostColumn) -> list[np.ndarray]:
+    """Key arrays (null indicator + value code) where equal values (Spark
+    key equality: null==null, NaN==NaN, -0.0==0.0) get equal codes, ordered
+    ascending with nulls first.  The separate null indicator avoids any
+    value/null sentinel collision."""
+    v = col.validity
+    dt = col.dtype
+    if isinstance(dt, T.StringType):
+        s = np.array(["" if x is None else x for x in col.data], dtype=str)
+        _, codes = np.unique(s, return_inverse=True)
+        codes = codes.astype(np.int64)
+    elif dt.fractional:
+        codes = _f64_sortable_bits(col.data).view(np.int64)
+    else:
+        codes = col.data.astype(np.int64)
+    return [v.astype(np.uint8), np.where(v, codes, np.int64(0))]
+
+
+def _agg_reduce(spec: AggSpec, col: HostColumn | None, seg_starts: np.ndarray,
+                seg_lens: np.ndarray, perm: np.ndarray,
+                in_type: T.DataType) -> HostColumn:
+    """Compute one aggregate per segment of the permuted batch."""
+    ngroups = len(seg_starts)
+    res_type = spec.result_type(in_type)
+    if spec.op == "count_star":
+        data = seg_lens.astype(np.int64)
+        return HostColumn(data, np.ones(ngroups, np.bool_), T.LongType())
+    assert col is not None
+    pv = col.validity[perm]
+    out_valid = np.zeros(ngroups, np.bool_)
+    if isinstance(res_type, T.StringType):
+        out = np.empty(ngroups, dtype=object)
+    else:
+        out = np.zeros(ngroups, dtype=res_type.np_dtype)
+    pd = col.data[perm]
+    for g in range(ngroups):
+        sl = slice(seg_starts[g], seg_starts[g] + seg_lens[g])
+        seg_d, seg_v = pd[sl], pv[sl]
+        vals = seg_d[seg_v]
+        if spec.op == "count":
+            out[g] = len(vals)
+            out_valid[g] = True
+            continue
+        if spec.op in ("first", "last"):
+            # first/last including nulls (ignoreNulls=False)
+            if seg_lens[g] > 0:
+                i = 0 if spec.op == "first" else seg_lens[g] - 1
+                if seg_v[i]:
+                    out[g] = seg_d[i]
+                    out_valid[g] = True
+            continue
+        if len(vals) == 0:
+            continue
+        if spec.op == "sum":
+            if res_type.integral:
+                out[g] = np.int64(np.sum(vals.astype(np.int64), dtype=np.int64))
+            else:
+                out[g] = np.sum(vals.astype(np.float64))
+            out_valid[g] = True
+        elif spec.op == "min":
+            out[g] = _nan_aware_min(vals, in_type)
+            out_valid[g] = True
+        elif spec.op == "max":
+            out[g] = _nan_aware_max(vals, in_type)
+            out_valid[g] = True
+        elif spec.op == "avg":
+            out[g] = np.sum(vals.astype(np.float64)) / len(vals)
+            out_valid[g] = True
+        elif spec.op == "first_non_null":
+            out[g] = vals[0]
+            out_valid[g] = True
+        elif spec.op == "last_non_null":
+            out[g] = vals[-1]
+            out_valid[g] = True
+        else:
+            raise NotImplementedError(spec.op)
+    return HostColumn(out, out_valid, res_type)
+
+
+def _nan_aware_min(vals, dt: T.DataType):
+    if isinstance(dt, T.StringType):
+        return min(vals)
+    if dt.fractional:
+        # Spark: NaN is largest -> min ignores NaN unless all NaN
+        nn = vals[~np.isnan(vals.astype(np.float64))]
+        return np.min(nn) if len(nn) else vals[0]
+    return np.min(vals)
+
+
+def _nan_aware_max(vals, dt: T.DataType):
+    if isinstance(dt, T.StringType):
+        return max(vals)
+    if dt.fractional:
+        f = vals.astype(np.float64)
+        return vals[np.argmax(np.where(np.isnan(f), np.inf, f))] \
+            if np.isnan(f).any() else np.max(vals)
+    return np.max(vals)
+
+
+def host_group_by(batch: HostBatch, key_indices: Sequence[int],
+                  aggs: Sequence[AggSpec]) -> HostBatch:
+    """Group ``batch`` by keys computing ``aggs``; output = keys then aggs,
+    groups in ascending key order (matches device sorted_group_by)."""
+    n = batch.num_rows
+    if key_indices:
+        codes: list[np.ndarray] = []
+        for k in key_indices:
+            codes.extend(_group_codes(batch.columns[k]))
+        perm = np.lexsort(list(reversed(codes)))
+        pc = [c[perm] for c in codes]
+        if n == 0:
+            boundaries = np.zeros(0, np.bool_)
+        else:
+            differ = np.zeros(n, np.bool_)
+            differ[0] = True
+            for c in pc:
+                differ[1:] |= c[1:] != c[:-1]
+            boundaries = differ
+        seg_starts = np.nonzero(boundaries)[0]
+        seg_lens = np.diff(np.append(seg_starts, n))
+    else:
+        perm = np.arange(n)
+        seg_starts = np.zeros(1, np.int64)
+        seg_lens = np.array([n], np.int64)
+
+    out_cols: list[HostColumn] = []
+    out_fields: list[T.StructField] = []
+    for k in key_indices:
+        col = batch.columns[k]
+        out_cols.append(col.take(perm[seg_starts]))
+        out_fields.append(batch.schema.fields[k])
+    for spec in aggs:
+        col = batch.columns[spec.child_index] if spec.op != "count_star" else None
+        in_t = col.dtype if col is not None else T.LongType()
+        out_cols.append(_agg_reduce(spec, col, seg_starts, seg_lens, perm, in_t))
+        arg = "1" if spec.op == "count_star" else batch.schema.names[spec.child_index]
+        name = f"count({arg})" if spec.op == "count_star" else f"{spec.op}({arg})"
+        out_fields.append(T.StructField(name, spec.result_type(in_t)))
+    return HostBatch(out_cols, T.Schema(out_fields))

@@ -1,0 +1,78 @@
+"""Differential-test harness: TPU path vs CPU oracle.
+
+Reference: integration_tests/src/main/python/asserts.py —
+``assert_gpu_and_cpu_are_equal_collect`` (:290) runs the same query on CPU
+and GPU and compares collected rows, with ``ignore_order`` and
+``approximate_float`` options (marks.py:17-25).  Here the two engines are
+the two backends of the same plan tree.
+"""
+from __future__ import annotations
+
+import math
+
+from spark_rapids_tpu.exec.core import PlanNode, collect_device, collect_host
+
+__all__ = ["assert_tpu_and_cpu_equal", "rows_equal"]
+
+
+def _val_equal(a, b, approx: bool) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if approx:
+            return math.isclose(fa, fb, rel_tol=1e-6, abs_tol=1e-9)
+        return fa == fb
+    return a == b
+
+
+def rows_equal(r1, r2, approx: bool = False) -> bool:
+    return len(r1) == len(r2) and all(
+        _val_equal(a, b, approx) for a, b in zip(r1, r2))
+
+
+def _sort_key(row):
+    """Null-safe, type-aware row ordering for ignore_order comparison.
+    Floats order numerically with -0.0 == 0.0 and NaN last, so rows that are
+    equal under ``rows_equal`` land at matching positions on both backends."""
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, 0, ""))
+        elif isinstance(v, bool):
+            out.append((1, int(v), ""))
+        elif isinstance(v, float):
+            if math.isnan(v):
+                out.append((3, 0, ""))
+            else:
+                out.append((2, v + 0.0, ""))  # -0.0 -> 0.0
+        elif isinstance(v, int):
+            # float() tier for cross-row ordering; str tiebreak keeps i64
+            # values beyond 2^53 deterministically ordered
+            out.append((2, float(v), str(v)))
+        else:
+            out.append((4, 0, str(v)))
+    return out
+
+
+def assert_tpu_and_cpu_equal(plan: PlanNode, ignore_order: bool = True,
+                             approximate_float: bool = True,
+                             conf=None) -> list[tuple]:
+    """Run ``plan`` on both backends and compare collected rows.
+
+    Returns the CPU rows (for further assertions). Mirrors
+    assert_gpu_and_cpu_are_equal_collect (asserts.py:290).
+    """
+    cpu = collect_host(plan, conf)
+    tpu = collect_device(plan, conf)
+    assert len(cpu) == len(tpu), \
+        f"row count mismatch: cpu={len(cpu)} tpu={len(tpu)}\n" \
+        f"cpu={cpu[:10]}\ntpu={tpu[:10]}"
+    c, t = (cpu, tpu) if not ignore_order else \
+        (sorted(cpu, key=_sort_key), sorted(tpu, key=_sort_key))
+    for i, (rc, rt) in enumerate(zip(c, t)):
+        assert rows_equal(rc, rt, approximate_float), \
+            f"row {i} differs:\n cpu={rc}\n tpu={rt}"
+    return cpu
