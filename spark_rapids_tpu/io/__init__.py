@@ -1,0 +1,14 @@
+"""I/O layer: columnar file scans and writers (reference SURVEY §2.5).
+
+Host-side decode is Arrow (pyarrow) — the TPU-first substitute for cuDF's
+device Parquet/ORC/CSV decoders: files decode on host threads into Arrow
+record batches that transfer to HBM without per-row conversion, with
+multithreaded prefetch overlapping host I/O with device compute (reference
+GpuParquetScan.scala MultiFileCloudParquetPartitionReader :1145).
+"""
+from spark_rapids_tpu.io.scan import (CsvScanExec, FileScanExec, OrcScanExec,
+                                      ParquetScanExec)
+from spark_rapids_tpu.io.writer import (write_csv, write_orc, write_parquet)
+
+__all__ = ["FileScanExec", "ParquetScanExec", "OrcScanExec", "CsvScanExec",
+           "write_parquet", "write_orc", "write_csv"]

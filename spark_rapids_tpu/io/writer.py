@@ -1,0 +1,105 @@
+"""Columnar file writers: Parquet / ORC / CSV.
+
+Reference: GpuParquetFileFormat.scala, GpuOrcFileFormat.scala,
+ColumnarOutputWriter (ColumnarFileFormat.scala:57), GpuFileFormatWriter
+(Spark write protocol: one part file per partition, _SUCCESS marker).
+TPU path: batches come back D2H as Arrow and pyarrow writes them — the
+host-encode mirror of the host-decode scan path.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Iterator
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.host.batch import HostBatch
+
+__all__ = ["write_parquet", "write_orc", "write_csv"]
+
+
+def _arrow_batches(plan: PlanNode, ctx: ExecCtx, pid: int) -> Iterator:
+    """One partition's output as pyarrow RecordBatches."""
+    import pyarrow as pa
+    schema = plan.output_schema.to_arrow()
+    for b in plan.partition_iter(ctx, pid):
+        if isinstance(b, ColumnBatch):
+            rb = b.to_arrow()
+        else:
+            rb = _host_to_arrow(b)
+        if rb.num_rows:
+            yield rb.cast(schema) if rb.schema != schema else rb
+
+
+def _host_to_arrow(b: HostBatch):
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    arrays = []
+    for f, c in zip(b.schema, b.columns):
+        at = T.to_arrow(f.data_type)
+        mask = ~c.validity
+        if isinstance(f.data_type, T.StringType):
+            arrays.append(pa.array(
+                [None if m else v for v, m in zip(c.data, mask)], type=at))
+        elif isinstance(f.data_type, (T.DateType, T.TimestampType)):
+            arrays.append(pa.Array.from_buffers(
+                at, len(c.data),
+                pa.array(c.data.astype(
+                    "int32" if isinstance(f.data_type, T.DateType)
+                    else "int64"), mask=mask).buffers()))
+        else:
+            arrays.append(pa.array(c.data, type=at, mask=mask))
+    return pa.RecordBatch.from_arrays(arrays, schema=b.schema.to_arrow())
+
+
+def _write(plan: PlanNode, path: str, fmt: str, ctx: ExecCtx | None = None,
+           **options) -> list[str]:
+    """Write the plan's output as one part file per partition under
+    ``path`` (Spark directory-output protocol), returning written files."""
+    import pyarrow as pa
+    ctx = ctx or ExecCtx()
+    os.makedirs(path, exist_ok=True)
+    job_id = uuid.uuid4().hex[:8]
+    schema = plan.output_schema.to_arrow()
+    written: list[str] = []
+    for pid in range(plan.num_partitions(ctx)):
+        batches = list(_arrow_batches(plan, ctx, pid))
+        if not batches and (written or pid != plan.num_partitions(ctx) - 1):
+            continue
+        # empty result: still emit one schema-bearing empty part file
+        # (Spark's write protocol) so the output stays readable
+        fname = os.path.join(
+            path, f"part-{pid:05d}-{job_id}.{fmt}")
+        table = pa.Table.from_batches(batches, schema=schema) if batches \
+            else schema.empty_table()
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(table, fname, **options)
+        elif fmt == "orc":
+            import pyarrow.orc as orc
+            orc.write_table(table, fname)
+        elif fmt == "csv":
+            import pyarrow.csv as pc
+            pc.write_csv(table, fname)
+        else:
+            raise ValueError(fmt)
+        written.append(fname)
+    # commit marker (Spark's _SUCCESS protocol)
+    open(os.path.join(path, "_SUCCESS"), "w").close()
+    return written
+
+
+def write_parquet(plan: PlanNode, path: str, ctx: ExecCtx | None = None,
+                  **options) -> list[str]:
+    return _write(plan, path, "parquet", ctx, **options)
+
+
+def write_orc(plan: PlanNode, path: str, ctx: ExecCtx | None = None
+              ) -> list[str]:
+    return _write(plan, path, "orc", ctx)
+
+
+def write_csv(plan: PlanNode, path: str, ctx: ExecCtx | None = None
+              ) -> list[str]:
+    return _write(plan, path, "csv", ctx)
