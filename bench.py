@@ -1,0 +1,74 @@
+"""Benchmark: TPC-DS-q6-shaped columnar step, device vs CPU oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The tracked north star (BASELINE.json) is >=4x speedup over CPU Spark on
+TPC-DS; this bench measures the framework's hot path (scan-resident
+filter -> group-by aggregate, SURVEY.md §3.3) on the device vs the
+single-threaded CPU oracle engine on identical data, so
+vs_baseline = speedup / 4.0.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    from __graft_entry__ import SCHEMA, _SPECS, _make_host_batch, \
+        _q6_condition, query_step
+    from spark_rapids_tpu.expr.core import bind, eval_host
+    from spark_rapids_tpu.ops.host_kernels import host_filter, host_group_by
+
+    n = 1 << 20
+    cap = 1 << 20
+    # host data first, uploaded once; never device_get the device inputs —
+    # under the axon tunnel a fetched array degrades later executions to a
+    # re-upload per call.
+    hb = _make_host_batch(n, seed=3)
+    batch = hb.to_device(capacity=cap)
+
+    # --- device path (jitted, steady-state) ---------------------------
+    step = jax.jit(query_step)
+    out = step(batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))  # compile+warm
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = step(batch)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        times.append(time.perf_counter() - t0)
+    dev_t = float(np.median(times))
+
+    # --- CPU oracle ---------------------------------------------------
+    cond = bind(_q6_condition(), SCHEMA)
+
+    def host_step(b):
+        c = eval_host(cond, b)
+        kept = host_filter(b, c.data.astype(bool) & c.validity)
+        return host_group_by(kept, [0], list(_SPECS))
+
+    h0 = time.perf_counter()
+    hout = host_step(hb)
+    host_t = time.perf_counter() - h0
+
+    # sanity: same group count
+    assert hout.num_rows == out.host_num_rows(), \
+        (hout.num_rows, out.host_num_rows())
+
+    speedup = host_t / dev_t
+    print(json.dumps({
+        "metric": "q6like_filter_groupby_speedup_vs_cpu_oracle_1M_rows",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 4.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Device mesh construction and batch sharding.
+
+The unit of distribution is a `ColumnBatch` shard per mesh slot along a
+named axis (default ``"data"``) — the TPU analog of one Spark task's
+partition living on one executor's GPU (reference
+sql-plugin/.../GpuShuffleExchangeExec.scala + RapidsShuffleManager).
+
+A *sharded batch* is an ordinary `ColumnBatch` pytree whose every leaf has
+a leading device axis P (``num_rows`` is ``int32[P]``), placed with a
+`NamedSharding` so that leaf axis 0 maps onto the mesh axis.  Inside
+`shard_map` each device sees leading extent 1; `_local_view` squeezes that
+away to recover a plain per-device `ColumnBatch`.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+__all__ = ["make_mesh", "shard_batches", "unshard_batch", "local_view",
+           "stacked_spec"]
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = "data",
+              devices: Sequence | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (all by default)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def stacked_spec(axis_name: str = "data") -> P:
+    """PartitionSpec prefix for every leaf of a stacked batch."""
+    return P(axis_name)
+
+
+def shard_batches(batches: Sequence[ColumnBatch], mesh: Mesh,
+                  axis_name: str = "data") -> ColumnBatch:
+    """Stack P per-device batches (same schema+capacity) into one sharded
+    batch pytree with leading device axis P placed along ``axis_name``."""
+    p = mesh.shape[axis_name]
+    if len(batches) != p:
+        raise ValueError(f"need {p} shards, got {len(batches)}")
+    schema = batches[0].schema
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def place(*leaves):
+        return jax.device_put(jnp.stack(leaves), sharding)
+
+    stacked = jax.tree_util.tree_map(place, *batches)
+    # tree_map over ColumnBatch pytrees rebuilds a ColumnBatch (schema aux
+    # is shared); its num_rows leaf is now int32[P].
+    assert isinstance(stacked, ColumnBatch)
+    assert stacked.schema == schema
+    return stacked
+
+
+def local_view(stacked: ColumnBatch) -> ColumnBatch:
+    """Inside shard_map: squeeze the leading extent-1 device axis."""
+    return jax.tree_util.tree_map(lambda x: x[0], stacked)
+
+
+def restack(local: ColumnBatch) -> ColumnBatch:
+    """Inside shard_map: re-add the leading device axis before returning."""
+    return jax.tree_util.tree_map(lambda x: x[None], local)
+
+
+def unshard_batch(stacked: ColumnBatch) -> list[ColumnBatch]:
+    """Pull a sharded batch back to P host-side ColumnBatch shards."""
+    leaves, treedef = jax.tree_util.tree_flatten(stacked)
+    host = jax.device_get(leaves)
+    p = host[-1].shape[0] if host else 1  # num_rows is int32[P]
+    out = []
+    for i in range(p):
+        out.append(jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(leaf[i]) for leaf in host]))
+    return out

@@ -1,0 +1,230 @@
+"""All-to-all hash exchange + distributed aggregation over a device mesh.
+
+TPU-native shuffle data plane (SURVEY.md §5.8).  The reference moves map
+output peer-to-peer over UCX tag matching (shuffle-plugin/.../UCX.scala,
+RapidsShuffleClient.scala, RapidsShuffleServer.scala); here the exchange
+is one XLA `all_to_all` collective inside `shard_map`, so it rides ICI
+within a slice and DCN across slices with zero host involvement, and it
+fuses with the surrounding kernels in one compiled program.
+
+Design: every device holds a fixed-capacity shard.  A shuffle step is
+  1. partition ids per row: Spark-bit-exact murmur3 pmod P
+     (reference GpuHashPartitioning.scala),
+  2. bucketize: one stable sort by partition id, then scatter into a
+     [P, C] send buffer per column (reference Table.contiguousSplit,
+     GpuPartitioning.scala:45-52),
+  3. `lax.all_to_all` on the [P, C] buffers (+ per-target row counts),
+  4. repack the received [P, C] buffers into one [P*C]-capacity batch
+     (front-pack permutation — reference concatenates received shuffle
+     buffers, RapidsShuffleClient BufferReceiveState).
+
+All shapes are static; row validity travels as counts, so the whole
+exchange jits and the compiler overlaps the collective with compute.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.core import EvalCtx, Val
+from spark_rapids_tpu.expr.hashing import murmur3_val, DEFAULT_SEED
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops.segmented import AggSpec, sorted_group_by
+from spark_rapids_tpu.parallel.mesh import local_view, restack
+
+__all__ = [
+    "partition_ids_for_keys", "make_hash_exchange",
+    "make_distributed_groupby", "MERGE_OPS",
+]
+
+
+def partition_ids_for_keys(batch: ColumnBatch, key_indices: Sequence[int],
+                           num_parts: int) -> jax.Array:
+    """int32[capacity]: pmod(murmur3(keys), P) per real row; P for padding.
+
+    Bit-exact with Spark's HashPartitioning(Murmur3Hash) so host- and
+    device-partitioned data interleave (reference GpuHashPartitioning).
+    """
+    cap = batch.capacity
+    mask = batch.row_mask()
+    ctx = EvalCtx(jnp, True, cap, mask)
+    seed = jnp.full(cap, DEFAULT_SEED, dtype=jnp.uint32)
+    for ki in key_indices:
+        c = batch.columns[ki]
+        seed = murmur3_val(Val(c.data, c.validity, c.lengths, c.dtype),
+                           seed, ctx)
+    h = seed.astype(jnp.int32)
+    pid = ((h % num_parts) + num_parts) % num_parts  # Spark pmod
+    return jnp.where(mask, pid, num_parts)
+
+
+def _bucketize(batch: ColumnBatch, part: jax.Array, num_parts: int):
+    """Split into [P, C] per-column send buffers + int32[P] counts."""
+    cap = batch.capacity
+    counts = jnp.sum(part[None, :] == jnp.arange(num_parts, dtype=jnp.int32)[:, None],
+                     axis=1, dtype=jnp.int32)
+    order = jnp.argsort(part, stable=True)       # padding (P) sinks to end
+    sorted_part = part[order]
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    rank = jnp.arange(cap, dtype=jnp.int32) - \
+        starts[jnp.clip(sorted_part, 0, num_parts - 1)]
+    dest = (sorted_part, rank)  # index (P, C); sorted_part==P drops
+
+    send_cols = []
+    for c in batch.columns:
+        data_s = c.data[order]
+        val_s = c.validity[order]
+        if c.is_string:
+            d = jnp.zeros((num_parts, cap, c.max_len), c.data.dtype
+                          ).at[dest].set(data_s, mode="drop")
+            ln = jnp.zeros((num_parts, cap), jnp.int32
+                           ).at[dest].set(c.lengths[order], mode="drop")
+        else:
+            d = jnp.zeros((num_parts, cap), c.data.dtype
+                          ).at[dest].set(data_s, mode="drop")
+            ln = None
+        v = jnp.zeros((num_parts, cap), jnp.bool_
+                      ).at[dest].set(val_s, mode="drop")
+        send_cols.append((d, v, ln))
+    return send_cols, counts
+
+
+def _repack(schema: T.Schema, recv_cols, recv_counts: jax.Array,
+            num_parts: int, cap: int) -> ColumnBatch:
+    """[P, C] received buffers -> one front-packed [P*C] batch."""
+    out_cap = num_parts * cap
+    real = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+            < recv_counts[:, None]).reshape(out_cap)
+    perm = jnp.argsort(~real, stable=True)
+    total = jnp.sum(recv_counts, dtype=jnp.int32)
+    cols = []
+    for f, (d, v, ln) in zip(schema, recv_cols):
+        if ln is not None:
+            col = DeviceColumn(d.reshape(out_cap, d.shape[-1]),
+                               v.reshape(out_cap), f.data_type,
+                               ln.reshape(out_cap))
+        else:
+            col = DeviceColumn(d.reshape(out_cap), v.reshape(out_cap),
+                               f.data_type)
+        cols.append(col)
+    cols = dk.gather_columns(cols, perm, total)
+    return ColumnBatch(cols, total, schema)
+
+
+def exchange_local(batch: ColumnBatch, part: jax.Array, num_parts: int,
+                   axis_name: str) -> ColumnBatch:
+    """Inside shard_map: all-to-all rows of ``batch`` by ``part`` id.
+
+    Output capacity is P*C (static worst case: every row lands on one
+    device).  The reference's analogs of these three phases are
+    contiguousSplit -> UCX tag send/recv -> BufferReceiveState reassembly.
+    """
+    send_cols, counts = _bucketize(batch, part, num_parts)
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name,
+                  split_axis=0, concat_axis=0, tiled=True)
+    recv_counts = a2a(counts)
+    recv_cols = [(a2a(d), a2a(v), a2a(ln) if ln is not None else None)
+                 for (d, v, ln) in send_cols]
+    return _repack(batch.schema, recv_cols, recv_counts, num_parts,
+                   batch.capacity)
+
+
+def canonicalize(batch: ColumnBatch) -> ColumnBatch:
+    """Re-zero padding rows after an external num_rows adjustment."""
+    mask = batch.row_mask()
+    cols = []
+    for c in batch.columns:
+        v = c.validity & mask
+        if c.is_string:
+            cols.append(DeviceColumn(jnp.where(v[:, None], c.data, 0), v,
+                                     c.dtype, jnp.where(v, c.lengths, 0)))
+        else:
+            cols.append(DeviceColumn(
+                jnp.where(v, c.data, jnp.zeros((), c.data.dtype)), v, c.dtype))
+    return ColumnBatch(cols, batch.num_rows, batch.schema)
+
+
+def make_hash_exchange(mesh: Mesh, schema: T.Schema,
+                       key_indices: Sequence[int],
+                       axis_name: str = "data"):
+    """Jitted sharded-batch -> sharded-batch all-to-all hash exchange."""
+    num_parts = mesh.shape[axis_name]
+
+    def step(stacked: ColumnBatch) -> ColumnBatch:
+        b = local_view(stacked)
+        part = partition_ids_for_keys(b, key_indices, num_parts)
+        return restack(exchange_local(b, part, num_parts, axis_name))
+
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=P(axis_name),
+                           out_specs=P(axis_name))
+    return jax.jit(mapped)
+
+
+# Merge-side op per update op (reference: CudfAggregate mergeAggregate,
+# AggregateFunctions.scala:531 — count merges as sum, etc.).  `avg` is not
+# single-column-mergeable: the exec layer decomposes it to sum+count before
+# reaching this kernel (HashAggregateExec buffer layout).
+MERGE_OPS = {
+    "sum": "sum", "count": "sum", "count_star": "sum",
+    "min": "min", "max": "max",
+    "first": "first", "last": "last",
+    "first_non_null": "first_non_null", "last_non_null": "last_non_null",
+}
+
+
+def make_distributed_groupby(mesh: Mesh, schema: T.Schema,
+                             key_indices: Sequence[int],
+                             specs: Sequence[AggSpec],
+                             axis_name: str = "data"):
+    """Jitted full distributed aggregation step over the mesh.
+
+    partial local group-by -> all-to-all exchange of partial rows by key
+    hash -> final merge group-by.  This is the TPU-shaped version of the
+    reference's partial agg / GpuShuffleExchangeExec / final agg plan
+    (aggregate.scala modes + GpuHashPartitioning), fused into ONE compiled
+    program per device so XLA overlaps the collective with compute.
+    """
+    num_parts = mesh.shape[axis_name]
+    key_indices = list(key_indices)
+    for s in specs:
+        if s.op not in MERGE_OPS:
+            raise ValueError(f"op {s.op} is not mergeable here; decompose "
+                             "at the exec layer (e.g. avg -> sum+count)")
+    nkeys = len(key_indices)
+    partial_keys = list(range(nkeys))
+    merge_specs = [AggSpec(MERGE_OPS[s.op], nkeys + i)
+                   for i, s in enumerate(specs)]
+
+    def step(stacked: ColumnBatch) -> ColumnBatch:
+        b = local_view(stacked)
+        part_out = sorted_group_by(b, key_indices, list(specs))
+        if nkeys:
+            part = partition_ids_for_keys(part_out, partial_keys, num_parts)
+        else:
+            # grand aggregate: merge on device 0
+            part = jnp.where(part_out.row_mask(), 0, num_parts)
+        ex = exchange_local(part_out, part, num_parts, axis_name)
+        merged = sorted_group_by(ex, partial_keys, merge_specs)
+        # merge output columns carry nested names (e.g. sum(sum(x))) but
+        # identical types; relabel to the partial (user-facing) schema.
+        out = ColumnBatch(merged.columns, merged.num_rows, part_out.schema)
+        if not nkeys:
+            # only device 0 received rows; suppress identity rows elsewhere
+            on0 = jax.lax.axis_index(axis_name) == 0
+            out = ColumnBatch(out.columns,
+                              jnp.where(on0, out.num_rows, 0), out.schema)
+            out = canonicalize(out)
+        return restack(out)
+
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=P(axis_name),
+                           out_specs=P(axis_name))
+    return jax.jit(mapped)
