@@ -24,15 +24,25 @@ from spark_rapids_tpu.expr.core import Expression
 
 __all__ = ["FileScanExec", "ParquetScanExec", "OrcScanExec", "CsvScanExec"]
 
-READER_TYPE = register(ConfEntry(
-    "spark.rapids.sql.format.parquet.reader.type", "MULTITHREADED",
-    "Reader mode: PERFILE, COALESCING, or MULTITHREADED (prefetching "
-    "thread pool; reference RapidsConf.scala:510).",
-    check=lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED"),
-    check_doc="one of PERFILE|COALESCING|MULTITHREADED"))
-READER_THREADS = register(ConfEntry(
-    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 4,
-    "Prefetch threads per scan (reference RapidsConf.scala:548).", conv=int))
+# per-format reader knobs, as in the reference (RapidsConf.scala:510,:548
+# registers parquet-specific keys; orc/csv get their own here so setting
+# one format's mode never changes another's behavior)
+READER_TYPE = {
+    fmt: register(ConfEntry(
+        f"spark.rapids.sql.format.{fmt}.reader.type", "MULTITHREADED",
+        "Reader mode: PERFILE, COALESCING, or MULTITHREADED (prefetching "
+        "thread pool; reference RapidsConf.scala:510).",
+        check=lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED"),
+        check_doc="one of PERFILE|COALESCING|MULTITHREADED"))
+    for fmt in ("parquet", "orc", "csv")
+}
+READER_THREADS = {
+    fmt: register(ConfEntry(
+        f"spark.rapids.sql.format.{fmt}.multiThreadedRead.numThreads", 4,
+        "Prefetch threads per scan (reference RapidsConf.scala:548).",
+        conv=int))
+    for fmt in ("parquet", "orc", "csv")
+}
 BATCH_ROWS = register(ConfEntry(
     "spark.rapids.sql.reader.batchRows", 1 << 16,
     "Max rows per decoded batch (reference batchSizeBytes analog, "
@@ -133,9 +143,9 @@ class FileScanExec(PlanNode):
     def _read_schema(self) -> T.Schema:
         raise NotImplementedError
 
-    def _read_file(self, path: str):
+    def _read_file(self, path: str, batch_rows: int = 1 << 16):
         """Return an iterator of pyarrow.RecordBatch for one file with
-        column pruning + pushdown applied."""
+        column pruning + pushdown applied, chunked at ``batch_rows``."""
         raise NotImplementedError
 
     # -- PlanNode ----------------------------------------------------------
@@ -164,7 +174,7 @@ class FileScanExec(PlanNode):
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         files = self._partition_files(ctx, pid)
-        mode = READER_TYPE.get(ctx.conf.settings)
+        mode = READER_TYPE[self.format_name].get(ctx.conf.settings)
         rbs = self._decode_iter(ctx, files, mode)
         if ctx.is_device:
             for rb in rbs:
@@ -173,7 +183,6 @@ class FileScanExec(PlanNode):
                 yield ColumnBatch.from_arrow(
                     rb, string_widths=self._width_map(rb))
         else:
-            from spark_rapids_tpu.exec.core import HostBatch
             for rb in rbs:
                 if rb.num_rows == 0:
                     continue
@@ -186,43 +195,48 @@ class FileScanExec(PlanNode):
                 if isinstance(f.data_type, T.StringType)}
 
     def _decode_iter(self, ctx: ExecCtx, files: list[str], mode: str):
+        batch_rows = BATCH_ROWS.get(ctx.conf.settings)
         if mode == "MULTITHREADED" and len(files) > 1:
             # prefetch pool: decode next files while current is consumed,
             # bounded to a numThreads-file window so host memory stays
             # bounded (reference MultiFileCloudParquetPartitionReader
             # inflight limits)
             from collections import deque
-            nthreads = READER_THREADS.get(ctx.conf.settings)
+            nthreads = READER_THREADS[self.format_name].get(ctx.conf.settings)
             with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
                 window: deque = deque()
                 it = iter(files)
                 for p in it:
                     window.append(pool.submit(
-                        lambda p=p: list(self._read_file(p))))
+                        lambda p=p: list(self._read_file(p, batch_rows))))
                     if len(window) >= nthreads:
                         break
                 for p in it:
                     yield from window.popleft().result()
                     window.append(pool.submit(
-                        lambda p=p: list(self._read_file(p))))
+                        lambda p=p: list(self._read_file(p, batch_rows))))
                 while window:
                     yield from window.popleft().result()
         elif mode == "COALESCING" and len(files) > 1:
             # stitch many small files into larger batches (reference
             # MultiFileParquetPartitionReader): concat arrow tables then
-            # re-chunk at the target size
+            # re-chunk at the target size. Files yielding zero batches
+            # (e.g. empty ORC/CSV parts) are skipped.
             import pyarrow as pa
-            tables = [pa.Table.from_batches(list(self._read_file(p)))
-                      for p in files]
-            tables = [t for t in tables if t.num_rows]
+            tables = []
+            for p in files:
+                bs = list(self._read_file(p, batch_rows))
+                if bs:
+                    t = pa.Table.from_batches(bs)
+                    if t.num_rows:
+                        tables.append(t)
             if not tables:
                 return
             merged = pa.concat_tables(tables)
-            target = BATCH_ROWS.get(ctx.conf.settings)
-            yield from merged.to_batches(max_chunksize=target)
+            yield from merged.to_batches(max_chunksize=batch_rows)
         else:
             for p in files:
-                yield from self._read_file(p)
+                yield from self._read_file(p, batch_rows)
 
     def node_desc(self) -> str:
         return (f"{type(self).__name__}[{self.format_name}, "
@@ -263,13 +277,13 @@ class ParquetScanExec(FileScanExec):
         import pyarrow.parquet as pq
         return T.Schema.from_arrow(pq.read_schema(self._files[0]))
 
-    def _read_file(self, path: str):
+    def _read_file(self, path: str, batch_rows: int = 1 << 16):
         import pyarrow.dataset as ds
         dataset = ds.dataset(path, format="parquet")
         filt = _to_arrow_filter(self._pushdown) if self._pushdown is not None \
             else None
         scanner = dataset.scanner(columns=self._schema.names, filter=filt,
-                                  batch_size=1 << 16)
+                                  batch_size=batch_rows)
         yield from scanner.to_batches()
 
 
@@ -282,18 +296,25 @@ class OrcScanExec(FileScanExec):
         import pyarrow.orc as orc
         return T.Schema.from_arrow(orc.ORCFile(self._files[0]).schema)
 
-    def _read_file(self, path: str):
+    def _read_file(self, path: str, batch_rows: int = 1 << 16):
         import pyarrow.orc as orc
         f = orc.ORCFile(path)
         cols = self._schema.names
         import pyarrow as pa
+        filt = _to_arrow_filter(self._pushdown) if self._pushdown is not None \
+            else None
         for stripe in range(f.nstripes):
             out = f.read_stripe(stripe, columns=cols)
             # read_stripe returns columns in file order; re-select to the
             # requested order (RecordBatch or Table depending on version)
             if isinstance(out, pa.RecordBatch):
                 out = pa.Table.from_batches([out])
-            yield from out.select(cols).to_batches()
+            out = out.select(cols)
+            if filt is not None:
+                # no stripe-level pushdown in pyarrow ORC: apply post-read
+                # (same result; reference pushes to the cuDF ORC reader)
+                out = out.filter(filt)
+            yield from out.to_batches(max_chunksize=batch_rows)
 
 
 class CsvScanExec(FileScanExec):
@@ -309,17 +330,7 @@ class CsvScanExec(FileScanExec):
         self._delim = delimiter
         super().__init__(paths, **kw)
 
-    def _read_schema(self) -> T.Schema:
-        if self._explicit_schema is not None:
-            return self._explicit_schema
-        import pyarrow.csv as pc
-        # streaming reader: schema comes from the first block without
-        # decoding the whole file
-        with pc.open_csv(self._files[0], parse_options=pc.ParseOptions(
-                delimiter=self._delim)) as reader:
-            return T.Schema.from_arrow(reader.schema)
-
-    def _read_file(self, path: str):
+    def _csv_options(self):
         import pyarrow.csv as pc
         ropts = pc.ReadOptions()
         popts = pc.ParseOptions(delimiter=self._delim)
@@ -330,8 +341,32 @@ class CsvScanExec(FileScanExec):
                 ropts = pc.ReadOptions(column_names=[f.name for f in at])
             copts = pc.ConvertOptions(
                 column_types={f.name: f.type for f in at})
+        elif not self._header:
+            # headerless without a schema: synthesize f0..fN names so the
+            # first data row is NOT consumed as the header
+            ropts = pc.ReadOptions(autogenerate_column_names=True)
+        return ropts, popts, copts
+
+    def _read_schema(self) -> T.Schema:
+        if self._explicit_schema is not None:
+            return self._explicit_schema
+        import pyarrow.csv as pc
+        ropts, popts, _ = self._csv_options()
+        # streaming reader: schema comes from the first block without
+        # decoding the whole file
+        with pc.open_csv(self._files[0], read_options=ropts,
+                         parse_options=popts) as reader:
+            return T.Schema.from_arrow(reader.schema)
+
+    def _read_file(self, path: str, batch_rows: int = 1 << 16):
+        import pyarrow.csv as pc
+        ropts, popts, copts = self._csv_options()
         tbl = pc.read_csv(path, read_options=ropts, parse_options=popts,
                           convert_options=copts)
         if self._columns:
             tbl = tbl.select(self._schema.names)
-        yield from tbl.to_batches(max_chunksize=1 << 16)
+        if self._pushdown is not None:
+            filt = _to_arrow_filter(self._pushdown)
+            if filt is not None:
+                tbl = tbl.filter(filt)
+        yield from tbl.to_batches(max_chunksize=batch_rows)

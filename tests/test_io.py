@@ -141,3 +141,62 @@ def test_empty_write_keeps_schema(pq_dir, tmp_path):
     back = ParquetScanExec(out)
     assert back.output_schema.names == ["a", "b"]
     assert collect_host(back) == []
+
+
+# ---------------------------------------------------------------------------
+# regression tests: review findings on the scan layer
+# ---------------------------------------------------------------------------
+
+def test_orc_csv_pushdown_is_applied(tmp_path, rng):
+    import pyarrow.orc as orc
+    n = 200
+    tbl = pa.table({"a": pa.array(rng.integers(0, 100, n), type=pa.int32()),
+                    "b": pa.array(rng.random(n))})
+    orc.write_table(tbl, str(tmp_path / "t.orc"))
+    import pyarrow.csv as pc
+    pc.write_csv(tbl, str(tmp_path / "t.csv"))
+    want = sorted(r for r in zip(*[c.to_pylist() for c in tbl.columns])
+                  if r[0] > 50)
+    for scan in (OrcScanExec(str(tmp_path / "t.orc"),
+                             pushdown=col("a") > lit(50)),
+                 CsvScanExec(str(tmp_path / "t.csv"),
+                             pushdown=col("a") > lit(50))):
+        got = sorted(collect_host(scan))
+        assert [r[0] for r in got] == [r[0] for r in want]
+        got_d = sorted(collect_device(scan))
+        assert [r[0] for r in got_d] == [r[0] for r in want]
+
+
+def test_csv_headerless_without_schema(tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("1,foo\n2,bar\n3,baz\n")
+    scan = CsvScanExec(str(p), header=False)
+    rows = collect_host(scan)
+    assert len(rows) == 3  # first row must NOT be eaten as a header
+    assert rows[0] == (1, "foo")
+
+
+def test_coalescing_with_empty_part(tmp_path, rng):
+    import pyarrow.orc as orc
+    d = tmp_path / "orcs"
+    d.mkdir()
+    full = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    empty = full.slice(0, 0)
+    orc.write_table(full, str(d / "p0.orc"))
+    orc.write_table(empty, str(d / "p1.orc"))
+    conf = TpuConf({"spark.rapids.sql.format.orc.reader.type": "COALESCING"})
+    rows = collect_host(OrcScanExec(str(d), partitions=1), conf=conf)
+    assert sorted(rows) == [(1,), (2,), (3,)]
+
+
+def test_batch_rows_honored_per_mode(pq_dir):
+    for mode in ("PERFILE", "MULTITHREADED"):
+        conf = TpuConf({
+            "spark.rapids.sql.format.parquet.reader.type": mode,
+            "spark.rapids.sql.reader.batchRows": 16,
+        })
+        scan = ParquetScanExec(pq_dir)
+        ctx = ExecCtx(backend="host", conf=conf)
+        for pid in range(scan.num_partitions(ctx)):
+            for b in scan.partition_iter(ctx, pid):
+                assert b.num_rows <= 16
