@@ -7,6 +7,7 @@ from spark_rapids_tpu.exec.basic import (FilterExec, GlobalLimitExec,
                                          LocalLimitExec, LocalScanExec,
                                          ProjectExec, RangeExec, UnionExec)
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+from spark_rapids_tpu.exec.joins import CrossJoinExec, JoinExec
 from spark_rapids_tpu.exec.sortexec import (CoalesceBatchesExec, SortExec,
                                             resolve_orders)
 
@@ -17,4 +18,5 @@ __all__ = [
     "FilterExec", "GlobalLimitExec", "LocalLimitExec", "LocalScanExec",
     "ProjectExec", "RangeExec", "UnionExec",
     "HashAggregateExec", "CoalesceBatchesExec", "SortExec", "resolve_orders",
+    "JoinExec", "CrossJoinExec",
 ]

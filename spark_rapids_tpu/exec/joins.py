@@ -1,0 +1,258 @@
+"""Join execs over the sort-merge device kernel.
+
+Reference join surface (SURVEY.md §2.4): GpuShuffledHashJoinExec /
+GpuBroadcastHashJoinExec (GpuHashJoin.doJoin, shims/spark300/
+GpuHashJoin.scala:193-249), GpuBroadcastNestedLoopJoinExec and
+GpuCartesianProductExec (crossJoin + condition filter), with
+GpuSortMergeJoinMeta replacing SMJ by shuffled hash join.  Here one
+`JoinExec` covers the equi-join types over ops/join.py's sort-merge
+kernel, and `CrossJoinExec` the nested-loop/cartesian shape; right outer
+runs as a side-swapped left outer (the reference's build-side flip).
+
+Conditions: like the reference's tagJoin (GpuHashJoin.scala:30-45), a
+residual non-equi condition is only allowed on inner/cross joins, where
+it is applied as a post-join filter.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.expr.core import (BoundReference, Expression, bind,
+                                        eval_device, eval_host)
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops.join import (JOIN_TYPES, gather_join_output,
+                                       join_indices, join_total)
+
+__all__ = ["JoinExec", "CrossJoinExec"]
+
+
+@partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type"))
+def _jit_total(lb, rb, lkeys, rkeys, join_type):
+    return join_total(lb, rb, lkeys, rkeys, join_type)
+
+
+@partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type", "out_cap",
+                                   "include_right", "schema"))
+def _jit_join(lb, rb, lkeys, rkeys, join_type, out_cap, include_right,
+              schema):
+    plan = join_indices(lb, rb, lkeys, rkeys, join_type, out_cap)
+    return gather_join_output(lb, rb, *plan, schema, include_right)
+
+
+def _nullable_schema(s: T.Schema) -> list[T.StructField]:
+    return [T.StructField(f.name, f.data_type, True) for f in s]
+
+
+class JoinExec(PlanNode):
+    """Equi-join: inner | left | right | full | semi | anti.
+
+    ``left_keys``/``right_keys`` are expressions over the respective
+    child schemas (the planner has already inserted casts so each pair
+    has equal types).  Key expressions are appended as projected columns
+    before the kernel and dropped from the output, so non-trivial keys
+    (e.g. casts) join correctly.
+    """
+
+    def __init__(self, left: PlanNode, right: PlanNode,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str, condition: Expression | None = None):
+        if join_type == "right":
+            # run as side-swapped left join; output reordered in
+            # partition_iter (reference build-side flip)
+            self._swapped = True
+            left, right = right, left
+            left_keys, right_keys = right_keys, left_keys
+            join_type = "left"
+        else:
+            self._swapped = False
+        assert join_type in JOIN_TYPES and join_type != "cross", join_type
+        if condition is not None and join_type != "inner":
+            raise ValueError(
+                f"non-equi condition not supported for {join_type} join "
+                "(reference tagJoin, GpuHashJoin.scala:30-45)")
+        super().__init__([left, right])
+        self.join_type = join_type
+        self._lkeys_b = [bind(k, left.output_schema) for k in left_keys]
+        self._rkeys_b = [bind(k, right.output_schema) for k in right_keys]
+        assert len(self._lkeys_b) == len(self._rkeys_b) and self._lkeys_b
+        for a, b in zip(self._lkeys_b, self._rkeys_b):
+            if type(a.dtype) is not type(b.dtype):
+                raise ValueError(f"join key type mismatch: {a.dtype} vs "
+                                 f"{b.dtype} (planner must insert casts)")
+        self.include_right = join_type not in ("semi", "anti")
+
+        lf = list(left.output_schema.fields)
+        rf = list(right.output_schema.fields)
+        if join_type == "full":
+            lf, rf = _nullable_schema(left.output_schema), \
+                _nullable_schema(right.output_schema)
+        elif join_type == "left":
+            rf = _nullable_schema(right.output_schema)
+        joined = lf + rf if self.include_right else lf
+        if self._swapped and self.include_right:
+            joined = joined[len(lf):] + joined[:len(lf)]
+        self._schema = T.Schema(joined)
+
+        self._condition = condition
+        if condition is not None:
+            cond_schema = T.Schema(list(left.output_schema.fields)
+                                   + list(right.output_schema.fields))
+            self._cond_b = bind(condition, cond_schema)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def output_batching(self):
+        return RequireSingleBatch
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return 1
+
+    # ------------------------------------------------------------------
+    def _augment_device(self, batch: ColumnBatch, keys) -> tuple:
+        """Append evaluated key columns; return (batch', key_indices)."""
+        n = batch.num_columns
+        cols = list(batch.columns)
+        fields = list(batch.schema.fields)
+        idx = []
+        for i, k in enumerate(keys):
+            if isinstance(k, BoundReference):
+                idx.append(k.index)
+                continue
+            v = eval_device(k, batch)
+            cols.append(v)
+            fields.append(T.StructField(f"_jk{i}", k.dtype, True))
+            idx.append(len(cols) - 1)
+        return ColumnBatch(cols, batch.num_rows, T.Schema(fields)), tuple(idx)
+
+    def _augment_host(self, batch: HostBatch, keys) -> tuple:
+        cols = list(batch.columns)
+        fields = list(batch.schema.fields)
+        idx = []
+        for i, k in enumerate(keys):
+            if isinstance(k, BoundReference):
+                idx.append(k.index)
+                continue
+            v = eval_host(k, batch)
+            cols.append(v)
+            fields.append(T.StructField(f"_jk{i}", k.dtype, True))
+            idx.append(len(cols) - 1)
+        return HostBatch(cols, T.Schema(fields)), tuple(idx)
+
+    def _materialize(self, ctx: ExecCtx, which: int):
+        batches = []
+        child = self.children[which]
+        for pid in range(child.num_partitions(ctx)):
+            batches.extend(child.partition_iter(ctx, pid))
+        if ctx.is_device:
+            if not batches:
+                from spark_rapids_tpu.exec.core import host_to_device
+                return host_to_device(HostBatch.empty(child.output_schema))
+            return dk.concat_batches(batches) if len(batches) > 1 \
+                else batches[0]
+        if not batches:
+            return HostBatch.empty(child.output_schema)
+        return hk.host_concat(batches)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        lb = self._materialize(ctx, 0)
+        rb = self._materialize(ctx, 1)
+        if ctx.is_device:
+            yield from self._run_device(ctx, lb, rb)
+        else:
+            yield from self._run_host(ctx, lb, rb)
+
+    # ------------------------------------------------------------------
+    def _run_device(self, ctx: ExecCtx, lb: ColumnBatch, rb: ColumnBatch):
+        lb2, lkeys = self._augment_device(lb, self._lkeys_b)
+        rb2, rkeys = self._augment_device(rb, self._rkeys_b)
+        total = int(jax.device_get(_jit_total(
+            lb2, rb2, lkeys, rkeys, self.join_type)))
+        out_cap = round_capacity(max(total, 1))
+        # kernel output: ALL left cols (incl appended keys) + right cols
+        kf = (list(lb2.schema.fields)
+              + (list(rb2.schema.fields) if self.include_right else []))
+        out = _jit_join(lb2, rb2, lkeys, rkeys, self.join_type, out_cap,
+                        self.include_right, T.Schema(kf))
+        out = self._project_out(out, lb, rb, lb2, rb2, device=True)
+        if self._condition is not None:
+            c = eval_device(self._cond_b, out)
+            out = dk.compact(out, c.data & c.validity)
+        if self._swapped and self.include_right:
+            out = self._reorder_device(out, lb.num_columns)
+        yield ColumnBatch(out.columns, out.num_rows, self._schema)
+
+    def _run_host(self, ctx: ExecCtx, lb: HostBatch, rb: HostBatch):
+        lb2, lkeys = self._augment_host(lb, self._lkeys_b)
+        rb2, rkeys = self._augment_host(rb, self._rkeys_b)
+        li, ri, lt, rt = hk.host_join(lb2, rb2, list(lkeys), list(rkeys),
+                                      self.join_type)
+        kf = (list(lb2.schema.fields)
+              + (list(rb2.schema.fields) if self.include_right else []))
+        out = hk.host_join_output(lb2, rb2, li, ri, lt, rt, T.Schema(kf),
+                                  self.include_right)
+        out = self._project_out(out, lb, rb, lb2, rb2, device=False)
+        if self._condition is not None:
+            c = eval_host(self._cond_b, out)
+            out = hk.host_filter(out, c.data.astype(np.bool_) & c.validity)
+        cols = list(out.columns)
+        if self._swapped and self.include_right:
+            nl = lb.num_columns
+            cols = cols[nl:] + cols[:nl]
+        yield HostBatch(cols, self._schema)
+
+    def _project_out(self, out, lb, rb, lb2, rb2, device: bool):
+        """Drop appended key columns from the kernel output."""
+        keep = list(range(lb.num_columns))
+        if self.include_right:
+            keep += [lb2.num_columns + i for i in range(rb.num_columns)]
+        cols = [out.columns[i] for i in keep]
+        fields = [out.schema.fields[i] for i in keep]
+        if device:
+            return ColumnBatch(cols, out.num_rows, T.Schema(fields))
+        return HostBatch(cols, T.Schema(fields))
+
+    def _reorder_device(self, out: ColumnBatch, nl: int) -> ColumnBatch:
+        cols = list(out.columns)
+        cols = cols[nl:] + cols[:nl]
+        return ColumnBatch(cols, out.num_rows, self._schema)
+
+    def node_desc(self) -> str:
+        jt = "right" if self._swapped else self.join_type
+        return f"JoinExec[{jt}, keys={len(self._lkeys_b)}]"
+
+
+class CrossJoinExec(JoinExec):
+    """Cartesian product with optional condition (reference
+    GpuCartesianProductExec / GpuBroadcastNestedLoopJoinExec)."""
+
+    def __init__(self, left: PlanNode, right: PlanNode,
+                 condition: Expression | None = None):
+        PlanNode.__init__(self, [left, right])
+        self._swapped = False
+        self.join_type = "cross"
+        self._lkeys_b = []
+        self._rkeys_b = []
+        self.include_right = True
+        self._schema = T.Schema(list(left.output_schema.fields)
+                                + list(right.output_schema.fields))
+        self._condition = condition
+        if condition is not None:
+            self._cond_b = bind(condition, self._schema)
+
+    def node_desc(self) -> str:
+        return "CrossJoinExec" + (
+            "[cond]" if self._condition is not None else "")

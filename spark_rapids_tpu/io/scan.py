@@ -232,7 +232,9 @@ class FileScanExec(PlanNode):
                         tables.append(t)
             if not tables:
                 return
-            merged = pa.concat_tables(tables)
+            # combine_chunks is what actually merges: concat_tables keeps
+            # per-file chunk boundaries and to_batches only splits chunks
+            merged = pa.concat_tables(tables).combine_chunks()
             yield from merged.to_batches(max_chunksize=batch_rows)
         else:
             for p in files:

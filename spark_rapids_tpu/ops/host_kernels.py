@@ -22,6 +22,7 @@ from spark_rapids_tpu.ops.sort import SortOrder
 __all__ = [
     "host_sort_permutation", "host_sort", "host_filter", "host_concat",
     "host_slice", "host_group_by", "host_take",
+    "host_join", "host_join_output",
 ]
 
 
@@ -245,3 +246,97 @@ def host_group_by(batch: HostBatch, key_indices: Sequence[int],
         name = f"count({arg})" if spec.op == "count_star" else f"{spec.op}({arg})"
         out_fields.append(T.StructField(name, spec.result_type(in_t)))
     return HostBatch(out_cols, T.Schema(out_fields))
+
+
+# ---------------------------------------------------------------------------
+# joins (CPU oracle for ops/join.py; Spark key semantics: null keys never
+# match, NaN==NaN, -0.0==0.0)
+# ---------------------------------------------------------------------------
+
+def _join_key(cols: list[HostColumn], i: int):
+    """Row i's key tuple, or None when any key column is null."""
+    out = []
+    for c in cols:
+        if not c.validity[i]:
+            return None
+        v = c.data[i]
+        if isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+            f = float(v)
+            if f != f:
+                v = "NaN"          # NaN == NaN for join keys
+            elif f == 0.0:
+                v = 0.0            # -0.0 == 0.0
+            else:
+                v = f
+        elif isinstance(v, np.generic):
+            v = v.item()
+        out.append(v)
+    return tuple(out)
+
+
+def host_join(lb: HostBatch, rb: HostBatch, lkeys: Sequence[int],
+              rkeys: Sequence[int], join_type: str):
+    """Returns (li, ri, l_take, r_take) int64/bool arrays (see
+    ops/join.py join_indices for the contract)."""
+    nl, nr = lb.num_rows, rb.num_rows
+    li, ri, lt, rt = [], [], [], []
+    if join_type == "cross":
+        for i in range(nl):
+            for j in range(nr):
+                li.append(i); ri.append(j); lt.append(True); rt.append(True)
+    else:
+        lcols = [lb.columns[k] for k in lkeys]
+        rcols = [rb.columns[k] for k in rkeys]
+        index: dict = {}
+        for j in range(nr):
+            k = _join_key(rcols, j)
+            if k is not None:
+                index.setdefault(k, []).append(j)
+        matched_r = np.zeros(nr, np.bool_)
+        for i in range(nl):
+            k = _join_key(lcols, i)
+            matches = index.get(k, []) if k is not None else []
+            if join_type == "semi":
+                if matches:
+                    li.append(i); ri.append(0); lt.append(True); rt.append(False)
+            elif join_type == "anti":
+                if not matches:
+                    li.append(i); ri.append(0); lt.append(True); rt.append(False)
+            elif matches:
+                for j in matches:
+                    matched_r[j] = True
+                    li.append(i); ri.append(j); lt.append(True); rt.append(True)
+            elif join_type in ("left", "full"):
+                li.append(i); ri.append(0); lt.append(True); rt.append(False)
+        if join_type == "full":
+            for j in range(nr):
+                if not matched_r[j]:
+                    li.append(0); ri.append(j); lt.append(False); rt.append(True)
+    return (np.asarray(li, np.int64), np.asarray(ri, np.int64),
+            np.asarray(lt, np.bool_), np.asarray(rt, np.bool_))
+
+
+def host_join_output(lb: HostBatch, rb: HostBatch, li, ri, lt, rt,
+                     schema, include_right: bool) -> HostBatch:
+    cols = []
+    for c in lb.columns:
+        cols.append(_take_masked(c, li, lt))
+    if include_right:
+        for c in rb.columns:
+            cols.append(_take_masked(c, ri, rt))
+    return HostBatch(cols, schema)
+
+
+def _take_masked(c: HostColumn, idx: np.ndarray, take: np.ndarray) -> HostColumn:
+    n = len(idx)
+    if len(c.data) == 0:
+        data = np.zeros(n, dtype=c.data.dtype) if c.data.dtype != object \
+            else np.full(n, None, dtype=object)
+        return HostColumn(data, np.zeros(n, np.bool_), c.dtype)
+    data = c.data[np.clip(idx, 0, len(c.data) - 1)]
+    validity = c.validity[np.clip(idx, 0, len(c.data) - 1)] & take
+    if c.data.dtype == object:
+        data = np.where(validity, data, None)
+    else:
+        data = np.where(validity, data, np.zeros((), c.data.dtype))
+    return HostColumn(data, validity, c.dtype)

@@ -203,6 +203,9 @@ class StructField:
         return (isinstance(other, StructField) and self.name == other.name
                 and self.data_type == other.data_type and self.nullable == other.nullable)
 
+    def __hash__(self):
+        return hash((self.name, self.data_type, self.nullable))
+
 
 class Schema:
     """An ordered list of named, typed, nullable fields."""
@@ -237,6 +240,9 @@ class Schema:
 
     def __eq__(self, other):
         return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self):
+        return hash(tuple(self.fields))
 
     def to_arrow(self):
         import pyarrow as pa

@@ -200,3 +200,19 @@ def test_batch_rows_honored_per_mode(pq_dir):
         for pid in range(scan.num_partitions(ctx)):
             for b in scan.partition_iter(ctx, pid):
                 assert b.num_rows <= 16
+
+
+def test_coalescing_merges_small_files(tmp_path):
+    d = tmp_path / "many"
+    d.mkdir()
+    for i in range(6):
+        pq.write_table(
+            pa.table({"a": pa.array(list(range(i * 10, i * 10 + 10)),
+                                    type=pa.int64())}),
+            d / f"f{i}.parquet")
+    conf = TpuConf({
+        "spark.rapids.sql.format.parquet.reader.type": "COALESCING"})
+    scan = ParquetScanExec(str(d), partitions=1)
+    ctx = ExecCtx(backend="host", conf=conf)
+    batches = list(scan.partition_iter(ctx, 0))
+    assert len(batches) == 1 and batches[0].num_rows == 60

@@ -1,0 +1,162 @@
+"""Join differential tests: device sort-merge kernel vs CPU oracle.
+
+Mirrors the reference's join coverage (integration_tests join_test.py:
+all join types x key types x nulls; tests/GpuHashJoinSuite) with fuzzed
+key data including nulls, NaN, -0.0 and duplicate keys.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec import (CrossJoinExec, JoinExec, LocalScanExec,
+                                   collect_device, collect_host)
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.cast import Cast
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+L_SCHEMA = T.Schema([
+    T.StructField("lk", T.IntegerType(), True),
+    T.StructField("lv", T.LongType(), True),
+    T.StructField("ls", T.StringType(), True),
+])
+R_SCHEMA = T.Schema([
+    T.StructField("rk", T.IntegerType(), True),
+    T.StructField("rv", T.DoubleType(), True),
+])
+
+
+def _sides(rng, nl=120, nr=90, key_range=25):
+    lk = [None if rng.random() < 0.08 else int(x)
+          for x in rng.integers(0, key_range, nl)]
+    rk = [None if rng.random() < 0.08 else int(x)
+          for x in rng.integers(0, key_range, nr)]
+    left = LocalScanExec.from_pydict({
+        "lk": lk,
+        "lv": [int(x) for x in rng.integers(-50, 50, nl)],
+        "ls": [f"s{x}" if x % 4 else None for x in rng.integers(0, 30, nl)],
+    }, L_SCHEMA, rows_per_batch=37)
+    right = LocalScanExec.from_pydict({
+        "rk": rk,
+        "rv": [None if rng.random() < 0.1 else float(np.round(x, 2))
+               for x in rng.normal(size=nr)],
+    }, R_SCHEMA, rows_per_batch=41)
+    return left, right
+
+
+@pytest.mark.parametrize("jt", ["inner", "left", "right", "full", "semi",
+                                "anti"])
+def test_join_types_match_oracle(rng, jt):
+    left, right = _sides(rng)
+    plan = JoinExec(left, right, [col("lk")], [col("rk")], jt)
+    rows = assert_tpu_and_cpu_equal(plan)
+    assert rows  # non-degenerate
+
+
+def test_inner_join_row_semantics(rng):
+    left = LocalScanExec.from_pydict(
+        {"lk": [1, 2, 2, None], "lv": [10, 20, 21, 30],
+         "ls": ["a", "b", "c", "d"]}, L_SCHEMA)
+    right = LocalScanExec.from_pydict(
+        {"rk": [2, 2, 3, None], "rv": [0.5, 0.6, 0.7, 0.8]}, R_SCHEMA)
+    plan = JoinExec(left, right, [col("lk")], [col("rk")], "inner")
+    rows = sorted(collect_host(plan))
+    # 2x2 match for key 2; nulls never match
+    assert len(rows) == 4
+    assert all(r[0] == 2 for r in rows)
+    assert sorted(collect_device(plan)) == rows
+
+
+def test_left_join_keeps_null_keys(rng):
+    left = LocalScanExec.from_pydict(
+        {"lk": [None, 5], "lv": [1, 2], "ls": ["x", "y"]}, L_SCHEMA)
+    right = LocalScanExec.from_pydict(
+        {"rk": [7], "rv": [1.0]}, R_SCHEMA)
+    plan = JoinExec(left, right, [col("lk")], [col("rk")], "left")
+    rows = sorted(collect_host(plan), key=lambda r: str(r))
+    assert len(rows) == 2
+    assert all(r[3] is None and r[4] is None for r in rows)
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_full_join_unmatched_both_sides(rng):
+    left, right = _sides(rng, nl=60, nr=60, key_range=40)
+    plan = JoinExec(left, right, [col("lk")], [col("rk")], "full")
+    cpu = assert_tpu_and_cpu_equal(plan)
+    # full join row count >= max side count
+    assert len(cpu) >= 60
+
+
+def test_join_on_expression_keys(rng):
+    left, right = _sides(rng)
+    plan = JoinExec(left, right, [Cast(col("lk"), T.LongType())],
+                    [Cast(col("rk"), T.LongType())], "inner")
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_join_multi_key_with_strings(rng):
+    schema_a = T.Schema([T.StructField("k1", T.IntegerType(), True),
+                         T.StructField("s1", T.StringType(), True)])
+    schema_b = T.Schema([T.StructField("k2", T.IntegerType(), True),
+                         T.StructField("s2", T.StringType(), True)])
+    n = 80
+    a = LocalScanExec.from_pydict({
+        "k1": [int(x) for x in rng.integers(0, 5, n)],
+        "s1": [f"g{x}" for x in rng.integers(0, 4, n)]}, schema_a)
+    b = LocalScanExec.from_pydict({
+        "k2": [int(x) for x in rng.integers(0, 5, n)],
+        "s2": [f"g{x}" for x in rng.integers(0, 4, n)]}, schema_b)
+    plan = JoinExec(a, b, [col("k1"), col("s1")], [col("k2"), col("s2")],
+                    "inner")
+    rows = assert_tpu_and_cpu_equal(plan)
+    for r in rows:
+        assert r[0] == r[2] and r[1] == r[3]
+
+
+def test_join_nan_and_negzero_keys(rng):
+    sa = T.Schema([T.StructField("k", T.DoubleType(), True)])
+    sb = T.Schema([T.StructField("k2", T.DoubleType(), True)])
+    a = LocalScanExec.from_pydict(
+        {"k": [float("nan"), -0.0, 1.5, None]}, sa)
+    b = LocalScanExec.from_pydict(
+        {"k2": [float("nan"), 0.0, 2.5, None]}, sb)
+    plan = JoinExec(a, b, [col("k")], [col("k2")], "inner")
+    rows = collect_host(plan)
+    # NaN==NaN and -0.0==0.0; nulls never match
+    assert len(rows) == 2
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_inner_join_with_condition(rng):
+    left, right = _sides(rng)
+    plan = JoinExec(left, right, [col("lk")], [col("rk")], "inner",
+                    condition=col("lv") > lit(0))
+    cpu = assert_tpu_and_cpu_equal(plan)
+    assert all(r[1] > 0 for r in cpu)
+
+
+def test_cross_join_with_condition(rng):
+    left, right = _sides(rng, nl=20, nr=15)
+    plan = CrossJoinExec(left, right)
+    cpu = assert_tpu_and_cpu_equal(plan)
+    assert len(cpu) == 20 * 15
+    plan2 = CrossJoinExec(left, right, condition=col("lv") > col("rv"))
+    assert_tpu_and_cpu_equal(plan2)
+
+
+def test_join_empty_sides(rng):
+    left = LocalScanExec.from_pydict(
+        {"lk": [], "lv": [], "ls": []}, L_SCHEMA)
+    right = LocalScanExec.from_pydict(
+        {"rk": [1, 2], "rv": [0.1, 0.2]}, R_SCHEMA)
+    for jt in ("inner", "left", "full", "semi", "anti", "right"):
+        plan = JoinExec(left, right, [col("lk")], [col("rk")], jt)
+        assert_tpu_and_cpu_equal(plan)
+
+
+def test_condition_rejected_for_outer():
+    left = LocalScanExec.from_pydict(
+        {"lk": [1], "lv": [1], "ls": ["a"]}, L_SCHEMA)
+    right = LocalScanExec.from_pydict({"rk": [1], "rv": [1.0]}, R_SCHEMA)
+    with pytest.raises(ValueError):
+        JoinExec(left, right, [col("lk")], [col("rk")], "left",
+                 condition=col("lv") > lit(0))
