@@ -8,6 +8,12 @@ from spark_rapids_tpu.exec.basic import (FilterExec, GlobalLimitExec,
                                          ProjectExec, RangeExec, UnionExec)
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec
 from spark_rapids_tpu.exec.joins import CrossJoinExec, JoinExec
+from spark_rapids_tpu.exec.partitioning import (HashPartitioning,
+                                                RangePartitioning,
+                                                RoundRobinPartitioning,
+                                                SinglePartitioning)
+from spark_rapids_tpu.exec.exchange import (BroadcastExchangeExec,
+                                            ShuffleExchangeExec)
 from spark_rapids_tpu.exec.sortexec import (CoalesceBatchesExec, SortExec,
                                             resolve_orders)
 
@@ -19,4 +25,6 @@ __all__ = [
     "ProjectExec", "RangeExec", "UnionExec",
     "HashAggregateExec", "CoalesceBatchesExec", "SortExec", "resolve_orders",
     "JoinExec", "CrossJoinExec",
+    "HashPartitioning", "RangePartitioning", "RoundRobinPartitioning",
+    "SinglePartitioning", "ShuffleExchangeExec", "BroadcastExchangeExec",
 ]

@@ -90,6 +90,9 @@ class ExecCtx:
     backend: str = "device"          # "device" | "host"
     conf: TpuConf = field(default_factory=lambda: TpuConf({}))
     metrics: dict[str, Metrics] = field(default_factory=dict)
+    # per-run stage cache: exchanges materialize their shuffle output here
+    # once per execution (reference: shuffle files / ShuffleBufferCatalog)
+    cache: dict = field(default_factory=dict)
 
     def metrics_for(self, node: "PlanNode") -> Metrics:
         key = f"{type(node).__name__}@{id(node):x}"

@@ -1,0 +1,166 @@
+"""Exchange execs: shuffle (repartition) and broadcast.
+
+Reference: GpuShuffleExchangeExecBase + ShuffledBatchRDD
+(GpuShuffleExchangeExec.scala:70, SURVEY.md §2.4) and
+GpuBroadcastExchangeExec (host-serialized torrent broadcast :47-368).
+
+Execution model: an exchange is a stage barrier.  On first pull it
+materializes every child partition, computes partition ids per batch on
+the executing backend, splits, and caches the per-output-partition batch
+lists in the ExecCtx (the analog of map-output in the
+ShuffleBufferCatalog; reference RapidsCachingWriter stores partition
+tables in the spillable device store).  Subsequent partition pulls serve
+from the cache.  On the device backend the id+split computation is one
+jitted program per batch — the local, single-process analog of the mesh
+all-to-all path in parallel/mesh_shuffle.py, which the session planner
+picks when a multi-device mesh is active.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.partitioning import Partitioning
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops import kernels as dk
+
+__all__ = ["ShuffleExchangeExec", "BroadcastExchangeExec"]
+
+
+@partial(jax.jit, static_argnames=("num_parts",))
+def _jit_group_by_part(batch: ColumnBatch, ids: jax.Array, num_parts: int):
+    """Sort rows by partition id; return (sorted_batch, counts[num_parts]).
+
+    The analog of Table.contiguousSplit (GpuPartitioning.scala:45-52):
+    one stable sort groups each partition's rows contiguously; the small
+    counts vector is the only thing synced to host, and each partition is
+    then sliced into a right-sized capacity (no num_parts x capacity
+    buffer blowup).
+    """
+    cap = batch.capacity
+    ids = jnp.where(batch.row_mask(), ids, num_parts)  # padding last
+    order = jnp.argsort(ids, stable=True)
+    counts = jnp.sum(ids[None, :] == jnp.arange(num_parts,
+                                                dtype=jnp.int32)[:, None],
+                     axis=1, dtype=jnp.int32)
+    cols = dk.gather_columns(batch.columns, order, batch.num_rows)
+    return ColumnBatch(cols, batch.num_rows, batch.schema), counts
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _jit_slice_part(sorted_batch: ColumnBatch, start, count, out_cap: int):
+    """Copy rows [start, start+count) into a fresh out_cap batch."""
+    idx = jnp.clip(start + jnp.arange(out_cap, dtype=jnp.int32), 0,
+                   sorted_batch.capacity - 1)
+    return dk.take(sorted_batch, idx, count)
+
+
+class ShuffleExchangeExec(PlanNode):
+    """Repartition child output by a Partitioning strategy."""
+
+    def __init__(self, partitioning: Partitioning, child: PlanNode):
+        super().__init__([child])
+        self.partitioning = partitioning
+        partitioning.bind(child.output_schema)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.partitioning.num_partitions
+
+    def _shuffled(self, ctx: ExecCtx):
+        key = ("shuffle", id(self))
+        if key in ctx.cache:
+            return ctx.cache[key]
+        child = self.children[0]
+        batches = []
+        for pid in range(child.num_partitions(ctx)):
+            batches.extend(child.partition_iter(ctx, pid))
+        self.partitioning.prepare(batches, ctx.is_device)
+        n = self.partitioning.num_partitions
+        out: list[list] = [[] for _ in range(n)]
+        for bi, b in enumerate(batches):
+            if ctx.is_device:
+                from spark_rapids_tpu.columnar.batch import round_capacity
+                ids = self.partitioning.device_ids(b, bi)
+                sb, counts_d = _jit_group_by_part(b, ids, n)
+                counts = np.asarray(jax.device_get(counts_d))
+                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+                for p in range(n):
+                    if counts[p] == 0:
+                        continue
+                    out[p].append(_jit_slice_part(
+                        sb, jnp.asarray(starts[p], jnp.int32),
+                        jnp.asarray(counts[p], jnp.int32),
+                        round_capacity(int(counts[p]))))
+            else:
+                if b.num_rows == 0:
+                    continue
+                ids = self.partitioning.host_ids(b, bi)
+                for p in range(n):
+                    piece = hk.host_filter(b, ids == p)
+                    if piece.num_rows:
+                        out[p].append(piece)
+        ctx.cache[key] = out
+        return out
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from self._shuffled(ctx)[pid]
+
+    def node_desc(self) -> str:
+        return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"
+                f"({self.partitioning.num_partitions})]")
+
+
+class BroadcastExchangeExec(PlanNode):
+    """Materialize the child once; every consumer partition sees the
+    full (concatenated) output (reference GpuBroadcastExchangeExec:
+    collect to host, torrent-broadcast, lazy device rebuild — here the
+    single-process analog caches one batch per backend)."""
+
+    def __init__(self, child: PlanNode):
+        super().__init__([child])
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return 1
+
+    def materialize(self, ctx: ExecCtx):
+        key = ("broadcast", id(self))
+        if key in ctx.cache:
+            return ctx.cache[key]
+        child = self.children[0]
+        batches = []
+        for pid in range(child.num_partitions(ctx)):
+            batches.extend(child.partition_iter(ctx, pid))
+        if ctx.is_device:
+            if not batches:
+                from spark_rapids_tpu.exec.core import host_to_device
+                b = host_to_device(HostBatch.empty(child.output_schema))
+            else:
+                b = dk.concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+        else:
+            b = hk.host_concat(batches) if batches \
+                else HostBatch.empty(child.output_schema)
+        ctx.cache[key] = b
+        return b
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield self.materialize(ctx)
+
+    def node_desc(self) -> str:
+        return "BroadcastExchangeExec"

@@ -191,7 +191,32 @@ def _compute_agg(spec: AggSpec, col: DeviceColumn | None, seg_id, real, cap,
                 # max is NaN when any contributing value is NaN
                 data = jnp.where(nan_cnt > 0, jnp.full((), jnp.nan, x.dtype), r)
         elif isinstance(col.dtype, T.StringType):
-            raise NotImplementedError("min/max over strings")
+            # lexicographic min/max by a per-segment sort: order rows by
+            # (segment, non-contributing-last, string key words) and take
+            # each segment's first row (reference: cudf groupby min/max
+            # string aggregations)
+            from jax import lax
+            from spark_rapids_tpu.ops.sort import encode_key_operands
+            words = encode_key_operands(col, ascending=(op == "min"))
+            flag = (~contributes).astype(jnp.uint8)
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            sorted_ops = lax.sort([seg_id, flag, *words, iota],
+                                  num_keys=2 + len(words),
+                                  is_stable=True)
+            s_seg, s_flag, order = sorted_ops[0], sorted_ops[1], sorted_ops[-1]
+            firsts = jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), s_seg[1:] != s_seg[:-1]])
+            take = firsts & (s_flag == 0)
+            target = jnp.where(take, s_seg, cap)
+            src = col.data[order]
+            data = jnp.zeros((cap, col.max_len), jnp.uint8
+                             ).at[target].set(src, mode="drop")
+            lens = jnp.zeros(cap, jnp.int32
+                             ).at[target].set(col.lengths[order], mode="drop")
+            validity = (cnt_valid > 0) & out_mask
+            return DeviceColumn(jnp.where(validity[:, None], data, 0),
+                                validity, col.dtype,
+                                jnp.where(validity, lens, 0)), col.dtype
         else:
             info = jnp.iinfo(col.data.dtype) if col.data.dtype != jnp.bool_ else None
             if col.data.dtype == jnp.bool_:
