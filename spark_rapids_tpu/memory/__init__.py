@@ -1,0 +1,14 @@
+"""Memory runtime: 3-tier spill catalog + device occupancy control.
+
+Reference layer L1 (SURVEY.md §2.2): RapidsBufferCatalog wiring
+device->host->disk spill stores (RapidsBufferCatalog.scala:128-142),
+SpillPriorities, SpillableColumnarBatch, GpuSemaphore, and the RMM
+alloc-failure hook (DeviceMemoryEventHandler.scala:42-69).
+"""
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, DeviceSemaphore,
+                                             SpillPriority,
+                                             SpillableColumnarBatch,
+                                             run_with_spill_retry)
+
+__all__ = ["BufferCatalog", "DeviceSemaphore", "SpillPriority",
+           "SpillableColumnarBatch", "run_with_spill_retry"]

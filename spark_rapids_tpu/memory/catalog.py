@@ -1,0 +1,358 @@
+"""3-tier buffer catalog: HBM -> host arena (C++) -> disk.
+
+Reference mapping (SURVEY.md §2.2, §3.5):
+  * `BufferCatalog` = RapidsBufferCatalog (RapidsBufferCatalog.scala:34)
+    + the three RapidsBufferStore tiers wired device->host->disk
+    (:136-137), with acquire/release refcounts and priority-ordered
+    synchronous spill (RapidsBufferStore.synchronousSpill:147-200).
+  * `SpillPriority` = SpillPriorities.scala:26-60 bands.
+  * `SpillableColumnarBatch` = SpillableColumnarBatch.scala:28 — hold
+    data across iterator steps without pinning HBM.
+  * `run_with_spill_retry` = DeviceMemoryEventHandler.onAllocFailure:
+    PJRT exposes no RMM-style alloc callback, so the hook is a catch of
+    XLA RESOURCE_EXHAUSTED around dispatch -> spill -> retry.
+  * `DeviceSemaphore` = GpuSemaphore.scala (concurrent tasks per chip).
+
+TPU-first storage design: a spilled batch's leaves are packed into ONE
+contiguous slice of the native host arena (native/arena.cpp) so the
+host tier has real pooling and the disk tier writes one file per
+buffer; restore rebuilds the ColumnBatch pytree from zero-copy numpy
+views of the slice.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.conf import ConfEntry, register
+
+__all__ = ["BufferCatalog", "SpillPriority", "SpillableColumnarBatch",
+           "DeviceSemaphore", "run_with_spill_retry"]
+
+
+DEVICE_SPILL_LIMIT = register(ConfEntry(
+    "spark.rapids.memory.tpu.spillStoreSize", 2 << 30,
+    "Soft HBM budget for catalog-registered batches; adding past it "
+    "spills lowest-priority buffers to host (reference "
+    "spark.rapids.memory.gpu pool fraction, RapidsConf.scala:269+)."))
+HOST_SPILL_LIMIT = register(ConfEntry(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Host arena size for spilled buffers (reference "
+    "RapidsConf.scala:330)."))
+
+
+class SpillPriority:
+    """Lower spills first (reference SpillPriorities.scala:26-60)."""
+    SHUFFLE_OUTPUT = 0
+    READ_SHUFFLE = 100
+    ACTIVE_BATCH = 1 << 30
+
+
+@dataclass
+class _Entry:
+    buffer_id: int
+    priority: int
+    size: int
+    refcount: int = 0
+    tier: str = "device"            # device | host | disk
+    batch: ColumnBatch | None = None
+    # host/disk tier state
+    treedef: Any = None
+    leaf_meta: list | None = None   # (dtype, shape, nbytes, offset_in_slice)
+    arena_offset: int | None = None
+    disk_path: str | None = None
+
+
+class BufferCatalog:
+    """id -> buffer map with acquire/refcount + tiered spill."""
+
+    def __init__(self, device_limit: int | None = None,
+                 host_limit: int | None = None,
+                 spill_dir: str | None = None):
+        from spark_rapids_tpu.native import HostArena
+        self._lock = threading.RLock()
+        self._entries: dict[int, _Entry] = {}
+        self._next_id = 0
+        self.device_limit = device_limit or DEVICE_SPILL_LIMIT.default
+        self.device_used = 0
+        self._arena = HostArena(host_limit or HOST_SPILL_LIMIT.default)
+        self._spill_dir = spill_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"srt_spill_{os.getpid()}")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self.metrics = {"device_spills": 0, "host_spills": 0,
+                        "bytes_spilled_to_host": 0,
+                        "bytes_spilled_to_disk": 0}
+
+    # -- registration --------------------------------------------------
+    def add_batch(self, batch: ColumnBatch, priority: int) -> int:
+        """Register a device batch; may synchronously spill others."""
+        size = batch.device_size_bytes()
+        with self._lock:
+            bid = self._next_id
+            self._next_id += 1
+            self._entries[bid] = _Entry(bid, priority, size, batch=batch)
+            self.device_used += size
+            if self.device_used > self.device_limit:
+                self._spill_device_locked(self.device_used
+                                          - self.device_limit)
+            return bid
+
+    def acquire(self, buffer_id: int) -> ColumnBatch:
+        """Materialize on device (unspilling if needed) and pin."""
+        with self._lock:
+            e = self._entries[buffer_id]
+            e.refcount += 1   # pin BEFORE unspill so the over-budget pass
+            try:              # cannot immediately re-spill this buffer
+                if e.tier != "device":
+                    self._unspill_locked(e)
+            except Exception:
+                e.refcount -= 1
+                raise
+            return e.batch
+
+    def release(self, buffer_id: int) -> None:
+        with self._lock:
+            e = self._entries[buffer_id]
+            assert e.refcount > 0, f"release without acquire: {buffer_id}"
+            e.refcount -= 1
+
+    def remove(self, buffer_id: int) -> None:
+        with self._lock:
+            e = self._entries.pop(buffer_id)
+            self._drop_storage_locked(e)
+
+    # -- spill ----------------------------------------------------------
+    def spill_device(self, target_bytes: int) -> int:
+        with self._lock:
+            return self._spill_device_locked(target_bytes)
+
+    def _spillable_locked(self):
+        return sorted((e for e in self._entries.values()
+                       if e.tier == "device" and e.refcount == 0),
+                      key=lambda e: e.priority)
+
+    def _spill_device_locked(self, target: int) -> int:
+        freed = 0
+        for e in self._spillable_locked():
+            if freed >= target:
+                break
+            self._spill_one_to_host_locked(e)
+            freed += e.size
+        return freed
+
+    def _spill_one_to_host_locked(self, e: _Entry) -> None:
+        leaves, treedef = jax.tree_util.tree_flatten(e.batch)
+        host = jax.device_get(leaves)
+        metas, total = [], 0
+        host = [np.asarray(a) for a in host]
+        for a in host:
+            nb = a.nbytes
+            # record the ORIGINAL shape: ascontiguousarray would promote
+            # 0-d scalars (num_rows) to 1-d and corrupt the restore
+            metas.append([a.dtype, a.shape, nb, total])
+            total = _align(total + nb)
+        off = None
+        if total <= self._arena.capacity:
+            off = self._arena.alloc(max(total, 1))
+            while off is None and self._spill_host_one_locked():
+                off = self._arena.alloc(max(total, 1))
+        e.treedef = treedef
+        e.leaf_meta = metas
+        if off is not None:
+            for a, m in zip(host, metas):
+                flat = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+                self._arena.view(off + m[3], m[2])[:] = flat
+            e.arena_offset = off
+            e.tier = "host"
+            self.metrics["bytes_spilled_to_host"] += total
+        else:
+            # buffer cannot fit in the host arena (too large, or arena
+            # fragmented with nothing spillable): fall through device->disk
+            # (reference RapidsHostMemoryStore spill-through)
+            packed = np.zeros(max(total, 1), np.uint8)
+            for a, m in zip(host, metas):
+                flat = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+                packed[m[3]:m[3] + m[2]] = flat
+            path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
+            with open(path, "wb") as f:
+                f.write(packed.tobytes())
+            e.disk_path = path
+            e.tier = "disk"
+            self.metrics["bytes_spilled_to_disk"] += total
+        e.batch = None
+        self.device_used -= e.size
+        self.metrics["device_spills"] += 1
+
+    def _spill_host_one_locked(self) -> bool:
+        """Move one host-tier buffer to disk; False if none exist."""
+        cands = sorted((e for e in self._entries.values()
+                        if e.tier == "host" and e.refcount == 0),
+                       key=lambda e: e.priority)
+        if not cands:
+            return False
+        e = cands[0]
+        total = _align_total(e.leaf_meta)
+        path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
+        self._arena.write_to_disk(e.arena_offset, total, path)
+        self._arena.free(e.arena_offset)
+        e.arena_offset = None
+        e.disk_path = path
+        e.tier = "disk"
+        self.metrics["host_spills"] += 1
+        self.metrics["bytes_spilled_to_disk"] += total
+        return True
+
+    # -- unspill ---------------------------------------------------------
+    def _unspill_locked(self, e: _Entry) -> None:
+        import jax.numpy as jnp
+        total = _align_total(e.leaf_meta)
+        if e.tier == "disk" and e.arena_offset is None:
+            # oversized direct-to-disk buffers restore without the arena
+            if total > self._arena.capacity:
+                with open(e.disk_path, "rb") as f:
+                    packed = np.frombuffer(f.read(), np.uint8)
+                leaves = [jnp.asarray(np.frombuffer(
+                    packed[rel:rel + nb].tobytes(), dtype=dtype
+                ).reshape(shape)) for dtype, shape, nb, rel in e.leaf_meta]
+                os.unlink(e.disk_path)
+                e.disk_path = None
+                self._finish_unspill_locked(e, leaves)
+                return
+            off = self._arena.alloc(max(total, 1))
+            while off is None:
+                if not self._spill_host_one_locked():
+                    raise MemoryError("host arena exhausted during unspill")
+                off = self._arena.alloc(max(total, 1))
+            try:
+                self._arena.read_from_disk(off, total, e.disk_path)
+            except Exception:
+                self._arena.free(off)
+                raise
+            os.unlink(e.disk_path)
+            e.disk_path = None
+            e.arena_offset = off
+            e.tier = "host"
+        leaves = []
+        for dtype, shape, nb, rel in e.leaf_meta:
+            raw = self._arena.view(e.arena_offset + rel, nb)
+            leaves.append(jnp.asarray(
+                np.frombuffer(raw.tobytes(), dtype=dtype).reshape(shape)))
+        self._arena.free(e.arena_offset)
+        e.arena_offset = None
+        self._finish_unspill_locked(e, leaves)
+
+    def _finish_unspill_locked(self, e: _Entry, leaves) -> None:
+        e.batch = jax.tree_util.tree_unflatten(e.treedef, leaves)
+        e.leaf_meta = None
+        e.treedef = None
+        e.tier = "device"
+        self.device_used += e.size
+        if self.device_used > self.device_limit:
+            self._spill_device_locked(self.device_used - self.device_limit)
+
+    def _drop_storage_locked(self, e: _Entry) -> None:
+        if e.tier == "device":
+            self.device_used -= e.size
+        elif e.tier == "host" and e.arena_offset is not None:
+            self._arena.free(e.arena_offset)
+        elif e.tier == "disk" and e.disk_path:
+            try:
+                os.unlink(e.disk_path)
+            except OSError:
+                pass
+        e.batch = None
+
+    # -- introspection ---------------------------------------------------
+    def tier_of(self, buffer_id: int) -> str:
+        with self._lock:
+            return self._entries[buffer_id].tier
+
+    def close(self) -> None:
+        with self._lock:
+            for e in list(self._entries.values()):
+                self._drop_storage_locked(e)
+            self._entries.clear()
+            self._arena.close()
+
+
+def _align(n: int) -> int:
+    return (n + 63) & ~63
+
+
+def _align_total(metas) -> int:
+    if not metas:
+        return 1
+    last = metas[-1]
+    return max(_align(last[3] + last[2]), 1)
+
+
+class SpillableColumnarBatch:
+    """Hold a batch across iterator steps without pinning HBM
+    (reference SpillableColumnarBatch.scala:28-47)."""
+
+    def __init__(self, batch: ColumnBatch, catalog: BufferCatalog,
+                 priority: int = SpillPriority.ACTIVE_BATCH):
+        self._catalog = catalog
+        self._id = catalog.add_batch(batch, priority)
+        self._closed = False
+
+    def get(self) -> ColumnBatch:
+        b = self._catalog.acquire(self._id)
+        self._catalog.release(self._id)
+        return b
+
+    def close(self) -> None:
+        if not self._closed:
+            self._catalog.remove(self._id)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class DeviceSemaphore:
+    """Bound concurrent tasks touching the chip (reference
+    GpuSemaphore.scala: spark.rapids.sql.concurrentGpuTasks)."""
+
+    def __init__(self, concurrency: int):
+        self._sem = threading.BoundedSemaphore(concurrency)
+        self.concurrency = concurrency
+
+    def __enter__(self):
+        self._sem.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+
+
+def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
+                         max_retries: int = 3, spill_bytes: int | None = None):
+    """Dispatch ``fn(*args)``; on XLA OOM spill from the catalog and
+    retry (the DeviceMemoryEventHandler.onAllocFailure loop)."""
+    attempt = 0
+    while True:
+        try:
+            out = fn(*args)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            return out
+        except (RuntimeError, jax.errors.JaxRuntimeError) as ex:
+            msg = str(ex)
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+                raise
+            attempt += 1
+            if attempt > max_retries:
+                raise
+            freed = catalog.spill_device(
+                spill_bytes or catalog.device_limit // 4)
+            if freed == 0:
+                raise

@@ -1,0 +1,161 @@
+// Host pinned-arena allocator + spill file I/O.
+//
+// Native analog of the reference's RMM pool / PinnedMemoryPool
+// (GpuDeviceManager.initializeRmm:196-262, allocatePinnedMemory:264-270)
+// on the host side: device (HBM) allocation belongs to PJRT/XLA, so the
+// framework's own memory runtime manages the HOST spill tier with a real
+// arena — one big mmap'd region, first-fit free list with coalescing —
+// plus O_DIRECT-free but fsync-correct file spill for the disk tier
+// (reference RapidsHostMemoryStore / RapidsDiskStore).
+//
+// Exposed via a C ABI consumed with ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <new>
+
+#include <sys/mman.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Arena {
+    uint8_t* base = nullptr;
+    size_t capacity = 0;
+    size_t used = 0;
+    // free list: offset -> size, kept coalesced
+    std::map<size_t, size_t> free_blocks;
+    // live allocations: offset -> size
+    std::map<size_t, size_t> live;
+    std::mutex mu;
+};
+
+constexpr size_t kAlign = 64;
+
+size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+
+extern "C" {
+
+void* arena_create(size_t bytes) {
+    auto* a = new (std::nothrow) Arena();
+    if (!a) return nullptr;
+    bytes = align_up(bytes);
+    // MAP_POPULATE pre-faults so spill copies don't page-fault mid-flight;
+    // mlock is best-effort "pinned" (may exceed RLIMIT_MEMLOCK in container)
+    void* p = mmap(nullptr, bytes, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_POPULATE, -1, 0);
+    if (p == MAP_FAILED) { delete a; return nullptr; }
+    (void)mlock(p, bytes);
+    a->base = static_cast<uint8_t*>(p);
+    a->capacity = bytes;
+    a->free_blocks[0] = bytes;
+    return a;
+}
+
+void arena_destroy(void* h) {
+    auto* a = static_cast<Arena*>(h);
+    if (!a) return;
+    if (a->base) { munlock(a->base, a->capacity); munmap(a->base, a->capacity); }
+    delete a;
+}
+
+// Returns byte offset into the arena, or -1 when it cannot fit (caller
+// spills to the next tier and retries — the DeviceMemoryEventHandler
+// pattern, DeviceMemoryEventHandler.scala:42-69).
+int64_t arena_alloc(void* h, size_t bytes) {
+    auto* a = static_cast<Arena*>(h);
+    bytes = align_up(bytes ? bytes : 1);
+    std::lock_guard<std::mutex> lock(a->mu);
+    for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+        if (it->second >= bytes) {
+            size_t off = it->first;
+            size_t rem = it->second - bytes;
+            a->free_blocks.erase(it);
+            if (rem) a->free_blocks[off + bytes] = rem;
+            a->live[off] = bytes;
+            a->used += bytes;
+            return static_cast<int64_t>(off);
+        }
+    }
+    return -1;
+}
+
+int arena_free(void* h, int64_t off64) {
+    auto* a = static_cast<Arena*>(h);
+    size_t off = static_cast<size_t>(off64);
+    std::lock_guard<std::mutex> lock(a->mu);
+    auto it = a->live.find(off);
+    if (it == a->live.end()) return -1;
+    size_t size = it->second;
+    a->live.erase(it);
+    a->used -= size;
+    // insert and coalesce with neighbors
+    auto ins = a->free_blocks.emplace(off, size).first;
+    if (ins != a->free_blocks.begin()) {
+        auto prev = std::prev(ins);
+        if (prev->first + prev->second == ins->first) {
+            prev->second += ins->second;
+            a->free_blocks.erase(ins);
+            ins = prev;
+        }
+    }
+    auto next = std::next(ins);
+    if (next != a->free_blocks.end() &&
+        ins->first + ins->second == next->first) {
+        ins->second += next->second;
+        a->free_blocks.erase(next);
+    }
+    return 0;
+}
+
+uint8_t* arena_base(void* h) { return static_cast<Arena*>(h)->base; }
+size_t arena_capacity(void* h) { return static_cast<Arena*>(h)->capacity; }
+size_t arena_used(void* h) { return static_cast<Arena*>(h)->used; }
+
+size_t arena_largest_free(void* h) {
+    auto* a = static_cast<Arena*>(h);
+    std::lock_guard<std::mutex> lock(a->mu);
+    size_t best = 0;
+    for (auto& kv : a->free_blocks) best = kv.second > best ? kv.second : best;
+    return best;
+}
+
+// ---- disk tier ----------------------------------------------------------
+
+// Write [ptr, ptr+bytes) to path. Returns 0 on success.
+int spill_write(const char* path, const uint8_t* ptr, size_t bytes) {
+    int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return -1;
+    size_t done = 0;
+    while (done < bytes) {
+        ssize_t w = write(fd, ptr + done, bytes - done);
+        if (w <= 0) { close(fd); return -1; }
+        done += static_cast<size_t>(w);
+    }
+    if (fdatasync(fd) != 0) { close(fd); return -1; }
+    int rc = close(fd);
+    return rc;
+}
+
+int64_t spill_read(const char* path, uint8_t* ptr, size_t bytes) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    size_t done = 0;
+    while (done < bytes) {
+        ssize_t r = read(fd, ptr + done, bytes - done);
+        if (r < 0) { close(fd); return -1; }
+        if (r == 0) break;
+        done += static_cast<size_t>(r);
+    }
+    close(fd);
+    return static_cast<int64_t>(done);
+}
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+"""Memory runtime tests: native arena, spill tiers, spillable batches.
+
+Mirrors the reference's RapidsBufferCatalogSuite /
+RapidsDeviceMemoryStoreSuite / RapidsDiskStoreSuite /
+SpillableColumnarBatchSuite coverage (SURVEY.md §4.1).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.memory import (BufferCatalog, DeviceSemaphore,
+                                     SpillPriority, SpillableColumnarBatch)
+from spark_rapids_tpu.native import HostArena
+
+SCHEMA = T.Schema([
+    T.StructField("a", T.LongType(), True),
+    T.StructField("s", T.StringType(), True),
+])
+
+
+def _batch(rng, n=256):
+    return HostBatch.from_pydict({
+        "a": [int(x) for x in rng.integers(-1000, 1000, n)],
+        "s": [f"str{x}" if x % 7 else None for x in rng.integers(0, 99, n)],
+    }, SCHEMA).to_device()
+
+
+def _rows(b):
+    return HostBatch.from_device(b).to_rows()
+
+
+# ---------------------------------------------------------------------------
+# native arena
+# ---------------------------------------------------------------------------
+
+def test_arena_alloc_free_coalesce():
+    a = HostArena(1 << 20)
+    offs = [a.alloc(1000) for _ in range(5)]
+    assert all(o is not None for o in offs)
+    assert a.used >= 5 * 1000
+    # free middle blocks; coalescing must let a big alloc succeed
+    for o in offs:
+        a.free(o)
+    assert a.used == 0
+    big = a.alloc((1 << 20) - 64)
+    assert big is not None
+    a.free(big)
+    with pytest.raises(ValueError):
+        a.free(big)  # double free detected
+    a.close()
+
+
+def test_arena_view_roundtrip(tmp_path):
+    a = HostArena(1 << 16)
+    off = a.alloc(4096)
+    data = np.arange(4096, dtype=np.uint8)
+    a.view(off, 4096)[:] = data
+    p = str(tmp_path / "x.bin")
+    a.write_to_disk(off, 4096, p)
+    off2 = a.alloc(4096)
+    a.read_from_disk(off2, 4096, p)
+    assert (a.view(off2, 4096) == data).all()
+    a.close()
+
+
+def test_arena_exhaustion_returns_none():
+    a = HostArena(1 << 12)
+    assert a.alloc(1 << 13) is None
+    a.close()
+
+
+# ---------------------------------------------------------------------------
+# catalog tiers
+# ---------------------------------------------------------------------------
+
+def test_spill_to_host_and_restore(rng):
+    b = _batch(rng)
+    want = _rows(b)
+    cat = BufferCatalog(device_limit=1, host_limit=1 << 24)
+    bid = cat.add_batch(b, SpillPriority.SHUFFLE_OUTPUT)
+    # over budget -> spilled immediately
+    assert cat.tier_of(bid) == "host"
+    got = cat.acquire(bid)
+    assert cat.tier_of(bid) == "device"
+    assert _rows(got) == want
+    cat.release(bid)
+    cat.remove(bid)
+    cat.close()
+
+
+def test_spill_through_to_disk(rng):
+    b1, b2 = _batch(rng), _batch(rng)
+    w1, w2 = _rows(b1), _rows(b2)
+    size = b1.device_size_bytes()
+    # host arena fits ~one batch -> second host spill pushes first to disk
+    cat = BufferCatalog(device_limit=1, host_limit=size + 4096)
+    id1 = cat.add_batch(b1, priority=0)
+    id2 = cat.add_batch(b2, priority=1)
+    assert cat.tier_of(id2) == "host"
+    assert cat.tier_of(id1) == "disk"
+    assert cat.metrics["host_spills"] == 1
+    # restore from disk
+    got1 = cat.acquire(id1)
+    assert _rows(got1) == w1
+    cat.release(id1)
+    got2 = cat.acquire(id2)
+    assert _rows(got2) == w2
+    cat.release(id2)
+    cat.close()
+
+
+def test_pinned_buffers_do_not_spill(rng):
+    b1, b2 = _batch(rng), _batch(rng)
+    cat = BufferCatalog(device_limit=10 << 20, host_limit=1 << 24)
+    id1 = cat.add_batch(b1, priority=0)
+    _ = cat.acquire(id1)           # pin
+    id2 = cat.add_batch(b2, priority=5)
+    freed = cat.spill_device(1)    # must pick b2 (b1 pinned)
+    assert freed > 0
+    assert cat.tier_of(id1) == "device"
+    assert cat.tier_of(id2) == "host"
+    cat.release(id1)
+    cat.close()
+
+
+def test_spill_priority_order(rng):
+    cat = BufferCatalog(device_limit=10 << 20, host_limit=1 << 24)
+    low = cat.add_batch(_batch(rng), priority=SpillPriority.SHUFFLE_OUTPUT)
+    high = cat.add_batch(_batch(rng), priority=SpillPriority.ACTIVE_BATCH)
+    cat.spill_device(1)  # spills exactly one, the lowest priority
+    assert cat.tier_of(low) == "host"
+    assert cat.tier_of(high) == "device"
+    cat.close()
+
+
+def test_spillable_columnar_batch(rng):
+    cat = BufferCatalog(device_limit=1, host_limit=1 << 24)
+    b = _batch(rng)
+    want = _rows(b)
+    with SpillableColumnarBatch(b, cat) as scb:
+        assert _rows(scb.get()) == want
+        assert _rows(scb.get()) == want  # repeatable
+    cat.close()
+
+
+def test_device_semaphore_bounds_concurrency():
+    sem = DeviceSemaphore(2)
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def task():
+        with sem:
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            import time
+            time.sleep(0.02)
+            with lock:
+                active[0] -= 1
+
+    threads = [threading.Thread(target=task) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert peak[0] <= 2
+
+
+def test_oversized_buffer_spills_direct_to_disk(rng):
+    b = _batch(rng, n=2048)
+    want = _rows(b)
+    # arena far smaller than the packed batch -> device->disk fallthrough
+    cat = BufferCatalog(device_limit=1, host_limit=1 << 12)
+    bid = cat.add_batch(b, 0)
+    assert cat.tier_of(bid) == "disk"
+    got = cat.acquire(bid)
+    assert _rows(got) == want
+    cat.release(bid)
+    cat.close()
+
+
+def test_arena_close_then_view_raises():
+    a = HostArena(1 << 12)
+    off = a.alloc(64)
+    a.close()
+    with pytest.raises(ValueError):
+        a.view(off, 64)
