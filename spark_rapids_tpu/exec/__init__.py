@@ -8,6 +8,7 @@ from spark_rapids_tpu.exec.basic import (FilterExec, GlobalLimitExec,
                                          ProjectExec, RangeExec, UnionExec)
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec
 from spark_rapids_tpu.exec.joins import CrossJoinExec, JoinExec
+from spark_rapids_tpu.exec.window import WindowExec
 from spark_rapids_tpu.exec.partitioning import (HashPartitioning,
                                                 RangePartitioning,
                                                 RoundRobinPartitioning,
@@ -24,7 +25,7 @@ __all__ = [
     "FilterExec", "GlobalLimitExec", "LocalLimitExec", "LocalScanExec",
     "ProjectExec", "RangeExec", "UnionExec",
     "HashAggregateExec", "CoalesceBatchesExec", "SortExec", "resolve_orders",
-    "JoinExec", "CrossJoinExec",
+    "JoinExec", "CrossJoinExec", "WindowExec",
     "HashPartitioning", "RangePartitioning", "RoundRobinPartitioning",
     "SinglePartitioning", "ShuffleExchangeExec", "BroadcastExchangeExec",
 ]

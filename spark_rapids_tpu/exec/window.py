@@ -1,0 +1,374 @@
+"""Window exec: evaluate window expressions over sorted partitions.
+
+Reference: GpuWindowExec (GpuWindowExec.scala:92, doExecuteColumnar:130)
+— requires a single batch per partition group and lowers to cuDF rolling
+windows.  Here the whole input is materialized (RequireSingleBatch, like
+the reference's child goal), sorted once by (partition keys, order
+keys), and every window expression is computed from the shared
+SegmentInfo arrays (ops/window.py).  Output rows are in sorted order
+(Spark leaves window output order undefined).
+
+All window expressions in one exec must share one WindowSpec — Spark's
+planner creates one WindowExec per distinct spec, and the planner here
+does the same.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.expr.core import (Expression, bind, eval_device,
+                                        eval_host, output_name)
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr.window import (DenseRank, Lag, Lead, Rank,
+                                          RowNumber, WindowExpression,
+                                          window_agg_op)
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+from spark_rapids_tpu.ops import host_kernels as hk
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops import window as W
+from spark_rapids_tpu.ops.sort import SortOrder, sort_batch
+
+__all__ = ["WindowExec"]
+
+
+def _wexpr_dtype(w: WindowExpression, bound_input) -> T.DataType:
+    """Output type computed from the BOUND function input (the raw
+    WindowExpression.dtype needs resolved children)."""
+    from spark_rapids_tpu.ops.segmented import AggSpec
+    f = w.function
+    if isinstance(f, A.AggregateFunction):
+        op = window_agg_op(f)
+        in_t = bound_input.dtype if bound_input is not None else T.LongType()
+        return AggSpec(op, 0).result_type(in_t)
+    if isinstance(f, (Lead, Lag)):
+        return bound_input.dtype
+    return f.dtype
+
+
+class WindowExec(PlanNode):
+    """Append one output column per window expression.
+
+    ``window_exprs``: WindowExpression (optionally Alias-wrapped), all
+    sharing the same WindowSpec.
+    """
+
+    def __init__(self, window_exprs: Sequence[Expression], child: PlanNode):
+        super().__init__([child])
+        from spark_rapids_tpu.expr.core import Alias
+        self._names = [output_name(e) for e in window_exprs]
+        self._wexprs: list[WindowExpression] = []
+        for e in window_exprs:
+            if isinstance(e, Alias):
+                e = e.children[0]
+            assert isinstance(e, WindowExpression), e
+            self._wexprs.append(e)
+        assert self._wexprs, "need at least one window expression"
+        spec0 = self._wexprs[0].spec
+        for e in self._wexprs[1:]:
+            if e.spec != spec0:
+                raise ValueError("one WindowExec handles one WindowSpec; "
+                                 "split plans per spec as Spark does")
+        self.spec = spec0
+        cs = child.output_schema
+        # bind partition/order/function-input expressions against the child
+        self._part_b = [bind(p, cs) for p in self.spec.partition_by]
+        self._order_b = [(bind(o[0], cs), o[1] if len(o) > 1 else True,
+                          o[2] if len(o) > 2 else None)
+                         for o in self.spec.order_by]
+        self._fn_inputs: list[Expression | None] = []
+        for w in self._wexprs:
+            f = w.function
+            if isinstance(f, (Lead, Lag)):
+                self._fn_inputs.append(bind(f.children[0], cs))
+            elif isinstance(f, A.AggregateFunction) and f.input is not None:
+                self._fn_inputs.append(bind(f.input, cs))
+            else:
+                self._fn_inputs.append(None)
+        self._out_dtypes = [_wexpr_dtype(w, b)
+                            for w, b in zip(self._wexprs, self._fn_inputs)]
+        self._schema = T.Schema(
+            list(cs.fields)
+            + [T.StructField(n, dt, True)
+               for n, dt in zip(self._names, self._out_dtypes)])
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def output_batching(self):
+        return RequireSingleBatch
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return 1
+
+    # ------------------------------------------------------------------
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child = self.children[0]
+        batches = []
+        for p in range(child.num_partitions(ctx)):
+            batches.extend(child.partition_iter(ctx, p))
+        if ctx.is_device:
+            if not batches:
+                from spark_rapids_tpu.exec.core import host_to_device
+                big = host_to_device(HostBatch.empty(child.output_schema))
+            else:
+                big = dk.concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+            yield self._run_device(big)
+        else:
+            big = hk.host_concat(batches) if batches \
+                else HostBatch.empty(child.output_schema)
+            yield self._run_host(big)
+
+    # ------------------------------------------------------------------
+    def _run_device(self, big: ColumnBatch) -> ColumnBatch:
+        nbase = big.num_columns
+        cols = list(big.columns)
+        fields = list(big.schema.fields)
+        part_idx, order_idx, input_idx = [], [], []
+        for e in self._part_b:
+            cols.append(eval_device(e, big))
+            fields.append(T.StructField(f"_wp{len(part_idx)}", e.dtype, True))
+            part_idx.append(len(cols) - 1)
+        for e, asc, nf in self._order_b:
+            cols.append(eval_device(e, big))
+            fields.append(T.StructField(f"_wo{len(order_idx)}", e.dtype, True))
+            order_idx.append(len(cols) - 1)
+        for e in self._fn_inputs:
+            if e is None:
+                input_idx.append(None)
+            else:
+                cols.append(eval_device(e, big))
+                fields.append(T.StructField(f"_wi{len(cols)}", e.dtype, True))
+                input_idx.append(len(cols) - 1)
+        aug = ColumnBatch(cols, big.num_rows, T.Schema(fields))
+        orders = [SortOrder(i, True, True) for i in part_idx] + \
+            [SortOrder(i, asc, nf)
+             for i, (_, asc, nf) in zip(order_idx, self._order_b)]
+        out = _jit_window(aug, tuple(orders), tuple(part_idx),
+                          tuple(order_idx), tuple(input_idx),
+                          tuple(self._wexprs), nbase, self._schema)
+        return out
+
+    def _run_host(self, big: HostBatch) -> HostBatch:
+        n = big.num_rows
+        part_cols = [eval_host(e, big) for e in self._part_b]
+        order_cols = [eval_host(e, big) for e, _, _ in self._order_b]
+        in_cols = [None if e is None else eval_host(e, big)
+                   for e in self._fn_inputs]
+        # sort indices by (partition, order) with host sort machinery
+        tmp_fields = [T.StructField(f"c{i}", c.dtype, True)
+                      for i, c in enumerate(part_cols + order_cols)]
+        tmp = HostBatch(part_cols + order_cols, T.Schema(tmp_fields))
+        orders = [SortOrder(i, True, True) for i in range(len(part_cols))] + \
+            [SortOrder(len(part_cols) + i, asc,
+                       nf if nf is not None else None)
+             for i, (_, asc, nf) in enumerate(self._order_b)]
+        perm = hk.host_sort_permutation(tmp, orders) if n else \
+            np.zeros(0, np.int64)
+        base = big.take(perm)
+        sp = [c.take(perm) for c in part_cols]
+        so = [c.take(perm) for c in order_cols]
+        si = [None if c is None else c.take(perm) for c in in_cols]
+
+        def key_tuple(colset, i):
+            out = []
+            for c in colset:
+                if not c.validity[i]:
+                    out.append(("\0null",))
+                else:
+                    v = c.data[i]
+                    if isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+                        f = float(v)
+                        v = "NaN" if f != f else (0.0 if f == 0.0 else f)
+                    out.append((v,))
+            return tuple(out)
+
+        seg_start = np.zeros(n, np.int64)
+        seg_end = np.zeros(n, np.int64)
+        peer_start = np.zeros(n, np.int64)
+        peer_end = np.zeros(n, np.int64)
+        s = 0
+        for i in range(1, n + 1):
+            if i == n or key_tuple(sp, i) != key_tuple(sp, s):
+                seg_start[s:i] = s
+                seg_end[s:i] = i - 1
+                ps = s
+                for j in range(s + 1, i + 1):
+                    if j == i or key_tuple(so, j) != key_tuple(so, ps):
+                        peer_start[ps:j] = ps
+                        peer_end[ps:j] = j - 1
+                        ps = j
+                s = i
+
+        new_cols = []
+        for w, inc, out_dt in zip(self._wexprs, si, self._out_dtypes):
+            f = w.function
+            frame = w.spec.resolved_frame()
+            if isinstance(f, RowNumber):
+                data = np.arange(n) - seg_start + 1
+                new_cols.append(HostColumn(data.astype(np.int32),
+                                           np.ones(n, bool), out_dt))
+            elif isinstance(f, Rank):
+                data = peer_start - seg_start + 1
+                new_cols.append(HostColumn(data.astype(np.int32),
+                                           np.ones(n, bool), out_dt))
+            elif isinstance(f, DenseRank):
+                data = np.zeros(n, np.int32)
+                r = 0
+                for i in range(n):
+                    if i == seg_start[i]:
+                        r = 1
+                    elif peer_start[i] == i:
+                        r += 1
+                    data[i] = r
+                new_cols.append(HostColumn(data, np.ones(n, bool), out_dt))
+            elif isinstance(f, (Lead, Lag)):
+                off = f.offset if isinstance(f, Lead) else -f.offset
+                data = np.empty(n, object)
+                validity = np.zeros(n, bool)
+                defv = None
+                if f.default is not None:
+                    from spark_rapids_tpu.expr.core import Literal
+                    assert isinstance(f.default, Literal)
+                    defv = f.default.value
+                for i in range(n):
+                    j = i + off
+                    if seg_start[i] <= j <= seg_end[i]:
+                        if inc.validity[j]:
+                            data[i] = inc.data[j]
+                            validity[i] = True
+                    elif defv is not None:
+                        data[i] = defv
+                        validity[i] = True
+                new_cols.append(_objs_to_host(data, validity, out_dt))
+            else:
+                op = window_agg_op(f)
+                data = np.empty(n, object)
+                validity = np.zeros(n, bool)
+                for i in range(n):
+                    if frame.mode == "rows":
+                        lo = seg_start[i] if frame.lower is None else \
+                            max(i + frame.lower, seg_start[i])
+                        hi = seg_end[i] if frame.upper is None else \
+                            min(i + frame.upper, seg_end[i])
+                    else:
+                        lo = seg_start[i] if frame.lower is None \
+                            else peer_start[i]
+                        hi = seg_end[i] if frame.upper is None \
+                            else peer_end[i]
+                    vals = []
+                    cnt_rows = 0
+                    for j in range(lo, hi + 1):
+                        cnt_rows += 1
+                        if inc is not None and inc.validity[j]:
+                            vals.append(inc.data[j])
+                    data[i], validity[i] = _host_agg(op, vals, cnt_rows,
+                                                     out_dt)
+                new_cols.append(_objs_to_host(data, validity, out_dt))
+        return HostBatch(list(base.columns) + new_cols, self._schema)
+
+    def node_desc(self) -> str:
+        return f"WindowExec[{self._names}]"
+
+
+def _host_agg(op, vals, cnt_rows, dtype):
+    import math
+    if op == "count_star":
+        return cnt_rows, True
+    if op == "count":
+        return len(vals), True
+    if not vals:
+        return None, False
+    fvals = [float(v) for v in vals]
+    if op == "sum":
+        if isinstance(dtype, T.LongType):
+            return int(sum(int(v) for v in vals)), True
+        return float(sum(fvals)), True
+    if op == "avg":
+        return float(sum(fvals) / len(vals)), True
+    has_nan = any(isinstance(v, float) and math.isnan(v) for v in vals)
+    if op == "min":
+        nn = [v for v in vals
+              if not (isinstance(v, float) and math.isnan(v))]
+        if nn:
+            return min(nn), True
+        return float("nan"), True
+    if op == "max":
+        if has_nan:
+            return float("nan"), True
+        return max(vals), True
+    raise ValueError(op)
+
+
+def _objs_to_host(data, validity, dtype) -> HostColumn:
+    if isinstance(dtype, T.StringType):
+        return HostColumn(data, validity, dtype)
+    npdt = dtype.np_dtype
+    arr = np.zeros(len(data), npdt)
+    for i, v in enumerate(data):
+        if validity[i]:
+            arr[i] = v
+    return HostColumn(arr, validity, dtype)
+
+
+@partial(jax.jit, static_argnames=("orders", "part_idx", "order_idx",
+                                   "input_idx", "wexprs", "nbase", "schema"))
+def _jit_window(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
+                wexprs, nbase: int, schema: T.Schema) -> ColumnBatch:
+    sb = sort_batch(aug, list(orders))
+    seg = W.sorted_segments(sb, part_idx, order_idx)
+    out_cols = list(sb.columns[:nbase])
+    for w, ii in zip(wexprs, input_idx):
+        f = w.function
+        if isinstance(f, RowNumber):
+            data = W.row_number(seg).astype(jnp.int32)
+            out_cols.append(DeviceColumn(
+                jnp.where(seg.real, data, 0), seg.real, T.IntegerType()))
+        elif isinstance(f, Rank):
+            data = W.rank(seg).astype(jnp.int32)
+            out_cols.append(DeviceColumn(
+                jnp.where(seg.real, data, 0), seg.real, T.IntegerType()))
+        elif isinstance(f, DenseRank):
+            data = W.dense_rank(seg).astype(jnp.int32)
+            out_cols.append(DeviceColumn(
+                jnp.where(seg.real, data, 0), seg.real, T.IntegerType()))
+        elif isinstance(f, (Lead, Lag)):
+            off = f.offset if isinstance(f, Lead) else -f.offset
+            col = sb.columns[ii]
+            dd = dv = None
+            if f.default is not None:
+                from spark_rapids_tpu.expr.core import Literal
+                assert isinstance(f.default, Literal)
+                if f.default.value is not None:
+                    dd = jnp.full(sb.capacity, f.default.value,
+                                  col.data.dtype)
+                    dv = jnp.ones(sb.capacity, jnp.bool_)
+            data, validity, lengths = W.lead_lag(col, seg, off, dd, dv)
+            out_cols.append(DeviceColumn(data, validity, col.dtype, lengths))
+        else:
+            op = window_agg_op(f)
+            frame = w.spec.resolved_frame()
+            if op == "count_star":
+                col = DeviceColumn(jnp.zeros(sb.capacity, jnp.int64),
+                                   seg.real, T.LongType())
+                data, validity, rtype = W.running_or_bounded_agg(
+                    "count", col, seg, frame)
+            else:
+                col = sb.columns[ii]
+                data, validity, rtype = W.running_or_bounded_agg(
+                    op, col, seg, frame)
+            zero = jnp.zeros((), data.dtype)
+            out_cols.append(DeviceColumn(jnp.where(validity, data, zero),
+                                         validity, rtype))
+    return ColumnBatch(out_cols, sb.num_rows, schema)

@@ -74,14 +74,15 @@ class BufferCatalog:
 
     def __init__(self, device_limit: int | None = None,
                  host_limit: int | None = None,
-                 spill_dir: str | None = None):
+                 spill_dir: str | None = None, conf=None):
         from spark_rapids_tpu.native import HostArena
+        settings = getattr(conf, "settings", {}) if conf is not None else {}
         self._lock = threading.RLock()
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
-        self.device_limit = device_limit or DEVICE_SPILL_LIMIT.default
+        self.device_limit = device_limit or DEVICE_SPILL_LIMIT.get(settings)
         self.device_used = 0
-        self._arena = HostArena(host_limit or HOST_SPILL_LIMIT.default)
+        self._arena = HostArena(host_limit or HOST_SPILL_LIMIT.get(settings))
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), f"srt_spill_{os.getpid()}")
         os.makedirs(self._spill_dir, exist_ok=True)
@@ -301,14 +302,25 @@ class SpillableColumnarBatch:
         self._catalog = catalog
         self._id = catalog.add_batch(batch, priority)
         self._closed = False
+        self._pins = 0
 
     def get(self) -> ColumnBatch:
+        """Materialize AND pin; pair every get() with an unpin() once the
+        batch is no longer referenced (reference incRefCount/close
+        contract) so the catalog cannot spill HBM still in use."""
         b = self._catalog.acquire(self._id)
-        self._catalog.release(self._id)
+        self._pins += 1
         return b
+
+    def unpin(self) -> None:
+        assert self._pins > 0
+        self._catalog.release(self._id)
+        self._pins -= 1
 
     def close(self) -> None:
         if not self._closed:
+            while self._pins:
+                self.unpin()
             self._catalog.remove(self._id)
             self._closed = True
 

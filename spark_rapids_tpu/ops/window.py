@@ -1,0 +1,286 @@
+"""Window function device kernels.
+
+Reference: GpuWindowExec + GpuWindowExpression (GpuWindowExec.scala:92,
+GpuWindowExpression.scala:169-830) lower to cuDF rolling-window
+aggregations.  TPU design: after one sort by (partition keys, order
+keys), every window shape becomes static-shape index arithmetic:
+
+* partition extents ``seg_start/seg_end`` via boundary-flag cummax,
+* running (UNBOUNDED PRECEDING..CURRENT ROW) and whole-partition frames
+  via prefix sums / segment reductions,
+* bounded ROWS frames via **sparse tables** (log2(cap) levels of
+  power-of-two-span min/max, XLA-friendly static depth) for min/max and
+  clamped prefix-sum differences for sum/count/avg,
+* RANGE frames differ from ROWS only in using peer-group edges
+  (first/last row with equal order keys) as the effective row,
+* row_number/rank/dense_rank/lead/lag from the same segment arrays.
+
+All results are computed in sorted order; the exec emits the sorted
+batch (Spark does not define window output order).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.segmented import _cols_differ
+from spark_rapids_tpu.ops.sort import SortOrder, normalize_floats, sort_batch
+
+__all__ = ["WindowFrame", "UNBOUNDED", "CURRENT_ROW", "SegmentInfo",
+           "sorted_segments", "running_or_bounded_agg", "row_number", "rank",
+           "dense_rank", "lead_lag"]
+
+UNBOUNDED = None
+CURRENT_ROW = 0
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    """ROWS/RANGE frame: bounds are None (unbounded) or int row offsets
+    (negative = preceding).  RANGE only supports UNBOUNDED/CURRENT_ROW
+    bounds (Spark's value-RANGE with literal offsets is a planner
+    rejection, as in the reference tagging)."""
+    mode: str = "range"            # "rows" | "range"
+    lower: int | None = UNBOUNDED  # None=unbounded preceding, k<=0 offset
+    upper: int | None = CURRENT_ROW  # None=unbounded following, k>=0
+
+    def __post_init__(self):
+        if self.mode == "range":
+            assert self.lower in (UNBOUNDED, CURRENT_ROW)
+            assert self.upper in (UNBOUNDED, CURRENT_ROW)
+
+
+@dataclass
+class SegmentInfo:
+    """Per-row partition/peer extents over the sorted batch."""
+    seg_start: jax.Array    # int32[cap] first row index of row's partition
+    seg_end: jax.Array      # int32[cap] last row index (inclusive)
+    peer_start: jax.Array   # first row of the order-key peer group
+    peer_end: jax.Array     # last row of the peer group
+    seg_id: jax.Array       # int32[cap]
+    order_change: jax.Array  # bool[cap] order key differs from prev in seg
+    real: jax.Array         # bool[cap]
+
+
+def sorted_segments(sb: ColumnBatch, part_idx: Sequence[int],
+                    order_idx: Sequence[int]) -> SegmentInfo:
+    cap = sb.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    real = sb.row_mask()
+    part_flag = jnp.zeros(cap, jnp.bool_)
+    for ki in part_idx:
+        part_flag = part_flag | _cols_differ(sb.columns[ki])
+    part_flag = (idx == 0) | (part_flag & real) | (idx == sb.num_rows)
+    part_flag = part_flag & (idx <= sb.num_rows)
+    seg_id = jnp.cumsum(part_flag.astype(jnp.int32)) - 1
+    seg_start = lax.cummax(jnp.where(part_flag, idx, 0))
+    # seg_end: reverse cummax of next-boundary - 1
+    nxt = jnp.where(part_flag, idx, cap)
+    rev_next = jnp.flip(lax.cummin(jnp.flip(
+        jnp.concatenate([nxt[1:], jnp.asarray([cap], jnp.int32)]))))
+    seg_end = jnp.minimum(rev_next - 1, jnp.maximum(sb.num_rows - 1, 0))
+
+    order_change = jnp.zeros(cap, jnp.bool_)
+    for ki in order_idx:
+        order_change = order_change | _cols_differ(sb.columns[ki])
+    peer_flag = part_flag | (order_change & real)
+    peer_start = lax.cummax(jnp.where(peer_flag, idx, 0))
+    pnxt = jnp.where(peer_flag, idx, cap)
+    rev_pnext = jnp.flip(lax.cummin(jnp.flip(
+        jnp.concatenate([pnxt[1:], jnp.asarray([cap], jnp.int32)]))))
+    peer_end = jnp.minimum(rev_pnext - 1, jnp.maximum(sb.num_rows - 1, 0))
+    return SegmentInfo(seg_start, seg_end, peer_start, peer_end, seg_id,
+                       order_change & real, real)
+
+
+# ---------------------------------------------------------------------------
+# frame edges
+# ---------------------------------------------------------------------------
+
+def _frame_edges(seg: SegmentInfo, frame: WindowFrame):
+    """(lo, hi) inclusive row-index bounds per row."""
+    cap = seg.seg_start.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    if frame.mode == "rows":
+        lo = seg.seg_start if frame.lower is UNBOUNDED else \
+            jnp.maximum(idx + frame.lower, seg.seg_start)
+        hi = seg.seg_end if frame.upper is UNBOUNDED else \
+            jnp.minimum(idx + frame.upper, seg.seg_end)
+    else:  # range: CURRENT_ROW means the whole peer group
+        lo = seg.seg_start if frame.lower is UNBOUNDED else seg.peer_start
+        hi = seg.seg_end if frame.upper is UNBOUNDED else seg.peer_end
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# sparse-table range min/max (static log depth)
+# ---------------------------------------------------------------------------
+
+def _sparse_table(x: jax.Array, op) -> list[jax.Array]:
+    """st[k][i] = op over x[i : i+2^k), clamped at the end."""
+    cap = x.shape[0]
+    levels = [x]
+    k = 1
+    while (1 << k) <= cap:
+        prev = levels[-1]
+        half = 1 << (k - 1)
+        shifted = jnp.concatenate([prev[half:], prev[-1:].repeat(half)])
+        levels.append(op(prev, shifted))
+        k += 1
+    return levels
+
+
+def _range_query(levels: list[jax.Array], lo, hi, op, identity):
+    """Per-row op over x[lo..hi] via two overlapping power-of-two spans."""
+    length = hi - lo + 1
+    valid = length > 0
+    length = jnp.maximum(length, 1)
+    # floor(log2(length)) via pure integer comparisons (no f64 log on TPU)
+    k = jnp.zeros(length.shape, jnp.int32)
+    for kk in range(1, len(levels)):
+        k = k + (length >= (1 << kk)).astype(jnp.int32)
+    cap = levels[0].shape[0]
+    res = jnp.full(levels[0].shape, identity, levels[0].dtype)
+    for kk in range(len(levels)):
+        span = 1 << kk
+        a = levels[kk][jnp.clip(lo, 0, cap - 1)]
+        b = levels[kk][jnp.clip(hi - span + 1, 0, cap - 1)]
+        cand = op(a, b)
+        res = jnp.where(k == kk, cand, res)
+    return jnp.where(valid, res, identity)
+
+
+# ---------------------------------------------------------------------------
+# aggregates over frames
+# ---------------------------------------------------------------------------
+
+def running_or_bounded_agg(op: str, col: DeviceColumn, seg: SegmentInfo,
+                           frame: WindowFrame):
+    """sum|count|avg|min|max over the frame. Returns (data, validity,
+    result_type)."""
+    cap = col.capacity
+    contributes = col.validity & seg.real
+    lo, hi = _frame_edges(seg, frame)
+
+    if op in ("sum", "count", "avg"):
+        if op == "count":
+            x = contributes.astype(jnp.int64)
+            acc_dt = jnp.int64
+        else:
+            acc_dt = jnp.int64 if col.dtype.integral else jnp.float64
+            x = jnp.where(contributes, col.data.astype(acc_dt),
+                          jnp.zeros((), acc_dt))
+        # empty frames (lo > hi, e.g. ROWS 2 FOLLOWING..5 FOLLOWING at the
+        # partition tail) must yield 0, not a negative cross-partition diff
+        hi1 = jnp.maximum(hi + 1, lo)
+        ps = jnp.concatenate([jnp.zeros(1, acc_dt), jnp.cumsum(x)])
+        total = ps[jnp.clip(hi1, 0, cap)] - ps[jnp.clip(lo, 0, cap)]
+        cnt_x = contributes.astype(jnp.int64)
+        pc = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(cnt_x)])
+        cnt = pc[jnp.clip(hi1, 0, cap)] - pc[jnp.clip(lo, 0, cap)]
+        if op == "count":
+            return cnt, seg.real, T.LongType()
+        if op == "avg":
+            data = total.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            return data, seg.real & (cnt > 0), T.DoubleType()
+        if col.dtype.integral:
+            return total, seg.real & (cnt > 0), T.LongType()
+        return total.astype(jnp.float64), seg.real & (cnt > 0), \
+            T.DoubleType()
+
+    if op in ("min", "max"):
+        if col.dtype.fractional:
+            x = normalize_floats(col.data)
+            # NaN largest: min ignores NaN unless all-NaN; max returns NaN
+            # if any NaN (Spark float ordering)
+            isnan = jnp.isnan(x)
+            base = jnp.where(contributes & ~isnan, x,
+                             jnp.full((), jnp.inf if op == "min" else -jnp.inf,
+                                      x.dtype))
+            ident = jnp.inf if op == "min" else -jnp.inf
+            fop = jnp.minimum if op == "min" else jnp.maximum
+            levels = _sparse_table(base, fop)
+            res = _range_query(levels, lo, hi, fop, ident)
+            hi1 = jnp.maximum(hi + 1, lo)
+            nan_x = (contributes & isnan).astype(jnp.int64)
+            pn = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(nan_x)])
+            nan_cnt = pn[jnp.clip(hi1, 0, cap)] - pn[jnp.clip(lo, 0, cap)]
+            cnt_x = contributes.astype(jnp.int64)
+            pc = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(cnt_x)])
+            cnt = pc[jnp.clip(hi1, 0, cap)] - pc[jnp.clip(lo, 0, cap)]
+            if op == "min":
+                data = jnp.where((cnt > 0) & (cnt == nan_cnt),
+                                 jnp.full((), jnp.nan, x.dtype), res)
+            else:
+                data = jnp.where(nan_cnt > 0, jnp.full((), jnp.nan, x.dtype),
+                                 res)
+            return data, seg.real & (cnt > 0), col.dtype
+        if col.is_string:
+            raise NotImplementedError("windowed min/max over strings")
+        d = col.data.astype(jnp.int64) if col.data.dtype == jnp.bool_ \
+            else col.data
+        info = jnp.iinfo(d.dtype)
+        ident = info.max if op == "min" else info.min
+        base = jnp.where(contributes, d, ident)
+        fop = jnp.minimum if op == "min" else jnp.maximum
+        levels = _sparse_table(base, fop)
+        res = _range_query(levels, lo, hi, fop, ident)
+        hi1 = jnp.maximum(hi + 1, lo)
+        cnt_x = contributes.astype(jnp.int64)
+        pc = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(cnt_x)])
+        cnt = pc[jnp.clip(hi1, 0, cap)] - pc[jnp.clip(lo, 0, cap)]
+        if col.data.dtype == jnp.bool_:
+            res = res.astype(jnp.bool_)
+        return res, seg.real & (cnt > 0), col.dtype
+
+    raise ValueError(f"window agg op {op}")
+
+
+# ---------------------------------------------------------------------------
+# ranking / offset functions
+# ---------------------------------------------------------------------------
+
+def row_number(seg: SegmentInfo) -> jax.Array:
+    idx = jnp.arange(seg.seg_start.shape[0], dtype=jnp.int32)
+    return idx - seg.seg_start + 1
+
+
+def rank(seg: SegmentInfo) -> jax.Array:
+    return seg.peer_start - seg.seg_start + 1
+
+
+def dense_rank(seg: SegmentInfo) -> jax.Array:
+    cap = seg.seg_start.shape[0]
+    changes = jnp.cumsum(seg.order_change.astype(jnp.int32))
+    return changes - changes[seg.seg_start] + 1
+
+
+def lead_lag(col: DeviceColumn, seg: SegmentInfo, offset: int,
+             default_data=None, default_valid=None):
+    """lead(offset>0) / lag(offset<0) within the partition."""
+    cap = col.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    src = idx + offset
+    in_seg = (src >= seg.seg_start) & (src <= seg.seg_end) & seg.real
+    srcc = jnp.clip(src, 0, cap - 1)
+    validity = jnp.where(in_seg, col.validity[srcc], False)
+    if col.is_string:
+        if default_data is not None:
+            raise NotImplementedError(
+                "non-null default for string lead/lag")
+        data = jnp.where(validity[:, None], col.data[srcc], 0)
+        lengths = jnp.where(validity, col.lengths[srcc], 0)
+        return data, validity, lengths
+    data = jnp.where(validity, col.data[srcc], jnp.zeros((), col.data.dtype))
+    if default_data is not None:
+        use_def = ~in_seg & seg.real & default_valid
+        data = jnp.where(use_def, default_data, data)
+        validity = validity | use_def
+    return data, validity, None

@@ -1,0 +1,135 @@
+"""Window function differential tests (reference WindowFunctionSuite +
+integration_tests window_function_test.py coverage)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec import LocalScanExec, collect_host
+from spark_rapids_tpu.exec.window import WindowExec
+from spark_rapids_tpu.expr.aggregates import Average, Count, CountStar, \
+    Max, Min, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import (CURRENT_ROW, UNBOUNDED, DenseRank,
+                                          Lag, Lead, Rank, RowNumber,
+                                          WindowExpression, WindowFrame,
+                                          WindowSpec)
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+SCHEMA = T.Schema([
+    T.StructField("g", T.IntegerType(), True),
+    T.StructField("o", T.IntegerType(), True),
+    T.StructField("v", T.LongType(), True),
+    T.StructField("f", T.DoubleType(), True),
+])
+
+
+def _scan(rng, n=200, ngroups=8):
+    return LocalScanExec.from_pydict({
+        "g": [None if rng.random() < 0.05 else int(x)
+              for x in rng.integers(0, ngroups, n)],
+        "o": [int(x) for x in rng.integers(0, 50, n)],
+        "v": [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(-100, 100, n)],
+        "f": [float("nan") if rng.random() < 0.05 else float(np.round(x, 3))
+              for x in rng.normal(size=n)],
+    }, SCHEMA, rows_per_batch=64)
+
+
+SPEC = WindowSpec(partition_by=(col("g"),), order_by=((col("o"), True),))
+
+
+def test_ranking_functions(rng):
+    plan = WindowExec([
+        WindowExpression(RowNumber(), SPEC).alias("rn"),
+        WindowExpression(Rank(), SPEC).alias("rk"),
+        WindowExpression(DenseRank(), SPEC).alias("dr"),
+    ], _scan(rng))
+    rows = assert_tpu_and_cpu_equal(plan)
+    assert rows
+
+
+def test_running_aggregates_default_frame(rng):
+    # default frame with order: RANGE unbounded preceding .. current row
+    plan = WindowExec([
+        WindowExpression(Sum(col("v")), SPEC).alias("rs"),
+        WindowExpression(Count(col("v")), SPEC).alias("rc"),
+        WindowExpression(CountStar(), SPEC).alias("rcs"),
+        WindowExpression(Average(col("v")), SPEC).alias("ra"),
+    ], _scan(rng))
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_whole_partition_aggregates(rng):
+    spec = WindowSpec(partition_by=(col("g"),))
+    plan = WindowExec([
+        WindowExpression(Sum(col("v")), spec).alias("ts"),
+        WindowExpression(Min(col("v")), spec).alias("tmin"),
+        WindowExpression(Max(col("f")), spec).alias("tmax"),
+    ], _scan(rng))
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_bounded_rows_frames(rng):
+    spec = WindowSpec(partition_by=(col("g"),),
+                      order_by=((col("o"), True),),
+                      frame=WindowFrame("rows", -2, 1))
+    plan = WindowExec([
+        WindowExpression(Sum(col("v")), spec).alias("ws"),
+        WindowExpression(Min(col("v")), spec).alias("wmin"),
+        WindowExpression(Max(col("v")), spec).alias("wmax"),
+        WindowExpression(Average(col("v")), spec).alias("wavg"),
+        WindowExpression(Max(col("f")), spec).alias("wfmax"),
+    ], _scan(rng))
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_lead_lag(rng):
+    plan = WindowExec([
+        WindowExpression(Lead(col("v"), 1), SPEC).alias("ld"),
+        WindowExpression(Lag(col("v"), 2), SPEC).alias("lg"),
+        WindowExpression(Lead(col("v"), 1, lit(-999)), SPEC).alias("ldd"),
+    ], _scan(rng))
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_desc_order_and_row_number(rng):
+    spec = WindowSpec(partition_by=(col("g"),),
+                      order_by=((col("o"), False),))
+    plan = WindowExec([
+        WindowExpression(RowNumber(), spec).alias("rn"),
+        WindowExpression(Sum(col("v")), spec).alias("rs"),
+    ], _scan(rng))
+    assert_tpu_and_cpu_equal(plan)
+
+
+def test_mixed_specs_rejected(rng):
+    other = WindowSpec(partition_by=(col("o"),))
+    with pytest.raises(ValueError):
+        WindowExec([
+            WindowExpression(RowNumber(), SPEC).alias("a"),
+            WindowExpression(RowNumber(), other).alias("b"),
+        ], _scan(rng))
+
+
+def test_empty_input(rng):
+    empty = LocalScanExec.from_pydict(
+        {"g": [], "o": [], "v": [], "f": []}, SCHEMA)
+    plan = WindowExec([
+        WindowExpression(RowNumber(), SPEC).alias("rn"),
+    ], empty)
+    assert assert_tpu_and_cpu_equal(plan) == []
+
+
+def test_bounded_following_only_frame(rng):
+    # ROWS BETWEEN 2 FOLLOWING AND 5 FOLLOWING: empty frames at partition
+    # tails must produce count 0 (regression: negative cross-partition diff)
+    spec = WindowSpec(partition_by=(col("g"),),
+                      order_by=((col("o"), True),),
+                      frame=WindowFrame("rows", 2, 5))
+    plan = WindowExec([
+        WindowExpression(CountStar(), spec).alias("c"),
+        WindowExpression(Count(col("v")), spec).alias("cv"),
+        WindowExpression(Sum(col("v")), spec).alias("s"),
+    ], _scan(rng, n=60, ngroups=4))
+    rows = assert_tpu_and_cpu_equal(plan)
+    assert all(r[4] >= 0 for r in rows)
