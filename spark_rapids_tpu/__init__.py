@@ -34,4 +34,13 @@ _jax.config.update("jax_enable_x64", True)
 
 from spark_rapids_tpu.version import __version__
 
-__all__ = ["__version__"]
+
+def __getattr__(name):
+    # lazy: session pulls in the exec/plan layers; keep bare import cheap
+    if name in ("TpuSession", "DataFrame"):
+        from spark_rapids_tpu import session as _s
+        return getattr(_s, name)
+    raise AttributeError(name)
+
+
+__all__ = ["__version__", "TpuSession", "DataFrame"]

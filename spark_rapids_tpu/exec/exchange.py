@@ -79,7 +79,7 @@ class ShuffleExchangeExec(PlanNode):
         return self.partitioning.num_partitions
 
     def _shuffled(self, ctx: ExecCtx):
-        key = ("shuffle", id(self))
+        key = ("shuffle", id(self), ctx.backend)
         if key in ctx.cache:
             return ctx.cache[key]
         child = self.children[0]
@@ -139,7 +139,7 @@ class BroadcastExchangeExec(PlanNode):
         return 1
 
     def materialize(self, ctx: ExecCtx):
-        key = ("broadcast", id(self))
+        key = ("broadcast", id(self), ctx.backend)
         if key in ctx.cache:
             return ctx.cache[key]
         child = self.children[0]

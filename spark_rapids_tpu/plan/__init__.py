@@ -1,0 +1,13 @@
+"""Planner layer: logical plan -> physical plan -> TpuOverrides.
+
+Reference L3 (SURVEY.md §2.1): GpuOverrides.scala plan rewriting +
+RapidsMeta tagging + GpuTransitionOverrides transition insertion.
+"""
+from spark_rapids_tpu.plan.logical import (Aggregate, Filter, Join, Limit,
+                                           LogicalPlan, Project, Repartition,
+                                           Scan, Sort, Union, Window)
+from spark_rapids_tpu.plan.overrides import PlannedNode, TpuOverrides
+
+__all__ = ["LogicalPlan", "Scan", "Project", "Filter", "Aggregate", "Join",
+           "Sort", "Limit", "Union", "Window", "Repartition",
+           "TpuOverrides", "PlannedNode"]

@@ -1,0 +1,155 @@
+"""Logical plan nodes (mini-Catalyst).
+
+The reference plugs into Spark Catalyst and never owns a logical plan;
+this standalone engine needs one as the DataFrame API's backing tree.
+Nodes are deliberately thin — resolution happens when the planner lowers
+them onto the dual-backend physical execs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import PlanNode
+from spark_rapids_tpu.expr.core import Expression
+
+__all__ = ["LogicalPlan", "Scan", "Project", "Filter", "Aggregate", "Join",
+           "Sort", "Limit", "Union", "Window", "Repartition"]
+
+
+class LogicalPlan:
+    children: tuple = ()
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+
+@dataclass
+class Scan(LogicalPlan):
+    """Leaf wrapping a physical source exec (file scan / local scan)."""
+    exec_node: PlanNode
+
+    @property
+    def children(self):
+        return ()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.exec_node.output_schema
+
+
+@dataclass
+class Project(LogicalPlan):
+    exprs: list
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class Filter(LogicalPlan):
+    condition: Expression
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+
+@dataclass
+class Aggregate(LogicalPlan):
+    group_exprs: list
+    agg_exprs: list
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str
+    left_on: list
+    right_on: list
+    condition: Expression | None = None
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass
+class Sort(LogicalPlan):
+    orders: list
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+
+@dataclass
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+
+@dataclass
+class Union(LogicalPlan):
+    inputs: list
+
+    @property
+    def children(self):
+        return tuple(self.inputs)
+
+    @property
+    def schema(self):
+        return self.inputs[0].schema
+
+
+@dataclass
+class Window(LogicalPlan):
+    window_exprs: list
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class Repartition(LogicalPlan):
+    num_partitions: int
+    keys: list  # empty = round robin
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.child.schema

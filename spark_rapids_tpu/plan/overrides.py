@@ -1,0 +1,258 @@
+"""Lowering + TpuOverrides: the plan-rewrite/tagging engine.
+
+Reference (SURVEY.md §2.1, §3.2): GpuOverrides wraps every plan node in
+a RapidsMeta, tags nodes that cannot run on the accelerator with
+reasons (RapidsMeta.willNotWorkOnGpu / tagForGpu,
+RapidsMeta.scala:189-216), converts the tagged tree, prints
+`spark.rapids.sql.explain`, and GpuTransitionOverrides inserts
+transitions.  Here:
+
+* `lower()` turns the logical plan into dual-backend physical execs
+  while recording, per node, the expressions it evaluates;
+* `TpuOverrides.apply()` tags each node — per-exec conf key
+  ``spark.rapids.sql.exec.<Name>``, per-expression key
+  ``spark.rapids.sql.expression.<Name>`` plus a device-capability
+  check — assigns device/host backends, inserts `BackendSwitchExec`
+  at boundaries, and renders the explain tree (``*`` = on TPU,
+  ``!`` = falls back, with reasons).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec import (CrossJoinExec, FilterExec,
+                                   GlobalLimitExec, HashAggregateExec,
+                                   HashPartitioning, JoinExec,
+                                   ProjectExec, RoundRobinPartitioning,
+                                   ShuffleExchangeExec, SortExec, UnionExec,
+                                   WindowExec)
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+from spark_rapids_tpu.expr.core import (Alias, Expression, col, output_name)
+from spark_rapids_tpu.expr.window import WindowExpression
+from spark_rapids_tpu.plan import logical as L
+
+__all__ = ["PlannedNode", "lower", "TpuOverrides"]
+
+
+@dataclass
+class PlannedNode:
+    """Physical exec + planning metadata (the RapidsMeta analog)."""
+    exec_node: PlanNode
+    exprs: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+    backend: str = "device"
+    reasons: list = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return type(self.exec_node).__name__
+
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
+    if isinstance(node, L.Scan):
+        return PlannedNode(node.exec_node)
+    if isinstance(node, L.Filter):
+        c = lower(node.child, conf)
+        ex = FilterExec(node.condition, c.exec_node)
+        return PlannedNode(ex, [node.condition], [c])
+    if isinstance(node, L.Project):
+        return _lower_project(node, conf)
+    if isinstance(node, L.Aggregate):
+        return _lower_aggregate(node, conf)
+    if isinstance(node, L.Join):
+        lc = lower(node.left, conf)
+        rc = lower(node.right, conf)
+        if node.how == "cross":
+            ex = CrossJoinExec(lc.exec_node, rc.exec_node, node.condition)
+        else:
+            ex = JoinExec(lc.exec_node, rc.exec_node, node.left_on,
+                          node.right_on, node.how, node.condition)
+        exprs = list(node.left_on) + list(node.right_on)
+        if node.condition is not None:
+            exprs.append(node.condition)
+        return PlannedNode(ex, exprs, [lc, rc])
+    if isinstance(node, L.Sort):
+        c = lower(node.child, conf)
+        ex = SortExec(node.orders, c.exec_node, global_sort=True)
+        return PlannedNode(ex, [], [c])
+    if isinstance(node, L.Limit):
+        c = lower(node.child, conf)
+        return PlannedNode(GlobalLimitExec(node.n, c.exec_node), [], [c])
+    if isinstance(node, L.Union):
+        cs = [lower(i, conf) for i in node.inputs]
+        return PlannedNode(UnionExec([c.exec_node for c in cs]), [], cs)
+    if isinstance(node, L.Window):
+        c = lower(node.child, conf)
+        ex = WindowExec(node.window_exprs, c.exec_node)
+        return PlannedNode(ex, list(node.window_exprs), [c])
+    if isinstance(node, L.Repartition):
+        c = lower(node.child, conf)
+        if node.keys:
+            part = HashPartitioning(node.keys, node.num_partitions)
+        else:
+            part = RoundRobinPartitioning(node.num_partitions)
+        ex = ShuffleExchangeExec(part, c.exec_node)
+        return PlannedNode(ex, list(node.keys), [c])
+    raise TypeError(f"cannot lower {node!r}")
+
+
+def _split_window_exprs(exprs):
+    """Separate window expressions out of a projection list."""
+    plain, windows = [], []
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, WindowExpression):
+            name = output_name(e)
+            windows.append(inner.alias(name) if not isinstance(e, Alias)
+                           else e)
+            plain.append(col(name))
+        else:
+            plain.append(e)
+    return plain, windows
+
+
+def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
+    c = lower(node.child, conf)
+    plain, windows = _split_window_exprs(node.exprs)
+    if not windows:
+        ex = ProjectExec(node.exprs, c.exec_node)
+        return PlannedNode(ex, list(node.exprs), [c])
+    # one WindowExec per distinct spec (Spark's planner does the same),
+    # then the final projection over the appended columns
+    by_spec: dict = {}
+    for w in windows:
+        inner = w.children[0] if isinstance(w, Alias) else w
+        by_spec.setdefault(inner.spec, []).append(w)
+    cur = c
+    for spec_windows in by_spec.values():
+        ex = WindowExec(spec_windows, cur.exec_node)
+        cur = PlannedNode(ex, list(spec_windows), [cur])
+    ex = ProjectExec(plain, cur.exec_node)
+    return PlannedNode(ex, list(plain), [cur])
+
+
+def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
+    c = lower(node.child, conf)
+    nparts = c.exec_node.num_partitions(ExecCtx(backend="host"))
+    if node.group_exprs and nparts > 1:
+        partial = HashAggregateExec(node.group_exprs, node.agg_exprs,
+                                    c.exec_node, mode="partial")
+        pmeta = PlannedNode(partial, list(node.agg_exprs), [c])
+        group_cols = [col(n) for n in partial._group_names]
+        shuffle = ShuffleExchangeExec(
+            HashPartitioning(group_cols, conf.shuffle_partitions), partial)
+        smeta = PlannedNode(shuffle, group_cols, [pmeta])
+        final = HashAggregateExec.final_from_partial(partial, shuffle)
+        return PlannedNode(final, list(node.agg_exprs), [smeta])
+    ex = HashAggregateExec(node.group_exprs, node.agg_exprs, c.exec_node,
+                           mode="complete")
+    return PlannedNode(ex, list(node.agg_exprs), [c])
+
+
+# ---------------------------------------------------------------------------
+# tagging + conversion
+# ---------------------------------------------------------------------------
+
+def _expr_classes(e: Expression):
+    yield e
+    for ch in e.children:
+        yield from _expr_classes(ch)
+
+
+class TpuOverrides:
+    """Tag the planned tree and realize backends + transitions."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+
+    def apply(self, root: PlannedNode) -> PlanNode:
+        self._tag(root)
+        self._insert_transitions(root)
+        explain_mode = self.conf.explain
+        if explain_mode and explain_mode != "NONE":
+            text = self.explain(root, only_fallback=(explain_mode
+                                                     == "NOT_ON_TPU"))
+            if text:
+                print(text)
+        if root.backend == "device":
+            return root.exec_node
+        return root.exec_node
+
+    def root_backend(self, root: PlannedNode) -> str:
+        return root.backend
+
+    # -- tagging -------------------------------------------------------
+    def _tag(self, meta: PlannedNode) -> None:
+        for ch in meta.children:
+            self._tag(ch)
+        conf = self.conf
+        if not conf.sql_enabled:
+            meta.will_not_work("spark.rapids.sql.enabled is false")
+        key = f"spark.rapids.sql.exec.{meta.name}"
+        if not conf.is_op_enabled(key):
+            meta.will_not_work(f"{key} is disabled")
+        for e in meta.exprs:
+            if not isinstance(e, Expression):
+                continue
+            for sub in _expr_classes(e):
+                cname = type(sub).__name__
+                ekey = f"spark.rapids.sql.expression.{cname}"
+                if not conf.is_op_enabled(ekey):
+                    meta.will_not_work(f"{ekey} is disabled")
+                if getattr(sub, "device_supported", True) is False:
+                    meta.will_not_work(
+                        f"expression {cname} has no device kernel")
+        self._tag_special(meta)
+        meta.backend = "host" if meta.reasons else "device"
+
+    def _tag_special(self, meta: PlannedNode) -> None:
+        ex = meta.exec_node
+        if isinstance(ex, WindowExec):
+            from spark_rapids_tpu.expr import aggregates as A
+            for w, dt in zip(ex._wexprs, ex._out_dtypes):
+                f = w.function
+                if isinstance(f, (A.Min, A.Max)) and isinstance(
+                        dt, T.StringType):
+                    meta.will_not_work(
+                        "windowed min/max over strings has no device kernel")
+
+    # -- transitions ---------------------------------------------------
+    def _insert_transitions(self, meta: PlannedNode) -> None:
+        for ch in meta.children:
+            self._insert_transitions(ch)
+        new_children = []
+        for ch in meta.children:
+            if ch.backend != meta.backend:
+                new_children.append(BackendSwitchExec(ch.exec_node,
+                                                      ch.backend))
+            else:
+                new_children.append(ch.exec_node)
+        if meta.children:
+            kids = list(meta.exec_node.children)
+            if len(kids) == len(new_children):
+                meta.exec_node.children = tuple(new_children)
+
+    # -- explain -------------------------------------------------------
+    def explain(self, meta: PlannedNode, only_fallback: bool = False,
+                indent: int = 0) -> str:
+        marker = "*" if meta.backend == "device" else "!"
+        line = "  " * indent + f"{marker} {meta.exec_node.node_desc()}"
+        if meta.reasons:
+            line += "  <-- " + "; ".join(meta.reasons)
+        lines = [] if (only_fallback and not meta.reasons) else [line]
+        for ch in meta.children:
+            sub = self.explain(ch, only_fallback, indent + 1)
+            if sub:
+                lines.append(sub)
+        return "\n".join(lines)

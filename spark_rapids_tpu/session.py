@@ -1,0 +1,217 @@
+"""TpuSession + DataFrame: the user-facing query API.
+
+The reference has no API of its own — it transparently accelerates
+Spark SQL (`spark.plugins=com.nvidia.spark.SQLPlugin`,
+SQLPlugin.scala:26-31).  Standalone, this engine exposes a PySpark-like
+DataFrame API whose plans run through the same rewrite pipeline: build
+logical plan -> lower to dual-backend execs -> TpuOverrides tagging
+(per-op conf keys, fallback reasons, explain) -> transitions -> execute
+on the TPU with the CPU engine as automatic fallback per node.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, collect_device, \
+    collect_host
+from spark_rapids_tpu.expr.core import Expression, col, lit, output_name
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import PlannedNode, TpuOverrides, lower
+
+__all__ = ["TpuSession", "DataFrame"]
+
+
+class TpuSession:
+    """Session: conf + data sources (reference: SparkSession + the
+    plugin's RapidsConf snapshot, Plugin.scala:116)."""
+
+    def __init__(self, conf: dict | TpuConf | None = None):
+        self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf or {})
+
+    # -- sources -------------------------------------------------------
+    def read_parquet(self, path, columns=None, **kw) -> "DataFrame":
+        from spark_rapids_tpu.io import ParquetScanExec
+        return DataFrame(self, L.Scan(ParquetScanExec(path, columns=columns,
+                                                      **kw)))
+
+    def read_orc(self, path, columns=None, **kw) -> "DataFrame":
+        from spark_rapids_tpu.io import OrcScanExec
+        return DataFrame(self, L.Scan(OrcScanExec(path, columns=columns,
+                                                  **kw)))
+
+    def read_csv(self, path, schema: T.Schema | None = None,
+                 **kw) -> "DataFrame":
+        from spark_rapids_tpu.io import CsvScanExec
+        return DataFrame(self, L.Scan(CsvScanExec(path, schema=schema, **kw)))
+
+    def from_pydict(self, data: dict, schema: T.Schema,
+                    partitions: int = 1,
+                    rows_per_batch: int | None = None) -> "DataFrame":
+        from spark_rapids_tpu.exec import LocalScanExec
+        return DataFrame(self, L.Scan(LocalScanExec.from_pydict(
+            data, schema, partitions, rows_per_batch)))
+
+    def from_arrow(self, table) -> "DataFrame":
+        from spark_rapids_tpu.exec import LocalScanExec
+        from spark_rapids_tpu.host.batch import HostBatch
+        import pyarrow as pa
+        if isinstance(table, pa.Table):
+            batches = [HostBatch.from_arrow(rb)
+                       for rb in table.to_batches()]
+        else:
+            batches = [HostBatch.from_arrow(table)]
+        schema = T.Schema.from_arrow(
+            table.schema if hasattr(table, "schema") else table.schema)
+        return DataFrame(self, L.Scan(LocalScanExec(batches, schema)))
+
+    def range(self, start: int, end: int | None = None, step: int = 1,
+              partitions: int = 1) -> "DataFrame":
+        from spark_rapids_tpu.exec import RangeExec
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.Scan(RangeExec(start, end, step,
+                                                partitions)))
+
+    def set(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        return self
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self._s = session
+        self._plan = plan
+
+    # -- schema --------------------------------------------------------
+    @property
+    def schema(self) -> T.Schema:
+        return self._planned().exec_node.output_schema
+
+    @property
+    def columns(self) -> list[str]:
+        return self.schema.names
+
+    # -- transformations ----------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        resolved = [self._col_or_expr(e) for e in exprs]
+        return DataFrame(self._s, L.Project(resolved, self._plan))
+
+    def where(self, condition: Expression) -> "DataFrame":
+        return DataFrame(self._s, L.Filter(condition, self._plan))
+
+    filter = where
+
+    def with_column(self, name: str, expr: Expression) -> "DataFrame":
+        existing = [col(n) for n in self._schema_names() if n != name]
+        return self.select(*existing, expr.alias(name))
+
+    def group_by(self, *keys) -> "GroupedData":
+        return GroupedData(self, [self._col_or_expr(k) for k in keys])
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition: Expression | None = None) -> "DataFrame":
+        left_on, right_on = [], []
+        if on is not None:
+            if isinstance(on, str):
+                on = [on]
+            for o in on:
+                if isinstance(o, str):
+                    left_on.append(col(o))
+                    right_on.append(col(o))
+                else:
+                    l, r = o
+                    left_on.append(col(l) if isinstance(l, str) else l)
+                    right_on.append(col(r) if isinstance(r, str) else r)
+        if how == "cross" or not left_on:
+            return DataFrame(self._s, L.Join(
+                self._plan, other._plan, "cross", [], [], condition))
+        return DataFrame(self._s, L.Join(self._plan, other._plan, how,
+                                         left_on, right_on, condition))
+
+    def order_by(self, *orders) -> "DataFrame":
+        return DataFrame(self._s, L.Sort(list(orders), self._plan))
+
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._s, L.Limit(n, self._plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self._s, L.Union([self._plan, other._plan]))
+
+    def repartition(self, num_partitions: int, *keys) -> "DataFrame":
+        return DataFrame(self._s, L.Repartition(
+            num_partitions, [self._col_or_expr(k) for k in keys],
+            self._plan))
+
+    # -- actions -------------------------------------------------------
+    def collect(self) -> list[tuple]:
+        ov, meta = self._overridden()
+        if meta.backend == "device":
+            return collect_device(meta.exec_node, self._s.conf)
+        return collect_host(meta.exec_node, self._s.conf)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        ov, meta = self._overridden()
+        backend = meta.backend
+        ctx = ExecCtx(backend=backend, conf=self._s.conf)
+        from spark_rapids_tpu.exec.core import device_to_host
+        rbs = []
+        for b in meta.exec_node.execute(ctx):
+            hb = device_to_host(b) if backend == "device" else b
+            rbs.append(hb.to_arrow())
+        if not rbs:
+            return pa.table([], schema=self.schema.to_arrow())
+        return pa.Table.from_batches(rbs)
+
+    def count(self) -> int:
+        from spark_rapids_tpu.expr.aggregates import CountStar
+        rows = self.agg(CountStar().alias("count")).collect()
+        return rows[0][0]
+
+    def explain(self) -> str:
+        ov, meta = self._overridden(quiet=True)
+        return ov.explain(meta)
+
+    def write_parquet(self, path: str, **kw) -> None:
+        from spark_rapids_tpu.io import write_parquet
+        ov, meta = self._overridden()
+        ctx = ExecCtx(backend=meta.backend, conf=self._s.conf)
+        write_parquet(meta.exec_node, path, ctx=ctx, **kw)
+
+    # -- internals -----------------------------------------------------
+    def _schema_names(self) -> list[str]:
+        return self.schema.names
+
+    def _col_or_expr(self, e):
+        return col(e) if isinstance(e, str) else e
+
+    def _planned(self) -> PlannedNode:
+        return lower(self._plan, self._s.conf)
+
+    def _overridden(self, quiet: bool = False):
+        meta = self._planned()
+        ov = TpuOverrides(self._s.conf)
+        if quiet:
+            ov._tag(meta)
+            ov._insert_transitions(meta)
+        else:
+            ov.apply(meta)
+        return ov, meta
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: list):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        exprs = list(self._keys) + list(aggs)
+        return DataFrame(self._df._s, L.Aggregate(
+            list(self._keys), exprs, self._df._plan))

@@ -1,0 +1,155 @@
+"""Session/DataFrame API + planner tests: lowering, overrides tagging,
+fallback transitions, explain (reference GpuOverrides/RapidsMeta
+behavior, SURVEY.md §3.2)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import Average, CountStar, Max, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import (RowNumber, WindowExpression,
+                                          WindowSpec)
+from spark_rapids_tpu.testing import _sort_key
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType(), True),
+    T.StructField("v", T.LongType(), True),
+    T.StructField("s", T.StringType(), True),
+])
+
+
+def _df(s, rng, n=200, parts=3):
+    return s.from_pydict({
+        "k": [None if rng.random() < 0.05 else int(x)
+              for x in rng.integers(0, 20, n)],
+        "v": [int(x) for x in rng.integers(-100, 100, n)],
+        "s": [f"s{x}" if x % 5 else None for x in rng.integers(0, 30, n)],
+    }, SCHEMA, partitions=parts, rows_per_batch=64)
+
+
+def test_select_filter_collect(rng):
+    s = TpuSession()
+    df = _df(s, rng)
+    rows = df.where(col("v") > lit(0)) \
+             .select(col("k"), (col("v") * lit(2)).alias("v2")) \
+             .collect()
+    assert rows and all(r[1] > 0 and r[1] % 2 == 0 for r in rows)
+
+
+def test_group_by_agg_multi_partition(rng):
+    s = TpuSession({"spark.rapids.sql.shuffle.partitions": 4})
+    df = _df(s, rng)
+    rows = df.group_by("k").agg(Sum(col("v")).alias("sv"),
+                                CountStar().alias("c"),
+                                Average(col("v")).alias("a")).collect()
+    # oracle via pure python
+    raw = _df(s, rng2 := np.random.default_rng(42), n=200).collect()
+    # recompute from the same generated data
+    import collections
+    acc = collections.defaultdict(lambda: [0, 0])
+    for k, v, _s in raw:
+        acc[k][0] += v
+        acc[k][1] += 1
+    want = sorted(((k, a[0], a[1], a[0] / a[1]) for k, a in acc.items()),
+                  key=_sort_key)
+    got = sorted(rows, key=_sort_key)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1] and g[2] == w[2]
+        assert abs(g[3] - w[3]) < 1e-9
+
+
+def test_join_api(rng):
+    s = TpuSession()
+    a = _df(s, rng, n=100)
+    b = s.from_pydict({"k2": [1, 2, 3], "name": ["a", "b", "c"]},
+                      T.Schema([T.StructField("k2", T.IntegerType(), True),
+                                T.StructField("name", T.StringType(), True)]))
+    rows = a.join(b, on=[("k", "k2")], how="inner").collect()
+    assert all(r[0] == r[3] for r in rows)
+
+
+def test_sort_limit(rng):
+    s = TpuSession()
+    df = _df(s, rng)
+    rows = df.order_by(("v", False)).limit(5).collect()
+    assert len(rows) == 5
+    vs = [r[1] for r in rows]
+    assert vs == sorted(vs, reverse=True)
+
+
+def test_window_in_select(rng):
+    s = TpuSession()
+    df = _df(s, rng, n=60)
+    spec = WindowSpec((col("k"),), ((col("v"), True),))
+    rows = df.select(col("k"), col("v"),
+                     WindowExpression(RowNumber(), spec).alias("rn")
+                     ).collect()
+    # row numbers within each k start at 1
+    by_k = {}
+    for k, v, rn in rows:
+        by_k.setdefault(k, []).append(rn)
+    for k, rns in by_k.items():
+        assert sorted(rns) == list(range(1, len(rns) + 1))
+
+
+def test_explain_and_fallback(rng):
+    s = TpuSession({"spark.rapids.sql.exec.FilterExec": "false"})
+    df = _df(s, rng).where(col("v") > lit(0)).select(col("k"))
+    text = df.explain()
+    assert "! FilterExec" in text
+    assert "spark.rapids.sql.exec.FilterExec is disabled" in text
+    assert "* ProjectExec" in text
+    # result still correct through the host fallback + transition
+    rows = df.collect()
+    all_rows = _df(s, np.random.default_rng(42)).collect()
+    assert len(rows) == sum(1 for r in all_rows if r[1] > 0)
+
+
+def test_expression_fallback_key(rng):
+    s = TpuSession({"spark.rapids.sql.expression.GreaterThan": "false"})
+    df = _df(s, rng).where(col("v") > lit(0))
+    assert "! FilterExec" in df.explain()
+    assert df.count() > 0
+
+
+def test_sql_disabled_runs_host(rng):
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    df = _df(s, rng).select(col("k"))
+    text = df.explain()
+    assert "*" not in text.split()[0]
+    assert df.count() == 200
+
+
+def test_with_column_union_repartition(rng):
+    s = TpuSession()
+    df = _df(s, rng, n=50)
+    d2 = df.with_column("w", col("v") + lit(1))
+    assert d2.columns == ["k", "v", "s", "w"]
+    u = d2.union(d2)
+    assert u.count() == 100
+    r = d2.repartition(4, "k")
+    assert sorted(r.collect(), key=_sort_key) == \
+        sorted(d2.collect(), key=_sort_key)
+
+
+def test_to_arrow_roundtrip(rng):
+    s = TpuSession()
+    df = _df(s, rng, n=30)
+    tbl = df.to_arrow()
+    assert tbl.num_rows == 30
+    df2 = s.from_arrow(tbl)
+    assert sorted(df2.collect(), key=_sort_key) == \
+        sorted(df.collect(), key=_sort_key)
+
+
+def test_write_parquet_via_session(rng, tmp_path):
+    s = TpuSession()
+    df = _df(s, rng, n=40)
+    out = str(tmp_path / "out")
+    df.write_parquet(out)
+    back = s.read_parquet(out)
+    assert sorted(back.collect(), key=_sort_key) == \
+        sorted(df.collect(), key=_sort_key)
