@@ -209,6 +209,14 @@ SHUFFLE_PARTITIONS = int_conf(
     "Number of shuffle partitions for exchanges (Spark's own knob; honored "
     "here for parity).")
 
+MESH_DEVICE_COUNT = int_conf(
+    "spark.rapids.tpu.mesh.deviceCount", 0,
+    "Devices in the 1-D mesh used for collective shuffle/aggregation. "
+    "When > 1, grouped aggregations and hash repartitions lower to "
+    "shard_map all-to-all programs over the mesh (the ICI data plane, "
+    "SURVEY.md §5.8) instead of the in-process exchange. 0 disables. "
+    "(ref: the UCX transport enable, RapidsConf.scala:652)")
+
 UDF_COMPILER_ENABLED = bool_conf(
     "spark.rapids.sql.udfCompiler.enabled", False,
     "Compile Python UDF bytecode to native expressions when possible. "
@@ -268,6 +276,9 @@ class TpuConf:
 
     @property
     def is_udf_compiler_enabled(self) -> bool: return self.get(UDF_COMPILER_ENABLED)
+
+    @property
+    def mesh_device_count(self) -> int: return self.get(MESH_DEVICE_COUNT)
 
     def is_op_enabled(self, op_conf_key: str, default: bool = True) -> bool:
         v = self.settings.get(op_conf_key)

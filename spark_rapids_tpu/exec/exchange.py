@@ -12,8 +12,9 @@ ShuffleBufferCatalog; reference RapidsCachingWriter stores partition
 tables in the spillable device store).  Subsequent partition pulls serve
 from the cache.  On the device backend the id+split computation is one
 jitted program per batch — the local, single-process analog of the mesh
-all-to-all path in parallel/mesh_shuffle.py, which the session planner
-picks when a multi-device mesh is active.
+all-to-all path (exec/mesh_exec.py, which the planner selects instead of
+this exec when ``spark.rapids.tpu.mesh.deviceCount`` > 1 and the shape
+matches; see plan/overrides.py lower()).
 """
 from __future__ import annotations
 

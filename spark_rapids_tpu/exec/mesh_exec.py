@@ -1,0 +1,295 @@
+"""Mesh-distributed execs: shuffle + aggregation under ``shard_map``.
+
+This is the engine-level wiring of the ICI all-to-all data plane
+(:mod:`spark_rapids_tpu.parallel.mesh_shuffle`): when the session conf
+sets ``spark.rapids.tpu.mesh.deviceCount`` > 1, the planner lowers a
+grouped aggregation to :class:`MeshAggregateExec` (one compiled
+partial -> all-to-all -> final-merge program per device) and a hash
+repartition to :class:`MeshExchangeExec`, instead of the in-process
+stage-barrier loop in :mod:`spark_rapids_tpu.exec.exchange`.
+
+Reference mapping (SURVEY.md §2.6, §3.4): the reference reaches its
+accelerated shuffle through RapidsShuffleInternalManager.getWriter/
+getReaderInternal (RapidsShuffleInternalManager.scala:285-345) with a
+UCX peer-to-peer data plane; the TPU-native plane is one XLA
+``all_to_all`` collective inside ``shard_map``, fused with the partial
+and final aggregations so the compiler overlaps the collective with
+compute.  Expression layout (pre-projection, update/merge specs, final
+projection) is shared with :class:`HashAggregateExec` — the same
+aggregation-buffer contract the reference's partial/final modes use
+(aggregate.scala:77-169).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.exec.aggregate import HashAggregateExec, _relabel_d
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.expr.core import Expression, bind, eval_device
+from spark_rapids_tpu.ops import kernels as dk
+from spark_rapids_tpu.ops.segmented import sorted_group_by
+from spark_rapids_tpu.parallel.mesh import (local_view, make_mesh, restack,
+                                            shard_batches, unshard_batch)
+from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
+                                                    exchange_local,
+                                                    partition_ids_for_keys)
+
+__all__ = ["MeshAggregateExec", "MeshExchangeExec", "mesh_for"]
+
+
+def mesh_for(ctx: ExecCtx, size: int, axis_name: str = "data"):
+    """The ctx-cached 1-D device mesh, or None if < size devices exist."""
+    key = ("mesh", size, axis_name)
+    if key not in ctx.cache:
+        devs = jax.devices()
+        ctx.cache[key] = (make_mesh(size, axis_name, devs[:size])
+                          if len(devs) >= size else None)
+    return ctx.cache[key]
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _jit_shard_slice(big: ColumnBatch, start, cap: int) -> ColumnBatch:
+    idx = jnp.clip(start + jnp.arange(cap, dtype=jnp.int32), 0,
+                   big.capacity - 1)
+    count = jnp.clip(big.num_rows - start, 0, cap)
+    return dk.take(big, idx, count)
+
+
+def pack_shards(batches: Sequence[ColumnBatch], p: int):
+    """Concat child batches, then slice into p equal-capacity shards.
+
+    One concat source guarantees uniform capacities and string widths
+    across shards, which stacking onto the mesh requires.  Row order is
+    preserved but the row->shard assignment is arbitrary — callers
+    shuffle by key immediately after (the reference's map-side split
+    has the same freedom).
+    """
+    big = batches[0] if len(batches) == 1 else dk.concat_batches(batches)
+    cap = round_capacity(max(-(-big.capacity // p), 8))
+    return [_jit_shard_slice(big, jnp.asarray(i * cap, jnp.int32), cap)
+            for i in range(p)]
+
+
+class MeshAggregateExec(PlanNode):
+    """Grouped aggregation as ONE distributed program over the mesh.
+
+    Device plan per shard: pre-project -> partial sorted group-by ->
+    all-to-all exchange of buffer rows by key hash -> merge group-by ->
+    final projection.  Falls back to a complete-mode
+    :class:`HashAggregateExec` on the host backend, when fewer devices
+    than ``mesh_size`` exist, or on empty input.
+    """
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 result_exprs: Sequence[Expression], child: PlanNode,
+                 mesh_size: int, axis_name: str = "data"):
+        super().__init__([child])
+        self.mesh_size = mesh_size
+        self.axis_name = axis_name
+        self._group_exprs = list(group_exprs)
+        self._result_exprs = list(result_exprs)
+        # expression layout (pre/update/merge/final) — HashAggregateExec
+        # owns this contract; partial mode exposes the buffer schema.
+        self._layout = HashAggregateExec(group_exprs, result_exprs, child,
+                                         mode="partial")
+        self._output_schema = T.Schema(
+            [T.StructField(f.name, f.data_type, True)
+             for f in HashAggregateExec.final_from_partial(
+                 self._layout, child).output_schema])
+        self._jitted = {}
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.mesh_size if ctx.is_device else 1
+
+    # -- fallback ------------------------------------------------------
+    def _complete_exec(self) -> HashAggregateExec:
+        # built lazily so transition-inserted wrappers around the child
+        # (same schema) are picked up
+        return HashAggregateExec(self._group_exprs, self._result_exprs,
+                                 self.children[0], mode="complete")
+
+    # -- distributed program -------------------------------------------
+    def _program(self, mesh):
+        key = id(mesh)
+        if key in self._jitted:
+            return self._jitted[key]
+        from jax.sharding import PartitionSpec as P
+        L = self._layout
+        key_idx = list(range(len(L._group_bound)))
+        p = self.mesh_size
+        axis = self.axis_name
+
+        def step(stacked: ColumnBatch) -> ColumnBatch:
+            b = local_view(stacked)
+            cols = [eval_device(e, b) for e in L._pre_exprs]
+            pre = ColumnBatch(cols, b.num_rows, L._pre_schema)
+            part_out = _relabel_d(
+                sorted_group_by(pre, key_idx, L._update_specs),
+                L._buffer_schema)
+            if key_idx:
+                pid = partition_ids_for_keys(part_out, key_idx, p)
+            else:
+                # grand aggregate: merge all partial rows on device 0
+                pid = jnp.where(part_out.row_mask(), 0, p)
+            ex = _relabel_d(exchange_local(part_out, pid, p, axis),
+                            L._buffer_schema)
+            merged = _relabel_d(
+                sorted_group_by(ex, key_idx, L._merge_specs),
+                L._buffer_schema)
+            out_cols = [eval_device(e, merged) for e in L._final_exprs]
+            out = ColumnBatch(out_cols, merged.num_rows,
+                              self._output_schema)
+            if not key_idx:
+                on0 = jax.lax.axis_index(axis) == 0
+                out = canonicalize(ColumnBatch(
+                    out.columns, jnp.where(on0, out.num_rows, 0),
+                    out.schema))
+            return restack(out)
+
+        fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P(axis),
+                                   out_specs=P(axis)))
+        self._jitted[key] = fn
+        return fn
+
+    def _outputs(self, ctx: ExecCtx):
+        key = ("meshagg", id(self), ctx.backend)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        child = self.children[0]
+        batches = [b for pid in range(child.num_partitions(ctx))
+                   for b in child.partition_iter(ctx, pid)]
+        mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
+        if mesh is None or not batches:
+            out = [list(self._complete_exec().partition_iter(ctx, 0))]
+            out += [[] for _ in range(self.mesh_size - 1)]
+        else:
+            shards = pack_shards(batches, self.mesh_size)
+            stacked = shard_batches(shards, mesh, self.axis_name)
+            result = self._program(mesh)(stacked)
+            out = [[b] for b in unshard_batch(result)]
+        ctx.cache[key] = out
+        return out
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        if not ctx.is_device:
+            yield from self._complete_exec().partition_iter(ctx, pid)
+            return
+        yield from self._outputs(ctx)[pid]
+
+    def node_desc(self) -> str:
+        return (f"MeshAggregateExec[mesh={self.mesh_size}, "
+                f"keys={self._layout._group_names}, "
+                f"out={self._output_schema.names}]")
+
+
+class MeshExchangeExec(PlanNode):
+    """Hash repartition as an all-to-all collective over the mesh.
+
+    Device path: pack child output into per-device shards, then ONE
+    compiled program computes Spark-bit-exact murmur3 partition ids and
+    exchanges rows (reference write path GpuHashPartitioning +
+    RapidsCachingWriter, read path RapidsShuffleIterator — here both
+    sides are the same collective).  Host backend delegates to the
+    in-process ShuffleExchangeExec.
+    """
+
+    def __init__(self, keys: Sequence[Expression], child: PlanNode,
+                 mesh_size: int, axis_name: str = "data"):
+        super().__init__([child])
+        self.mesh_size = mesh_size
+        self.axis_name = axis_name
+        self._keys = list(keys)
+        self._bound = [bind(k, child.output_schema) for k in self._keys]
+        self._jitted = {}
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.mesh_size
+
+    def _host_exchange(self):
+        from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+        from spark_rapids_tpu.exec.partitioning import HashPartitioning
+        return ShuffleExchangeExec(
+            HashPartitioning(self._keys, self.mesh_size), self.children[0])
+
+    def _program(self, mesh):
+        key = id(mesh)
+        if key in self._jitted:
+            return self._jitted[key]
+        from jax.sharding import PartitionSpec as P
+        p = self.mesh_size
+        axis = self.axis_name
+        bound = self._bound
+        schema = self.output_schema
+
+        def step(stacked: ColumnBatch) -> ColumnBatch:
+            b = local_view(stacked)
+            cols = list(b.columns)
+            fields = list(schema.fields)
+            kidx = []
+            for i, k in enumerate(bound):
+                cols.append(eval_device(k, b))
+                fields.append(T.StructField(f"_pk{i}", k.dtype, True))
+                kidx.append(len(cols) - 1)
+            aug = ColumnBatch(cols, b.num_rows, T.Schema(fields))
+            pid = partition_ids_for_keys(aug, kidx, p)
+            return restack(exchange_local(b, pid, p, axis))
+
+        fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P(axis),
+                                   out_specs=P(axis)))
+        self._jitted[key] = fn
+        return fn
+
+    def _outputs(self, ctx: ExecCtx):
+        key = ("meshex", id(self), ctx.backend)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        child = self.children[0]
+        if not ctx.is_device:
+            he = self._host_exchange()
+            out = [list(he.partition_iter(ctx, pid))
+                   for pid in range(self.mesh_size)]
+            ctx.cache[key] = out
+            return out
+        batches = [b for pid in range(child.num_partitions(ctx))
+                   for b in child.partition_iter(ctx, pid)]
+        mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
+        if mesh is None or not batches:
+            he = self._host_exchange()
+            out = [list(he.partition_iter(ctx, pid))
+                   for pid in range(self.mesh_size)]
+        else:
+            shards = pack_shards(batches, self.mesh_size)
+            stacked = shard_batches(shards, mesh, self.axis_name)
+            result = self._program(mesh)(stacked)
+            out = [[b] for b in unshard_batch(result)]
+        ctx.cache[key] = out
+        return out
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from self._outputs(ctx)[pid]
+
+    def node_desc(self) -> str:
+        return (f"MeshExchangeExec[mesh={self.mesh_size}, "
+                f"keys={[output_name_safe(k) for k in self._keys]}]")
+
+
+def output_name_safe(e: Expression) -> str:
+    from spark_rapids_tpu.expr.core import output_name
+    try:
+        return output_name(e)
+    except Exception:  # noqa: BLE001 - descriptive label only
+        return repr(e)

@@ -98,6 +98,12 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         return PlannedNode(ex, list(node.window_exprs), [c])
     if isinstance(node, L.Repartition):
         c = lower(node.child, conf)
+        if node.keys and conf.mesh_device_count > 1 \
+                and node.num_partitions == conf.mesh_device_count:
+            from spark_rapids_tpu.exec.mesh_exec import MeshExchangeExec
+            ex = MeshExchangeExec(node.keys, c.exec_node,
+                                  conf.mesh_device_count)
+            return PlannedNode(ex, list(node.keys), [c])
         if node.keys:
             part = HashPartitioning(node.keys, node.num_partitions)
         else:
@@ -144,6 +150,11 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
 
 def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
+    if node.group_exprs and conf.mesh_device_count > 1:
+        from spark_rapids_tpu.exec.mesh_exec import MeshAggregateExec
+        ex = MeshAggregateExec(node.group_exprs, node.agg_exprs, c.exec_node,
+                               conf.mesh_device_count)
+        return PlannedNode(ex, list(node.agg_exprs), [c])
     nparts = c.exec_node.num_partitions(ExecCtx(backend="host"))
     if node.group_exprs and nparts > 1:
         partial = HashAggregateExec(node.group_exprs, node.agg_exprs,
