@@ -1,21 +1,23 @@
-"""Benchmark: TPC-DS-q6-shaped columnar step, device vs CPU oracle.
+"""Benchmark: TPC-DS q6 (BASELINE configs[0]) device vs CPU oracle.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Always prints that line, even on failure: ALL device work (backend init
-AND the timed runs) happens on a daemon worker thread under a deadline,
-so a tunnel hang at any point still yields a JSON line (the reference
-treats init failure as fail-fast, Plugin.scala:146-153). A small smoke
-size runs first; if only the smoke size completes, the line is labeled
-with the smoke row count — a smoke number is never reported under the
-full-size metric name.
+Runs a scale-factor ladder (SF0.01 smoke -> SF1 -> SF10) of TPC-DS q6
+through the real engine (parquet scan -> joins -> filter -> group-by ->
+having -> sort -> limit, spark_rapids_tpu.bench.runner), verifying each
+rung against the host oracle.  The emitted line is the LARGEST rung that
+completed, labeled with its scale factor — a smoke number is never
+reported under a bigger-SF metric name.
 
-The tracked north star (BASELINE.json) is >=4x speedup over CPU Spark on
-TPC-DS; this bench measures the framework's hot path (scan-resident
-filter -> group-by aggregate, SURVEY.md §3.3) on the device vs the
-single-threaded CPU oracle engine on identical data, so
-vs_baseline = speedup / 4.0. (Oracle is NOT CPU Spark — interim proxy.)
+Robustness (round-1 failure mode: tunnel hang): ALL device work runs on
+a daemon worker thread under init/total deadlines, so a JSON line is
+always printed (the reference treats init failure as fail-fast,
+Plugin.scala:146-153).
+
+vs_baseline = speedup / 4.0 against BASELINE.json's >=4x-vs-CPU-Spark
+target.  The oracle is this repo's single-threaded numpy engine, NOT
+CPU Spark — an interim proxy, stated in the metric name.
 """
 from __future__ import annotations
 
@@ -26,71 +28,29 @@ import threading
 import time
 import traceback
 
-import numpy as np
-
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
-TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "600"))
-SMOKE_ROWS = 1 << 16
-FULL_ROWS = 1 << 20
+TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "540"))
+MAX_SF = float(os.environ.get("BENCH_SF", "10"))
+DATA_DIR = os.environ.get("BENCH_DATA_DIR",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".bench_data"))
+LADDER = [sf for sf in (0.01, 1.0, 10.0) if sf <= MAX_SF] or [0.01]
 
 
-def _metric_name(rows: int) -> str:
-    tag = "1M" if rows == FULL_ROWS else f"{rows // 1024}k"
-    return f"q6like_filter_groupby_speedup_vs_cpu_oracle_{tag}_rows"
-
-
-def _emit(value: float, rows: int, error: str | None = None):
+def _emit(value: float, sf: float, error: str | None = None,
+          extra: dict | None = None):
     rec = {
-        "metric": _metric_name(rows),
+        "metric": f"tpcds_q6_sf{sf:g}_speedup_vs_cpu_oracle",
         "value": round(float(value), 3),
         "unit": "x",
         "vs_baseline": round(float(value) / 4.0, 3),
     }
+    if extra:
+        rec.update(extra)
     if error:
-        rec["error"] = error[:500]
+        rec["error"] = str(error)[:500]
     print(json.dumps(rec))
     sys.stdout.flush()
-
-
-def _run_size(n: int) -> float:
-    """Run the q6-shaped step at n rows; return device-vs-oracle speedup."""
-    import jax
-    from __graft_entry__ import SCHEMA, _SPECS, _make_host_batch, \
-        _q6_condition, query_step
-    from spark_rapids_tpu.expr.core import bind, eval_host
-    from spark_rapids_tpu.ops.host_kernels import host_filter, host_group_by
-
-    # host data first, uploaded once; never device_get the device inputs —
-    # under the axon tunnel a fetched array degrades later executions to a
-    # re-upload per call.
-    hb = _make_host_batch(n, seed=3)
-    batch = hb.to_device(capacity=n)
-
-    step = jax.jit(query_step)
-    out = step(batch)
-    jax.block_until_ready(jax.tree_util.tree_leaves(out))  # compile+warm
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        out = step(batch)
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
-        times.append(time.perf_counter() - t0)
-    dev_t = float(np.median(times))
-
-    cond = bind(_q6_condition(), SCHEMA)
-
-    def host_step(b):
-        c = eval_host(cond, b)
-        kept = host_filter(b, c.data.astype(bool) & c.validity)
-        return host_group_by(kept, [0], list(_SPECS))
-
-    h0 = time.perf_counter()
-    hout = host_step(hb)
-    host_t = time.perf_counter() - h0
-
-    assert hout.num_rows == out.host_num_rows(), \
-        (hout.num_rows, out.host_num_rows())
-    return host_t / dev_t
 
 
 def main() -> None:
@@ -101,8 +61,20 @@ def main() -> None:
             import jax
             jax.devices()
             state["init"] = True
-            state["smoke"] = _run_size(SMOKE_ROWS)
-            state["full"] = _run_size(FULL_ROWS)
+            from spark_rapids_tpu.bench.runner import run_benchmark
+            for sf in LADDER:
+                iters = 3 if sf <= 1 else 1
+                reports = run_benchmark(
+                    os.path.join(DATA_DIR, f"sf{sf:g}"), sf, ["q6"],
+                    iterations=iters, verify=True)
+                r = reports[0]
+                if "error" in r:
+                    state["error"] = f"sf{sf:g}: {r['error']}"
+                    break
+                if not r.get("ok", False):
+                    state["error"] = f"sf{sf:g}: device != oracle"
+                    break
+                state["best"] = (sf, r)
         except BaseException as e:  # noqa: BLE001 - reported via JSON line
             state["error"] = \
                 f"{type(e).__name__}: {e} | {traceback.format_exc(limit=3)}"
@@ -111,23 +83,22 @@ def main() -> None:
     t.start()
     t.join(INIT_TIMEOUT_S)
     if t.is_alive() and "init" not in state:
-        _emit(0.0, FULL_ROWS,
+        _emit(0.0, LADDER[-1],
               error=f"jax backend init did not return in {INIT_TIMEOUT_S}s")
         os._exit(1)
     t.join(max(0.0, TOTAL_TIMEOUT_S - INIT_TIMEOUT_S))
-    hung = t.is_alive()
     err = state.get("error")
-    if hung:
-        err = (err or "") + f" benchmark exceeded {TOTAL_TIMEOUT_S}s deadline"
-    if "full" in state:
-        _emit(state["full"], FULL_ROWS, error=err)
-        rc = 0
-    elif "smoke" in state:
-        _emit(state["smoke"], SMOKE_ROWS,
-              error=err or "full-size run did not complete")
+    if t.is_alive():
+        err = (err or "") + f" deadline {TOTAL_TIMEOUT_S}s exceeded"
+    if "best" in state:
+        sf, r = state["best"]
+        _emit(r.get("speedup", 0.0), sf, error=err,
+              extra={"device_s": r.get("device_s"),
+                     "oracle_s": r.get("oracle_s"),
+                     "rows": r.get("rows")})
         rc = 0
     else:
-        _emit(0.0, FULL_ROWS, error=err or "no result")
+        _emit(0.0, LADDER[0], error=err or "no rung completed")
         rc = 1
     # worker thread may still hold native state; exit hard so a hung
     # atexit teardown can't eat the already-printed JSON line.

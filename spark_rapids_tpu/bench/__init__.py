@@ -1,0 +1,11 @@
+"""TPC-DS-like benchmark harness: data generator, queries, runner.
+
+Reference: integration_tests/src/main/scala/com/nvidia/spark/rapids/tests/
+tpcds/TpcdsLikeSpark.scala (queries as DataFrame code with explicit
+schemas), BenchmarkRunner.scala (CLI runner), BenchUtils.scala
+(per-iteration JSON reports).
+"""
+from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds
+from spark_rapids_tpu.bench.tpcds_queries import QUERIES, build_query
+
+__all__ = ["generate_tpcds", "QUERIES", "build_query"]

@@ -1,0 +1,109 @@
+"""TPC-DS benchmark runner: per-query timing + JSON reports.
+
+Reference: BenchmarkRunner.scala (collect/writeParquet modes, iteration
+timing) + BenchUtils.scala (JSON report per run) + CompareResults.scala
+(CPU-vs-accelerator output verification).  Here verification is the
+host-oracle backend of the same plan (the round-trip the test suite
+uses), selected with ``--verify``.
+
+CLI:
+    python -m spark_rapids_tpu.bench.runner --sf 0.1 --queries q3,q6 \
+        --data-dir /tmp/tpcds --iterations 2 --verify
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+__all__ = ["run_benchmark"]
+
+
+def _collect_rows(df, backend: str):
+    from spark_rapids_tpu.exec.core import collect_device, collect_host
+    ov, meta = df._overridden(quiet=True)
+    if backend == "host":
+        return collect_host(meta.exec_node, df._s.conf)
+    return collect_device(meta.exec_node, df._s.conf)
+
+
+def _norm(rows):
+    return sorted(
+        tuple((x is None, str(x)) for x in r) for r in rows)
+
+
+def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
+                  verify: bool = False, session_conf: dict | None = None,
+                  generate: bool = True) -> list[dict]:
+    """Run each query ``iterations`` times on the device engine; report
+    per-query wall times (median), row counts, and optional host-oracle
+    verification. Returns a list of per-query report dicts."""
+    from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds
+    from spark_rapids_tpu.bench.tpcds_queries import build_query
+    from spark_rapids_tpu.session import TpuSession
+
+    if generate:
+        t0 = time.perf_counter()
+        generate_tpcds(data_dir, sf=sf)
+        gen_s = time.perf_counter() - t0
+    else:
+        gen_s = 0.0
+
+    reports = []
+    for name in queries:
+        session = TpuSession(dict(session_conf or {}))
+        rec = {"query": name, "sf": sf, "gen_s": round(gen_s, 3)}
+        try:
+            times = []
+            rows = None
+            for _ in range(max(1, iterations)):
+                df = build_query(name, session, data_dir)
+                t0 = time.perf_counter()
+                rows = _collect_rows(df, "device")
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            rec["device_s"] = round(times[len(times) // 2], 4)
+            rec["device_s_all"] = [round(t, 4) for t in times]
+            rec["rows"] = len(rows)
+            if verify:
+                df = build_query(name, session, data_dir)
+                t0 = time.perf_counter()
+                oracle = _collect_rows(df, "host")
+                rec["oracle_s"] = round(time.perf_counter() - t0, 4)
+                rec["speedup"] = round(rec["oracle_s"] / rec["device_s"], 3)
+                rec["ok"] = _norm(rows) == _norm(oracle)
+            else:
+                rec["ok"] = True
+        except Exception as e:  # noqa: BLE001 - per-query isolation
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec["ok"] = False
+        reports.append(rec)
+    return reports
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default=os.environ.get(
+        "TPCDS_DATA_DIR", "/tmp/tpcds_data"))
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--queries", default="q3,q6,q42,q52,q55")
+    ap.add_argument("--iterations", type=int, default=1)
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--report", default=None,
+                    help="write the JSON report to this path")
+    args = ap.parse_args()
+
+    data_dir = os.path.join(args.data_dir, f"sf{args.sf:g}")
+    reports = run_benchmark(data_dir, args.sf,
+                            [q.strip() for q in args.queries.split(",")],
+                            iterations=args.iterations, verify=args.verify)
+    out = json.dumps(reports, indent=2)
+    print(out)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

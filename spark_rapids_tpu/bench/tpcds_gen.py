@@ -1,0 +1,189 @@
+"""SF-scalable TPC-DS-shaped data generator (column-pruned, parquet).
+
+Generates the five tables the query slice uses — store_sales, date_dim,
+item, customer, customer_address — with dsdgen-like row counts, key
+ranges, null fractions, and surrogate-key conventions (d_date_sk epoch
+2415022 = 1900-01-01, store_sales ~2.88M rows/SF).  Columns are pruned
+to those the queries touch; distributions are synthetic (deterministic
+numpy, seeded), NOT dsdgen bit-exact — this measures engine speed, not
+dsdgen conformance.  Reference harness: TpcdsLikeSpark.scala (explicit
+schemas + csv-to-parquet conversion), docs/benchmarks.md:104-147.
+"""
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["generate_tpcds", "table_row_counts", "TABLES"]
+
+TABLES = ("date_dim", "item", "customer", "customer_address", "store_sales")
+
+_DATE_SK_EPOCH = 2415022            # dsdgen: d_date_sk of 1900-01-01
+_DATE_DIM_DAYS = 73049              # 1900-01-01 .. 2099-12-31
+_SALES_DATE_LO = 35794              # days(1998-01-01 - 1900-01-01)
+_SALES_DATE_HI = 37985              # days(2003-12-31 - 1900-01-01)
+
+_CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+               "Men", "Music", "Shoes", "Sports", "Women"]
+_STATES = ["AL", "AK", "AZ", "AR", "CA", "CO", "CT", "DE", "FL", "GA",
+           "HI", "ID", "IL", "IN", "IA", "KS", "KY", "LA", "ME", "MD",
+           "MA", "MI", "MN", "MS", "MO", "MT", "NE", "NV", "NH", "NJ",
+           "NM", "NY", "NC", "ND", "OH", "OK", "OR", "PA", "RI", "SC",
+           "SD", "TN", "TX", "UT", "VT", "VA", "WA", "WV", "WI", "WY"]
+
+
+def table_row_counts(sf: float) -> dict[str, int]:
+    """dsdgen-like scaling: store_sales linear in SF; dimensions sublinear
+    (item SF1=18k/SF10~57k, customer SF1=100k/SF10~500k)."""
+    sf = max(sf, 0.001)
+    n_cust = max(200, int(100_000 * sf ** 0.7))
+    return {
+        "date_dim": _DATE_DIM_DAYS,
+        "item": max(100, int(18_000 * sf ** 0.5)),
+        "customer": n_cust,
+        "customer_address": max(100, n_cust // 2),
+        "store_sales": max(1000, int(2_880_000 * sf)),
+    }
+
+
+def _gen_date_dim(counts) -> dict[str, np.ndarray]:
+    days = np.arange(_DATE_DIM_DAYS, dtype=np.int64)
+    dates = np.datetime64("1900-01-01") + days
+    y = dates.astype("datetime64[Y]").astype(int) + 1970
+    m = dates.astype("datetime64[M]").astype(int) % 12 + 1
+    dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
+    return {
+        "d_date_sk": (days + _DATE_SK_EPOCH).astype(np.int32),
+        "d_year": y.astype(np.int32),
+        "d_moy": m.astype(np.int32),
+        "d_dom": dom.astype(np.int32),
+        "d_month_seq": ((y - 1900) * 12 + (m - 1)).astype(np.int32),
+        "d_qoy": ((m - 1) // 3 + 1).astype(np.int32),
+    }
+
+
+def _with_nulls(rng, arr: np.ndarray, frac: float) -> np.ndarray:
+    """Object array with ~frac nulls (None)."""
+    out = arr.astype(object)
+    if frac > 0:
+        out[rng.random(len(arr)) < frac] = None
+    return out
+
+
+def _gen_item(rng, n: int) -> dict[str, np.ndarray]:
+    brand_id = rng.integers(1001001, 1010016, n).astype(np.int32)
+    cat_idx = rng.integers(0, len(_CATEGORIES), n)
+    return {
+        "i_item_sk": np.arange(1, n + 1, dtype=np.int32),
+        "i_brand_id": brand_id,
+        "i_brand": np.array([f"Brand#{b % 100}" for b in brand_id],
+                            dtype=object),
+        "i_category_id": (cat_idx + 1).astype(np.int32),
+        "i_category": _with_nulls(
+            rng, np.array([_CATEGORIES[i] for i in cat_idx], dtype=object),
+            0.005),
+        "i_current_price": _with_nulls(
+            rng, np.round(rng.uniform(0.09, 99.99, n), 2), 0.01),
+        "i_manufact_id": rng.integers(1, 1001, n).astype(np.int32),
+        "i_manager_id": rng.integers(1, 101, n).astype(np.int32),
+    }
+
+
+def _gen_customer(rng, n: int, n_addr: int) -> dict[str, np.ndarray]:
+    return {
+        "c_customer_sk": np.arange(1, n + 1, dtype=np.int32),
+        "c_current_addr_sk": _with_nulls(
+            rng, rng.integers(1, n_addr + 1, n).astype(np.int32), 0.01),
+    }
+
+
+def _gen_customer_address(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "ca_address_sk": np.arange(1, n + 1, dtype=np.int32),
+        "ca_state": _with_nulls(
+            rng, np.array([_STATES[i] for i in
+                           rng.integers(0, len(_STATES), n)], dtype=object),
+            0.01),
+    }
+
+
+def _gen_store_sales(rng, n: int, n_items: int, n_cust: int):
+    qty = rng.integers(1, 101, n).astype(np.int32)
+    price = np.round(np.exp(rng.normal(2.5, 1.0, n)).clip(0.01, 300.0), 2)
+    return {
+        "ss_sold_date_sk": _with_nulls(
+            rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
+                  + _DATE_SK_EPOCH).astype(np.int32), 0.02),
+        "ss_item_sk": rng.integers(1, n_items + 1, n).astype(np.int32),
+        "ss_customer_sk": _with_nulls(
+            rng, rng.integers(1, n_cust + 1, n).astype(np.int32), 0.04),
+        "ss_quantity": qty,
+        "ss_sales_price": price,
+        "ss_ext_sales_price": np.round(price * qty, 2),
+    }
+
+
+def _write_parquet(path: str, data: dict, rows_per_file: int) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    os.makedirs(path, exist_ok=True)
+    n = len(next(iter(data.values())))
+    cols = {}
+    for name, arr in data.items():
+        if arr.dtype == object:
+            base = next((x for x in arr if x is not None), 0)
+            if isinstance(base, str):
+                cols[name] = pa.array(list(arr), type=pa.string())
+            elif isinstance(base, float):
+                cols[name] = pa.array(
+                    [None if x is None else float(x) for x in arr],
+                    type=pa.float64())
+            else:
+                cols[name] = pa.array(
+                    [None if x is None else int(x) for x in arr],
+                    type=pa.int32())
+        else:
+            cols[name] = pa.array(arr)
+    table = pa.table(cols)
+    nfiles = max(1, -(-n // rows_per_file))
+    for i in range(nfiles):
+        part = table.slice(i * rows_per_file,
+                           min(rows_per_file, n - i * rows_per_file))
+        pq.write_table(part, os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+def generate_tpcds(data_dir: str, sf: float = 0.01, seed: int = 42,
+                   tables: Sequence[str] = TABLES,
+                   rows_per_file: int = 1 << 20) -> dict[str, int]:
+    """Generate the pruned TPC-DS tables under ``data_dir/<table>/``.
+
+    Returns {table: rows}.  Skips tables whose directory already exists
+    (delete the dir to regenerate).
+    """
+    counts = table_row_counts(sf)
+    written = {}
+    for t in tables:
+        out = os.path.join(data_dir, t)
+        written[t] = counts[t]
+        if os.path.isdir(out) and os.listdir(out):
+            continue
+        rng = np.random.default_rng(seed + zlib.crc32(t.encode()) % 1000)
+        if t == "date_dim":
+            data = _gen_date_dim(counts)
+        elif t == "item":
+            data = _gen_item(rng, counts["item"])
+        elif t == "customer":
+            data = _gen_customer(rng, counts["customer"],
+                                 counts["customer_address"])
+        elif t == "customer_address":
+            data = _gen_customer_address(rng, counts["customer_address"])
+        elif t == "store_sales":
+            data = _gen_store_sales(rng, counts["store_sales"],
+                                    counts["item"], counts["customer"])
+        else:
+            raise ValueError(f"unknown table {t}")
+        _write_parquet(out, data, rows_per_file)
+    return written
