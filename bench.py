@@ -58,6 +58,8 @@ def main() -> None:
 
     def _work():
         try:
+            from spark_rapids_tpu.runtime import enable_compilation_cache
+            enable_compilation_cache()
             import jax
             jax.devices()
             state["init"] = True

@@ -1,0 +1,91 @@
+"""Isolate WHY sorted_group_by costs ~38s to compile on XLA:CPU.
+
+Times jit.lower() and lowered.compile() for progressively simpler programs at
+one capacity, to find the compile hog (suspect: variadic lax.sort).
+"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax, jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+CAP = 1 << 18
+
+
+def timeit(name, fn):
+    t0 = time.time()
+    out = fn()
+    print(f"{name}: {time.time()-t0:.2f}s", flush=True)
+    return out
+
+
+def compile_of(f, *args):
+    lowered = jax.jit(f).lower(*args)
+    n_lines = len(lowered.as_text().splitlines())
+    t0 = time.time()
+    lowered.compile()
+    return time.time() - t0, n_lines
+
+
+def main():
+    i64 = jnp.zeros(CAP, jnp.int64)
+    i32 = jnp.zeros(CAP, jnp.int32)
+    f64 = jnp.zeros(CAP, jnp.float64)
+    u8 = jnp.zeros(CAP, jnp.uint8)
+    strmat = jnp.zeros((CAP, 16), jnp.uint8)
+
+    # 1. single-key sort
+    t, n = compile_of(lambda a: lax.sort([a], num_keys=1, is_stable=True), i64)
+    print(f"sort 1 op (i64): {t:.2f}s ({n} hlo lines)", flush=True)
+
+    # 2. two-operand sort (key + payload)
+    t, n = compile_of(lambda a, b: lax.sort([a, b], num_keys=1, is_stable=True), i64, i32)
+    print(f"sort 2 ops key=1: {t:.2f}s ({n})", flush=True)
+
+    # 3. variadic sort, 4 keys
+    t, n = compile_of(lambda a, b, c, d, e: lax.sort([a, b, c, d, e], num_keys=4, is_stable=True),
+                      u8, i64, u8, f64, i32)
+    print(f"sort 5 ops key=4: {t:.2f}s ({n})", flush=True)
+
+    # 4. variadic sort, 8 keys (string-ish)
+    ops = [u8] + [i32] * 6 + [i32]
+    t, n = compile_of(lambda *a: lax.sort(list(a), num_keys=7, is_stable=True), *ops)
+    print(f"sort 8 ops key=7: {t:.2f}s ({n})", flush=True)
+
+    # 5. segment_sum alone
+    t, n = compile_of(lambda x, s: jax.ops.segment_sum(x, s, num_segments=CAP), i64, i32)
+    print(f"segment_sum: {t:.2f}s ({n})", flush=True)
+
+    # 6. scatter .at[].set
+    t, n = compile_of(lambda x, p: jnp.zeros(CAP, jnp.int64).at[p].set(x, mode="drop"), i64, i32)
+    print(f"scatter set: {t:.2f}s ({n})", flush=True)
+
+    # 7. the real sorted_group_by
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.ops.segmented import AggSpec, sorted_group_by
+
+    key = DeviceColumn(i32, jnp.ones(CAP, jnp.bool_), T.IntegerType())
+    val = DeviceColumn(f64, jnp.ones(CAP, jnp.bool_), T.DoubleType())
+    schema = T.Schema([T.StructField("k", T.IntegerType()), T.StructField("v", T.DoubleType())])
+    batch = ColumnBatch([key, val], jnp.asarray(CAP, jnp.int32), schema)
+
+    def gb(b):
+        return sorted_group_by(b, [0], [AggSpec("sum", 1), AggSpec("count", 1)])
+
+    t, n = compile_of(gb, batch)
+    print(f"sorted_group_by int key: {t:.2f}s ({n})", flush=True)
+
+    # 8. group-by with a string key
+    skey = DeviceColumn(strmat, jnp.ones(CAP, jnp.bool_), T.StringType(), i32)
+    schema2 = T.Schema([T.StructField("k", T.StringType()), T.StructField("v", T.DoubleType())])
+    batch2 = ColumnBatch([skey, val], jnp.asarray(CAP, jnp.int32), schema2)
+    t, n = compile_of(gb, batch2)
+    print(f"sorted_group_by str key: {t:.2f}s ({n})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

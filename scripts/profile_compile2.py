@@ -1,0 +1,43 @@
+"""Sort compile time vs capacity + mitigation probes."""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax, jax.numpy as jnp
+from jax import lax
+
+
+def compile_of(f, *args):
+    lowered = jax.jit(f).lower(*args)
+    t0 = time.time()
+    lowered.compile()
+    return time.time() - t0
+
+
+def main():
+    for logcap in (10, 14, 16, 18, 20):
+        cap = 1 << logcap
+        a = jnp.zeros(cap, jnp.int32)
+        t = compile_of(lambda x: lax.sort([x], num_keys=1, is_stable=True), a)
+        print(f"sort 1op cap=2^{logcap}: {t:.2f}s", flush=True)
+
+    cap = 1 << 18
+    a = jnp.zeros(cap, jnp.int32)
+    b = jnp.zeros(cap, jnp.int32)
+    # is_stable=False
+    t = compile_of(lambda x: lax.sort([x], num_keys=1, is_stable=False), a)
+    print(f"sort 1op unstable: {t:.2f}s", flush=True)
+    # jnp.sort / argsort
+    t = compile_of(lambda x: jnp.argsort(x), a)
+    print(f"argsort: {t:.2f}s", flush=True)
+    # sort_key_val
+    t = compile_of(lambda x, y: lax.sort_key_val(x, y), a, b)
+    print(f"sort_key_val: {t:.2f}s", flush=True)
+    # 2D sort along axis (batch of rows)
+    m = jnp.zeros((8, cap // 8), jnp.int32)
+    t = compile_of(lambda x: lax.sort(x, dimension=1, is_stable=True), m)
+    print(f"sort 2d: {t:.2f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -192,27 +192,64 @@ class HashAggregateExec(PlanNode):
             yield from self._run_host(child_it, key_idx)
 
     # -- device path (reference aggregate.scala:427-485 concat+merge loop) --
+    #
+    # Compilation discipline (XLA analog of the reference's zero-per-batch-
+    # compilation hot loop, SURVEY §3.3): the whole per-batch update and the
+    # cross-batch merge are each ONE jitted program, and the running buffer
+    # is held at a fixed canonical capacity (shrunk back after each merge)
+    # instead of walking pow2 buckets upward with the input size.
+    def _jit_fns(self):
+        if not hasattr(self, "_update_jit"):
+            key_idx = list(range(len(self._group_bound)))
+
+            def update(b):
+                cols = [eval_device(e, b) for e in self._pre_exprs]
+                pre = ColumnBatch(cols, b.num_rows, self._pre_schema)
+                return _relabel_d(
+                    sorted_group_by(pre, key_idx, self._update_specs),
+                    self._buffer_schema)
+
+            def merge(run, part):
+                cat = _relabel_d(dk.concat_batches([run, part]),
+                                 self._buffer_schema)
+                return _relabel_d(
+                    sorted_group_by(cat, key_idx, self._merge_specs),
+                    self._buffer_schema)
+
+            def final(run):
+                cols = [eval_device(e, run) for e in self._final_exprs]
+                return ColumnBatch(cols, run.num_rows, self._output_schema)
+
+            import jax
+            self._update_jit = jax.jit(update)
+            self._merge_jit = jax.jit(merge)
+            self._final_jit = jax.jit(final)
+        return self._update_jit, self._merge_jit, self._final_jit
+
     def _run_device(self, child_it, key_idx) -> Iterator[ColumnBatch]:
+        update_jit, merge_jit, final_jit = self._jit_fns()
         running: ColumnBatch | None = None
-        saw_input = False
+        target_cap = 0
         for b in child_it:
-            saw_input = True
             if self.mode == "final":
                 part = _relabel_d(b, self._buffer_schema)
             else:
-                cols = [eval_device(e, b) for e in self._pre_exprs]
-                pre = ColumnBatch(cols, b.num_rows, self._pre_schema)
-                part = _relabel_d(
-                    sorted_group_by(pre, key_idx, self._update_specs),
-                    self._buffer_schema)
+                part = update_jit(b)
             if running is None:
                 running = part
-            else:
-                cat = dk.concat_batches([running, part])
-                cat = _relabel_d(cat, self._buffer_schema)
-                running = _relabel_d(
-                    sorted_group_by(cat, key_idx, self._merge_specs),
-                    self._buffer_schema)
+                target_cap = part.capacity
+                continue
+            target_cap = max(target_cap, part.capacity)
+            running = dk.pad_capacity(running, target_cap)
+            part = dk.pad_capacity(part, target_cap)
+            merged = merge_jit(running, part)
+            # shrink back to the canonical capacity; num_groups is
+            # materialized host-side to keep the shrink sound (the only
+            # per-batch sync, and it doubles as backpressure)
+            ng = merged.host_num_rows()
+            while target_cap < ng:
+                target_cap <<= 1
+            running = dk.shrink_capacity(merged, target_cap)
         if running is None:
             if key_idx or self.mode == "partial":
                 return  # no groups / nothing to emit
@@ -227,8 +264,7 @@ class HashAggregateExec(PlanNode):
         if self.mode == "partial":
             yield running
         else:
-            cols = [eval_device(e, running) for e in self._final_exprs]
-            yield ColumnBatch(cols, running.num_rows, self._output_schema)
+            yield final_jit(running)
 
     # -- host oracle path --------------------------------------------------
     def _run_host(self, child_it, key_idx) -> Iterator[HostBatch]:

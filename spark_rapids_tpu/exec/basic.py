@@ -88,12 +88,25 @@ class ProjectExec(PlanNode):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    def _jit_fn(self):
+        # one program per batch shape: whole-projection jit (the eager
+        # per-op path costs a dispatch round trip per op on a remote TPU)
+        if not hasattr(self, "_project_jit"):
+            import jax
+
+            def project(b):
+                cols = [eval_device(e, b) for e in self._bound]
+                return ColumnBatch(cols, b.num_rows, self._schema)
+
+            self._project_jit = jax.jit(project)
+        return self._project_jit
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
         if ctx.is_device:
+            fn = self._jit_fn()
             for b in child_it:
-                cols = [eval_device(e, b) for e in self._bound]
-                yield ColumnBatch(cols, b.num_rows, self._schema)
+                yield fn(b)
         else:
             for b in child_it:
                 cols = [eval_host(e, b) for e in self._bound]
@@ -117,13 +130,24 @@ class FilterExec(PlanNode):
     def output_schema(self) -> T.Schema:
         return self.children[0].output_schema
 
+    def _jit_fn(self):
+        if not hasattr(self, "_filter_jit"):
+            import jax
+
+            def filt(b):
+                c = eval_device(self._cond, b)
+                keep = c.data & c.validity  # null -> drop (SQL WHERE)
+                return dk.compact(b, keep)
+
+            self._filter_jit = jax.jit(filt)
+        return self._filter_jit
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
         if ctx.is_device:
+            fn = self._jit_fn()
             for b in child_it:
-                c = eval_device(self._cond, b)
-                keep = c.data & c.validity  # null -> drop (SQL WHERE)
-                yield dk.compact(b, keep)
+                yield fn(b)
         else:
             for b in child_it:
                 c = eval_host(self._cond, b)

@@ -31,21 +31,29 @@ from spark_rapids_tpu.host.batch import HostBatch
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops import host_kernels as hk
 from spark_rapids_tpu.ops.join import (JOIN_TYPES, gather_join_output,
-                                       join_indices, join_total)
+                                       join_indices_from_probe, join_probe)
 
 __all__ = ["JoinExec", "CrossJoinExec"]
 
 
 @partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type"))
-def _jit_total(lb, rb, lkeys, rkeys, join_type):
-    return join_total(lb, rb, lkeys, rkeys, join_type)
+def _jit_probe(lb, rb, lkeys, rkeys, join_type):
+    """Heavy phase (all sorts): compiled once per (capacities, keys)."""
+    probe_arrays, total = join_probe(lb, rb, lkeys, rkeys, join_type)
+    # drop the None placeholder for non-full joins (pytree-stable output)
+    if probe_arrays[-1] is None:
+        probe_arrays = probe_arrays[:-1]
+    return probe_arrays, total
 
 
-@partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type", "out_cap",
+@partial(jax.jit, static_argnames=("cl", "join_type", "out_cap",
                                    "include_right", "schema"))
-def _jit_join(lb, rb, lkeys, rkeys, join_type, out_cap, include_right,
-              schema):
-    plan = join_indices(lb, rb, lkeys, rkeys, join_type, out_cap)
+def _jit_gather(lb, rb, probe_arrays, cl, join_type, out_cap, include_right,
+                schema):
+    """Light phase (gathers only): re-specialized per output capacity."""
+    if join_type != "full":
+        probe_arrays = probe_arrays + (None,)
+    plan = join_indices_from_probe(cl, probe_arrays, join_type, out_cap)
     return gather_join_output(lb, rb, *plan, schema, include_right)
 
 
@@ -179,14 +187,16 @@ class JoinExec(PlanNode):
     def _run_device(self, ctx: ExecCtx, lb: ColumnBatch, rb: ColumnBatch):
         lb2, lkeys = self._augment_device(lb, self._lkeys_b)
         rb2, rkeys = self._augment_device(rb, self._rkeys_b)
-        total = int(jax.device_get(_jit_total(
-            lb2, rb2, lkeys, rkeys, self.join_type)))
+        probe_arrays, total_dev = _jit_probe(
+            lb2, rb2, lkeys, rkeys, self.join_type)
+        total = int(jax.device_get(total_dev))
         out_cap = round_capacity(max(total, 1))
         # kernel output: ALL left cols (incl appended keys) + right cols
         kf = (list(lb2.schema.fields)
               + (list(rb2.schema.fields) if self.include_right else []))
-        out = _jit_join(lb2, rb2, lkeys, rkeys, self.join_type, out_cap,
-                        self.include_right, T.Schema(kf))
+        out = _jit_gather(lb2, rb2, probe_arrays, lb2.capacity,
+                          self.join_type, out_cap, self.include_right,
+                          T.Schema(kf))
         out = self._project_out(out, lb, rb, lb2, rb2, device=True)
         if self._condition is not None:
             c = eval_device(self._cond_b, out)

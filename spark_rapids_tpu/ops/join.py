@@ -36,7 +36,8 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.ops.segmented import _cols_differ
 from spark_rapids_tpu.ops.sort import encode_key_operands
 
-__all__ = ["join_total", "join_indices", "JOIN_TYPES"]
+__all__ = ["join_probe", "join_total", "join_indices_from_probe",
+           "gather_join_output", "JOIN_TYPES"]
 
 JOIN_TYPES = ("inner", "left", "semi", "anti", "full", "cross")
 
@@ -132,31 +133,44 @@ def _probe(lbatch: ColumnBatch, rbatch: ColumnBatch,
     return start, cnt, rsort_perm, out_cnt, unmatched_r
 
 
-def join_total(lbatch: ColumnBatch, rbatch: ColumnBatch,
+def join_probe(lbatch: ColumnBatch, rbatch: ColumnBatch,
                lkeys: Sequence[int], rkeys: Sequence[int],
-               join_type: str) -> jax.Array:
-    """Phase 1: total output rows (device scalar int32/int64)."""
-    _, _, _, out_cnt, unmatched_r = _probe(lbatch, rbatch, lkeys, rkeys,
-                                           join_type)
+               join_type: str):
+    """Phase 1 (the heavy phase: contains every sort).
+
+    Returns ``(probe_arrays, total)`` where ``probe_arrays`` feeds
+    :func:`join_indices_from_probe` and ``total`` is the output row count
+    (device scalar).  Splitting probe from gather means the sorts run ONCE
+    per join, with only the cheap gather re-specialized per output
+    capacity (the reference's two cuDF phases, gather-map + gather,
+    GpuHashJoin.scala:300-326, have the same split).
+    """
+    start, cnt, rsort_perm, out_cnt, unmatched_r = _probe(
+        lbatch, rbatch, lkeys, rkeys, join_type)
     total = jnp.sum(out_cnt, dtype=jnp.int64)
     if unmatched_r is not None:
         total = total + jnp.sum(unmatched_r, dtype=jnp.int64)
-    return total
+    return (start, cnt, rsort_perm, out_cnt, unmatched_r), total
 
 
-def join_indices(lbatch: ColumnBatch, rbatch: ColumnBatch,
-                 lkeys: Sequence[int], rkeys: Sequence[int],
-                 join_type: str, out_cap: int):
-    """Phase 2: gather plan into a static ``out_cap`` output.
+def join_total(lbatch: ColumnBatch, rbatch: ColumnBatch,
+               lkeys: Sequence[int], rkeys: Sequence[int],
+               join_type: str) -> jax.Array:
+    """Total output rows (device scalar); prefer :func:`join_probe`."""
+    return join_probe(lbatch, rbatch, lkeys, rkeys, join_type)[1]
+
+
+def join_indices_from_probe(cl: int, probe_arrays, join_type: str,
+                            out_cap: int):
+    """Phase 2: gather plan into a static ``out_cap`` output from
+    precomputed probe arrays (no sorts here).
 
     Returns (li, ri, l_take, r_take, total):
       li/ri: int32[out_cap] source row per output slot (clamped in range),
       l_take/r_take: bool[out_cap] — False means that side is all-null for
       the slot (outer non-matches) or the slot is padding.
     """
-    cl = lbatch.capacity
-    start, cnt, rsort_perm, out_cnt, unmatched_r = _probe(
-        lbatch, rbatch, lkeys, rkeys, join_type)
+    start, cnt, rsort_perm, out_cnt, unmatched_r = probe_arrays
     offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32),
          jnp.cumsum(out_cnt)[:-1].astype(jnp.int32)])

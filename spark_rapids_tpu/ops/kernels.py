@@ -11,6 +11,7 @@ inside one compiled program, no host sync on the data-dependent row count.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax
@@ -20,7 +21,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
-__all__ = ["compact", "take", "concat_batches", "slice_batch", "gather_columns"]
+__all__ = ["compact", "take", "concat_batches", "slice_batch",
+           "gather_columns", "shrink_capacity", "pad_capacity"]
 
 
 def _gather_column(col: DeviceColumn, perm: jax.Array,
@@ -70,6 +72,57 @@ def slice_batch(batch: ColumnBatch, limit: jax.Array) -> ColumnBatch:
     identity = jnp.arange(batch.capacity, dtype=jnp.int32)
     cols = gather_columns(batch.columns, identity, new_count)
     return ColumnBatch(cols, new_count, batch.schema)
+
+
+def shrink_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:
+    """Static-slice a front-packed batch down to ``cap`` rows of storage.
+
+    The caller must know (host-side) that ``num_rows <= cap``; rows are
+    already front-packed so a plain prefix slice keeps them all.  Used to
+    hold a running aggregation buffer at a fixed canonical capacity
+    instead of walking compilation buckets upward.  Jitted per (cap,
+    batch-shape) so the eager path costs one dispatch, not one per column.
+    """
+    if batch.capacity <= cap:
+        return batch
+    return _shrink_jit(batch, cap)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _shrink_jit(batch: ColumnBatch, cap: int) -> ColumnBatch:
+    cols = []
+    for c in batch.columns:
+        if c.is_string:
+            cols.append(DeviceColumn(c.data[:cap], c.validity[:cap],
+                                     c.dtype, c.lengths[:cap]))
+        else:
+            cols.append(DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype))
+    return ColumnBatch(cols, batch.num_rows, batch.schema)
+
+
+def pad_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:
+    """Grow a batch's storage to ``cap`` rows with trailing padding
+    (cheap realloc; keeps compilation buckets canonical)."""
+    if cap <= batch.capacity:
+        return batch
+    return _pad_jit(batch, cap)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _pad_jit(batch: ColumnBatch, cap: int) -> ColumnBatch:
+    pad = cap - batch.capacity
+    cols = []
+    for c in batch.columns:
+        validity = jnp.concatenate([c.validity, jnp.zeros(pad, jnp.bool_)])
+        if c.is_string:
+            data = jnp.concatenate(
+                [c.data, jnp.zeros((pad, c.max_len), jnp.uint8)])
+            lengths = jnp.concatenate([c.lengths, jnp.zeros(pad, jnp.int32)])
+            cols.append(DeviceColumn(data, validity, c.dtype, lengths))
+        else:
+            data = jnp.concatenate([c.data, jnp.zeros(pad, c.data.dtype)])
+            cols.append(DeviceColumn(data, validity, c.dtype))
+    return ColumnBatch(cols, batch.num_rows, batch.schema)
 
 
 def concat_batches(batches: Sequence[ColumnBatch],

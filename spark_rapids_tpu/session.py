@@ -29,6 +29,8 @@ class TpuSession:
 
     def __init__(self, conf: dict | TpuConf | None = None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf or {})
+        from spark_rapids_tpu.runtime import ensure_runtime
+        ensure_runtime(self.conf)
 
     # -- sources -------------------------------------------------------
     def read_parquet(self, path, columns=None, **kw) -> "DataFrame":
