@@ -1,9 +1,11 @@
 """Sort compile time vs capacity + mitigation probes."""
 import os, sys, time
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force-assign: shell pins axon
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax, jax.numpy as jnp
+import jax
+jax.config.update("jax_platforms", "cpu")  # env alone cannot stop the axon hook
+import jax.numpy as jnp
 from jax import lax
 
 

@@ -24,21 +24,24 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
-from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.expr.core import (BoundReference, Expression, bind,
                                         eval_device, eval_host)
 from spark_rapids_tpu.host.batch import HostBatch
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops import host_kernels as hk
-from spark_rapids_tpu.ops.join import (JOIN_TYPES, gather_join_output,
-                                       join_indices_from_probe, join_probe)
+from spark_rapids_tpu.ops.join import (JOIN_TYPES, build_prepare_fast,
+                                       gather_join_output,
+                                       join_indices_from_probe, join_probe,
+                                       matched_build_rows, probe_fast)
 
 __all__ = ["JoinExec", "CrossJoinExec"]
 
 
 @partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type"))
 def _jit_probe(lb, rb, lkeys, rkeys, join_type):
-    """Heavy phase (all sorts): compiled once per (capacities, keys)."""
+    """Heavy rank-path phase (all sorts): compiled once per capacity pair."""
     probe_arrays, total = join_probe(lb, rb, lkeys, rkeys, join_type)
     # drop the None placeholder for non-full joins (pytree-stable output)
     if probe_arrays[-1] is None:
@@ -46,15 +49,31 @@ def _jit_probe(lb, rb, lkeys, rkeys, join_type):
     return probe_arrays, total
 
 
+@partial(jax.jit, static_argnames=("rkey",))
+def _jit_build_prep(rb, rkey):
+    return build_prepare_fast(rb, rkey)
+
+
+@partial(jax.jit, static_argnames=("lkey", "join_type"))
+def _jit_probe_fast(lb, prep, lkey, join_type):
+    probe_arrays, total = probe_fast(lb, lkey, *prep, join_type)
+    return probe_arrays[:-1], total  # drop the None placeholder
+
+
 @partial(jax.jit, static_argnames=("cl", "join_type", "out_cap",
-                                   "include_right", "schema"))
+                                   "include_right", "schema",
+                                   "track_matched"))
 def _jit_gather(lb, rb, probe_arrays, cl, join_type, out_cap, include_right,
-                schema):
+                schema, track_matched=False):
     """Light phase (gathers only): re-specialized per output capacity."""
-    if join_type != "full":
+    if len(probe_arrays) == 4:
         probe_arrays = probe_arrays + (None,)
     plan = join_indices_from_probe(cl, probe_arrays, join_type, out_cap)
-    return gather_join_output(lb, rb, *plan, schema, include_right)
+    out = gather_join_output(lb, rb, *plan, schema, include_right)
+    if track_matched:
+        li, ri, l_take, r_take, total = plan
+        return out, matched_build_rows(ri, r_take, rb.capacity)
+    return out
 
 
 def _nullable_schema(s: T.Schema) -> list[T.StructField]:
@@ -122,12 +141,12 @@ class JoinExec(PlanNode):
     def output_schema(self) -> T.Schema:
         return self._schema
 
-    @property
-    def output_batching(self):
-        return RequireSingleBatch
-
     def num_partitions(self, ctx: ExecCtx) -> int:
-        return 1
+        # stream-side partitioning is preserved (per-left-row join types);
+        # full outer needs one pass to emit unmatched build rows at the end
+        if self.join_type == "full":
+            return 1
+        return self.children[0].num_partitions(ctx)
 
     # ------------------------------------------------------------------
     def _augment_device(self, batch: ColumnBatch, keys) -> tuple:
@@ -176,34 +195,144 @@ class JoinExec(PlanNode):
         return hk.host_concat(batches)
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        lb = self._materialize(ctx, 0)
-        rb = self._materialize(ctx, 1)
         if ctx.is_device:
-            yield from self._run_device(ctx, lb, rb)
+            yield from self._run_device_stream(ctx, pid)
         else:
+            key = (id(self), "host_build")
+            if key not in ctx.cache:
+                ctx.cache[key] = self._materialize(ctx, 1)
+            rb = ctx.cache[key]
+            child = self.children[0]
+            pids = range(child.num_partitions(ctx)) \
+                if self.join_type == "full" else [pid]
+            batches = [b for p in pids for b in child.partition_iter(ctx, p)]
+            lb = hk.host_concat(batches) if batches \
+                else HostBatch.empty(child.output_schema)
             yield from self._run_host(ctx, lb, rb)
 
     # ------------------------------------------------------------------
-    def _run_device(self, ctx: ExecCtx, lb: ColumnBatch, rb: ColumnBatch):
-        lb2, lkeys = self._augment_device(lb, self._lkeys_b)
-        rb2, rkeys = self._augment_device(rb, self._rkeys_b)
-        probe_arrays, total_dev = _jit_probe(
-            lb2, rb2, lkeys, rkeys, self.join_type)
-        total = int(jax.device_get(total_dev))
-        out_cap = round_capacity(max(total, 1))
-        # kernel output: ALL left cols (incl appended keys) + right cols
-        kf = (list(lb2.schema.fields)
+    # Device path: build side prepared once (sorted keys for the fast
+    # searchsorted probe, reference GpuHashJoin's build-side table,
+    # GpuHashJoin.scala:193-249), then the stream side is joined PER
+    # BATCH — no whole-side concat, no per-batch sort on the fast path.
+    def _use_fast_path(self) -> bool:
+        if len(self._lkeys_b) != 1:
+            return False
+        lt, rt = self._lkeys_b[0].dtype, self._rkeys_b[0].dtype
+        return (not lt.fractional and not rt.fractional
+                and not isinstance(lt, (T.StringType, T.BooleanType))
+                and not isinstance(rt, (T.StringType, T.BooleanType))
+                and type(lt) is type(rt))
+
+    def _build_device(self, ctx: ExecCtx):
+        key = (id(self), "build")
+        if key not in ctx.cache:
+            rb = self._materialize(ctx, 1)
+            rb2, rkeys = self._augment_device(rb, self._rkeys_b)
+            prep = _jit_build_prep(rb2, rkeys[0]) \
+                if self.join_type != "cross" and self._use_fast_path() \
+                else None
+            ctx.cache[key] = (rb2, rkeys, prep)
+        return ctx.cache[key]
+
+    def _run_device_stream(self, ctx: ExecCtx, pid: int):
+        rb2, rkeys, prep = self._build_device(ctx)
+        jt = self.join_type
+        stream_jt = "left" if jt == "full" else jt
+        n_right_raw = len(self.children[1].output_schema.fields)
+        kf = (list(self._stream_aug_fields())
               + (list(rb2.schema.fields) if self.include_right else []))
-        out = _jit_gather(lb2, rb2, probe_arrays, lb2.capacity,
-                          self.join_type, out_cap, self.include_right,
-                          T.Schema(kf))
-        out = self._project_out(out, lb, rb, lb2, rb2, device=True)
-        if self._condition is not None:
-            c = eval_device(self._cond_b, out)
-            out = dk.compact(out, c.data & c.validity)
-        if self._swapped and self.include_right:
-            out = self._reorder_device(out, lb.num_columns)
-        yield ColumnBatch(out.columns, out.num_rows, self._schema)
+        kf_schema = T.Schema(kf)
+        matched = None
+        child = self.children[0]
+        pids = range(child.num_partitions(ctx)) if jt == "full" else [pid]
+        for lpid in pids:
+            for lb in child.partition_iter(ctx, lpid):
+                lb2, lkeys = self._augment_device(lb, self._lkeys_b)
+                if prep is not None:
+                    probe_arrays, total_dev = _jit_probe_fast(
+                        lb2, prep, lkeys[0], stream_jt)
+                else:
+                    probe_arrays, total_dev = _jit_probe(
+                        lb2, rb2, lkeys, rkeys, stream_jt)
+                total = int(jax.device_get(total_dev))
+                if total == 0:
+                    if jt == "full" and matched is None:
+                        matched = jnp.zeros(rb2.capacity, jnp.bool_)
+                    continue
+                out_cap = round_capacity(max(total, 1))
+                if jt == "full":
+                    out, bm = _jit_gather(
+                        lb2, rb2, probe_arrays, lb2.capacity, stream_jt,
+                        out_cap, self.include_right, kf_schema,
+                        track_matched=True)
+                    matched = bm if matched is None else matched | bm
+                else:
+                    out = _jit_gather(
+                        lb2, rb2, probe_arrays, lb2.capacity, stream_jt,
+                        out_cap, self.include_right, kf_schema)
+                out = self._project_out(
+                    out, lb.num_columns, lb2.num_columns, n_right_raw,
+                    device=True)
+                if self._condition is not None:
+                    out = self._condition_jit()(out)
+                if self._swapped and self.include_right:
+                    out = self._reorder_device(out, lb.num_columns)
+                yield ColumnBatch(out.columns, out.num_rows, self._schema)
+        if jt == "full":
+            if matched is None:
+                matched = jnp.zeros(rb2.capacity, jnp.bool_)
+            tail = self._unmatched_right_jit()(rb2, matched)
+            if tail.host_num_rows() > 0:
+                yield tail
+
+    def _stream_aug_fields(self):
+        """Fields of an augmented stream batch (left schema + appended
+        non-BoundReference key columns)."""
+        fields = list(self.children[0].output_schema.fields)
+        for i, k in enumerate(self._lkeys_b):
+            if not isinstance(k, BoundReference):
+                fields.append(T.StructField(f"_jk{i}", k.dtype, True))
+        return fields
+
+    def _condition_jit(self):
+        if not hasattr(self, "_cond_jit"):
+            def filt(out):
+                c = eval_device(self._cond_b, out)
+                return dk.compact(out, c.data & c.validity)
+            self._cond_jit = jax.jit(filt)
+        return self._cond_jit
+
+    def _unmatched_right_jit(self):
+        """Full outer tail: build rows never matched by any stream batch,
+        null-extended on the left (reference fullJoin's right coverage)."""
+        if not hasattr(self, "_unmatched_jit"):
+            left_fields = list(self.children[0].output_schema.fields)
+            right_schema = self.children[1].output_schema
+            n_right = len(right_schema.fields)
+
+            def fn(rb2, matched):
+                keep = rb2.row_mask() & ~matched
+                rraw = ColumnBatch(rb2.columns[:n_right], rb2.num_rows,
+                                   right_schema)
+                rc = dk.compact(rraw, keep)
+                cap = rb2.capacity
+                null_cols = []
+                for f in left_fields:
+                    validity = jnp.zeros(cap, jnp.bool_)
+                    if isinstance(f.data_type, T.StringType):
+                        null_cols.append(DeviceColumn(
+                            jnp.zeros((cap, 1), jnp.uint8), validity,
+                            f.data_type, jnp.zeros(cap, jnp.int32)))
+                    else:
+                        null_cols.append(DeviceColumn(
+                            jnp.zeros(cap, f.data_type.np_dtype), validity,
+                            f.data_type))
+                return ColumnBatch(null_cols + list(rc.columns),
+                                   rc.num_rows, self._schema)
+
+            self._unmatched_jit = jax.jit(fn)
+        return self._unmatched_jit
 
     def _run_host(self, ctx: ExecCtx, lb: HostBatch, rb: HostBatch):
         lb2, lkeys = self._augment_host(lb, self._lkeys_b)
@@ -214,7 +343,8 @@ class JoinExec(PlanNode):
               + (list(rb2.schema.fields) if self.include_right else []))
         out = hk.host_join_output(lb2, rb2, li, ri, lt, rt, T.Schema(kf),
                                   self.include_right)
-        out = self._project_out(out, lb, rb, lb2, rb2, device=False)
+        out = self._project_out(out, lb.num_columns, lb2.num_columns,
+                                rb.num_columns, device=False)
         if self._condition is not None:
             c = eval_host(self._cond_b, out)
             out = hk.host_filter(out, c.data.astype(np.bool_) & c.validity)
@@ -224,11 +354,12 @@ class JoinExec(PlanNode):
             cols = cols[nl:] + cols[:nl]
         yield HostBatch(cols, self._schema)
 
-    def _project_out(self, out, lb, rb, lb2, rb2, device: bool):
+    def _project_out(self, out, n_left_raw: int, n_left_aug: int,
+                     n_right_raw: int, device: bool):
         """Drop appended key columns from the kernel output."""
-        keep = list(range(lb.num_columns))
+        keep = list(range(n_left_raw))
         if self.include_right:
-            keep += [lb2.num_columns + i for i in range(rb.num_columns)]
+            keep += [n_left_aug + i for i in range(n_right_raw)]
         cols = [out.columns[i] for i in keep]
         fields = [out.schema.fields[i] for i in keep]
         if device:

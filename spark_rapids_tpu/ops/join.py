@@ -114,16 +114,7 @@ def _probe(lbatch: ColumnBatch, rbatch: ColumnBatch,
     start = jnp.searchsorted(sorted_rid, lid, side="left").astype(jnp.int32)
     end = jnp.searchsorted(sorted_rid, lid, side="right").astype(jnp.int32)
     cnt = jnp.where(lid == _I32MAX, 0, end - start)
-    if join_type == "inner":
-        out_cnt = cnt
-    elif join_type in ("left", "full"):
-        out_cnt = jnp.where(real_l, jnp.maximum(cnt, 1), 0)
-    elif join_type == "semi":
-        out_cnt = jnp.where(real_l & (cnt > 0), 1, 0).astype(jnp.int32)
-    elif join_type == "anti":
-        out_cnt = jnp.where(real_l & (cnt == 0), 1, 0).astype(jnp.int32)
-    else:
-        raise ValueError(f"join_type {join_type}")
+    out_cnt = _out_cnt(cnt, real_l, join_type)
     unmatched_r = None
     if join_type == "full":
         sorted_lid = lax.sort([lid], num_keys=1)[0]
@@ -131,6 +122,68 @@ def _probe(lbatch: ColumnBatch, rbatch: ColumnBatch,
         e = jnp.searchsorted(sorted_lid, rid, side="right")
         unmatched_r = rbatch.row_mask() & (e == s)
     return start, cnt, rsort_perm, out_cnt, unmatched_r
+
+
+def build_prepare_fast(rbatch: ColumnBatch, rkey: int):
+    """Sort the build side ONCE by its (single, integral) key.
+
+    Returns ``(sorted_key, perm, nv)``: the build keys sorted ascending
+    with the ``nv`` valid entries first and every invalid/padding slot
+    rewritten to the dtype max so the array stays globally sorted (probe
+    ranges are clipped to ``nv``, which keeps genuine max-valued keys —
+    they live at positions < nv).  This is the streaming-join analog of
+    the reference's build-side hash table (GpuHashJoin build side,
+    GpuHashJoin.scala:193-249): built once, probed per stream batch with
+    no per-batch sort.
+    """
+    col = rbatch.columns[rkey]
+    valid = col.validity & rbatch.row_mask()
+    cr = rbatch.capacity
+    iota = jnp.arange(cr, dtype=jnp.int32)
+    flag = (~valid).astype(jnp.uint8)
+    _, skey, perm = lax.sort([flag, col.data, iota], num_keys=2,
+                             is_stable=True)
+    nv = jnp.sum(valid, dtype=jnp.int32)
+    maxv = jnp.iinfo(col.data.dtype).max
+    skey = jnp.where(iota < nv, skey, maxv)
+    return skey, perm, nv
+
+
+def probe_fast(lbatch: ColumnBatch, lkey: int, sorted_key, perm, nv,
+               join_type: str):
+    """Per-stream-batch probe against a prepared build side: two
+    searchsorted passes, zero sorts.  Same contract as the heavy phase of
+    :func:`join_probe` (without full-outer bookkeeping — streaming full
+    outer tracks matched build rows in the gather phase instead)."""
+    col = lbatch.columns[lkey]
+    lvalid = col.validity & lbatch.row_mask()
+    start = jnp.searchsorted(sorted_key, col.data, side="left").astype(jnp.int32)
+    end = jnp.searchsorted(sorted_key, col.data, side="right").astype(jnp.int32)
+    end = jnp.minimum(end, nv)
+    start = jnp.minimum(start, end)
+    cnt = jnp.where(lvalid, end - start, 0)
+    out_cnt = _out_cnt(cnt, lbatch.row_mask(), join_type)
+    total = jnp.sum(out_cnt, dtype=jnp.int64)
+    return (start, cnt, perm, out_cnt, None), total
+
+
+def _out_cnt(cnt, real_l, join_type):
+    if join_type == "inner":
+        return cnt
+    if join_type in ("left", "full"):
+        return jnp.where(real_l, jnp.maximum(cnt, 1), 0)
+    if join_type == "semi":
+        return jnp.where(real_l & (cnt > 0), 1, 0).astype(jnp.int32)
+    if join_type == "anti":
+        return jnp.where(real_l & (cnt == 0), 1, 0).astype(jnp.int32)
+    raise ValueError(f"join_type {join_type}")
+
+
+def matched_build_rows(ri, r_take, cr: int) -> jax.Array:
+    """bool[cr]: build rows referenced by matched output slots (streaming
+    full-outer bookkeeping, accumulated across stream batches)."""
+    slots = jnp.where(r_take, ri, cr)
+    return jnp.zeros(cr, jnp.bool_).at[slots].set(True, mode="drop")
 
 
 def join_probe(lbatch: ColumnBatch, rbatch: ColumnBatch,

@@ -55,7 +55,11 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         except Exception:
             pass  # knob name varies across jax versions
         _enabled_dir = cache_dir
-    except Exception:
+    except (OSError, AttributeError, ValueError) as e:
+        import warnings
+        warnings.warn(
+            f"persistent XLA compilation cache DISABLED ({e}); every "
+            "program will recompile per process", RuntimeWarning)
         return None
     return _enabled_dir
 
