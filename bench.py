@@ -34,7 +34,9 @@ MAX_SF = float(os.environ.get("BENCH_SF", "10"))
 DATA_DIR = os.environ.get("BENCH_DATA_DIR",
                           os.path.join(os.path.dirname(
                               os.path.abspath(__file__)), ".bench_data"))
-LADDER = [sf for sf in (0.01, 1.0, 10.0) if sf <= MAX_SF] or [0.01]
+# smoke rung is SF0.1, the smallest scale where q6 produces result rows —
+# a 0-row "device == oracle" comparison verifies nothing (round-2 verdict)
+LADDER = [sf for sf in (0.1, 1.0, 10.0) if sf <= MAX_SF] or [0.1]
 
 
 def _emit(value: float, sf: float, error: str | None = None,
@@ -75,6 +77,9 @@ def main() -> None:
                     break
                 if not r.get("ok", False):
                     state["error"] = f"sf{sf:g}: device != oracle"
+                    break
+                if r.get("rows", 0) <= 0:
+                    state["error"] = f"sf{sf:g}: query produced 0 rows"
                     break
                 state["best"] = (sf, r)
         except BaseException as e:  # noqa: BLE001 - reported via JSON line

@@ -181,13 +181,13 @@ class HashAggregateExec(PlanNode):
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child = self.children[0]
         if self.mode == "complete":
-            child_it = (b for cpid in range(child.num_partitions(ctx))
-                        for b in child.partition_iter(ctx, cpid))
+            from spark_rapids_tpu.exec.core import drain_partitions
+            child_it = drain_partitions(ctx, child)
         else:
             child_it = child.partition_iter(ctx, pid)
         key_idx = list(range(len(self._group_bound)))
         if ctx.is_device:
-            yield from self._run_device(child_it, key_idx)
+            yield from self._run_device(ctx, child_it, key_idx)
         else:
             yield from self._run_host(child_it, key_idx)
 
@@ -199,7 +199,7 @@ class HashAggregateExec(PlanNode):
     # is held at a fixed canonical capacity (shrunk back after each merge)
     # instead of walking pow2 buckets upward with the input size.
     def _jit_fns(self):
-        if not hasattr(self, "_update_jit"):
+        if not hasattr(self, "_jits"):
             key_idx = list(range(len(self._group_bound)))
 
             def update(b):
@@ -221,12 +221,13 @@ class HashAggregateExec(PlanNode):
                 return ColumnBatch(cols, run.num_rows, self._output_schema)
 
             import jax
-            self._update_jit = jax.jit(update)
-            self._merge_jit = jax.jit(merge)
-            self._final_jit = jax.jit(final)
-        return self._update_jit, self._merge_jit, self._final_jit
+            # single atomic publication: concurrent partition workers must
+            # never observe a partially-initialized triple
+            self._jits = (jax.jit(update), jax.jit(merge), jax.jit(final))
+        return self._jits
 
-    def _run_device(self, child_it, key_idx) -> Iterator[ColumnBatch]:
+    def _run_device(self, ctx: ExecCtx, child_it, key_idx) \
+            -> Iterator[ColumnBatch]:
         update_jit, merge_jit, final_jit = self._jit_fns()
         running: ColumnBatch | None = None
         target_cap = 0
@@ -234,22 +235,22 @@ class HashAggregateExec(PlanNode):
             if self.mode == "final":
                 part = _relabel_d(b, self._buffer_schema)
             else:
-                part = update_jit(b)
+                part = ctx.dispatch(update_jit, b)
             if running is None:
                 running = part
                 target_cap = part.capacity
                 continue
             target_cap = max(target_cap, part.capacity)
-            running = dk.pad_capacity(running, target_cap)
-            part = dk.pad_capacity(part, target_cap)
-            merged = merge_jit(running, part)
+            running = ctx.dispatch(dk.pad_capacity, running, target_cap)
+            part = ctx.dispatch(dk.pad_capacity, part, target_cap)
+            merged = ctx.dispatch(merge_jit, running, part)
             # shrink back to the canonical capacity; num_groups is
             # materialized host-side to keep the shrink sound (the only
             # per-batch sync, and it doubles as backpressure)
             ng = merged.host_num_rows()
             while target_cap < ng:
                 target_cap <<= 1
-            running = dk.shrink_capacity(merged, target_cap)
+            running = ctx.dispatch(dk.shrink_capacity, merged, target_cap)
         if running is None:
             if key_idx or self.mode == "partial":
                 return  # no groups / nothing to emit
@@ -264,7 +265,7 @@ class HashAggregateExec(PlanNode):
         if self.mode == "partial":
             yield running
         else:
-            yield final_jit(running)
+            yield ctx.dispatch(final_jit, running)
 
     # -- host oracle path --------------------------------------------------
     def _run_host(self, child_it, key_idx) -> Iterator[HostBatch]:

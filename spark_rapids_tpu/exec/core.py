@@ -19,19 +19,28 @@ numOutputBatches, totalTime per operator.
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Iterator, Sequence
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.conf import ConfEntry, TpuConf, register
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.host.batch import HostBatch
 
 __all__ = [
     "ExecCtx", "PlanNode", "CoalesceGoal", "TargetSize", "RequireSingleBatch",
     "collect", "collect_host", "collect_device", "Metrics",
+    "drain_partitions",
 ]
+
+CONCURRENT_TASKS = register(ConfEntry(
+    "spark.rapids.sql.concurrentTpuTasks", 2,
+    "Concurrent tasks allowed to occupy the chip (reference "
+    "spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:351). "
+    "Partitions execute on a worker pool bounded by this semaphore.",
+    conv=int))
 
 
 # ---------------------------------------------------------------------------
@@ -85,7 +94,13 @@ class Metrics:
 
 @dataclass
 class ExecCtx:
-    """Execution context: backend selection + conf + metrics sink."""
+    """Execution context: backend + conf + metrics + device runtime.
+
+    The runtime members are the execution-side wiring of the memory
+    subsystem (reference RapidsExecutorPlugin.init, Plugin.scala:124-154):
+    a shared BufferCatalog (spill tiers), a DeviceSemaphore bounding chip
+    occupancy, and a worker pool draining partitions concurrently.
+    """
 
     backend: str = "device"          # "device" | "host"
     conf: TpuConf = field(default_factory=lambda: TpuConf({}))
@@ -93,16 +108,97 @@ class ExecCtx:
     # per-run stage cache: exchanges materialize their shuffle output here
     # once per execution (reference: shuffle files / ShuffleBufferCatalog)
     cache: dict = field(default_factory=dict)
+    _lock: threading.RLock = field(default_factory=threading.RLock)
+    _inflight: dict = field(default_factory=dict)
 
     def metrics_for(self, node: "PlanNode") -> Metrics:
         key = f"{type(node).__name__}@{id(node):x}"
-        if key not in self.metrics:
-            self.metrics[key] = Metrics()
-        return self.metrics[key]
+        with self._lock:
+            if key not in self.metrics:
+                self.metrics[key] = Metrics()
+            return self.metrics[key]
 
     @property
     def is_device(self) -> bool:
         return self.backend == "device"
+
+    # -- device runtime ----------------------------------------------------
+    @property
+    def task_concurrency(self) -> int:
+        return max(1, self.conf.get(CONCURRENT_TASKS))
+
+    @property
+    def catalog(self):
+        with self._lock:
+            if "catalog" not in self.cache:
+                from spark_rapids_tpu.memory.catalog import BufferCatalog
+                self.cache["catalog"] = BufferCatalog(conf=self.conf)
+            return self.cache["catalog"]
+
+    @property
+    def semaphore(self):
+        with self._lock:
+            if "semaphore" not in self.cache:
+                from spark_rapids_tpu.memory.catalog import DeviceSemaphore
+                self.cache["semaphore"] = DeviceSemaphore(
+                    self.task_concurrency)
+            return self.cache["semaphore"]
+
+    def dispatch(self, fn, *args, **kwargs):
+        """Run a heavy device program under (a) the DeviceSemaphore
+        bounding chip occupancy (reference GpuSemaphore.acquireIfNecessary
+        — acquired at the dispatch chokepoint, never while blocking on
+        other tasks, so nested partition drains cannot deadlock) and
+        (b) the OOM-spill-retry hook (DeviceMemoryEventHandler loop)."""
+        if not self.is_device:
+            return fn(*args, **kwargs)
+        from spark_rapids_tpu.memory.catalog import run_with_spill_retry
+        with self.semaphore:
+            return run_with_spill_retry(fn, self.catalog, *args, **kwargs)
+
+    def close(self) -> None:
+        """End-of-execution cleanup: release the BufferCatalog (spilled
+        disk files, host arena) if one was created."""
+        with self._lock:
+            catalog = self.cache.pop("catalog", None)
+        if catalog is not None:
+            catalog.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def cached(self, key, factory):
+        """Thread-safe once-per-execution materialization (exchange /
+        broadcast / join-build stage cache).  Exactly one caller runs
+        ``factory``; concurrent callers block until it completes."""
+        with self._lock:
+            if key in self.cache:
+                return self.cache[key]
+            ev = self._inflight.get(key)
+            if ev is None:
+                ev = self._inflight[key] = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if owner:
+            try:
+                val = factory()
+                with self._lock:
+                    self.cache[key] = val
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                ev.set()
+            return val
+        ev.wait()
+        with self._lock:
+            if key in self.cache:
+                return self.cache[key]
+        raise RuntimeError(f"stage materialization failed for {key!r} "
+                           "in another task")
 
 
 # ---------------------------------------------------------------------------
@@ -145,9 +241,10 @@ class PlanNode:
     # -- execution helpers -------------------------------------------------
     def execute(self, ctx: ExecCtx) -> Iterator:
         """All partitions' batches, in partition order, with output
-        metrics recorded for this (root) node."""
-        for pid in range(self.num_partitions(ctx)):
-            yield from self.timed_iter(ctx, self.partition_iter(ctx, pid))
+        metrics recorded for this (root) node.  On the device backend
+        partitions run concurrently on a worker pool (reference: Spark's
+        task scheduler running doExecuteColumnar RDD partitions)."""
+        yield from self.timed_iter(ctx, drain_partitions(ctx, self))
 
     def timed_iter(self, ctx: ExecCtx, it: Iterator) -> Iterator:
         """Wrap an iterator with totalTime / output metrics."""
@@ -174,6 +271,62 @@ class PlanNode:
 
 
 # ---------------------------------------------------------------------------
+# Concurrent partition drain
+# ---------------------------------------------------------------------------
+
+def drain_partitions(ctx: ExecCtx, node: PlanNode) -> Iterator:
+    """Yield every partition's batches in partition order.
+
+    Device backend with >1 partitions: partitions are drained concurrently
+    by a worker pool; each worker holds the DeviceSemaphore while pulling a
+    batch (chip-occupancy bound, reference GpuSemaphore.acquireIfNecessary,
+    GpuSemaphore.scala:74-126) and parks finished batches in the
+    BufferCatalog as spillable buffers (priority READ_SHUFFLE) so completed
+    partitions don't pin HBM while earlier partitions are still being
+    consumed (reference RapidsCachingWriter storing map output spillable,
+    RapidsShuffleInternalManager.scala:90-155).
+    """
+    n = node.num_partitions(ctx)
+    workers = min(ctx.task_concurrency, n) if ctx.is_device else 1
+    if workers <= 1 or n <= 1:
+        for pid in range(n):
+            yield from node.partition_iter(ctx, pid)
+        return
+
+    import concurrent.futures as cf
+    from spark_rapids_tpu.memory.catalog import (SpillableColumnarBatch,
+                                                 SpillPriority)
+    catalog = ctx.catalog
+
+    def drain(pid: int):
+        # chip occupancy is bounded inside ctx.dispatch, not here: holding
+        # the semaphore across a next() that may itself drain partitions
+        # (join build sides, nested exchanges) would deadlock
+        return [SpillableColumnarBatch(b, catalog, SpillPriority.READ_SHUFFLE)
+                for b in node.partition_iter(ctx, pid)]
+
+    with cf.ThreadPoolExecutor(max_workers=workers,
+                               thread_name_prefix="tpu-task") as pool:
+        futures = [pool.submit(drain, pid) for pid in range(n)]
+        try:
+            for fut in futures:
+                for sb in fut.result():
+                    yield sb.get()
+                    sb.close()
+        finally:
+            # early consumer exit / error: release every still-registered
+            # buffer (close is idempotent; unconsumed = leaked otherwise)
+            for fut in futures:
+                if fut.cancel():
+                    continue
+                try:
+                    for sb in fut.result():
+                        sb.close()
+                except BaseException:
+                    pass
+
+
+# ---------------------------------------------------------------------------
 # Collect surface
 # ---------------------------------------------------------------------------
 
@@ -184,21 +337,21 @@ def _rows_from_host(b: HostBatch) -> list[tuple]:
 
 def collect_host(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
     """Run on the CPU oracle; rows as python tuples."""
-    ctx = ExecCtx(backend="host", conf=conf or TpuConf({}))
-    out: list[tuple] = []
-    for b in plan.execute(ctx):
-        out.extend(_rows_from_host(b))
-    return out
+    with ExecCtx(backend="host", conf=conf or TpuConf({})) as ctx:
+        out: list[tuple] = []
+        for b in plan.execute(ctx):
+            out.extend(_rows_from_host(b))
+        return out
 
 
 def collect_device(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
     """Run on the TPU path; rows as python tuples (D2H at the end only)."""
-    ctx = ExecCtx(backend="device", conf=conf or TpuConf({}))
-    out: list[tuple] = []
-    for b in plan.execute(ctx):
-        hb = device_to_host(b)
-        out.extend(_rows_from_host(hb))
-    return out
+    with ExecCtx(backend="device", conf=conf or TpuConf({})) as ctx:
+        out: list[tuple] = []
+        for b in plan.execute(ctx):
+            hb = device_to_host(b)
+            out.extend(_rows_from_host(hb))
+        return out
 
 
 def collect(plan: PlanNode, backend: str = "device",

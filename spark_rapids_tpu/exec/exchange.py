@@ -80,31 +80,42 @@ class ShuffleExchangeExec(PlanNode):
         return self.partitioning.num_partitions
 
     def _shuffled(self, ctx: ExecCtx):
-        key = ("shuffle", id(self), ctx.backend)
-        if key in ctx.cache:
-            return ctx.cache[key]
+        return ctx.cached(("shuffle", id(self), ctx.backend),
+                          lambda: self._do_shuffle(ctx))
+
+    def _do_shuffle(self, ctx: ExecCtx):
+        """Materialize the map side.  Device-backend output partitions are
+        parked in the BufferCatalog as spillable buffers with
+        SHUFFLE_OUTPUT priority — spilled first under memory pressure —
+        instead of pinning raw HBM (reference RapidsCachingWriter.write,
+        RapidsShuffleInternalManager.scala:90-155)."""
+        from spark_rapids_tpu.exec.core import drain_partitions
         child = self.children[0]
-        batches = []
-        for pid in range(child.num_partitions(ctx)):
-            batches.extend(child.partition_iter(ctx, pid))
+        batches = list(drain_partitions(ctx, child))
         self.partitioning.prepare(batches, ctx.is_device)
         n = self.partitioning.num_partitions
         out: list[list] = [[] for _ in range(n)]
-        for bi, b in enumerate(batches):
-            if ctx.is_device:
-                from spark_rapids_tpu.columnar.batch import round_capacity
+        if ctx.is_device:
+            from spark_rapids_tpu.columnar.batch import round_capacity
+            from spark_rapids_tpu.memory.catalog import (
+                SpillableColumnarBatch, SpillPriority)
+            catalog = ctx.catalog
+            for bi, b in enumerate(batches):
                 ids = self.partitioning.device_ids(b, bi)
-                sb, counts_d = _jit_group_by_part(b, ids, n)
+                sb, counts_d = ctx.dispatch(_jit_group_by_part, b, ids, n)
                 counts = np.asarray(jax.device_get(counts_d))
                 starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
                 for p in range(n):
                     if counts[p] == 0:
                         continue
-                    out[p].append(_jit_slice_part(
-                        sb, jnp.asarray(starts[p], jnp.int32),
+                    piece = ctx.dispatch(
+                        _jit_slice_part, sb, jnp.asarray(starts[p], jnp.int32),
                         jnp.asarray(counts[p], jnp.int32),
-                        round_capacity(int(counts[p]))))
-            else:
+                        round_capacity(int(counts[p])))
+                    out[p].append(SpillableColumnarBatch(
+                        piece, catalog, SpillPriority.SHUFFLE_OUTPUT))
+        else:
+            for bi, b in enumerate(batches):
                 if b.num_rows == 0:
                     continue
                 ids = self.partitioning.host_ids(b, bi)
@@ -112,11 +123,19 @@ class ShuffleExchangeExec(PlanNode):
                     piece = hk.host_filter(b, ids == p)
                     if piece.num_rows:
                         out[p].append(piece)
-        ctx.cache[key] = out
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        yield from self._shuffled(ctx)[pid]
+        for item in self._shuffled(ctx)[pid]:
+            if ctx.is_device:
+                b = item.get()
+                yield b
+                # unpin (re-spillable) rather than close: shuffle output
+                # stays re-readable for the execution's lifetime and is
+                # reclaimed when the ExecCtx closes its catalog
+                item.unpin()
+            else:
+                yield item
 
     def node_desc(self) -> str:
         return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"
@@ -140,13 +159,13 @@ class BroadcastExchangeExec(PlanNode):
         return 1
 
     def materialize(self, ctx: ExecCtx):
-        key = ("broadcast", id(self), ctx.backend)
-        if key in ctx.cache:
-            return ctx.cache[key]
+        return ctx.cached(("broadcast", id(self), ctx.backend),
+                          lambda: self._materialize(ctx))
+
+    def _materialize(self, ctx: ExecCtx):
+        from spark_rapids_tpu.exec.core import drain_partitions
         child = self.children[0]
-        batches = []
-        for pid in range(child.num_partitions(ctx)):
-            batches.extend(child.partition_iter(ctx, pid))
+        batches = list(drain_partitions(ctx, child))
         if ctx.is_device:
             if not batches:
                 from spark_rapids_tpu.exec.core import host_to_device
@@ -157,7 +176,6 @@ class BroadcastExchangeExec(PlanNode):
         else:
             b = hk.host_concat(batches) if batches \
                 else HostBatch.empty(child.output_schema)
-        ctx.cache[key] = b
         return b
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:

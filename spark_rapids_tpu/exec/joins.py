@@ -180,10 +180,9 @@ class JoinExec(PlanNode):
         return HostBatch(cols, T.Schema(fields)), tuple(idx)
 
     def _materialize(self, ctx: ExecCtx, which: int):
-        batches = []
+        from spark_rapids_tpu.exec.core import drain_partitions
         child = self.children[which]
-        for pid in range(child.num_partitions(ctx)):
-            batches.extend(child.partition_iter(ctx, pid))
+        batches = list(drain_partitions(ctx, child))
         if ctx.is_device:
             if not batches:
                 from spark_rapids_tpu.exec.core import host_to_device
@@ -198,10 +197,8 @@ class JoinExec(PlanNode):
         if ctx.is_device:
             yield from self._run_device_stream(ctx, pid)
         else:
-            key = (id(self), "host_build")
-            if key not in ctx.cache:
-                ctx.cache[key] = self._materialize(ctx, 1)
-            rb = ctx.cache[key]
+            rb = ctx.cached((id(self), "host_build"),
+                            lambda: self._materialize(ctx, 1))
             child = self.children[0]
             pids = range(child.num_partitions(ctx)) \
                 if self.join_type == "full" else [pid]
@@ -225,15 +222,14 @@ class JoinExec(PlanNode):
                 and type(lt) is type(rt))
 
     def _build_device(self, ctx: ExecCtx):
-        key = (id(self), "build")
-        if key not in ctx.cache:
+        def build():
             rb = self._materialize(ctx, 1)
             rb2, rkeys = self._augment_device(rb, self._rkeys_b)
             prep = _jit_build_prep(rb2, rkeys[0]) \
                 if self.join_type != "cross" and self._use_fast_path() \
                 else None
-            ctx.cache[key] = (rb2, rkeys, prep)
-        return ctx.cache[key]
+            return rb2, rkeys, prep
+        return ctx.cached((id(self), "build"), build)
 
     def _run_device_stream(self, ctx: ExecCtx, pid: int):
         rb2, rkeys, prep = self._build_device(ctx)
@@ -250,11 +246,11 @@ class JoinExec(PlanNode):
             for lb in child.partition_iter(ctx, lpid):
                 lb2, lkeys = self._augment_device(lb, self._lkeys_b)
                 if prep is not None:
-                    probe_arrays, total_dev = _jit_probe_fast(
-                        lb2, prep, lkeys[0], stream_jt)
+                    probe_arrays, total_dev = ctx.dispatch(
+                        _jit_probe_fast, lb2, prep, lkeys[0], stream_jt)
                 else:
-                    probe_arrays, total_dev = _jit_probe(
-                        lb2, rb2, lkeys, rkeys, stream_jt)
+                    probe_arrays, total_dev = ctx.dispatch(
+                        _jit_probe, lb2, rb2, lkeys, rkeys, stream_jt)
                 total = int(jax.device_get(total_dev))
                 if total == 0:
                     if jt == "full" and matched is None:
@@ -262,15 +258,15 @@ class JoinExec(PlanNode):
                     continue
                 out_cap = round_capacity(max(total, 1))
                 if jt == "full":
-                    out, bm = _jit_gather(
-                        lb2, rb2, probe_arrays, lb2.capacity, stream_jt,
-                        out_cap, self.include_right, kf_schema,
+                    out, bm = ctx.dispatch(
+                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                        stream_jt, out_cap, self.include_right, kf_schema,
                         track_matched=True)
                     matched = bm if matched is None else matched | bm
                 else:
-                    out = _jit_gather(
-                        lb2, rb2, probe_arrays, lb2.capacity, stream_jt,
-                        out_cap, self.include_right, kf_schema)
+                    out = ctx.dispatch(
+                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                        stream_jt, out_cap, self.include_right, kf_schema)
                 out = self._project_out(
                     out, lb.num_columns, lb2.num_columns, n_right_raw,
                     device=True)

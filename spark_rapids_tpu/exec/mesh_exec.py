@@ -162,12 +162,12 @@ class MeshAggregateExec(PlanNode):
         return fn
 
     def _outputs(self, ctx: ExecCtx):
-        key = ("meshagg", id(self), ctx.backend)
-        if key in ctx.cache:
-            return ctx.cache[key]
-        child = self.children[0]
-        batches = [b for pid in range(child.num_partitions(ctx))
-                   for b in child.partition_iter(ctx, pid)]
+        return ctx.cached(("meshagg", id(self), ctx.backend),
+                          lambda: self._compute_outputs(ctx))
+
+    def _compute_outputs(self, ctx: ExecCtx):
+        from spark_rapids_tpu.exec.core import drain_partitions
+        batches = list(drain_partitions(ctx, self.children[0]))
         mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
         if mesh is None or not batches:
             out = [list(self._complete_exec().partition_iter(ctx, 0))]
@@ -177,7 +177,6 @@ class MeshAggregateExec(PlanNode):
             stacked = shard_batches(shards, mesh, self.axis_name)
             result = self._program(mesh)(stacked)
             out = [[b] for b in unshard_batch(result)]
-        ctx.cache[key] = out
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
@@ -254,18 +253,16 @@ class MeshExchangeExec(PlanNode):
         return fn
 
     def _outputs(self, ctx: ExecCtx):
-        key = ("meshex", id(self), ctx.backend)
-        if key in ctx.cache:
-            return ctx.cache[key]
-        child = self.children[0]
+        return ctx.cached(("meshex", id(self), ctx.backend),
+                          lambda: self._compute_outputs(ctx))
+
+    def _compute_outputs(self, ctx: ExecCtx):
+        from spark_rapids_tpu.exec.core import drain_partitions
         if not ctx.is_device:
             he = self._host_exchange()
-            out = [list(he.partition_iter(ctx, pid))
-                   for pid in range(self.mesh_size)]
-            ctx.cache[key] = out
-            return out
-        batches = [b for pid in range(child.num_partitions(ctx))
-                   for b in child.partition_iter(ctx, pid)]
+            return [list(he.partition_iter(ctx, pid))
+                    for pid in range(self.mesh_size)]
+        batches = list(drain_partitions(ctx, self.children[0]))
         mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
         if mesh is None or not batches:
             he = self._host_exchange()
@@ -276,7 +273,6 @@ class MeshExchangeExec(PlanNode):
             stacked = shard_batches(shards, mesh, self.axis_name)
             result = self._program(mesh)(stacked)
             out = [[b] for b in unshard_batch(result)]
-        ctx.cache[key] = out
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:

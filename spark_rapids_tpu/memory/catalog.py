@@ -75,20 +75,37 @@ class BufferCatalog:
     def __init__(self, device_limit: int | None = None,
                  host_limit: int | None = None,
                  spill_dir: str | None = None, conf=None):
-        from spark_rapids_tpu.native import HostArena
         settings = getattr(conf, "settings", {}) if conf is not None else {}
         self._lock = threading.RLock()
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
         self.device_limit = device_limit or DEVICE_SPILL_LIMIT.get(settings)
         self.device_used = 0
-        self._arena = HostArena(host_limit or HOST_SPILL_LIMIT.get(settings))
-        self._spill_dir = spill_dir or os.path.join(
-            os.environ.get("TMPDIR", "/tmp"), f"srt_spill_{os.getpid()}")
-        os.makedirs(self._spill_dir, exist_ok=True)
+        # the C++ arena maps its full capacity up front (~0.3s for 1GB),
+        # so it is created on FIRST SPILL, not per catalog/query
+        self._host_limit = host_limit or HOST_SPILL_LIMIT.get(settings)
+        self._arena_obj = None
+        self._spill_dir_base = spill_dir
+        self._spill_dir_made: str | None = None
         self.metrics = {"device_spills": 0, "host_spills": 0,
                         "bytes_spilled_to_host": 0,
                         "bytes_spilled_to_disk": 0}
+
+    @property
+    def _arena(self):
+        if self._arena_obj is None:
+            from spark_rapids_tpu.native import HostArena
+            self._arena_obj = HostArena(self._host_limit)
+        return self._arena_obj
+
+    @property
+    def _spill_dir(self) -> str:
+        if self._spill_dir_made is None:
+            d = self._spill_dir_base or os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), f"srt_spill_{os.getpid()}")
+            os.makedirs(d, exist_ok=True)
+            self._spill_dir_made = d
+        return self._spill_dir_made
 
     # -- registration --------------------------------------------------
     def add_batch(self, batch: ColumnBatch, priority: int) -> int:
@@ -279,7 +296,9 @@ class BufferCatalog:
             for e in list(self._entries.values()):
                 self._drop_storage_locked(e)
             self._entries.clear()
-            self._arena.close()
+            if self._arena_obj is not None:
+                self._arena_obj.close()
+                self._arena_obj = None
 
 
 def _align(n: int) -> int:
@@ -348,13 +367,14 @@ class DeviceSemaphore:
 
 
 def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
-                         max_retries: int = 3, spill_bytes: int | None = None):
-    """Dispatch ``fn(*args)``; on XLA OOM spill from the catalog and
-    retry (the DeviceMemoryEventHandler.onAllocFailure loop)."""
+                         max_retries: int = 3, spill_bytes: int | None = None,
+                         **kwargs):
+    """Dispatch ``fn(*args, **kwargs)``; on XLA OOM spill from the catalog
+    and retry (the DeviceMemoryEventHandler.onAllocFailure loop)."""
     attempt = 0
     while True:
         try:
-            out = fn(*args)
+            out = fn(*args, **kwargs)
             jax.block_until_ready(jax.tree_util.tree_leaves(out))
             return out
         except (RuntimeError, jax.errors.JaxRuntimeError) as ex:

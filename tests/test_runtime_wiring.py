@@ -1,0 +1,128 @@
+"""Memory runtime + task parallelism wired into execution.
+
+Round-3 verdict item 2: shuffle outputs must live in the BufferCatalog as
+spillable buffers (not raw HBM lists), partitions must execute
+concurrently under the DeviceSemaphore, and a query over data larger than
+the device spill budget must pass by spilling (reference
+RapidsCachingWriter + DeviceMemoryEventHandler + GpuSemaphore).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.basic import LocalScanExec, ProjectExec
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, device_to_host
+from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.exec.partitioning import HashPartitioning
+from spark_rapids_tpu.expr.core import col
+
+
+def _scan(n=1000, partitions=4, rows_per_batch=None):
+    data = {"k": list(range(n)), "v": [float(i) for i in range(n)]}
+    schema = T.Schema([T.StructField("k", T.LongType()),
+                       T.StructField("v", T.DoubleType())])
+    return LocalScanExec.from_pydict(data, schema, partitions,
+                                     rows_per_batch or (n // partitions))
+
+
+def _rows(plan, ctx):
+    out = []
+    for b in plan.execute(ctx):
+        hb = device_to_host(b) if ctx.is_device else b
+        cols = [c.to_list() for c in hb.columns]
+        out.extend(zip(*cols))
+    return sorted(out)
+
+
+def test_shuffle_output_spills_and_restores():
+    """Shuffle map output larger than a tiny device budget spills to the
+    host arena and is restored on read; results stay correct."""
+    plan = ShuffleExchangeExec(HashPartitioning([col("k")], 3), _scan())
+    conf = TpuConf({"spark.rapids.memory.tpu.spillStoreSize": 1 << 10})
+    ctx = ExecCtx(backend="device", conf=conf)
+    rows = _rows(plan, ctx)
+    catalog = ctx.cache["catalog"]
+    assert catalog.metrics["device_spills"] > 0, \
+        "tiny budget must force shuffle-output spills"
+    host_ctx = ExecCtx(backend="host")
+    assert rows == _rows(plan, host_ctx)
+
+
+def test_spill_survives_disk_tier():
+    """Host arena too small as well -> buffers continue to disk."""
+    plan = ShuffleExchangeExec(HashPartitioning([col("k")], 3),
+                               _scan(n=4000))
+    conf = TpuConf({"spark.rapids.memory.tpu.spillStoreSize": 1 << 10,
+                    "spark.rapids.memory.host.spillStorageSize": 1 << 12})
+    ctx = ExecCtx(backend="device", conf=conf)
+    rows = _rows(plan, ctx)
+    catalog = ctx.cache["catalog"]
+    assert catalog.metrics["bytes_spilled_to_disk"] > 0
+    assert rows == _rows(plan, ExecCtx(backend="host"))
+
+
+class _SlowScan(LocalScanExec):
+    """Leaf that sleeps per partition: measures drain concurrency."""
+
+    def __init__(self, delay, *a, **kw):
+        super().__init__(*a, **kw)
+        self._delay = delay
+
+    def partition_iter(self, ctx, pid):
+        time.sleep(self._delay)
+        yield from super().partition_iter(ctx, pid)
+
+
+def _slow_plan(delay=0.25, partitions=4):
+    data = {"k": list(range(64)), "v": [float(i) for i in range(64)]}
+    schema = T.Schema([T.StructField("k", T.LongType()),
+                       T.StructField("v", T.DoubleType())])
+    cols = [c for c in schema]
+    base = LocalScanExec.from_pydict(data, schema, partitions, 16)
+    slow = _SlowScan(delay, base._batches, schema, partitions)
+    return ProjectExec([col("k"), (col("v") * col("v")).alias("v2")], slow)
+
+
+def test_concurrent_partition_drain_speedup():
+    plan = _slow_plan()
+    seq_conf = TpuConf({"spark.rapids.sql.concurrentTpuTasks": 1})
+    par_conf = TpuConf({"spark.rapids.sql.concurrentTpuTasks": 4})
+    # warm compile caches first so timing measures the drain, not XLA
+    _rows(plan, ExecCtx(backend="device", conf=par_conf))
+    t0 = time.perf_counter()
+    seq_rows = _rows(plan, ExecCtx(backend="device", conf=seq_conf))
+    seq_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    par_rows = _rows(plan, ExecCtx(backend="device", conf=par_conf))
+    par_t = time.perf_counter() - t0
+    assert par_rows == seq_rows
+    assert par_t < seq_t / 1.8, (seq_t, par_t)
+
+
+def test_dispatch_concurrency_semaphore_bound():
+    """The semaphore caps simultaneous dispatches at the conf value."""
+    import threading
+    conf = TpuConf({"spark.rapids.sql.concurrentTpuTasks": 2})
+    ctx = ExecCtx(backend="device", conf=conf)
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def probe():
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.05)
+        with lock:
+            active[0] -= 1
+        return 0
+
+    threads = [threading.Thread(target=lambda: ctx.dispatch(probe))
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert peak[0] <= 2
