@@ -1,0 +1,237 @@
+"""Generate exec: explode / posexplode of split-string arrays.
+
+Reference: GpuGenerateExec (GpuGenerateExec.scala:101) — per input row a
+generator emits 0..n output rows; the child columns are repeated per
+generated row, optionally with a position column, and ``outer`` keeps
+rows whose generator yields nothing (null-extended).
+
+The engine's columnar layer has no standalone array column type (scans
+produce scalars + strings), so the canonical array producer here is
+``split(string, delimiter)`` — the generator is fused: ``SplitExplode``
+splits and explodes in one device program.  TPU design: delimiter
+positions come from a cumulative-sum over the padded byte matrix, output
+row -> (source row, piece index) via the same offsets/searchsorted plan
+as the join gather, and piece bytes are sliced with take_along_axis —
+all static shapes, one host sync for the output total.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.expr.core import Expression, bind, eval_device, \
+    eval_host
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+
+__all__ = ["GenerateExec", "SplitExplode"]
+
+
+class SplitExplode(Expression):
+    """Generator: explode(split(child, delimiter)) (single-byte delim)."""
+
+    sql_name = "SplitExplode"
+
+    def __init__(self, child: Expression, delimiter: str):
+        assert len(delimiter.encode("utf-8")) == 1, \
+            "SplitExplode supports single-byte delimiters"
+        self.children = [child]
+        self.delimiter = delimiter
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def nullable(self):
+        return True
+
+    def with_new_children(self, children):
+        return SplitExplode(children[0], self.delimiter)
+
+    def __repr__(self):
+        return f"SplitExplode({self.children[0]!r}, {self.delimiter!r})"
+
+
+@partial(jax.jit, static_argnames=())
+def _jit_counts(col: DeviceColumn, real: jax.Array, delim: int):
+    """Per-row piece counts (0 for null/padding rows) + total."""
+    w = col.max_len
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    is_d = (col.data == jnp.uint8(delim)) & (pos < col.lengths[:, None])
+    counts = jnp.where(col.validity & real,
+                       jnp.sum(is_d, axis=1, dtype=jnp.int32) + 1, 0)
+    return counts, jnp.sum(counts, dtype=jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("out_cap", "pos_col", "outer"))
+def _jit_generate(batch: ColumnBatch, col: DeviceColumn, counts, delim: int,
+                  out_cap: int, pos_col: bool, outer: bool):
+    """Build the generated batch: child columns gathered per output row +
+    [pos] + piece string column."""
+    cap = batch.capacity
+    w = col.max_len
+    real = batch.row_mask()
+    emit = jnp.maximum(counts, 1) if outer else counts
+    emit = jnp.where(real, emit, 0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(emit)[:-1].astype(jnp.int32)])
+    total = jnp.sum(emit, dtype=jnp.int32)
+
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    in_range = j < total
+    src = (jnp.searchsorted(offsets, j, side="right") - 1).astype(jnp.int32)
+    src = jnp.clip(src, 0, cap - 1)
+    k = j - offsets[src]                       # piece index within the row
+    has_piece = in_range & (k < counts[src])   # outer null-extension rows
+
+    # delimiter cumulative counts per source row
+    posw = jnp.arange(w, dtype=jnp.int32)[None, :]
+    is_d = (col.data == jnp.uint8(delim)) & (posw < col.lengths[:, None])
+    cum = jnp.cumsum(is_d, axis=1)             # [cap, w]
+    src_cum = cum[src]                         # [out_cap, w]
+    # k-th delimiter position = first index with cum == k
+    start = jnp.where(k > 0,
+                      _first_ge(src_cum, k) + 1, 0)
+    end = _first_ge(src_cum, k + 1)
+    end = jnp.minimum(end, col.lengths[src])
+    start = jnp.minimum(start, end)
+    plen = (end - start).astype(jnp.int32)
+
+    take = start[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    take = jnp.clip(take, 0, w - 1)
+    bytes_out = jnp.take_along_axis(col.data[src], take, axis=1)
+    mask = jnp.arange(w, dtype=jnp.int32)[None, :] < plen[:, None]
+    validity = has_piece
+    bytes_out = jnp.where(mask & validity[:, None], bytes_out, 0)
+    piece = DeviceColumn(bytes_out, validity, T.StringType(),
+                         jnp.where(validity, plen, 0))
+
+    out_cols = []
+    for c in batch.columns:
+        v = c.validity[src] & in_range
+        if c.is_string:
+            out_cols.append(DeviceColumn(
+                jnp.where(v[:, None], c.data[src], 0), v, c.dtype,
+                jnp.where(v, c.lengths[src], 0)))
+        else:
+            out_cols.append(DeviceColumn(
+                jnp.where(v, c.data[src], jnp.zeros((), c.data.dtype)),
+                v, c.dtype))
+    if pos_col:
+        pv = in_range & has_piece
+        out_cols.append(DeviceColumn(
+            jnp.where(pv, k.astype(jnp.int32), 0), pv, T.IntegerType()))
+    out_cols.append(piece)
+    return out_cols, total
+
+
+def _first_ge(cum: jax.Array, k) -> jax.Array:
+    """Per output row: first column index where cum >= k (w if none)."""
+    w = cum.shape[1]
+    kk = k[:, None] if jnp.ndim(k) == 1 else k
+    hit = cum >= kk
+    idx = jnp.where(hit, jnp.arange(w, dtype=jnp.int32)[None, :], w)
+    return jnp.min(idx, axis=1).astype(jnp.int32)
+
+
+class GenerateExec(PlanNode):
+    """explode/posexplode of a SplitExplode generator, child columns
+    repeated per generated row (reference GpuGenerateExec.scala:101)."""
+
+    def __init__(self, generator: Expression, child: PlanNode,
+                 outer: bool = False, pos: bool = False,
+                 output_names=("col",)):
+        super().__init__([child])
+        assert isinstance(generator, SplitExplode), \
+            "only SplitExplode generators are supported"
+        self.generator = generator
+        self.outer = outer
+        self.pos = pos
+        self._gen_bound = bind(generator.children[0], child.output_schema)
+        assert isinstance(self._gen_bound.dtype, T.StringType), \
+            "SplitExplode input must be a string"
+        names = list(output_names)
+        fields = list(child.output_schema.fields)
+        if pos:
+            fields.append(T.StructField(
+                names[0] if len(names) > 1 else "pos", T.IntegerType(), True))
+        fields.append(T.StructField(names[-1], T.StringType(), True))
+        self._schema = T.Schema(fields)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        child_it = self.children[0].partition_iter(ctx, pid)
+        delim = self.generator.delimiter.encode("utf-8")[0]
+        if ctx.is_device:
+            for b in child_it:
+                gcol = self._eval_jit()(b)
+                real = b.row_mask()
+                counts, total_d = _jit_counts(gcol, real, delim)
+                if self.outer:
+                    total = int(jax.device_get(
+                        jnp.sum(jnp.where(real, jnp.maximum(counts, 1), 0),
+                                dtype=jnp.int64)))
+                else:
+                    total = int(jax.device_get(total_d))
+                if total == 0:
+                    continue
+                out_cap = round_capacity(total)
+                cols, tot = ctx.dispatch(
+                    _jit_generate, b, gcol, counts, delim, out_cap,
+                    self.pos, self.outer)
+                yield ColumnBatch(cols, tot, self._schema)
+        else:
+            for b in child_it:
+                yield self._host_generate(b)
+
+    def _eval_jit(self):
+        if not hasattr(self, "_gen_jit"):
+            self._gen_jit = jax.jit(lambda b: eval_device(self._gen_bound, b))
+        return self._gen_jit
+
+    def _host_generate(self, b: HostBatch) -> HostBatch:
+        gv = eval_host(self._gen_bound, b)
+        src_idx, poss, pieces = [], [], []
+        for i in range(b.num_rows):
+            if not gv.validity[i]:
+                if self.outer:
+                    src_idx.append(i)
+                    poss.append(None)
+                    pieces.append(None)
+                continue
+            parts = str(gv.data[i]).split(self.generator.delimiter)
+            for k, p in enumerate(parts):
+                src_idx.append(i)
+                poss.append(k)
+                pieces.append(p)
+        cols = []
+        idx = np.asarray(src_idx, dtype=np.int64)
+        for c in b.columns:
+            cols.append(HostColumn(c.data[idx] if len(idx) else
+                                   c.data[:0], c.validity[idx] if len(idx)
+                                   else c.validity[:0], c.dtype))
+        if self.pos:
+            pv = np.asarray([p is not None for p in poss], np.bool_)
+            pd = np.asarray([0 if p is None else p for p in poss], np.int32)
+            cols.append(HostColumn(pd, pv, T.IntegerType()))
+        sv = np.asarray([p is not None for p in pieces], np.bool_)
+        sd = np.empty(len(pieces), dtype=object)
+        for i, p in enumerate(pieces):
+            sd[i] = p
+        cols.append(HostColumn(sd, sv, T.StringType()))
+        return HostBatch(cols, self._schema)
+
+    def node_desc(self) -> str:
+        kind = "posexplode" if self.pos else "explode"
+        return f"GenerateExec[{kind}{'_outer' if self.outer else ''}]"

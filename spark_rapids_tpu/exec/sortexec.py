@@ -66,6 +66,13 @@ class SortExec(PlanNode):
     def children_coalesce_goal(self) -> list[CoalesceGoal | None]:
         return [RequireSingleBatch if self._global else None]
 
+    def _jit_fn(self):
+        if not hasattr(self, "_sort_jit"):
+            import jax
+            self._sort_jit = jax.jit(
+                lambda b: sort_batch(b, self._orders))
+        return self._sort_jit
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
         if ctx.is_device:
@@ -73,7 +80,7 @@ class SortExec(PlanNode):
             if not batches:
                 return
             b = batches[0] if len(batches) == 1 else dk.concat_batches(batches)
-            yield sort_batch(b, self._orders)
+            yield ctx.dispatch(self._jit_fn(), b)
         else:
             batches = list(child_it)
             if not batches:

@@ -28,7 +28,7 @@ from spark_rapids_tpu import types as T
 
 __all__ = [
     "Val", "EvalCtx", "Expression", "Literal", "BoundReference",
-    "UnresolvedAttribute", "Alias", "col", "lit", "bind",
+    "UnresolvedAttribute", "Alias", "col", "lit", "grouping_id", "bind",
     "eval_host", "eval_device",
 ]
 
@@ -432,6 +432,12 @@ def col(name: str) -> UnresolvedAttribute:
 
 def lit(v) -> Literal:
     return Literal.infer(v)
+
+
+def grouping_id() -> UnresolvedAttribute:
+    """The grouping-set id column produced by rollup/cube/grouping_sets
+    (Spark's grouping_id(); bit i set = key i was nulled out)."""
+    return UnresolvedAttribute("spark_grouping_id")
 
 
 def output_name(e: Expression) -> str:

@@ -15,7 +15,8 @@ from spark_rapids_tpu.exec.core import PlanNode
 from spark_rapids_tpu.expr.core import Expression
 
 __all__ = ["LogicalPlan", "Scan", "Project", "Filter", "Aggregate", "Join",
-           "Sort", "Limit", "Union", "Window", "Repartition"]
+           "Sort", "Limit", "Union", "Window", "Repartition", "Expand",
+           "Generate"]
 
 
 class LogicalPlan:
@@ -134,6 +135,33 @@ class Union(LogicalPlan):
 class Window(LogicalPlan):
     window_exprs: list
     child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class Expand(LogicalPlan):
+    """N projections per input row (rollup/cube/grouping sets;
+    reference GpuExpandExec.scala:67)."""
+    projections: list  # list of same-arity expression lists
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class Generate(LogicalPlan):
+    """Generator (explode/posexplode) appended to or replacing the child
+    output (reference GpuGenerateExec.scala:101)."""
+    generator: Expression
+    child: LogicalPlan
+    outer: bool = False
+    pos: bool = False
+    output_names: list = field(default_factory=lambda: ["col"])
 
     @property
     def children(self):

@@ -96,6 +96,18 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         c = lower(node.child, conf)
         ex = WindowExec(node.window_exprs, c.exec_node)
         return PlannedNode(ex, list(node.window_exprs), [c])
+    if isinstance(node, L.Expand):
+        c = lower(node.child, conf)
+        from spark_rapids_tpu.exec.expand import ExpandExec
+        ex = ExpandExec(node.projections, c.exec_node)
+        exprs = [e for proj in node.projections for e in proj]
+        return PlannedNode(ex, exprs, [c])
+    if isinstance(node, L.Generate):
+        c = lower(node.child, conf)
+        from spark_rapids_tpu.exec.generate import GenerateExec
+        ex = GenerateExec(node.generator, c.exec_node, outer=node.outer,
+                          pos=node.pos, output_names=node.output_names)
+        return PlannedNode(ex, [node.generator], [c])
     if isinstance(node, L.Repartition):
         c = lower(node.child, conf)
         if node.keys and conf.mesh_device_count > 1 \

@@ -16,7 +16,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.conf import TpuConf
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, collect_device, \
     collect_host
-from spark_rapids_tpu.expr.core import Expression, col, lit, output_name
+from spark_rapids_tpu.expr.core import (Alias, Expression, col, lit,
+                                        output_name)
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.overrides import PlannedNode, TpuOverrides, lower
 
@@ -112,6 +113,36 @@ class DataFrame:
     def group_by(self, *keys) -> "GroupedData":
         return GroupedData(self, [self._col_or_expr(k) for k in keys])
 
+    def rollup(self, *keys) -> "GroupedData":
+        """GROUP BY ROLLUP: grouping sets = every key-prefix down to the
+        grand total (reference GpuExpandExec-backed rollup)."""
+        ks = [self._col_or_expr(k) for k in keys]
+        sets = [set(range(i)) for i in range(len(ks), -1, -1)]
+        return GroupedData(self, ks, grouping_sets=sets)
+
+    def cube(self, *keys) -> "GroupedData":
+        """GROUP BY CUBE: all 2^n grouping sets."""
+        from itertools import combinations
+        ks = [self._col_or_expr(k) for k in keys]
+        n = len(ks)
+        sets = [set(c) for r in range(n, -1, -1)
+                for c in combinations(range(n), r)]
+        return GroupedData(self, ks, grouping_sets=sets)
+
+    def grouping_sets(self, keys, sets) -> "GroupedData":
+        """Explicit GROUPING SETS; ``sets`` lists per-set key names (or
+        indices into ``keys``)."""
+        ks = [self._col_or_expr(k) for k in keys]
+        names = [output_name(k) for k in ks]
+        idx_sets = []
+        for s in sets:
+            idx = set()
+            for item in s:
+                idx.add(item if isinstance(item, int) else
+                        names.index(item))
+            idx_sets.append(idx)
+        return GroupedData(self, ks, grouping_sets=idx_sets)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
@@ -134,6 +165,17 @@ class DataFrame:
                 self._plan, other._plan, "cross", [], [], condition))
         return DataFrame(self._s, L.Join(self._plan, other._plan, how,
                                          left_on, right_on, condition))
+
+    def explode_split(self, expr, delimiter: str, output_name: str = "col",
+                      pos: bool = False, outer: bool = False) -> "DataFrame":
+        """explode(split(expr, delimiter)): one output row per piece, child
+        columns repeated; ``pos`` adds the piece index, ``outer`` keeps
+        null-input rows (reference GpuGenerateExec explode/posexplode)."""
+        from spark_rapids_tpu.exec.generate import SplitExplode
+        gen = SplitExplode(self._col_or_expr(expr), delimiter)
+        names = (["pos", output_name] if pos else [output_name])
+        return DataFrame(self._s, L.Generate(gen, self._plan, outer=outer,
+                                             pos=pos, output_names=names))
 
     def order_by(self, *orders) -> "DataFrame":
         return DataFrame(self._s, L.Sort(list(orders), self._plan))
@@ -209,11 +251,57 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, keys: list):
+    def __init__(self, df: DataFrame, keys: list, grouping_sets=None):
         self._df = df
         self._keys = keys
+        self._sets = grouping_sets  # list[set[int]] of ACTIVE key indices
 
     def agg(self, *aggs) -> DataFrame:
-        exprs = list(self._keys) + list(aggs)
+        if self._sets is None:
+            exprs = list(self._keys) + list(aggs)
+            return DataFrame(self._df._s, L.Aggregate(
+                list(self._keys), exprs, self._df._plan))
+        return self._agg_grouping_sets(list(aggs))
+
+    def _agg_grouping_sets(self, aggs: list) -> DataFrame:
+        """Rollup/cube/grouping-sets: Expand with nulled-out key columns +
+        a spark_grouping_id literal per set, then a plain group-by over
+        (keys..., spark_grouping_id) so rollup-nulls never merge with
+        data-nulls (reference GpuExpandExec + Spark's Expand planning)."""
+        from spark_rapids_tpu.expr.core import Literal, UnresolvedAttribute
+        user_names = [output_name(k) for k in self._keys]
+        child_cols = self._df.columns
+        pre_exprs = [col(n) for n in child_cols]
+        key_names = []
+        for k, name in zip(self._keys, user_names):
+            inner = k.children[0] if isinstance(k, Alias) else k
+            if isinstance(inner, UnresolvedAttribute) and \
+                    inner.name in child_cols and name == inner.name:
+                key_names.append(name)  # plain column key
+                continue
+            # computed key: project under a collision-proof name so an
+            # existing child column of the same name can't shadow it
+            resolved = name if name not in child_cols else f"_gs_{name}"
+            pre_exprs.append(inner.alias(resolved))
+            key_names.append(resolved)
+        pre = self._df.select(*pre_exprs)
+        pre_schema = pre.schema
+        nk = len(self._keys)
+        projections = []
+        for s in self._sets:
+            proj = []
+            for n in pre_schema.names:
+                if n in key_names and key_names.index(n) not in s:
+                    f = pre_schema.field(n)
+                    proj.append(Literal(None, f.data_type).alias(n))
+                else:
+                    proj.append(col(n))
+            gid = sum(1 << (nk - 1 - i) for i in range(nk) if i not in s)
+            proj.append(Literal(gid, T.LongType()).alias("spark_grouping_id"))
+            projections.append(proj)
+        expanded = DataFrame(self._df._s, L.Expand(projections, pre._plan))
+        group_exprs = [col(n) for n in key_names] + [col("spark_grouping_id")]
+        result_exprs = [col(n) if n == u else col(n).alias(u)
+                        for n, u in zip(key_names, user_names)] + aggs
         return DataFrame(self._df._s, L.Aggregate(
-            list(self._keys), exprs, self._df._plan))
+            group_exprs, result_exprs, expanded._plan))
