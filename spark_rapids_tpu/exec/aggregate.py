@@ -52,6 +52,9 @@ class HashAggregateExec(PlanNode):
             raise ValueError("use HashAggregateExec.final_from_partial()")
         assert mode in ("complete", "partial")
         super().__init__([child])
+        from spark_rapids_tpu.expr.misc import reject_partition_aware
+        reject_partition_aware(list(group_exprs) + list(result_exprs),
+                               "aggregations")
         self.mode = mode
         child_schema = child.output_schema
 
@@ -165,6 +168,10 @@ class HashAggregateExec(PlanNode):
     @property
     def output_schema(self) -> T.Schema:
         return self._output_schema
+
+    @property
+    def bound_exprs(self):
+        return list(self._pre_exprs) + list(self._final_exprs)
 
     @property
     def output_batching(self):

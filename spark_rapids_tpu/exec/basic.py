@@ -7,8 +7,10 @@ GpuGlobalLimitExec, GpuCollectLimitExec).
 """
 from __future__ import annotations
 
+from functools import partial as _partial
 from typing import Iterator, Sequence
 
+import jax as _jax
 import numpy as np
 
 from spark_rapids_tpu import types as T
@@ -23,6 +25,20 @@ from spark_rapids_tpu.ops import kernels as dk
 
 __all__ = ["LocalScanExec", "ProjectExec", "FilterExec", "RangeExec",
            "UnionExec", "LocalLimitExec", "GlobalLimitExec"]
+
+
+@_partial(_jax.jit, static_argnames=("cap",))
+def _jit_miid(mask, cap: int, base):
+    import jax.numpy as jnp
+    data = jnp.where(mask, base + jnp.arange(cap, dtype=jnp.int64), 0)
+    return DeviceColumn(data, mask, T.LongType())
+
+
+@_partial(_jax.jit, static_argnames=("cap",))
+def _jit_spid(mask, cap: int, pid):
+    import jax.numpy as jnp
+    data = jnp.where(mask, pid.astype(jnp.int32), 0)
+    return DeviceColumn(data, mask, T.IntegerType())
 
 
 class LocalScanExec(PlanNode):
@@ -74,7 +90,12 @@ class LocalScanExec(PlanNode):
 
 
 class ProjectExec(PlanNode):
-    """Evaluate bound expressions per batch (GpuProjectExec.project)."""
+    """Evaluate bound expressions per batch (GpuProjectExec.project).
+
+    Partition-aware expressions (spark_partition_id /
+    monotonically_increasing_id) are rewritten to references of extra
+    input columns computed per batch from (pid, row offset) — reference
+    GpuSparkPartitionID/GpuMonotonicallyIncreasingID."""
 
     def __init__(self, exprs: Sequence[Expression], child: PlanNode):
         super().__init__([child])
@@ -83,10 +104,34 @@ class ProjectExec(PlanNode):
         self._schema = T.Schema([
             T.StructField(output_name(r), b.dtype)
             for r, b in zip(self._raw, self._bound)])
+        # hoist partition-aware expressions into extra input columns
+        from spark_rapids_tpu.expr.core import BoundReference
+        from spark_rapids_tpu.expr.misc import PartitionAwareExpression
+        self._paware: list = []
+        ncols = len(child.output_schema.fields)
+        seen: dict[str, int] = {}
+
+        def hoist(node):
+            if isinstance(node, PartitionAwareExpression):
+                key = type(node).__name__
+                if key not in seen:
+                    seen[key] = ncols + len(self._paware)
+                    self._paware.append(node)
+                return BoundReference(seen[key], node.dtype, False,
+                                      f"_{key}")
+            return node
+
+        if any(any(isinstance(s, PartitionAwareExpression)
+                   for s in e.walk()) for e in self._bound):
+            self._bound = [e.transform_up(hoist) for e in self._bound]
 
     @property
     def output_schema(self) -> T.Schema:
         return self._schema
+
+    @property
+    def bound_exprs(self):
+        return list(self._bound)
 
     def _jit_fn(self):
         # one program per batch shape: whole-projection jit (the eager
@@ -104,13 +149,55 @@ class ProjectExec(PlanNode):
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
         if ctx.is_device:
+            import jax.numpy as jnp
             fn = self._jit_fn()
+            # running row offset stays a DEVICE scalar: no per-batch sync
+            offset = jnp.asarray(0, jnp.int64)
             for b in child_it:
+                if self._paware:
+                    b = self._with_paware_device(b, pid, offset)
+                    offset = offset + b.num_rows
                 yield fn(b)
         else:
+            offset = 0
             for b in child_it:
+                if self._paware:
+                    b = self._with_paware_host(b, pid, offset)
+                    offset += b.num_rows
                 cols = [eval_host(e, b) for e in self._bound]
                 yield HostBatch(cols, self._schema)
+
+    def _with_paware_device(self, b, pid: int, offset):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.expr.misc import MonotonicallyIncreasingID
+        cols = list(b.columns)
+        fields = list(b.schema.fields)
+        for node in self._paware:
+            if isinstance(node, MonotonicallyIncreasingID):
+                col_ = _jit_miid(b.row_mask(), b.capacity,
+                                 jnp.asarray(pid << 33, jnp.int64) + offset)
+            else:
+                col_ = _jit_spid(b.row_mask(), b.capacity,
+                                 jnp.asarray(pid, jnp.int32))
+            cols.append(col_)
+            fields.append(T.StructField(f"_{type(node).__name__}",
+                                        node.dtype, False))
+        return ColumnBatch(cols, b.num_rows, T.Schema(fields))
+
+    def _with_paware_host(self, b, pid: int, offset: int):
+        from spark_rapids_tpu.expr.misc import MonotonicallyIncreasingID
+        cols = list(b.columns)
+        fields = list(b.schema.fields)
+        n = b.num_rows
+        for node in self._paware:
+            if isinstance(node, MonotonicallyIncreasingID):
+                data = (np.arange(n, dtype=np.int64) + (pid << 33) + offset)
+            else:
+                data = np.full(n, pid, dtype=np.int32)
+            cols.append(HostColumn(data, np.ones(n, np.bool_), node.dtype))
+            fields.append(T.StructField(f"_{type(node).__name__}",
+                                        node.dtype, False))
+        return HostBatch(cols, T.Schema(fields))
 
     def node_desc(self) -> str:
         return f"ProjectExec[{self._schema.names}]"
@@ -122,9 +209,15 @@ class FilterExec(PlanNode):
 
     def __init__(self, condition: Expression, child: PlanNode):
         super().__init__([child])
+        from spark_rapids_tpu.expr.misc import reject_partition_aware
+        reject_partition_aware([condition], "a filter condition")
         self._cond = bind(condition, child.output_schema)
         assert isinstance(self._cond.dtype, T.BooleanType), \
             f"filter condition must be boolean, got {self._cond.dtype}"
+
+    @property
+    def bound_exprs(self):
+        return [self._cond]
 
     @property
     def output_schema(self) -> T.Schema:

@@ -229,6 +229,13 @@ class PlanNode:
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         raise NotImplementedError
 
+    #: bound (fully-typed) expressions this operator evaluates — the
+    #: planner's tagging pass checks device_supported on these, since
+    #: dtype-dependent checks can't run on unresolved trees
+    @property
+    def bound_exprs(self) -> list:
+        return []
+
     # -- batching contracts (reference GpuExec.scala:71-86) ----------------
     @property
     def children_coalesce_goal(self) -> list[CoalesceGoal | None]:

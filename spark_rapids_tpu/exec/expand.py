@@ -50,6 +50,10 @@ class ExpandExec(PlanNode):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    @property
+    def bound_exprs(self):
+        return [e for proj in self._bound for e in proj]
+
     def _jit_fns(self):
         # one program PER projection, emitted one at a time (reference
         # GpuExpandExec emits per projection) so peak device memory is one

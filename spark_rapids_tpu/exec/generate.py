@@ -170,6 +170,10 @@ class GenerateExec(PlanNode):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    @property
+    def bound_exprs(self):
+        return [self._gen_bound]
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
         delim = self.generator.delimiter.encode("utf-8")[0]

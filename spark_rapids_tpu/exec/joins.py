@@ -109,6 +109,9 @@ class JoinExec(PlanNode):
                 f"non-equi condition not supported for {join_type} join "
                 "(reference tagJoin, GpuHashJoin.scala:30-45)")
         super().__init__([left, right])
+        from spark_rapids_tpu.expr.misc import reject_partition_aware
+        reject_partition_aware(list(left_keys) + list(right_keys)
+                               + [condition], "join keys/conditions")
         self.join_type = join_type
         self._lkeys_b = [bind(k, left.output_schema) for k in left_keys]
         self._rkeys_b = [bind(k, right.output_schema) for k in right_keys]
@@ -140,6 +143,13 @@ class JoinExec(PlanNode):
     @property
     def output_schema(self) -> T.Schema:
         return self._schema
+
+    @property
+    def bound_exprs(self):
+        out = list(self._lkeys_b) + list(self._rkeys_b)
+        if self._condition is not None:
+            out.append(self._cond_b)
+        return out
 
     def num_partitions(self, ctx: ExecCtx) -> int:
         # stream-side partitioning is preserved (per-left-row join types);

@@ -20,7 +20,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
 
-__all__ = ["Cast", "java_double_str"]
+__all__ = ["Cast", "AnsiCast", "java_double_str"]
 
 _MICROS_PER_DAY = 86_400_000_000
 
@@ -223,3 +223,41 @@ class Cast(Expression):
         except (ValueError, OverflowError):
             return None
         return None
+
+
+class AnsiCast(Cast):
+    """ANSI-mode cast (reference GpuCast.scala ansi variants,
+    RapidsConf.scala:461-492 incompat flags): overflow or unparseable
+    input RAISES instead of wrapping/yielding null.  Host-only — the
+    device path has no error channel, exactly why the reference gates
+    ansi casts behind incompat flags."""
+
+    sql_name = "AnsiCast"
+
+    def with_new_children(self, children):
+        return AnsiCast(children[0], self.to)
+
+    @property
+    def device_supported(self) -> bool:
+        return False
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        src, dst = a.dtype, self.to
+        if dst.integral and (src.fractional or src.integral):
+            info = np.iinfo(dst.np_dtype)
+            d = a.data[a.validity]
+            bad = (d < info.min) | (d > info.max)
+            if src.fractional:
+                bad |= ~np.isfinite(d)
+            if np.any(bad):
+                raise ArithmeticError(
+                    f"Casting to {dst.name} causes overflow (ANSI mode)")
+        if isinstance(src, T.StringType) and not isinstance(dst, T.StringType):
+            for i in range(ctx.capacity):
+                if a.validity[i] and \
+                        self._string_to_value(a.data[i], dst) is None:
+                    raise ValueError(
+                        f"invalid input for ANSI cast to {dst.name}: "
+                        f"{a.data[i]!r}")
+        return super()._eval(vals, ctx)

@@ -148,6 +148,12 @@ class Expression:
         raise NotImplementedError(type(self).__name__)
 
     # -- tree utilities ----------------------------------------------------
+    def walk(self):
+        """Pre-order iterator over this node and all descendants."""
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
     def transform_up(self, fn) -> "Expression":
         new_children = [c.transform_up(fn) for c in self.children]
         node = self if all(a is b for a, b in zip(new_children, self.children)) \

@@ -15,7 +15,9 @@ from spark_rapids_tpu.expr.core import Expression, EvalCtx
 
 __all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
            "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
-           "DateDiff", "ToDate"]
+           "DateDiff", "ToDate", "AddMonths", "LastDay", "NextDay",
+           "TruncDate", "WeekOfYear", "FromUnixTime", "UnixTimestamp",
+           "DateFormatClass", "MonthsBetween"]
 
 _MICROS_PER_DAY = 86_400_000_000
 
@@ -227,3 +229,394 @@ class ToDate(Expression):
     @property
     def dtype(self):
         return T.DateType()
+
+
+# ---------------------------------------------------------------------------
+# round-3 breadth: add_months / months_between / last_day / next_day /
+# trunc / weekofyear (device) + from_unixtime / unix_timestamp /
+# date_format (string paths host-only)
+# (reference datetimeExpressions.scala GpuAddMonths/GpuMonthsBetween/
+#  GpuLastDay analogs; string formatting is host-tagged like the
+#  reference's timeZoneId-gated expressions)
+# ---------------------------------------------------------------------------
+
+def _last_dom(y, m, xp):
+    """Last day-of-month for (y, m) vectorized (leap-aware)."""
+    lengths = xp.asarray(np.array([31, 28, 31, 30, 31, 30,
+                                   31, 31, 30, 31, 30, 31], np.int32))
+    base = lengths[m - 1]
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return xp.where((m == 2) & leap, 29, base).astype(np.int32)
+
+
+class AddMonths(Expression):
+    """add_months(date, n): clamps day-of-month to the target month's end
+    (Spark semantics)."""
+
+    sql_name = "AddMonths"
+
+    def __init__(self, start: Expression, months: Expression):
+        self.children = (start, months)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        s, n = self.children
+        if not isinstance(s.dtype, T.DateType):
+            s = Cast(s, T.DateType())
+        if not isinstance(n.dtype, T.IntegerType):
+            n = Cast(n, T.IntegerType())
+        return AddMonths(s, n)
+
+    def _eval(self, vals, ctx):
+        a, n = vals
+        xp = ctx.xp
+        y, m, d = civil_from_days(a.data, xp)
+        total = (y.astype(np.int64) * 12 + (m - 1)) + n.data.astype(np.int64)
+        ny = (total // 12).astype(np.int32)
+        nm = (total - ny.astype(np.int64) * 12).astype(np.int32) + 1
+        nd = xp.minimum(d, _last_dom(ny, nm, xp))
+        validity = a.validity & n.validity
+        return ctx.canonical(
+            days_from_civil(ny, nm, nd, xp).astype(np.int32), validity,
+            T.DateType())
+
+
+class LastDay(Expression):
+    sql_name = "LastDay"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        return self if isinstance(c.dtype, T.DateType) \
+            else LastDay(Cast(c, T.DateType()))
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        y, m, d = civil_from_days(a.data, xp)
+        nd = _last_dom(y, m, xp)
+        return ctx.canonical(
+            days_from_civil(y, m, nd, xp).astype(np.int32), a.validity,
+            T.DateType())
+
+
+_DOW_NAMES = {"MO": 0, "TU": 1, "WE": 2, "TH": 3, "FR": 4, "SA": 5, "SU": 6}
+
+
+class NextDay(Expression):
+    """next_day(date, 'Mon'): first date later than ``date`` falling on
+    the given weekday."""
+
+    sql_name = "NextDay"
+
+    def __init__(self, child: Expression, day_of_week: str):
+        self.children = (child,)
+        self.day_of_week = day_of_week
+        key = day_of_week.strip()[:2].upper()
+        if key not in _DOW_NAMES:
+            raise ValueError(f"bad day of week: {day_of_week!r}")
+        self._target = _DOW_NAMES[key]  # Monday=0
+
+    def with_new_children(self, children):
+        return NextDay(children[0], self.day_of_week)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        return self if isinstance(c.dtype, T.DateType) \
+            else NextDay(Cast(c, T.DateType()), self.day_of_week)
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        # epoch day 0 = 1970-01-01 = Thursday = 3 (Monday=0)
+        dow = (a.data.astype(np.int64) + 3) % 7
+        delta = (self._target - dow) % 7
+        delta = ctx.xp.where(delta == 0, 7, delta)
+        return ctx.canonical((a.data + delta).astype(np.int32), a.validity,
+                             T.DateType())
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt): fmt in year|yyyy|yy|quarter|month|mon|mm|week."""
+
+    sql_name = "TruncDate"
+
+    def __init__(self, child: Expression, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt
+        f = fmt.lower()
+        if f in ("year", "yyyy", "yy"):
+            self._level = "year"
+        elif f == "quarter":
+            self._level = "quarter"
+        elif f in ("month", "mon", "mm"):
+            self._level = "month"
+        elif f == "week":
+            self._level = "week"
+        else:
+            raise ValueError(f"bad trunc format: {fmt!r}")
+
+    def with_new_children(self, children):
+        return TruncDate(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        return self if isinstance(c.dtype, T.DateType) \
+            else TruncDate(Cast(c, T.DateType()), self.fmt)
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        if self._level == "week":  # truncate to Monday
+            dow = (a.data.astype(np.int64) + 3) % 7
+            data = (a.data - dow).astype(np.int32)
+        else:
+            y, m, d = civil_from_days(a.data, xp)
+            if self._level == "year":
+                m = xp.ones_like(m)
+            elif self._level == "quarter":
+                m = ((m - 1) // 3) * 3 + 1
+            data = days_from_civil(y, m, xp.ones_like(d), xp).astype(np.int32)
+        return ctx.canonical(data, a.validity, T.DateType())
+
+
+class WeekOfYear(_DateExtract):
+    """ISO-8601 week number (Spark weekofyear)."""
+
+    sql_name = "WeekOfYear"
+
+    def _pick(self, y, m, d, days, xp):
+        doy_days = days - days_from_civil(y, xp.ones_like(m),
+                                          xp.ones_like(d), xp)
+        doy = (doy_days + 1).astype(np.int64)          # 1-based day of year
+        dow = ((days.astype(np.int64) + 3) % 7) + 1    # ISO Monday=1
+
+        def p(yy):
+            yy = yy.astype(np.int64)
+            return (yy + yy // 4 - yy // 100 + yy // 400) % 7
+
+        weeks_in = lambda yy: xp.where(  # noqa: E731
+            (p(yy) == 4) | (p(yy - 1) == 3), 53, 52)
+        w = (doy - dow + 10) // 7
+        w = xp.where(w < 1, weeks_in(y - 1), w)
+        w = xp.where((w > 52) & (w > weeks_in(y)), 1, w)
+        return w.astype(np.int32)
+
+
+def _java_fmt_to_strftime(fmt: str) -> str:
+    """Translate the common Java SimpleDateFormat patterns to strftime."""
+    out = []
+    i = 0
+    mapping = [("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+               ("HH", "%H"), ("mm", "%M"), ("ss", "%S"), ("EEEE", "%A"),
+               ("EEE", "%a"), ("MMMM", "%B"), ("MMM", "%b"), ("DDD", "%j"),
+               ("a", "%p")]
+    while i < len(fmt):
+        for pat, rep in mapping:
+            if fmt.startswith(pat, i):
+                out.append(rep)
+                i += len(pat)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds, fmt) -> formatted string (host-only:
+    string formatting has no device kernel, reference gates the same)."""
+
+    sql_name = "FromUnixTime"
+
+    def __init__(self, child: Expression, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_fmt_to_strftime(fmt)
+
+    def with_new_children(self, children):
+        return FromUnixTime(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        import datetime as _dt
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            if not a.validity[i]:
+                out[i] = None
+                continue
+            ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(seconds=int(a.data[i]))
+            out[i] = ts.strftime(self._strf)
+        from spark_rapids_tpu.expr.core import Val
+        return Val(out, a.validity, None, T.StringType())
+
+
+class UnixTimestamp(Expression):
+    """unix_timestamp(ts|date|string[, fmt]) -> seconds since epoch.
+    Device-supported for timestamp/date inputs; string parsing is
+    host-only."""
+
+    sql_name = "UnixTimestamp"
+
+    def __init__(self, child: Expression, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_fmt_to_strftime(fmt)
+
+    def with_new_children(self, children):
+        return UnixTimestamp(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.LongType()
+
+    @property
+    def device_supported(self):
+        return not isinstance(self.children[0].dtype, T.StringType)
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if isinstance(a.dtype, T.TimestampType):
+            data = a.data // 1_000_000
+            return ctx.canonical(data.astype(np.int64), a.validity,
+                                 T.LongType())
+        if isinstance(a.dtype, T.DateType):
+            data = a.data.astype(np.int64) * 86_400
+            return ctx.canonical(data, a.validity, T.LongType())
+        import datetime as _dt
+        out = np.zeros(ctx.capacity, dtype=np.int64)
+        validity = np.zeros(ctx.capacity, dtype=np.bool_)
+        for i in range(ctx.capacity):
+            if not a.validity[i]:
+                continue
+            try:
+                ts = _dt.datetime.strptime(str(a.data[i]), self._strf)
+                out[i] = int((ts - _dt.datetime(1970, 1, 1)).total_seconds())
+                validity[i] = True
+            except ValueError:
+                pass
+        return ctx.canonical(out, validity, T.LongType())
+
+
+class DateFormatClass(Expression):
+    """date_format(timestamp, fmt) -> string (host-only)."""
+
+    sql_name = "DateFormatClass"
+
+    def __init__(self, child: Expression, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_fmt_to_strftime(fmt)
+
+    def with_new_children(self, children):
+        return DateFormatClass(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        c = self.children[0]
+        return self if isinstance(c.dtype, T.TimestampType) \
+            else DateFormatClass(Cast(c, T.TimestampType()), self.fmt)
+
+    def _eval(self, vals, ctx):
+        import datetime as _dt
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            if not a.validity[i]:
+                out[i] = None
+                continue
+            ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                microseconds=int(a.data[i]))
+            out[i] = ts.strftime(self._strf)
+        from spark_rapids_tpu.expr.core import Val
+        return Val(out, a.validity, None, T.StringType())
+
+
+class MonthsBetween(Expression):
+    """months_between(end, start[, roundOff]) over timestamps (Spark:
+    whole months when days match or both are month-ends, else +
+    (day+time delta)/31; rounded to 8 digits when roundOff)."""
+
+    sql_name = "MonthsBetween"
+
+    def __init__(self, end: Expression, start: Expression,
+                 round_off: bool = True):
+        self.children = (end, start)
+        self.round_off = round_off
+
+    def with_new_children(self, children):
+        return MonthsBetween(children[0], children[1], self.round_off)
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        kids = [c if isinstance(c.dtype, T.TimestampType)
+                else Cast(c, T.TimestampType()) for c in self.children]
+        return MonthsBetween(*kids, round_off=self.round_off)
+
+    def _eval(self, vals, ctx):
+        a, b = vals
+        xp = ctx.xp
+        validity = a.validity & b.validity
+
+        def parts(v):
+            days = v.data // _MICROS_PER_DAY
+            sec = (v.data - days * _MICROS_PER_DAY).astype(np.float64) / 1e6
+            y, m, d = civil_from_days(days, xp)
+            return y.astype(np.int64), m.astype(np.int64), \
+                d.astype(np.int64), sec
+
+        y1, m1, d1, s1 = parts(a)
+        y2, m2, d2, s2 = parts(b)
+        months = ((y1 - y2) * 12 + (m1 - m2)).astype(np.float64)
+        last1 = d1 == _last_dom(y1.astype(np.int32), m1.astype(np.int32),
+                                xp).astype(np.int64)
+        last2 = d2 == _last_dom(y2.astype(np.int32), m2.astype(np.int32),
+                                xp).astype(np.int64)
+        whole = (d1 == d2) | (last1 & last2)
+        sec_per_day = 86_400.0
+        frac = ((d1 - d2).astype(np.float64) * sec_per_day + (s1 - s2)) \
+            / (31.0 * sec_per_day)
+        out = xp.where(whole, months, months + frac)
+        if self.round_off:
+            out = xp.round(out * 1e8) / 1e8
+        return ctx.canonical(out, validity, T.DoubleType())

@@ -1,0 +1,73 @@
+"""Partition-aware expressions (reference GpuSparkPartitionID /
+GpuMonotonicallyIncreasingID in the expression library, SURVEY §2.4).
+
+These need the task's partition id and running row offset, which plain
+expression eval doesn't see — ProjectExec detects them, computes an
+input column per batch (one tiny jitted program fed by device scalars,
+no per-batch retrace) and rewrites the expression to a BoundReference
+(exec/basic.py)."""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression
+
+__all__ = ["MonotonicallyIncreasingID", "SparkPartitionID",
+           "PartitionAwareExpression"]
+
+
+class PartitionAwareExpression(Expression):
+    """Marker: evaluation requires (partition_id, row_offset)."""
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_new_children(self, children):
+        return self
+
+    def _eval(self, vals, ctx):
+        raise ValueError(
+            f"{self.sql_name}() is only supported inside select() "
+            "projections (ProjectExec hoists it; other operators cannot "
+            "supply partition context)")
+
+
+def reject_partition_aware(exprs, where: str) -> None:
+    """Plan-time guard: raise a clear error instead of a runtime crash
+    when a partition-aware expression appears outside a projection."""
+    for e in exprs:
+        if e is None or not isinstance(e, Expression):
+            continue
+        stack = [e]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, PartitionAwareExpression):
+                raise ValueError(
+                    f"{n.sql_name}() is not allowed in {where}; compute it "
+                    "in a select() first")
+            stack.extend(n.children)
+
+
+class MonotonicallyIncreasingID(PartitionAwareExpression):
+    """(partition_id << 33) + row index within the partition — unique and
+    monotonically increasing per partition (Spark semantics)."""
+
+    sql_name = "MonotonicallyIncreasingID"
+
+    @property
+    def dtype(self):
+        return T.LongType()
+
+    def __repr__(self):
+        return "monotonically_increasing_id()"
+
+
+class SparkPartitionID(PartitionAwareExpression):
+    sql_name = "SparkPartitionID"
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def __repr__(self):
+        return "spark_partition_id()"

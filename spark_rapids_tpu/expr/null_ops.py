@@ -1,0 +1,127 @@
+"""Null-handling expressions (reference nullExpressions.scala, 287 LoC:
+GpuIsNan, GpuNaNvl, GpuNvl family, GpuNullIf via coalesce/if rewrites).
+
+All elementwise, device-supported; semantics follow Spark:
+* isnan(null) = false;
+* nanvl(a, b): b when a is NaN, else a (doubles);
+* nvl/nvl2/nullif are the standard SQL forms.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
+
+__all__ = ["IsNaN", "NaNvl", "Nvl", "Nvl2", "NullIf"]
+
+
+class IsNaN(Expression):
+    sql_name = "IsNaN"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        xp = ctx.xp
+        if a.dtype.fractional:
+            data = xp.isnan(a.data) & a.validity
+        else:
+            data = xp.zeros(ctx.capacity, dtype=bool)
+        return ctx.canonical(data, ctx.row_mask, T.BooleanType())
+
+
+class NaNvl(Expression):
+    sql_name = "NaNvl"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        a, b = self.children
+        if type(a.dtype) is not type(b.dtype):
+            return NaNvl(a, Cast(b, a.dtype))
+        return self
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a, b = vals
+        xp = ctx.xp
+        if not a.dtype.fractional:
+            return a
+        use_b = a.validity & xp.isnan(a.data)
+        data = xp.where(use_b, b.data.astype(a.data.dtype), a.data)
+        validity = xp.where(use_b, b.validity, a.validity)
+        return ctx.canonical(data, validity, a.dtype)
+
+
+class Nvl(Expression):
+    """nvl(a, b) = coalesce(a, b) (reference GpuNvl)."""
+
+    sql_name = "Nvl"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.conditional import Coalesce
+        return Coalesce(*self.children).coerced()
+
+
+class Nvl2(Expression):
+    """nvl2(a, b, c): b when a is not null else c (reference GpuNvl2 via
+    If(IsNotNull(a), b, c))."""
+
+    sql_name = "Nvl2"
+
+    def __init__(self, a: Expression, b: Expression, c: Expression):
+        self.children = (a, b, c)
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.predicates import IsNotNull
+        a, b, c = self.children
+        return If(IsNotNull(a), b, c).coerced()
+
+
+class NullIf(Expression):
+    """nullif(a, b): null when a == b else a."""
+
+    sql_name = "NullIf"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.core import Literal
+        from spark_rapids_tpu.expr.predicates import EqualTo
+        a, b = self.children
+        return If(EqualTo(a, b), Literal(None, a.dtype), a).coerced()

@@ -1,0 +1,140 @@
+"""Regular-expression expressions — host-only kernels.
+
+Reference: the regexp family lives behind a shim expr and runs only where
+cuDF grew regex support (Spark300Shims.scala:235 registers GpuRLike etc.
+per shim); this engine keeps the family host-tagged (device_supported =
+False) so the planner schedules the enclosing exec on the CPU oracle —
+the same "refuse what can't match" strategy the tagging framework exists
+for (SURVEY §7).
+
+Patterns are Java-regex syntax; they are translated approximately to
+Python `re` (common constructs are identical — character classes,
+quantifiers, groups, anchors).  Known divergences (possessive
+quantifiers, \\p{javaX} classes) raise at construction.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression, EvalCtx, Val
+
+__all__ = ["RLike", "RegExpReplace", "RegExpExtract"]
+
+_UNSUPPORTED = re.compile(r"\*\+|\+\+|\}\+|\\p\{java")
+
+
+def _compile(pattern: str):
+    if _UNSUPPORTED.search(pattern):
+        raise ValueError(
+            f"Java-regex construct not supported in host regex: {pattern!r}")
+    return re.compile(pattern)
+
+
+class _RegExpBase(Expression):
+    @property
+    def device_supported(self) -> bool:
+        return False  # host-only: planner falls the exec back (explain `!`)
+
+
+class RLike(_RegExpBase):
+    """str RLIKE pattern (unanchored search, Java semantics)."""
+
+    sql_name = "RLike"
+
+    def __init__(self, child: Expression, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self._re = _compile(pattern)
+
+    def with_new_children(self, children):
+        return RLike(children[0], self.pattern)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        out = np.zeros(ctx.capacity, dtype=np.bool_)
+        for i in range(ctx.capacity):
+            if a.validity[i]:
+                out[i] = self._re.search(str(a.data[i])) is not None
+        return ctx.canonical(out, a.validity, T.BooleanType())
+
+    def __repr__(self):
+        return f"RLike({self.children[0]!r}, {self.pattern!r})"
+
+
+class RegExpReplace(_RegExpBase):
+    """regexp_replace(str, pattern, replacement) — replaces ALL matches;
+    Java $1 backreferences are translated to Python \\1."""
+
+    sql_name = "RegExpReplace"
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.replacement = replacement
+        self._re = _compile(pattern)
+        self._repl = re.sub(r"\$(\d+)", r"\\\1", replacement)
+
+    def with_new_children(self, children):
+        return RegExpReplace(children[0], self.pattern, self.replacement)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            out[i] = self._re.sub(self._repl, str(a.data[i])) \
+                if a.validity[i] else None
+        return Val(out, a.validity, None, T.StringType())
+
+    def __repr__(self):
+        return (f"RegExpReplace({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.replacement!r})")
+
+
+class RegExpExtract(_RegExpBase):
+    """regexp_extract(str, pattern, idx): group ``idx`` of the first
+    match; empty string when no match (Spark semantics)."""
+
+    sql_name = "RegExpExtract"
+
+    def __init__(self, child: Expression, pattern: str, idx: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.idx = idx
+        self._re = _compile(pattern)
+
+    def with_new_children(self, children):
+        return RegExpExtract(children[0], self.pattern, self.idx)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    def _eval(self, vals, ctx: EvalCtx):
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            if not a.validity[i]:
+                out[i] = None
+                continue
+            m = self._re.search(str(a.data[i]))
+            if m is None:
+                out[i] = ""
+            else:
+                g = m.group(self.idx)
+                out[i] = g if g is not None else ""
+        return Val(out, a.validity, None, T.StringType())
+
+    def __repr__(self):
+        return (f"RegExpExtract({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.idx})")

@@ -21,7 +21,9 @@ from spark_rapids_tpu.expr.predicates import _string_pair_device
 
 __all__ = ["Upper", "Lower", "Length", "Substring", "Concat", "StartsWith",
            "EndsWith", "Contains", "Like", "StringTrim", "StringTrimLeft",
-           "StringTrimRight", "StringReplace"]
+           "StringTrimRight", "StringReplace", "ConcatWs", "StringLocate",
+           "SubstringIndex", "InitCap", "StringLPad", "StringRPad",
+           "StringRepeat"]
 
 
 def _char_starts(data, lengths, xp):
@@ -448,4 +450,304 @@ class StringReplace(Expression):
                     if s.data[i] else a.data[i]
             else:
                 out[i] = None
+        return Val(out, validity, None, T.StringType())
+
+
+# ---------------------------------------------------------------------------
+# round-3 breadth (reference stringFunctions.scala GpuStringLocate /
+# GpuConcatWs / GpuSubstringIndex / GpuInitCap / GpuStringLPad/RPad /
+# GpuStringRepeat). Device kernels where the byte-matrix layout maps
+# cleanly; pad/repeat/initcap are host-tagged (unicode-width semantics).
+# ---------------------------------------------------------------------------
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): null inputs are SKIPPED (no
+    separator); result is null only when sep is null."""
+
+    sql_name = "ConcatWs"
+
+    def __init__(self, separator: str, *children: Expression):
+        self.children = tuple(children)
+        self.separator = separator
+
+    def with_new_children(self, children):
+        return ConcatWs(self.separator, *children)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        xp = ctx.xp
+        if not ctx.is_device:
+            out = np.empty(ctx.capacity, dtype=object)
+            for i in range(ctx.capacity):
+                parts = [str(v.data[i]) for v in vals if v.validity[i]]
+                out[i] = self.separator.join(parts)
+            return Val(out, ctx.row_mask.copy(), None, T.StringType())
+        sep = ctx._const_string(self.separator, ctx.row_mask)
+        data = xp.zeros((ctx.capacity, 1), np.uint8)
+        lengths = xp.zeros(ctx.capacity, np.int32)
+        have_any = xp.zeros(ctx.capacity, bool)
+        for v in vals:
+            need_sep = have_any & v.validity
+            sep_len = xp.where(need_sep, sep.lengths, 0)
+            data, lengths = _concat2_device(data, lengths, sep.data, sep_len, xp)
+            piece_len = xp.where(v.validity, v.lengths, 0)
+            data, lengths = _concat2_device(data, lengths, v.data, piece_len, xp)
+            have_any = have_any | v.validity
+        validity = ctx.row_mask
+        return ctx.canonical(data, validity, T.StringType(), lengths)
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, start]): 1-based character position of the
+    first occurrence at/after ``start``; 0 when absent; null inputs ->
+    null (start is a literal int)."""
+
+    sql_name = "StringLocate"
+
+    def __init__(self, substr: Expression, string: Expression,
+                 start: int = 1):
+        self.children = (substr, string)
+        self.start = start
+
+    def with_new_children(self, children):
+        return StringLocate(children[0], children[1], self.start)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def _eval(self, vals, ctx):
+        sub, s = vals
+        xp = ctx.xp
+        validity = sub.validity & s.validity
+        if not ctx.is_device:
+            out = np.zeros(ctx.capacity, np.int32)
+            for i in range(ctx.capacity):
+                if not validity[i]:
+                    continue
+                if self.start < 1:
+                    out[i] = 0
+                    continue
+                out[i] = str(s.data[i]).find(str(sub.data[i]),
+                                             self.start - 1) + 1
+            return ctx.canonical(out, validity, T.IntegerType())
+        if self.start < 1:
+            return ctx.canonical(xp.zeros(ctx.capacity, np.int32), validity,
+                                 T.IntegerType())
+        w = s.data.shape[1]
+        ws = sub.data.shape[1]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        # match[i, o] = bytes o..o+sublen match the needle
+        match = xp.ones((ctx.capacity, w), bool)
+        for k in range(ws):
+            idx = xp.clip(j + k, 0, w - 1)
+            sv = xp.take_along_axis(s.data, idx, axis=1)
+            inside = k < sub.lengths[:, None]
+            eq = sv == sub.data[:, k][:, None]
+            valid_pos = (j + k) < s.lengths[:, None]
+            match = match & xp.where(inside, eq & valid_pos, True)
+        match = match & (j + sub.lengths[:, None] <= s.lengths[:, None])
+        # character index of each byte + start filter (both char-based)
+        starts = _char_starts(s.data, s.lengths, xp)
+        char_idx = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+        match = match & starts & (char_idx >= (self.start - 1))
+        empty = sub.lengths == 0
+        found = xp.any(match, axis=1)
+        first_byte = xp.argmax(match, axis=1)
+        pos = xp.take_along_axis(char_idx, first_byte[:, None],
+                                 axis=1)[:, 0] + 1
+        nchars = xp.sum(starts, axis=1).astype(np.int32)
+        out = xp.where(empty,
+                       xp.where(self.start - 1 <= nchars, self.start, 0),
+                       xp.where(found, pos, 0)).astype(np.int32)
+        return ctx.canonical(out, validity, T.IntegerType())
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count): prefix up to the count-th
+    delimiter (suffix after |count|-th-from-end when count < 0);
+    single-byte delimiters on device."""
+
+    sql_name = "SubstringIndex"
+
+    def __init__(self, child: Expression, delim: str, count: int):
+        self.children = (child,)
+        self.delim = delim
+        self.count = count
+
+    def with_new_children(self, children):
+        return SubstringIndex(children[0], self.delim, self.count)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return len(self.delim.encode("utf-8")) == 1
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        xp = ctx.xp
+        if not ctx.is_device:
+            out = np.empty(ctx.capacity, dtype=object)
+            for i in range(ctx.capacity):
+                if not a.validity[i]:
+                    out[i] = None
+                    continue
+                s = str(a.data[i])
+                c = self.count
+                if c == 0 or not self.delim:
+                    out[i] = ""
+                elif c > 0:
+                    out[i] = self.delim.join(s.split(self.delim)[:c])
+                else:
+                    out[i] = self.delim.join(s.split(self.delim)[c:])
+            return Val(out, a.validity, None, T.StringType())
+        w = a.data.shape[1]
+        d = self.delim.encode("utf-8")[0]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        is_d = (a.data == np.uint8(d)) & (j < a.lengths[:, None])
+        cum = xp.cumsum(is_d.astype(np.int32), axis=1)
+        ndelim = xp.where(a.lengths > 0, cum[:, -1], 0) \
+            if w > 0 else xp.zeros(ctx.capacity, np.int32)
+        c = self.count
+        if c == 0:
+            return ctx.canonical(xp.zeros_like(a.data), a.validity,
+                                 T.StringType(), xp.zeros_like(a.lengths))
+        if c > 0:
+            # end before the c-th delimiter (whole string if fewer)
+            hit = is_d & (cum == c)
+            found = xp.any(hit, axis=1)
+            endb = xp.where(found, xp.argmax(hit, axis=1).astype(np.int32),
+                            a.lengths)
+            new_len = endb
+            keep = j < new_len[:, None]
+            data = xp.where(keep, a.data, 0)
+            return ctx.canonical(data, a.validity, T.StringType(), new_len)
+        # c < 0: start after the (ndelim + c)-th delimiter from the left
+        k = ndelim + c + 1          # 1-based index of the delimiter
+        hit = is_d & (cum == k[:, None])
+        found = (k > 0) & xp.any(hit, axis=1)
+        startb = xp.where(found,
+                          xp.argmax(hit, axis=1).astype(np.int32) + 1, 0)
+        new_len = (a.lengths - startb).astype(np.int32)
+        idx = xp.clip(startb[:, None] + j, 0, w - 1)
+        shifted = xp.take_along_axis(a.data, idx, axis=1)
+        keep = j < new_len[:, None]
+        data = xp.where(keep, shifted, 0)
+        return ctx.canonical(data, a.validity, T.StringType(), new_len)
+
+
+class _HostOnlyStringUnary(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False
+
+
+class InitCap(_HostOnlyStringUnary):
+    """initcap: first letter of each word upper, rest lower (host-only:
+    Java title-casing is unicode-table driven)."""
+
+    sql_name = "InitCap"
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            if not a.validity[i]:
+                out[i] = None
+                continue
+            s = str(a.data[i]).lower()
+            out[i] = "".join(
+                ch.upper() if k == 0 or s[k - 1] == " " else ch
+                for k, ch in enumerate(s))
+        return Val(out, a.validity, None, T.StringType())
+
+
+class _PadBase(Expression):
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        self.children = (child,)
+        self.length = length
+        self.pad = pad
+
+    def with_new_children(self, children):
+        return type(self)(children[0], self.length, self.pad)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False  # char-width pad semantics are host-only for now
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            out[i] = self._pad(str(a.data[i])) if a.validity[i] else None
+        return Val(out, a.validity, None, T.StringType())
+
+    def _pad(self, s: str) -> str:
+        n = max(self.length, 0)  # Spark: negative pad length -> ''
+        if len(s) >= n:
+            return s[:n]
+        if not self.pad:
+            return s
+        fill = (self.pad * n)[: n - len(s)]
+        return self._join(s, fill)
+
+
+class StringLPad(_PadBase):
+    sql_name = "StringLPad"
+
+    def _join(self, s, fill):
+        return fill + s
+
+
+class StringRPad(_PadBase):
+    sql_name = "StringRPad"
+
+    def _join(self, s, fill):
+        return s + fill
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) (host-only: output width is data-dependent)."""
+
+    sql_name = "StringRepeat"
+
+    def __init__(self, child: Expression, times: Expression):
+        self.children = (child, times)
+
+    @property
+    def dtype(self):
+        return T.StringType()
+
+    @property
+    def device_supported(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        a, n = vals
+        validity = a.validity & n.validity
+        out = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            out[i] = str(a.data[i]) * max(int(n.data[i]), 0) \
+                if validity[i] else None
         return Val(out, validity, None, T.StringType())

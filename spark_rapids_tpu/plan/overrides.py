@@ -187,12 +187,6 @@ def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
 # tagging + conversion
 # ---------------------------------------------------------------------------
 
-def _expr_classes(e: Expression):
-    yield e
-    for ch in e.children:
-        yield from _expr_classes(ch)
-
-
 class TpuOverrides:
     """Tag the planned tree and realize backends + transitions."""
 
@@ -225,15 +219,22 @@ class TpuOverrides:
         key = f"spark.rapids.sql.exec.{meta.name}"
         if not conf.is_op_enabled(key):
             meta.will_not_work(f"{key} is disabled")
-        for e in meta.exprs:
+        bound = list(getattr(meta.exec_node, "bound_exprs", []))
+        for e in list(meta.exprs) + bound:
             if not isinstance(e, Expression):
                 continue
-            for sub in _expr_classes(e):
+            for sub in e.walk():
                 cname = type(sub).__name__
                 ekey = f"spark.rapids.sql.expression.{cname}"
                 if not conf.is_op_enabled(ekey):
                     meta.will_not_work(f"{ekey} is disabled")
-                if getattr(sub, "device_supported", True) is False:
+                try:
+                    ds = sub.device_supported
+                except TypeError:
+                    # dtype-dependent check on an unbound tree: the bound
+                    # copy (exec_node.bound_exprs) carries the decision
+                    ds = True
+                if ds is False:
                     meta.will_not_work(
                         f"expression {cname} has no device kernel")
         self._tag_special(meta)
