@@ -29,8 +29,15 @@ def _collect_rows(df, backend: str):
 
 
 def _norm(rows):
-    return sorted(
-        tuple((x is None, str(x)) for x in r) for r in rows)
+    """Order-insensitive row normalization with float tolerance: device
+    and oracle may sum doubles in different orders (streaming joins /
+    concurrent partials), so floats compare at 9 significant digits
+    (reference asserts.py approximate_float)."""
+    def cell(x):
+        if isinstance(x, float):
+            return (x is None, f"{x:.9g}")
+        return (x is None, str(x))
+    return sorted(tuple(cell(x) for x in r) for r in rows)
 
 
 def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,

@@ -1,13 +1,15 @@
 """SF-scalable TPC-DS-shaped data generator (column-pruned, parquet).
 
-Generates the five tables the query slice uses — store_sales, date_dim,
-item, customer, customer_address — with dsdgen-like row counts, key
-ranges, null fractions, and surrogate-key conventions (d_date_sk epoch
-2415022 = 1900-01-01, store_sales ~2.88M rows/SF).  Columns are pruned
-to those the queries touch; distributions are synthetic (deterministic
-numpy, seeded), NOT dsdgen bit-exact — this measures engine speed, not
-dsdgen conformance.  Reference harness: TpcdsLikeSpark.scala (explicit
-schemas + csv-to-parquet conversion), docs/benchmarks.md:104-147.
+Generates the tables the 20-query slice uses — store_sales, catalog_sales,
+web_sales, date_dim, time_dim, item, customer, customer_address, store,
+customer_demographics, household_demographics, promotion — with
+dsdgen-like row counts, key ranges, null fractions, and surrogate-key
+conventions (d_date_sk epoch 2415022 = 1900-01-01, store_sales ~2.88M
+rows/SF).  Columns are pruned to those the queries touch; distributions
+are synthetic (deterministic numpy, seeded), NOT dsdgen bit-exact — this
+measures engine speed, not dsdgen conformance.  Reference harness:
+TpcdsLikeSpark.scala (explicit schemas + csv-to-parquet conversion),
+docs/benchmarks.md:104-147.
 """
 from __future__ import annotations
 
@@ -19,33 +21,57 @@ import numpy as np
 
 __all__ = ["generate_tpcds", "table_row_counts", "TABLES"]
 
-TABLES = ("date_dim", "item", "customer", "customer_address", "store_sales")
+TABLES = ("date_dim", "time_dim", "item", "customer", "customer_address",
+          "store", "customer_demographics", "household_demographics",
+          "promotion", "store_sales", "catalog_sales", "web_sales")
+
+#: bump when generated schemas change; tables regenerate on mismatch
+_SCHEMA_VERSION = "v4"
 
 _DATE_SK_EPOCH = 2415022            # dsdgen: d_date_sk of 1900-01-01
 _DATE_DIM_DAYS = 73049              # 1900-01-01 .. 2099-12-31
 _SALES_DATE_LO = 35794              # days(1998-01-01 - 1900-01-01)
 _SALES_DATE_HI = 37985              # days(2003-12-31 - 1900-01-01)
+_UNIX_EPOCH_OFF = 25567             # days(1970-01-01 - 1900-01-01)
 
 _CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
                "Men", "Music", "Shoes", "Sports", "Women"]
+_CLASSES = ["accent", "bedding", "birdal", "blinds/shades", "classical",
+            "computers", "curtains/drapes", "decor", "dresses", "earings",
+            "fiction", "fragrances", "furniture", "glassware", "history",
+            "infants", "jewelry boxes", "kids", "maternity", "mattresses",
+            "mens", "musical", "mystery", "pants", "pendants", "pop",
+            "reference", "rock", "romance", "rugs", "scanners", "shirts",
+            "swimwear", "tables", "wallpaper", "womens"]
 _STATES = ["AL", "AK", "AZ", "AR", "CA", "CO", "CT", "DE", "FL", "GA",
            "HI", "ID", "IL", "IN", "IA", "KS", "KY", "LA", "ME", "MD",
            "MA", "MI", "MN", "MS", "MO", "MT", "NE", "NV", "NH", "NJ",
            "NM", "NY", "NC", "ND", "OH", "OK", "OR", "PA", "RI", "SC",
            "SD", "TN", "TX", "UT", "VT", "VA", "WA", "WV", "WI", "WY"]
+_FIRST = ["James", "Mary", "John", "Patricia", "Robert", "Jennifer",
+          "Michael", "Linda", "William", "Elizabeth", "David", "Barbara"]
+_LAST = ["Smith", "Johnson", "Williams", "Brown", "Jones", "Garcia",
+         "Miller", "Davis", "Rodriguez", "Martinez", "Hernandez", "Lopez"]
 
 
 def table_row_counts(sf: float) -> dict[str, int]:
-    """dsdgen-like scaling: store_sales linear in SF; dimensions sublinear
-    (item SF1=18k/SF10~57k, customer SF1=100k/SF10~500k)."""
+    """dsdgen-like scaling: fact tables linear in SF; dimensions
+    sublinear (item SF1=18k, customer SF1=100k)."""
     sf = max(sf, 0.001)
     n_cust = max(200, int(100_000 * sf ** 0.7))
     return {
         "date_dim": _DATE_DIM_DAYS,
+        "time_dim": 86_400,
         "item": max(100, int(18_000 * sf ** 0.5)),
         "customer": n_cust,
         "customer_address": max(100, n_cust // 2),
+        "store": max(4, int(12 * sf ** 0.5)),
+        "customer_demographics": max(500, int(50_000 * sf ** 0.5)),
+        "household_demographics": 7_200,
+        "promotion": max(30, int(300 * sf ** 0.5)),
         "store_sales": max(1000, int(2_880_000 * sf)),
+        "catalog_sales": max(500, int(1_440_000 * sf)),
+        "web_sales": max(250, int(720_000 * sf)),
     }
 
 
@@ -55,13 +81,25 @@ def _gen_date_dim(counts) -> dict[str, np.ndarray]:
     y = dates.astype("datetime64[Y]").astype(int) + 1970
     m = dates.astype("datetime64[M]").astype(int) % 12 + 1
     dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
+    dow = (days + 1) % 7            # 1900-01-01 was a Monday; 0 = Sunday
     return {
         "d_date_sk": (days + _DATE_SK_EPOCH).astype(np.int32),
+        "d_date": (days - _UNIX_EPOCH_OFF).astype(np.int32),  # DateType
         "d_year": y.astype(np.int32),
         "d_moy": m.astype(np.int32),
         "d_dom": dom.astype(np.int32),
+        "d_dow": dow.astype(np.int32),
         "d_month_seq": ((y - 1900) * 12 + (m - 1)).astype(np.int32),
         "d_qoy": ((m - 1) // 3 + 1).astype(np.int32),
+    }
+
+
+def _gen_time_dim(_counts) -> dict[str, np.ndarray]:
+    secs = np.arange(86_400, dtype=np.int64)
+    return {
+        "t_time_sk": secs.astype(np.int32),
+        "t_hour": (secs // 3600).astype(np.int32),
+        "t_minute": ((secs // 60) % 60).astype(np.int32),
     }
 
 
@@ -76,27 +114,52 @@ def _with_nulls(rng, arr: np.ndarray, frac: float) -> np.ndarray:
 def _gen_item(rng, n: int) -> dict[str, np.ndarray]:
     brand_id = rng.integers(1001001, 1010016, n).astype(np.int32)
     cat_idx = rng.integers(0, len(_CATEGORIES), n)
+    cls_idx = rng.integers(0, len(_CLASSES), n)
+    manu = rng.integers(1, 1001, n).astype(np.int32)
     return {
         "i_item_sk": np.arange(1, n + 1, dtype=np.int32),
+        "i_item_id": np.array([f"AAAAAAAA{k:08d}" for k in range(1, n + 1)],
+                              dtype=object),
+        "i_item_desc": np.array(
+            [f"desc {k} {_CLASSES[c]}" for k, c in enumerate(cls_idx)],
+            dtype=object),
         "i_brand_id": brand_id,
         "i_brand": np.array([f"Brand#{b % 100}" for b in brand_id],
                             dtype=object),
+        "i_class_id": (cls_idx + 1).astype(np.int32),
+        "i_class": np.array([_CLASSES[i] for i in cls_idx], dtype=object),
         "i_category_id": (cat_idx + 1).astype(np.int32),
         "i_category": _with_nulls(
             rng, np.array([_CATEGORIES[i] for i in cat_idx], dtype=object),
             0.005),
         "i_current_price": _with_nulls(
             rng, np.round(rng.uniform(0.09, 99.99, n), 2), 0.01),
-        "i_manufact_id": rng.integers(1, 1001, n).astype(np.int32),
+        "i_manufact_id": manu,
+        "i_manufact": np.array([f"manufact#{v}" for v in manu], dtype=object),
         "i_manager_id": rng.integers(1, 101, n).astype(np.int32),
     }
 
 
-def _gen_customer(rng, n: int, n_addr: int) -> dict[str, np.ndarray]:
+def _gen_customer(rng, n: int, n_addr: int, n_cdemo: int,
+                  n_hdemo: int) -> dict[str, np.ndarray]:
     return {
         "c_customer_sk": np.arange(1, n + 1, dtype=np.int32),
+        "c_customer_id": np.array(
+            [f"AAAAAAAA{k:08d}" for k in range(1, n + 1)], dtype=object),
         "c_current_addr_sk": _with_nulls(
             rng, rng.integers(1, n_addr + 1, n).astype(np.int32), 0.01),
+        "c_current_cdemo_sk": _with_nulls(
+            rng, rng.integers(1, n_cdemo + 1, n).astype(np.int32), 0.01),
+        "c_current_hdemo_sk": _with_nulls(
+            rng, rng.integers(1, n_hdemo + 1, n).astype(np.int32), 0.01),
+        "c_first_name": _with_nulls(
+            rng, np.array([_FIRST[i] for i in
+                           rng.integers(0, len(_FIRST), n)], dtype=object),
+            0.01),
+        "c_last_name": _with_nulls(
+            rng, np.array([_LAST[i] for i in
+                           rng.integers(0, len(_LAST), n)], dtype=object),
+            0.01),
     }
 
 
@@ -107,33 +170,206 @@ def _gen_customer_address(rng, n: int) -> dict[str, np.ndarray]:
             rng, np.array([_STATES[i] for i in
                            rng.integers(0, len(_STATES), n)], dtype=object),
             0.01),
+        "ca_city": np.array([f"City{v:03d}" for v in
+                             rng.integers(0, 400, n)], dtype=object),
+        "ca_county": np.array([f"County{v:03d}" for v in
+                               rng.integers(0, 200, n)], dtype=object),
+        "ca_zip": np.array([f"{v:05d}" for v in
+                            rng.integers(10000, 99999, n)], dtype=object),
+        "ca_gmt_offset": rng.choice([-10.0, -9.0, -8.0, -7.0, -6.0, -5.0],
+                                    n),
     }
 
 
-def _gen_store_sales(rng, n: int, n_items: int, n_cust: int):
+def _gen_store(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "s_store_sk": np.arange(1, n + 1, dtype=np.int32),
+        "s_store_id": np.array([f"AAAAAAAA{k:08d}" for k in range(1, n + 1)],
+                               dtype=object),
+        "s_store_name": np.array(
+            [["ought", "able", "pri", "ese", "anti", "cally", "ation",
+              "eing"][k % 8] for k in range(n)], dtype=object),
+        "s_state": np.array([_STATES[i] for i in
+                             rng.integers(0, 10, n)], dtype=object),
+        "s_county": np.array([f"County{v:03d}" for v in
+                              rng.integers(0, 30, n)], dtype=object),
+        "s_city": np.array([f"City{v:03d}" for v in
+                            rng.integers(0, 40, n)], dtype=object),
+        "s_company_id": rng.integers(1, 7, n).astype(np.int32),
+        "s_company_name": np.array(["Unknown"] * n, dtype=object),
+        "s_gmt_offset": np.array([(-8.0, -7.0, -6.0, -5.0)[k % 4]
+                                  for k in range(n)]),
+    }
+
+
+def _gen_customer_demographics(rng, n: int) -> dict[str, np.ndarray]:
+    eds = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+           "Advanced Degree", "Unknown"]
+    return {
+        "cd_demo_sk": np.arange(1, n + 1, dtype=np.int32),
+        "cd_gender": np.array([("M", "F")[v] for v in
+                               rng.integers(0, 2, n)], dtype=object),
+        "cd_marital_status": np.array(
+            [("M", "S", "D", "W", "U")[v] for v in rng.integers(0, 5, n)],
+            dtype=object),
+        "cd_education_status": np.array(
+            [eds[v] for v in rng.integers(0, len(eds), n)], dtype=object),
+        "cd_purchase_estimate": (rng.integers(1, 21, n) * 500).astype(
+            np.int32),
+        "cd_credit_rating": np.array(
+            [("Low Risk", "Good", "High Risk", "Unknown")[v]
+             for v in rng.integers(0, 4, n)], dtype=object),
+    }
+
+
+def _gen_household_demographics(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "hd_demo_sk": np.arange(1, n + 1, dtype=np.int32),
+        "hd_dep_count": rng.integers(0, 10, n).astype(np.int32),
+        "hd_vehicle_count": rng.integers(-1, 5, n).astype(np.int32),
+        "hd_buy_potential": np.array(
+            [(">10000", "5001-10000", "1001-5000", "501-1000", "0-500",
+              "Unknown")[v] for v in rng.integers(0, 6, n)], dtype=object),
+    }
+
+
+def _gen_promotion(rng, n: int) -> dict[str, np.ndarray]:
+    yn = lambda frac: np.array(  # noqa: E731
+        [("Y" if v else "N") for v in rng.random(n) < frac], dtype=object)
+    return {
+        "p_promo_sk": np.arange(1, n + 1, dtype=np.int32),
+        "p_channel_email": yn(0.1),
+        "p_channel_event": yn(0.15),
+        "p_channel_dmail": yn(0.1),
+        "p_channel_tv": yn(0.1),
+    }
+
+
+def _sales_common(rng, n, counts, prefix):
     qty = rng.integers(1, 101, n).astype(np.int32)
     price = np.round(np.exp(rng.normal(2.5, 1.0, n)).clip(0.01, 300.0), 2)
+    wholesale = np.round(price * rng.uniform(0.3, 0.9, n), 2)
+    ext = np.round(price * qty, 2)
+    return qty, price, wholesale, ext
+
+
+def _gen_store_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
+    qty, price, wholesale, ext = _sales_common(rng, n, counts, "ss")
     return {
         "ss_sold_date_sk": _with_nulls(
             rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
                   + _DATE_SK_EPOCH).astype(np.int32), 0.02),
-        "ss_item_sk": rng.integers(1, n_items + 1, n).astype(np.int32),
+        "ss_sold_time_sk": _with_nulls(
+            rng, rng.integers(0, 86_400, n).astype(np.int32), 0.02),
+        "ss_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
         "ss_customer_sk": _with_nulls(
-            rng, rng.integers(1, n_cust + 1, n).astype(np.int32), 0.04),
+            rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
+            0.04),
+        "ss_cdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_demographics"] + 1,
+                              n).astype(np.int32), 0.04),
+        "ss_hdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["household_demographics"] + 1,
+                              n).astype(np.int32), 0.04),
+        "ss_store_sk": _with_nulls(
+            rng, rng.integers(1, counts["store"] + 1, n).astype(np.int32),
+            0.02),
+        "ss_promo_sk": _with_nulls(
+            rng, rng.integers(1, counts["promotion"] + 1, n).astype(np.int32),
+            0.02),
+        "ss_ticket_number": rng.integers(1, max(n // 3, 2),
+                                         n).astype(np.int64),
         "ss_quantity": qty,
+        "ss_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
         "ss_sales_price": price,
-        "ss_ext_sales_price": np.round(price * qty, 2),
+        "ss_ext_sales_price": ext,
+        "ss_wholesale_cost": wholesale,
+        "ss_ext_wholesale_cost": np.round(wholesale * qty, 2),
+        "ss_coupon_amt": np.round(
+            ext * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3], n), 2),
+        "ss_net_profit": np.round(ext - wholesale * qty, 2),
     }
 
 
-def _write_parquet(path: str, data: dict, rows_per_file: int) -> None:
+def _gen_catalog_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
+    qty, price, wholesale, ext = _sales_common(rng, n, counts, "cs")
+    return {
+        "cs_sold_date_sk": _with_nulls(
+            rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
+                  + _DATE_SK_EPOCH).astype(np.int32), 0.02),
+        "cs_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
+        "cs_bill_customer_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
+            0.03),
+        "cs_bill_cdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_demographics"] + 1,
+                              n).astype(np.int32), 0.03),
+        "cs_promo_sk": _with_nulls(
+            rng, rng.integers(1, counts["promotion"] + 1, n).astype(np.int32),
+            0.02),
+        "cs_quantity": qty,
+        "cs_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
+        "cs_sales_price": price,
+        "cs_ext_sales_price": ext,
+        "cs_coupon_amt": np.round(
+            ext * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3], n), 2),
+    }
+
+
+def _gen_web_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
+    qty, price, wholesale, ext = _sales_common(rng, n, counts, "ws")
+    return {
+        "ws_sold_date_sk": _with_nulls(
+            rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
+                  + _DATE_SK_EPOCH).astype(np.int32), 0.02),
+        "ws_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
+        "ws_bill_customer_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
+            0.03),
+        "ws_quantity": qty,
+        "ws_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
+        "ws_sales_price": price,
+        "ws_ext_sales_price": ext,
+    }
+
+
+_GENERATORS = {
+    "date_dim": lambda rng, counts: _gen_date_dim(counts),
+    "time_dim": lambda rng, counts: _gen_time_dim(counts),
+    "item": lambda rng, counts: _gen_item(rng, counts["item"]),
+    "customer": lambda rng, counts: _gen_customer(
+        rng, counts["customer"], counts["customer_address"],
+        counts["customer_demographics"],
+        counts["household_demographics"]),
+    "customer_address": lambda rng, counts: _gen_customer_address(
+        rng, counts["customer_address"]),
+    "store": lambda rng, counts: _gen_store(rng, counts["store"]),
+    "customer_demographics": lambda rng, counts: _gen_customer_demographics(
+        rng, counts["customer_demographics"]),
+    "household_demographics": lambda rng, counts:
+        _gen_household_demographics(rng, counts["household_demographics"]),
+    "promotion": lambda rng, counts: _gen_promotion(rng, counts["promotion"]),
+    "store_sales": lambda rng, counts: _gen_store_sales(
+        rng, counts["store_sales"], counts),
+    "catalog_sales": lambda rng, counts: _gen_catalog_sales(
+        rng, counts["catalog_sales"], counts),
+    "web_sales": lambda rng, counts: _gen_web_sales(
+        rng, counts["web_sales"], counts),
+}
+
+
+def _write_parquet(path: str, data: dict, rows_per_file: int,
+                   date_cols: Sequence[str] = ()) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
     os.makedirs(path, exist_ok=True)
     n = len(next(iter(data.values())))
     cols = {}
     for name, arr in data.items():
-        if arr.dtype == object:
+        if name in date_cols:
+            cols[name] = pa.array(np.asarray(arr, dtype=np.int32),
+                                  type=pa.int32()).cast(pa.date32())
+        elif arr.dtype == object:
             base = next((x for x in arr if x is not None), 0)
             if isinstance(base, str):
                 cols[name] = pa.array(list(arr), type=pa.string())
@@ -160,30 +396,24 @@ def generate_tpcds(data_dir: str, sf: float = 0.01, seed: int = 42,
                    rows_per_file: int = 1 << 20) -> dict[str, int]:
     """Generate the pruned TPC-DS tables under ``data_dir/<table>/``.
 
-    Returns {table: rows}.  Skips tables whose directory already exists
-    (delete the dir to regenerate).
+    Returns {table: rows}.  Skips tables already generated at the current
+    schema version (marker file); regenerates on version mismatch.
     """
     counts = table_row_counts(sf)
     written = {}
     for t in tables:
         out = os.path.join(data_dir, t)
         written[t] = counts[t]
-        if os.path.isdir(out) and os.listdir(out):
+        marker = os.path.join(out, f"_{_SCHEMA_VERSION}")
+        if os.path.isdir(out) and os.path.exists(marker):
             continue
+        if os.path.isdir(out):
+            import shutil
+            shutil.rmtree(out)
         rng = np.random.default_rng(seed + zlib.crc32(t.encode()) % 1000)
-        if t == "date_dim":
-            data = _gen_date_dim(counts)
-        elif t == "item":
-            data = _gen_item(rng, counts["item"])
-        elif t == "customer":
-            data = _gen_customer(rng, counts["customer"],
-                                 counts["customer_address"])
-        elif t == "customer_address":
-            data = _gen_customer_address(rng, counts["customer_address"])
-        elif t == "store_sales":
-            data = _gen_store_sales(rng, counts["store_sales"],
-                                    counts["item"], counts["customer"])
-        else:
-            raise ValueError(f"unknown table {t}")
-        _write_parquet(out, data, rows_per_file)
+        data = _GENERATORS[t](rng, counts)
+        _write_parquet(out, data, rows_per_file,
+                       date_cols=("d_date",) if t == "date_dim" else ())
+        with open(marker, "w") as f:
+            f.write(_SCHEMA_VERSION + "\n")
     return written

@@ -126,8 +126,24 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
 
 
 def _split_window_exprs(exprs):
-    """Separate window expressions out of a projection list."""
+    """Separate window expressions out of a projection list.
+
+    Handles windows at ANY depth: nested occurrences (e.g.
+    ``x * 100 / sum(x).over(spec)``) are hoisted into generated columns
+    and replaced by references (round-1 advisor finding: the old code
+    only split top-level windows, letting nested ones crash projection
+    eval)."""
     plain, windows = [], []
+    counter = [0]
+
+    def hoist(node):
+        if isinstance(node, WindowExpression):
+            name = f"_we{counter[0]}"
+            counter[0] += 1
+            windows.append(node.alias(name))
+            return col(name)
+        return node
+
     for e in exprs:
         inner = e.children[0] if isinstance(e, Alias) else e
         if isinstance(inner, WindowExpression):
@@ -136,7 +152,7 @@ def _split_window_exprs(exprs):
                            else e)
             plain.append(col(name))
         else:
-            plain.append(e)
+            plain.append(e.transform_up(hoist))
     return plain, windows
 
 

@@ -42,6 +42,13 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """
     global _enabled_dir
     cache_dir = cache_dir or COMPILATION_CACHE_DIR.default
+    # partition by XLA_FLAGS: executables compiled under different flag
+    # sets (e.g. the virtual-device test mesh) trigger machine-feature
+    # mismatch warnings when loaded into a differently-flagged process
+    import hashlib
+    tag = hashlib.md5(
+        os.environ.get("XLA_FLAGS", "").encode()).hexdigest()[:8]
+    cache_dir = os.path.join(cache_dir, tag)
     if _enabled_dir == cache_dir:
         return _enabled_dir
     try:
