@@ -32,6 +32,18 @@ import jax as _jax
 # must be set before any jax computation.
 _jax.config.update("jax_enable_x64", True)
 
+# Pin pyarrow's internal pools to one thread BEFORE any pool use: pyarrow
+# compute/alloc on its multi-threaded pool concurrently with jax CPU
+# execution segfaults intermittently in this runtime (see
+# runtime.pin_arrow_threads).  Import-time is the only point guaranteed
+# single-threaded and before first use.
+try:
+    import pyarrow as _pa
+    _pa.set_cpu_count(1)
+    _pa.set_io_thread_count(1)
+except Exception:  # pyarrow optional at import time
+    pass
+
 from spark_rapids_tpu.version import __version__
 
 

@@ -29,6 +29,19 @@ __all__ = ["ColumnBatch", "round_capacity"]
 
 _MIN_CAPACITY = 8
 
+# Arrow<->device conversions are serialized AND pyarrow's internal pool
+# is pinned to one thread (runtime.pin_arrow_threads): pyarrow compute
+# kernels running on their multi-threaded pool concurrently with jax CPU
+# execution segfault intermittently.  The lock costs little —
+# conversions are host-side staging; device programs still overlap.
+_ARROW_LOCK = __import__("threading").Lock()
+
+
+def _arrow_guard():
+    from spark_rapids_tpu.runtime import pin_arrow_threads
+    pin_arrow_threads()
+    return _ARROW_LOCK
+
 
 def round_capacity(n: int) -> int:
     """Round a row count up to the compilation capacity bucket (pow2)."""
@@ -91,6 +104,11 @@ class ColumnBatch:
     def from_arrow(rb, capacity: int | None = None,
                    string_widths: dict[str, int] | None = None) -> "ColumnBatch":
         """Build a device batch from a pyarrow.RecordBatch (H2D transfer)."""
+        with _arrow_guard():
+            return ColumnBatch._from_arrow_locked(rb, capacity, string_widths)
+
+    @staticmethod
+    def _from_arrow_locked(rb, capacity=None, string_widths=None):
         import pyarrow as pa
         n = rb.num_rows
         cap = capacity or round_capacity(max(n, 1))
@@ -100,7 +118,7 @@ class ColumnBatch:
             arr = rb.column(i)
             if isinstance(arr, pa.ChunkedArray):
                 arr = arr.combine_chunks()
-            validity = _arrow_validity(arr, n)
+            validity = T.arrow_validity_numpy(arr)
             if isinstance(field.data_type, T.StringType):
                 w = (string_widths or {}).get(field.name)
                 bm, lens = _strings_to_matrix(arr, w)
@@ -111,10 +129,25 @@ class ColumnBatch:
         return ColumnBatch(cols, jnp.asarray(n, dtype=jnp.int32), schema)
 
     def to_arrow(self):
-        """Copy the batch back to host as a pyarrow.RecordBatch (D2H)."""
+        """Copy the batch back to host as a pyarrow.RecordBatch (D2H).
+
+        Leaves are materialized as OWNED numpy copies: pyarrow keeps
+        references to the buffers it is handed, and zero-copy views into
+        jax device buffers can dangle once the runtime reclaims them
+        (observed as a segfault under the virtual multi-device CPU mesh).
+        """
         import pyarrow as pa
         n = self.host_num_rows()
         host_cols = jax.device_get([(c.data, c.validity, c.lengths) for c in self.columns])
+        with _arrow_guard():
+            return self._to_arrow_locked(n, host_cols)
+
+    def _to_arrow_locked(self, n, host_cols):
+        import pyarrow as pa
+        # slice to the real rows BEFORE the ownership copy: copying the
+        # full pow2-capacity buffers wastes D2H-path memory traffic
+        host_cols = [tuple(None if a is None else np.array(a[:n], copy=True)
+                           for a in t) for t in host_cols]
         arrays = []
         for field, (data, validity, lengths) in zip(self.schema, host_cols):
             v = np.asarray(validity[:n], dtype=np.bool_)
@@ -147,12 +180,6 @@ class ColumnBatch:
             if c.lengths is not None:
                 total += c.lengths.size * 4
         return total
-
-
-def _arrow_validity(arr, n: int) -> np.ndarray:
-    if arr.null_count == 0:
-        return np.ones(n, dtype=np.bool_)
-    return np.asarray(arr.is_valid(), dtype=np.bool_)
 
 
 def _strings_to_matrix(arr, width: int | None = None):

@@ -190,9 +190,11 @@ PINNED_POOL_SIZE = bytes_conf(
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec", "none",
-    "Codec for shuffle partition buffers: none or lz4. "
-    "(ref RapidsConf.scala:729)",
-    check=lambda v: v in ("none", "lz4"), check_doc="must be none|lz4")
+    "Codec for shuffle partition buffers: none, lz4 (native C++ block "
+    "codec, native/lz4.cpp) or zstd. (ref RapidsConf.scala:729, "
+    "NvcompLZ4CompressionCodec.scala:25)",
+    check=lambda v: v in ("none", "lz4", "zstd"),
+    check_doc="must be none|lz4|zstd")
 
 SHUFFLE_TRANSPORT_CLASS = conf(
     "spark.rapids.shuffle.transport.class",

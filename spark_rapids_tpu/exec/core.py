@@ -157,10 +157,16 @@ class ExecCtx:
             return run_with_spill_retry(fn, self.catalog, *args, **kwargs)
 
     def close(self) -> None:
-        """End-of-execution cleanup: release the BufferCatalog (spilled
-        disk files, host arena) if one was created."""
+        """End-of-execution cleanup: close shuffle transports, then the
+        BufferCatalog (spilled disk files, host arena) if created."""
+        from spark_rapids_tpu.shuffle import ShuffleTransport
         with self._lock:
+            tkeys = [k for k, v in self.cache.items()
+                     if isinstance(v, ShuffleTransport)]
+            transports = [self.cache.pop(k) for k in tkeys]
             catalog = self.cache.pop("catalog", None)
+        for t in transports:
+            t.close()
         if catalog is not None:
             catalog.close()
 

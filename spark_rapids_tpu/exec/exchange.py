@@ -84,22 +84,20 @@ class ShuffleExchangeExec(PlanNode):
                           lambda: self._do_shuffle(ctx))
 
     def _do_shuffle(self, ctx: ExecCtx):
-        """Materialize the map side.  Device-backend output partitions are
-        parked in the BufferCatalog as spillable buffers with
-        SHUFFLE_OUTPUT priority — spilled first under memory pressure —
-        instead of pinning raw HBM (reference RapidsCachingWriter.write,
-        RapidsShuffleInternalManager.scala:90-155)."""
+        """Materialize the map side through the shuffle transport SPI
+        (reference RapidsCachingWriter.write storing spillable partition
+        tables, RapidsShuffleInternalManager.scala:90-155; transport
+        loaded by reflection, RapidsShuffleTransport.scala:638-658).
+        Host backend keeps plain batch lists (the oracle path)."""
         from spark_rapids_tpu.exec.core import drain_partitions
         child = self.children[0]
         batches = list(drain_partitions(ctx, child))
         self.partitioning.prepare(batches, ctx.is_device)
         n = self.partitioning.num_partitions
-        out: list[list] = [[] for _ in range(n)]
         if ctx.is_device:
             from spark_rapids_tpu.columnar.batch import round_capacity
-            from spark_rapids_tpu.memory.catalog import (
-                SpillableColumnarBatch, SpillPriority)
-            catalog = ctx.catalog
+            from spark_rapids_tpu.shuffle import make_transport
+            transport = make_transport(ctx.conf, ctx)
             for bi, b in enumerate(batches):
                 ids = self.partitioning.device_ids(b, bi)
                 sb, counts_d = ctx.dispatch(_jit_group_by_part, b, ids, n)
@@ -112,30 +110,25 @@ class ShuffleExchangeExec(PlanNode):
                         _jit_slice_part, sb, jnp.asarray(starts[p], jnp.int32),
                         jnp.asarray(counts[p], jnp.int32),
                         round_capacity(int(counts[p])))
-                    out[p].append(SpillableColumnarBatch(
-                        piece, catalog, SpillPriority.SHUFFLE_OUTPUT))
-        else:
-            for bi, b in enumerate(batches):
-                if b.num_rows == 0:
-                    continue
-                ids = self.partitioning.host_ids(b, bi)
-                for p in range(n):
-                    piece = hk.host_filter(b, ids == p)
-                    if piece.num_rows:
-                        out[p].append(piece)
+                    transport.write_partition(id(self), bi, p, piece)
+            return transport
+        out: list[list] = [[] for _ in range(n)]
+        for bi, b in enumerate(batches):
+            if b.num_rows == 0:
+                continue
+            ids = self.partitioning.host_ids(b, bi)
+            for p in range(n):
+                piece = hk.host_filter(b, ids == p)
+                if piece.num_rows:
+                    out[p].append(piece)
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        for item in self._shuffled(ctx)[pid]:
-            if ctx.is_device:
-                b = item.get()
-                yield b
-                # unpin (re-spillable) rather than close: shuffle output
-                # stays re-readable for the execution's lifetime and is
-                # reclaimed when the ExecCtx closes its catalog
-                item.unpin()
-            else:
-                yield item
+        shuffled = self._shuffled(ctx)
+        if ctx.is_device:
+            yield from shuffled.fetch_partition(id(self), pid)
+        else:
+            yield from shuffled[pid]
 
     def node_desc(self) -> str:
         return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"

@@ -82,9 +82,21 @@ class BufferCatalog:
         self.device_limit = device_limit or DEVICE_SPILL_LIMIT.get(settings)
         self.device_used = 0
         # the C++ arena maps its full capacity up front (~0.3s for 1GB),
-        # so it is created on FIRST SPILL, not per catalog/query
+        # so it is created on FIRST SPILL, not per catalog/query — unless
+        # spark.rapids.memory.pinnedPool.size asks for an eager staging
+        # pool, which is a PROCESS-level singleton (reference
+        # allocatePinnedMemory: once per executor, GpuDeviceManager.scala:
+        # 264-270)
         self._host_limit = host_limit or HOST_SPILL_LIMIT.get(settings)
         self._arena_obj = None
+        self._arena_shared = False
+        from spark_rapids_tpu.conf import PINNED_POOL_SIZE
+        pinned = PINNED_POOL_SIZE.get(settings)
+        if pinned and pinned > 0:
+            from spark_rapids_tpu.runtime import get_pinned_arena
+            self._arena_obj = get_pinned_arena(
+                max(self._host_limit, pinned))
+            self._arena_shared = True
         self._spill_dir_base = spill_dir
         self._spill_dir_made: str | None = None
         self.metrics = {"device_spills": 0, "host_spills": 0,
@@ -296,9 +308,9 @@ class BufferCatalog:
             for e in list(self._entries.values()):
                 self._drop_storage_locked(e)
             self._entries.clear()
-            if self._arena_obj is not None:
+            if self._arena_obj is not None and not self._arena_shared:
                 self._arena_obj.close()
-                self._arena_obj = None
+            self._arena_obj = None
 
 
 def _align(n: int) -> int:

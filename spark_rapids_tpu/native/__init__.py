@@ -16,13 +16,15 @@ import tempfile
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "arena.cpp")
+_SRCS = [os.path.join(_DIR, "arena.cpp"), os.path.join(_DIR, "lz4.cpp")]
 
 
 def _so_path() -> str:
-    with open(_SRC, "rb") as f:
-        h = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_DIR, f"_arena_{h}.so")
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_DIR, f"_native_{h.hexdigest()[:16]}.so")
 
 
 def _build(so: str) -> None:
@@ -31,14 +33,15 @@ def _build(so: str) -> None:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
     os.close(fd)
     try:
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *_SRCS,
                "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    for stale in glob.glob(os.path.join(_DIR, "_arena_*.so")):
+    for stale in glob.glob(os.path.join(_DIR, "_arena_*.so")) + \
+            glob.glob(os.path.join(_DIR, "_native_*.so")):
         if stale != so:
             try:
                 os.unlink(stale)
@@ -87,7 +90,48 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.spill_read.argtypes = [ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_uint8),
                                ctypes.c_size_t]
+    lib.lz4_compress_bound.restype = ctypes.c_size_t
+    lib.lz4_compress_bound.argtypes = [ctypes.c_size_t]
+    lib.lz4_compress.restype = ctypes.c_int64
+    lib.lz4_compress.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_size_t,
+                                 ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_size_t]
+    lib.lz4_decompress.restype = ctypes.c_int64
+    lib.lz4_decompress.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_size_t,
+                                   ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_size_t]
     return lib
+
+
+def lz4_compress(data) -> bytes:
+    """LZ4 block-compress a bytes-like buffer (native codec)."""
+    import numpy as np
+    lib = load()
+    src = np.frombuffer(data, dtype=np.uint8)
+    bound = lib.lz4_compress_bound(src.size)
+    dst = np.empty(bound, dtype=np.uint8)
+    n = lib.lz4_compress(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), src.size,
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), bound)
+    if n < 0:
+        raise ValueError("lz4 compression overflow")
+    return dst[:n].tobytes()
+
+
+def lz4_decompress(data, out_size: int) -> bytes:
+    """Decompress an LZ4 block into exactly ``out_size`` bytes."""
+    import numpy as np
+    lib = load()
+    src = np.frombuffer(data, dtype=np.uint8)
+    dst = np.empty(out_size, dtype=np.uint8)
+    n = lib.lz4_decompress(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), src.size,
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out_size)
+    if n != out_size:
+        raise ValueError(f"lz4 decompression failed ({n} != {out_size})")
+    return dst.tobytes()
 
 
 class HostArena:

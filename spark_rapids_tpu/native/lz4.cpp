@@ -1,0 +1,168 @@
+// LZ4 block-format codec (compressor + decompressor).
+//
+// The reference compresses shuffle/spill buffers on-device with nvcomp's
+// LZ4 (NvcompLZ4CompressionCodec.scala:25); the TPU build's staging
+// buffers live in host memory, so the codec is host-side C++ — same
+// block format, greedy hash-table matcher (the classic LZ4 "fast" level).
+//
+// Block format: sequences of
+//   token: high nibble = literal count (15 => extension bytes follow),
+//          low nibble  = match length - 4 (15 => extension bytes follow)
+//   <literals> <2-byte little-endian match offset> <match len extension>
+// The final sequence is literals-only.  Encoder rules honored: the last
+// 5 bytes are always literals; no match starts within 12 bytes of the end.
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+size_t lz4_compress_bound(size_t n) {
+  return n + n / 255 + 16;
+}
+
+// Returns compressed size, or -1 if dst is too small.
+int64_t lz4_compress(const uint8_t* src, size_t n, uint8_t* dst,
+                     size_t dst_cap) {
+  const size_t HASH_LOG = 16;
+  const size_t HASH_SIZE = 1u << HASH_LOG;
+  static thread_local uint32_t table[1u << 16];
+  std::memset(table, 0, HASH_SIZE * sizeof(uint32_t));
+
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  // matches must end >= 5 bytes before the end; candidates need 4+8 bytes
+  const uint8_t* const mflimit = (n >= 12) ? iend - 12 : src;
+  const uint8_t* anchor = src;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+
+  auto hash4 = [](const uint8_t* p) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return (v * 2654435761u) >> (32 - HASH_LOG);
+  };
+  auto read32 = [](const uint8_t* p) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+  };
+
+  if (n >= 13) {
+    table[hash4(ip)] = (uint32_t)(ip - src);
+    ip++;
+    while (ip < mflimit) {
+      // find a 4-byte match
+      uint32_t h = hash4(ip);
+      const uint8_t* match = src + table[h];
+      table[h] = (uint32_t)(ip - src);
+      if (match >= ip || (size_t)(ip - match) > 65535 ||
+          read32(match) != read32(ip)) {
+        ip++;
+        continue;
+      }
+      // extend backwards
+      while (ip > anchor && match > src && ip[-1] == match[-1]) {
+        ip--;
+        match--;
+      }
+      // emit literals
+      size_t lit = (size_t)(ip - anchor);
+      uint8_t* token = op++;
+      if (op + lit + lit / 255 + 8 > oend) return -1;
+      if (lit >= 15) {
+        *token = 15u << 4;
+        size_t rest = lit - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+      } else {
+        *token = (uint8_t)(lit << 4);
+      }
+      std::memcpy(op, anchor, lit);
+      op += lit;
+      // match length (beyond the 4-byte minimum)
+      size_t offset = (size_t)(ip - match);
+      const uint8_t* mp = match + 4;
+      const uint8_t* p = ip + 4;
+      const uint8_t* matchlimit = iend - 5;
+      while (p < matchlimit && *p == *mp) { p++; mp++; }
+      size_t mlen = (size_t)(p - ip) - 4;
+      if (op + 2 + mlen / 255 + 1 > oend) return -1;
+      *op++ = (uint8_t)(offset & 0xff);
+      *op++ = (uint8_t)(offset >> 8);
+      if (mlen >= 15) {
+        *token |= 15;
+        size_t rest = mlen - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+      } else {
+        *token |= (uint8_t)mlen;
+      }
+      ip = p;
+      anchor = ip;
+      if (ip < mflimit) table[hash4(ip - 2)] = (uint32_t)(ip - 2 - src);
+    }
+  }
+  // trailing literals
+  size_t lit = (size_t)(iend - anchor);
+  if (op + 1 + lit + lit / 255 + 1 > oend) return -1;
+  uint8_t* token = op++;
+  if (lit >= 15) {
+    *token = 15u << 4;
+    size_t rest = lit - 15;
+    while (rest >= 255) { *op++ = 255; rest -= 255; }
+    *op++ = (uint8_t)rest;
+  } else {
+    *token = (uint8_t)(lit << 4);
+  }
+  std::memcpy(op, anchor, lit);
+  op += lit;
+  return (int64_t)(op - dst);
+}
+
+// Returns decompressed size, or -1 on malformed input / overflow.
+int64_t lz4_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                       size_t dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (ip + lit > iend || op + lit > oend) return -1;
+    std::memcpy(op, ip, lit);
+    ip += lit;
+    op += lit;
+    if (ip >= iend) break;  // final literals-only sequence
+    if (ip + 2 > iend) return -1;
+    size_t offset = (size_t)ip[0] | ((size_t)ip[1] << 8);
+    ip += 2;
+    if (offset == 0 || (size_t)(op - dst) < offset) return -1;
+    size_t mlen = (token & 15) + 4;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    if (op + mlen > oend) return -1;
+    const uint8_t* match = op - offset;
+    for (size_t i = 0; i < mlen; i++) op[i] = match[i];  // may overlap
+    op += mlen;
+  }
+  return (int64_t)(op - dst);
+}
+
+}  // extern "C"

@@ -32,6 +32,41 @@ COMPILATION_CACHE_DIR = register(ConfEntry(
     "Directory for the persistent XLA compilation cache."))
 
 _enabled_dir: str | None = None
+_arrow_pinned = False
+_pinned_arena = None
+
+
+def get_pinned_arena(size: int):
+    """Process-level pinned staging arena (reference
+    allocatePinnedMemory, GpuDeviceManager.scala:264-270: allocated once
+    per executor process, not per query).  Grown only, never closed —
+    BufferCatalog shares it when pinnedPool.size > 0."""
+    global _pinned_arena
+    if _pinned_arena is None or _pinned_arena.capacity < size:
+        from spark_rapids_tpu.native import HostArena
+        _pinned_arena = HostArena(size)
+    return _pinned_arena
+
+
+def pin_arrow_threads() -> None:
+    """Pin pyarrow's internal compute/IO pools to one thread.
+
+    Empirically required in this runtime: pyarrow compute kernels
+    (fill_null/cast/array) segfault intermittently when their internal
+    pool runs concurrently with jax CPU execution on other python
+    threads.  The engine supplies its own parallelism (drain worker
+    pool), so single-threaded pyarrow conversions lose nothing.
+    """
+    global _arrow_pinned
+    if _arrow_pinned:
+        return
+    try:
+        import pyarrow as pa
+        pa.set_cpu_count(1)
+        pa.set_io_thread_count(1)
+    except Exception:
+        pass
+    _arrow_pinned = True
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -73,8 +108,9 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
 
 def ensure_runtime(conf=None) -> None:
     """Session-start runtime init (reference RapidsExecutorPlugin.init,
-    Plugin.scala:124-154): compilation cache now; device pool / semaphore
-    wiring lives in memory/catalog.py."""
+    Plugin.scala:124-154): compilation cache + arrow thread pinning;
+    device pool / semaphore wiring lives in memory/catalog.py."""
+    pin_arrow_threads()
     settings = getattr(conf, "settings", None) or {}
     if COMPILATION_CACHE_ENABLED.get(settings):
         enable_compilation_cache(COMPILATION_CACHE_DIR.get(settings))

@@ -1,0 +1,60 @@
+"""Accelerated-shuffle subsystem: transport SPI + codec + serializer.
+
+Reference (SURVEY §2.6, §5.8): `RapidsShuffleTransport` is a pluggable
+SPI loaded by reflection from ``spark.rapids.shuffle.transport.class``
+(RapidsShuffleTransport.scala:378-460, makeTransport :638-658), with the
+UCX implementation as its one transport; shuffle data lives spillable in
+the catalog and is served on demand.  The TPU analog keeps the SPI shape:
+a transport owns (a) map-output storage and (b) the data plane that moves
+partition bytes to consumers.  `LocalShuffleTransport` (shuffle/local.py)
+is the single-process plane; the mesh collective path (parallel/
+mesh_shuffle.py) is the ICI plane the planner picks for mesh-sharded
+plans.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Iterable, Protocol, runtime_checkable
+
+from spark_rapids_tpu.conf import TpuConf
+
+__all__ = ["ShuffleTransport", "make_transport"]
+
+
+@runtime_checkable
+class ShuffleTransport(Protocol):
+    """Transport SPI (reference RapidsShuffleTransport.scala:378-460).
+
+    A transport instance is scoped to one execution: the exchange writes
+    every map task's partition batches, then consumers fetch per reduce
+    partition.  Implementations own storage (spillable or serialized) and
+    the movement plane.
+    """
+
+    def write_partition(self, shuffle_id: int, map_id: int, part_id: int,
+                        batch) -> None:
+        """Store one map-output batch for (shuffle, map, partition)."""
+        ...
+
+    def fetch_partition(self, shuffle_id: int, part_id: int) -> Iterable:
+        """All batches of one reduce partition (any map order)."""
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+def make_transport(conf: TpuConf, ctx=None) -> ShuffleTransport:
+    """Reflection-load the transport class from
+    ``spark.rapids.shuffle.transport.class`` (reference makeTransport,
+    RapidsShuffleTransport.scala:638-658)."""
+    from spark_rapids_tpu.conf import SHUFFLE_TRANSPORT_CLASS
+    path = conf.get(SHUFFLE_TRANSPORT_CLASS)
+    mod_name, _, cls_name = path.rpartition(".")
+    try:
+        mod = importlib.import_module(mod_name)
+        cls = getattr(mod, cls_name)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(
+            f"cannot load shuffle transport {path!r}: {e}") from e
+    return cls(conf, ctx)

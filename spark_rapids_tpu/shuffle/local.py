@@ -1,0 +1,87 @@
+"""LocalShuffleTransport: the single-process shuffle data plane.
+
+Reference mapping (SURVEY §2.6): RapidsCachingWriter stores map-output
+tables spillable in the device store (RapidsShuffleInternalManager.scala:
+90-155) and RapidsCachingReader serves local blocks straight from the
+catalog.  Here:
+
+* codec == none  -> partition batches stay device-resident, registered in
+  the execution's BufferCatalog as SpillableColumnarBatch with
+  SHUFFLE_OUTPUT priority (spilled first under pressure);
+* codec != none  -> batches are serialized (Arrow IPC, shuffle/
+  serializer.py) and compressed into host bytes — the
+  GpuColumnarBatchSerializer + nvcomp path — and restored on fetch.
+
+Multi-host planes (ICI collectives / DCN) implement the same SPI; the
+planner's mesh path (exec/mesh_exec.py) is the ICI plane.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from spark_rapids_tpu.conf import (SHUFFLE_COMPRESSION_CODEC,
+                                   SHUFFLE_MAX_METADATA_SIZE, TpuConf)
+from spark_rapids_tpu.shuffle.compression import get_codec
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+
+__all__ = ["LocalShuffleTransport"]
+
+
+class LocalShuffleTransport:
+    """In-process ShuffleTransport (see shuffle/__init__.py SPI)."""
+
+    def __init__(self, conf: TpuConf, ctx=None):
+        self.conf = conf
+        self.ctx = ctx
+        self.codec = get_codec(conf.get(SHUFFLE_COMPRESSION_CODEC))
+        self.max_metadata = conf.get(SHUFFLE_MAX_METADATA_SIZE)
+        self._lock = threading.Lock()
+        # (shuffle_id, part_id) -> list of stored items in map order
+        self._store: dict[tuple, list] = {}
+        self.metrics = {"bytes_written": 0, "bytes_compressed": 0,
+                        "batches_written": 0}
+
+    # -- SPI ------------------------------------------------------------
+    def write_partition(self, shuffle_id: int, map_id: int, part_id: int,
+                        batch) -> None:
+        if self.codec is None and self.ctx is not None:
+            from spark_rapids_tpu.memory.catalog import (
+                SpillableColumnarBatch, SpillPriority)
+            item = ("spillable", SpillableColumnarBatch(
+                batch, self.ctx.catalog, SpillPriority.SHUFFLE_OUTPUT))
+        else:
+            raw = serialize_batch(batch, self.max_metadata)
+            self.metrics["bytes_written"] += len(raw)
+            if self.codec is not None:
+                comp = self.codec.compress(raw)
+                self.metrics["bytes_compressed"] += len(comp)
+                item = ("bytes", comp, len(raw))
+            else:
+                item = ("bytes", raw, len(raw))
+        with self._lock:
+            self._store.setdefault((shuffle_id, part_id), []).append(item)
+        self.metrics["batches_written"] += 1
+
+    def fetch_partition(self, shuffle_id: int, part_id: int) -> Iterable:
+        with self._lock:
+            items = list(self._store.get((shuffle_id, part_id), ()))
+        for item in items:
+            if item[0] == "spillable":
+                b = item[1].get()
+                yield b
+                item[1].unpin()
+            else:
+                _, data, raw_size = item
+                raw = self.codec.decompress(data, raw_size) \
+                    if self.codec is not None else data
+                yield deserialize_batch(raw, device=True)
+
+    def close(self) -> None:
+        with self._lock:
+            items = [i for lst in self._store.values() for i in lst]
+            self._store.clear()
+        for item in items:
+            if item[0] == "spillable":
+                item[1].close()

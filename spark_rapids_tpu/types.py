@@ -175,17 +175,55 @@ def arrow_fixed_to_numpy(arr, dt: DataType) -> "np.ndarray":
     """Extract a fixed-width Arrow array as numpy in the framework's
     physical encoding (date=int32 days, timestamp=int64 micros, nulls
     zero-filled).  Shared by the host oracle batch and the device batch so
-    the two paths cannot diverge."""
+    the two paths cannot diverge.
+
+    Reads the Arrow buffers with raw numpy math instead of
+    pyarrow.compute kernels: the decode path runs on concurrent drain
+    workers and pa.compute interleaved with jax CPU execution segfaulted
+    intermittently (fill_null/cast); buffer reads are plain memory."""
     import pyarrow as pa
     if isinstance(dt, TimestampType):
-        return arr.cast(pa.timestamp("us")).cast(pa.int64()) \
-            .fill_null(0).to_numpy(zero_copy_only=False).astype(np.int64)
-    if isinstance(dt, DateType):
-        return arr.cast(pa.int32()).fill_null(0) \
-            .to_numpy(zero_copy_only=False).astype(np.int32)
-    if isinstance(dt, BooleanType):
-        return np.asarray(arr.fill_null(False), dtype=np.bool_)
-    return arr.fill_null(0).to_numpy(zero_copy_only=False).astype(dt.np_dtype)
+        expect = pa.timestamp("us")
+        base = np.int64
+    elif isinstance(dt, DateType):
+        expect = pa.date32()
+        base = np.int32
+    elif isinstance(dt, BooleanType):
+        expect = pa.bool_()
+        base = None
+    else:
+        expect = to_arrow(dt)
+        base = np.dtype(dt.np_dtype)
+    if arr.type != expect:
+        arr = arr.cast(expect)  # rare physical-type adjust (scan shims)
+    n = len(arr)
+    off = arr.offset
+    bufs = arr.buffers()
+    if base is None:  # boolean: bit-packed values
+        nbytes = (off + n + 7) // 8
+        bits = np.frombuffer(bufs[1], np.uint8, count=nbytes)
+        out = np.unpackbits(bits, bitorder="little")[off:off + n] \
+            .astype(np.bool_)
+    else:
+        itemsize = np.dtype(base).itemsize
+        out = np.frombuffer(bufs[1], base, count=n,
+                            offset=off * itemsize).copy()
+    if arr.null_count:
+        valid = arrow_validity_numpy(arr)
+        out[~valid] = 0
+    return out if base is None else out.astype(dt.np_dtype, copy=False)
+
+
+def arrow_validity_numpy(arr) -> "np.ndarray":
+    """bool[n] validity from the Arrow bitmap (no pa.compute)."""
+    n = len(arr)
+    if arr.null_count == 0 or arr.buffers()[0] is None:
+        return np.ones(n, dtype=np.bool_)
+    off = arr.offset
+    nbytes = (off + n + 7) // 8
+    bits = np.frombuffer(arr.buffers()[0], np.uint8, count=nbytes)
+    return np.unpackbits(bits, bitorder="little")[off:off + n] \
+        .astype(np.bool_)
 
 
 class StructField:

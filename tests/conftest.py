@@ -14,6 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+try:  # pin pyarrow pools before ANY use (see runtime.pin_arrow_threads)
+    import pyarrow as _pa
+    _pa.set_cpu_count(1)
+    _pa.set_io_thread_count(1)
+except ImportError:
+    pass
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
