@@ -64,8 +64,10 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         return PlannedNode(node.exec_node)
     if isinstance(node, L.Filter):
         c = lower(node.child, conf)
-        ex = FilterExec(node.condition, c.exec_node)
-        return PlannedNode(ex, [node.condition], [c])
+        from spark_rapids_tpu.udf import maybe_compile_udfs
+        cond = maybe_compile_udfs([node.condition], conf)[0]
+        ex = FilterExec(cond, c.exec_node)
+        return PlannedNode(ex, [cond], [c])
     if isinstance(node, L.Project):
         return _lower_project(node, conf)
     if isinstance(node, L.Aggregate):
@@ -158,10 +160,12 @@ def _split_window_exprs(exprs):
 
 def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
-    plain, windows = _split_window_exprs(node.exprs)
+    from spark_rapids_tpu.udf import maybe_compile_udfs
+    exprs = maybe_compile_udfs(node.exprs, conf)
+    plain, windows = _split_window_exprs(exprs)
     if not windows:
-        ex = ProjectExec(node.exprs, c.exec_node)
-        return PlannedNode(ex, list(node.exprs), [c])
+        ex = ProjectExec(exprs, c.exec_node)
+        return PlannedNode(ex, list(exprs), [c])
     # one WindowExec per distinct spec (Spark's planner does the same),
     # then the final projection over the appended columns
     by_spec: dict = {}

@@ -1,0 +1,18 @@
+"""Python-UDF compiler: bytecode -> native expression trees.
+
+Reference: the udf-compiler module (udf-compiler/, 4.3k LoC) symbolically
+executes JVM bytecode of Scala lambdas into Catalyst expressions
+(LambdaReflection + CFG + Instruction.scala + CatalystExpressionBuilder.
+scala:45-80, `compile` :66), falling back silently when a construct is
+unsupported.  The TPU analog symbolically executes CPython bytecode:
+straight-line lambdas over arithmetic/comparison ops compile to the
+engine's Expression IR and run on device; anything else stays a
+host-evaluated row-at-a-time PythonUDF (the planner tags the enclosing
+exec off-device, explain shows `!`).
+
+Enabled by ``spark.rapids.sql.udfCompiler.enabled``.
+"""
+from spark_rapids_tpu.udf.compiler import (PythonUDF, compile_udf,
+                                           maybe_compile_udfs, udf)
+
+__all__ = ["udf", "PythonUDF", "compile_udf", "maybe_compile_udfs"]

@@ -1,0 +1,234 @@
+"""CPython-bytecode symbolic execution -> Expression IR.
+
+The compile strategy mirrors the reference's Instruction/State design
+(udf-compiler Instruction.scala symbolic stack machine, State.scala):
+walk the instruction stream with a symbolic operand stack whose entries
+are Expression nodes; a RETURN yields the compiled tree.  v0 scope:
+straight-line code (no jumps/loops/short-circuit), arithmetic
+(+ - * / // % **), unary minus, comparisons, and calls to a small
+builtin allowlist (abs).  Unsupported constructs raise internally and
+the caller falls back to the row-at-a-time host UDF — the reference's
+silent-fallback contract (LogicalPlanRules.apply :79-94).
+"""
+from __future__ import annotations
+
+import dis
+from typing import Callable, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import (EvalCtx, Expression, Literal, Val,
+                                        lit)
+
+__all__ = ["PythonUDF", "compile_udf", "maybe_compile_udfs", "udf"]
+
+
+class _Unsupported(Exception):
+    pass
+
+
+# python 3.11+ BINARY_OP argument -> builder
+def _binary_builders():
+    from spark_rapids_tpu.expr import arithmetic as A
+
+    def div(a, b):
+        return A.Divide(a, b)
+
+    return {
+        "+": lambda a, b: A.Add(a, b),
+        "-": lambda a, b: A.Subtract(a, b),
+        "*": lambda a, b: A.Multiply(a, b),
+        "/": div,
+        "//": lambda a, b: A.IntegralDivide(a, b)
+        if hasattr(A, "IntegralDivide") else _unsup(),
+        "%": lambda a, b: A.Remainder(a, b),
+        "**": _pow,
+    }
+
+
+def _pow(a, b):
+    from spark_rapids_tpu.expr.math_ops import Pow
+    return Pow(a, b)
+
+
+def _unsup():
+    raise _Unsupported("operator")
+
+
+def _compare_builders():
+    from spark_rapids_tpu.expr import predicates as P
+    return {
+        "==": P.EqualTo, "!=": lambda a, b: P.Not(P.EqualTo(a, b)),
+        "<": P.LessThan, "<=": P.LessThanOrEqual,
+        ">": P.GreaterThan, ">=": P.GreaterThanOrEqual,
+    }
+
+
+def compile_udf(fn: Callable, args: Sequence[Expression]) -> Expression | None:
+    """Compile ``fn``'s bytecode against symbolic ``args``; None when any
+    construct is outside the supported subset (silent fallback)."""
+    try:
+        return _compile(fn, list(args))
+    except Exception:
+        return None
+
+
+def _compile(fn: Callable, args: list[Expression]) -> Expression:
+    code = fn.__code__
+    if code.co_argcount != len(args):
+        raise _Unsupported("arity")
+    locals_map: dict[str, Expression] = {
+        name: args[i] for i, name in
+        enumerate(code.co_varnames[:code.co_argcount])}
+    binops = _binary_builders()
+    cmps = _compare_builders()
+    from spark_rapids_tpu.expr.arithmetic import Abs, UnaryMinus
+    allowed_globals = {"abs": lambda a: Abs(a)}
+
+    stack: list = []
+    for ins in dis.get_instructions(fn):
+        op = ins.opname
+        if op in ("RESUME", "NOP", "PRECALL", "CACHE", "PUSH_NULL",
+                  "COPY_FREE_VARS"):
+            continue
+        if op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_BORROW"):
+            if ins.argval not in locals_map:
+                raise _Unsupported(f"unbound local {ins.argval}")
+            stack.append(locals_map[ins.argval])
+        elif op in ("LOAD_FAST_LOAD_FAST", "LOAD_FAST_BORROW_LOAD_FAST_BORROW"):
+            for name in ins.argval:
+                if name not in locals_map:
+                    raise _Unsupported(f"unbound local {name}")
+                stack.append(locals_map[name])
+        elif op == "LOAD_CONST":
+            stack.append(lit(ins.argval))
+        elif op in ("LOAD_GLOBAL",):
+            name = ins.argval
+            if name not in allowed_globals:
+                raise _Unsupported(f"global {name}")
+            stack.append(allowed_globals[name])
+        elif op == "BINARY_OP":
+            sym = ins.argrepr.rstrip("=")
+            if "=" in ins.argrepr and not ins.argrepr.endswith("="):
+                raise _Unsupported(ins.argrepr)
+            if sym not in binops:
+                raise _Unsupported(f"binary {ins.argrepr}")
+            b, a = stack.pop(), stack.pop()
+            stack.append(binops[sym](a, b))
+        elif op == "UNARY_NEGATIVE":
+            stack.append(UnaryMinus(stack.pop()))
+        elif op == "COMPARE_OP":
+            sym = ins.argrepr.split()[0]
+            if sym not in cmps:
+                raise _Unsupported(f"compare {ins.argrepr}")
+            b, a = stack.pop(), stack.pop()
+            stack.append(cmps[sym](a, b))
+        elif op == "CALL":
+            argc = ins.arg
+            call_args = [stack.pop() for _ in range(argc)][::-1]
+            target = stack.pop()
+            if stack and stack[-1] is None:
+                stack.pop()
+            if not callable(target):
+                raise _Unsupported("call target")
+            stack.append(target(*call_args))
+        elif op in ("RETURN_VALUE",):
+            if len(stack) != 1:
+                raise _Unsupported("stack depth at return")
+            return stack[0]
+        elif op == "RETURN_CONST":
+            return lit(ins.argval)
+        elif op == "STORE_FAST":
+            locals_map[ins.argval] = stack.pop()
+        else:
+            raise _Unsupported(op)
+    raise _Unsupported("no return")
+
+
+# ---------------------------------------------------------------------------
+# Row-at-a-time fallback expression (reference GpuScalaUDF / the
+# uncompiled ScalaUDF path — host-only, tags the exec off device)
+# ---------------------------------------------------------------------------
+
+class PythonUDF(Expression):
+    sql_name = "PythonUDF"
+
+    def __init__(self, fn: Callable, children: Sequence[Expression],
+                 return_type: T.DataType):
+        self.fn = fn
+        self.children = tuple(children)
+        self.return_type = return_type
+
+    def with_new_children(self, children):
+        return PythonUDF(self.fn, children, self.return_type)
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def device_supported(self):
+        return False
+
+    def _eval(self, vals: list[Val], ctx: EvalCtx):
+        n = ctx.capacity
+        is_str = isinstance(self.return_type, T.StringType)
+        out = np.empty(n, dtype=object) if is_str else \
+            np.zeros(n, dtype=self.return_type.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            # null-propagating call semantics, matching what the COMPILED
+            # expression tree produces (null in -> null out) so results
+            # do not depend on whether compilation succeeded
+            if not all(v.validity[i] for v in vals):
+                if is_str:
+                    out[i] = None
+                continue
+            r = self.fn(*[v.data[i] for v in vals])
+            if r is not None:
+                out[i] = r
+                validity[i] = True
+            elif is_str:
+                out[i] = None
+        return Val(out, validity, None, self.return_type)
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "<lambda>")
+        return f"PythonUDF({name}, {', '.join(map(repr, self.children))})"
+
+
+def udf(fn: Callable, return_type: T.DataType | None = None):
+    """Wrap a python function as a column UDF:
+    ``df.select(udf(lambda x: x * 2 + 1)(col("a")))``."""
+
+    def apply(*cols):
+        rt = return_type or T.DoubleType()
+        return PythonUDF(fn, [c for c in cols], rt)
+
+    return apply
+
+
+def maybe_compile_udfs(exprs: Sequence[Expression], conf) -> list[Expression]:
+    """Planner hook: replace PythonUDF nodes with compiled native trees
+    when the compiler conf is on (reference LogicalPlanRules.apply)."""
+    from spark_rapids_tpu.conf import UDF_COMPILER_ENABLED
+    if not conf.get(UDF_COMPILER_ENABLED):
+        return list(exprs)
+
+    def rewrite(node):
+        if isinstance(node, PythonUDF):
+            compiled = compile_udf(node.fn, node.children)
+            if compiled is not None:
+                # honor the declared return type either way, so the output
+                # schema is identical whether or not compilation succeeds
+                from spark_rapids_tpu.expr.cast import Cast
+                return Cast(compiled, node.return_type)
+        return node
+
+    return [e.transform_up(rewrite) if isinstance(e, Expression) else e
+            for e in exprs]

@@ -1,0 +1,91 @@
+"""Python-UDF compiler: bytecode -> native expressions, silent fallback.
+
+Round-3 verdict item 8 (reference udf-compiler CatalystExpressionBuilder
+compile :66, silent-fallback LogicalPlanRules :79-94).
+"""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.udf import PythonUDF, compile_udf, udf
+
+
+def _session(compiler=True):
+    return TpuSession({"spark.rapids.sql.udfCompiler.enabled": compiler})
+
+
+def _df(s):
+    schema = T.Schema([T.StructField("a", T.DoubleType()),
+                       T.StructField("b", T.DoubleType())])
+    return s.from_pydict({"a": [1.0, 2.0, None, -4.5],
+                          "b": [10.0, 20.0, 30.0, 40.0]}, schema)
+
+
+def test_compile_straight_line():
+    tree = compile_udf(lambda x: x * 2 + 1, [col("a")])
+    assert tree is not None
+    assert "Add" in repr(type(tree)) or "Add" in repr(tree)
+
+
+def test_compile_two_args_and_abs():
+    assert compile_udf(lambda x, y: abs(x - y), [col("a"), col("b")]) \
+        is not None
+    assert compile_udf(lambda x: x ** 2, [col("a")]) is not None
+    assert compile_udf(lambda x, y: x >= y, [col("a"), col("b")]) is not None
+
+
+def test_unsupported_returns_none():
+    assert compile_udf(lambda x: len(str(x)), [col("a")]) is None
+    assert compile_udf(lambda x: [x], [col("a")]) is None
+    assert compile_udf(lambda x: x if x > 0 else -x, [col("a")]) is None
+
+
+def test_compiled_udf_runs_on_device():
+    s = _session(compiler=True)
+    out = _df(s).select(col("a"),
+                        udf(lambda x: x * 2 + 1)(col("a")).alias("u"))
+    ex = out.explain()
+    assert "PythonUDF" not in ex       # compiled away
+    assert "!" not in ex               # fully on device
+    rows = sorted(out.collect(), key=str)
+    assert (1.0, 3.0) in rows and (2.0, 5.0) in rows
+    assert any(r[0] is None and r[1] is None for r in rows)
+
+
+def test_uncompilable_falls_back_to_host():
+    s = _session(compiler=True)
+    f = udf(lambda x: float(len(f"{x:.2f}")), T.DoubleType())
+    out = _df(s).select(f(col("b")).alias("u"))
+    assert "!" in out.explain()        # host fallback visible
+    rows = sorted(out.collect())
+    assert rows[0][0] == 5.0           # "10.00"
+
+
+def test_compiler_disabled_stays_host():
+    s = _session(compiler=False)
+    out = _df(s).select(udf(lambda x: x * 2 + 1)(col("a")).alias("u"))
+    assert "PythonUDF" in out.explain()
+    assert "!" in out.explain()
+    rows = sorted(out.collect(), key=str)
+    assert (3.0,) in rows and (5.0,) in rows
+
+
+def test_compiled_matches_host_oracle():
+    s = _session(compiler=True)
+    out = _df(s).select(
+        udf(lambda x, y: abs(x - y) * 2)(col("a"), col("b")).alias("u"),
+        udf(lambda x: -x + 0.5)(col("b")).alias("v"))
+    dev = sorted(out.collect(), key=str)
+    ov, meta = out._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf), key=str)
+    assert dev == host
+
+
+def test_filter_with_compiled_udf():
+    s = _session(compiler=True)
+    pred = udf(lambda x: x > 15.0, T.BooleanType())
+    out = _df(s).where(pred(col("b")).cast(T.BooleanType()))
+    rows = out.collect()
+    assert all(r[1] > 15.0 for r in rows) and len(rows) == 3
