@@ -94,6 +94,7 @@ class JoinExec(PlanNode):
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  join_type: str, condition: Expression | None = None):
+        user_join_type = join_type  # pre-swap, for user-facing errors
         if join_type == "right":
             # run as side-swapped left join; output reordered in
             # partition_iter (reference build-side flip)
@@ -106,8 +107,8 @@ class JoinExec(PlanNode):
         assert join_type in JOIN_TYPES and join_type != "cross", join_type
         if condition is not None and join_type != "inner":
             raise ValueError(
-                f"non-equi condition not supported for {join_type} join "
-                "(reference tagJoin, GpuHashJoin.scala:30-45)")
+                f"non-equi condition not supported for {user_join_type} "
+                "join (reference tagJoin, GpuHashJoin.scala:30-45)")
         super().__init__([left, right])
         from spark_rapids_tpu.expr.misc import reject_partition_aware
         reject_partition_aware(list(left_keys) + list(right_keys)

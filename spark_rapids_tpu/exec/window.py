@@ -234,7 +234,10 @@ class WindowExec(PlanNode):
                     data[i] = r
                 new_cols.append(HostColumn(data, np.ones(n, bool), out_dt))
             elif isinstance(f, (Lead, Lag)):
-                off = f.offset if isinstance(f, Lead) else -f.offset
+                # Lag subclasses Lead: test Lag first (same fix as the
+                # device path — both sides previously read forward, which
+                # differential testing could not catch)
+                off = -f.offset if isinstance(f, Lag) else f.offset
                 data = np.empty(n, object)
                 validity = np.zeros(n, bool)
                 defv = None
@@ -344,17 +347,32 @@ def _jit_window(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
             out_cols.append(DeviceColumn(
                 jnp.where(seg.real, data, 0), seg.real, T.IntegerType()))
         elif isinstance(f, (Lead, Lag)):
-            off = f.offset if isinstance(f, Lead) else -f.offset
+            # NOTE: Lag subclasses Lead — test Lag FIRST (isinstance of
+            # Lead is true for both; the old order made lag read forward)
+            off = -f.offset if isinstance(f, Lag) else f.offset
             col = sb.columns[ii]
-            dd = dv = None
+            dd = dv = dl = None
             if f.default is not None:
                 from spark_rapids_tpu.expr.core import Literal
                 assert isinstance(f.default, Literal)
                 if f.default.value is not None:
-                    dd = jnp.full(sb.capacity, f.default.value,
-                                  col.data.dtype)
+                    if col.is_string:
+                        import numpy as _np
+                        from spark_rapids_tpu.columnar.column import \
+                            round_string_width
+                        bs = str(f.default.value).encode("utf-8")
+                        w = max(col.max_len,
+                                round_string_width(max(len(bs), 1)))
+                        row = _np.zeros(w, _np.uint8)
+                        row[:len(bs)] = _np.frombuffer(bs, _np.uint8)
+                        dd = jnp.broadcast_to(jnp.asarray(row),
+                                              (sb.capacity, w))
+                        dl = jnp.full(sb.capacity, len(bs), jnp.int32)
+                    else:
+                        dd = jnp.full(sb.capacity, f.default.value,
+                                      col.data.dtype)
                     dv = jnp.ones(sb.capacity, jnp.bool_)
-            data, validity, lengths = W.lead_lag(col, seg, off, dd, dv)
+            data, validity, lengths = W.lead_lag(col, seg, off, dd, dv, dl)
             out_cols.append(DeviceColumn(data, validity, col.dtype, lengths))
         else:
             op = window_agg_op(f)

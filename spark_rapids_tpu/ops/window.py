@@ -263,8 +263,13 @@ def dense_rank(seg: SegmentInfo) -> jax.Array:
 
 
 def lead_lag(col: DeviceColumn, seg: SegmentInfo, offset: int,
-             default_data=None, default_valid=None):
-    """lead(offset>0) / lag(offset<0) within the partition."""
+             default_data=None, default_valid=None, default_len=None):
+    """lead(offset>0) / lag(offset<0) within the partition.
+
+    ``default_*``: optional out-of-frame fill — scalar-broadcast array
+    for fixed-width columns; for strings a [cap, w] byte matrix plus
+    ``default_len`` (round-1 advisor finding: strings previously raised
+    inside the jitted program)."""
     cap = col.capacity
     idx = jnp.arange(cap, dtype=jnp.int32)
     src = idx + offset
@@ -272,11 +277,21 @@ def lead_lag(col: DeviceColumn, seg: SegmentInfo, offset: int,
     srcc = jnp.clip(src, 0, cap - 1)
     validity = jnp.where(in_seg, col.validity[srcc], False)
     if col.is_string:
-        if default_data is not None:
-            raise NotImplementedError(
-                "non-null default for string lead/lag")
-        data = jnp.where(validity[:, None], col.data[srcc], 0)
+        cdata = col.data
+        if default_data is not None and \
+                default_data.shape[1] > cdata.shape[1]:
+            cdata = jnp.pad(
+                cdata, ((0, 0), (0, default_data.shape[1] - cdata.shape[1])))
+        data = jnp.where(validity[:, None], cdata[srcc], 0)
         lengths = jnp.where(validity, col.lengths[srcc], 0)
+        if default_data is not None:
+            if data.shape[1] < default_data.shape[1]:
+                data = jnp.pad(
+                    data, ((0, 0), (0, default_data.shape[1] - data.shape[1])))
+            use_def = ~in_seg & seg.real & default_valid
+            data = jnp.where(use_def[:, None], default_data, data)
+            lengths = jnp.where(use_def, default_len, lengths)
+            validity = validity | use_def
         return data, validity, lengths
     data = jnp.where(validity, col.data[srcc], jnp.zeros((), col.data.dtype))
     if default_data is not None:

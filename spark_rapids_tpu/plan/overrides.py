@@ -284,8 +284,13 @@ class TpuOverrides:
                 new_children.append(ch.exec_node)
         if meta.children:
             kids = list(meta.exec_node.children)
-            if len(kids) == len(new_children):
-                meta.exec_node.children = tuple(new_children)
+            # planner invariant: the meta tree mirrors the exec tree; a
+            # mismatch is a lowering bug and silently skipping it would
+            # run a child on the wrong backend (round-1 advisor finding)
+            assert len(kids) == len(new_children), (
+                f"planner arity mismatch at {meta.name}: exec has "
+                f"{len(kids)} children, meta has {len(new_children)}")
+            meta.exec_node.children = tuple(new_children)
 
     # -- explain -------------------------------------------------------
     def explain(self, meta: PlannedNode, only_fallback: bool = False,

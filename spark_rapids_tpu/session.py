@@ -107,8 +107,15 @@ class DataFrame:
     filter = where
 
     def with_column(self, name: str, expr: Expression) -> "DataFrame":
-        existing = [col(n) for n in self._schema_names() if n != name]
-        return self.select(*existing, expr.alias(name))
+        # replacing an existing column keeps its position (Spark
+        # semantics; round-1 advisor finding: the old code moved it last)
+        names = self._schema_names()
+        if name in names:
+            exprs = [expr.alias(name) if n == name else col(n)
+                     for n in names]
+        else:
+            exprs = [col(n) for n in names] + [expr.alias(name)]
+        return self.select(*exprs)
 
     def group_by(self, *keys) -> "GroupedData":
         return GroupedData(self, [self._col_or_expr(k) for k in keys])

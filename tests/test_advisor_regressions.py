@@ -1,0 +1,101 @@
+"""Regression tests for the round-1/2 advisor findings (VERDICT Weak #8).
+
+1. Nested WindowExpressions inside projections (fixed by planner
+   hoisting; also covered by TPC-DS q12/q20/q98).
+2. String lead/lag with a non-null default (was a jitted
+   NotImplementedError).
+3. _insert_transitions arity mismatch must fail loudly, not skip.
+4. with_column must keep a replaced column's position.
+5. Right-join non-equi error reports the join type the USER wrote.
+"""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import (Lag, Lead, WindowExpression,
+                                          WindowSpec)
+from spark_rapids_tpu.session import TpuSession
+
+
+def _both(df):
+    dev = sorted(df.collect(), key=str)
+    ov, meta = df._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, df._s.conf), key=str)
+    return dev, host
+
+
+def test_nested_window_expression_in_projection():
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("g", T.StringType()),
+                       T.StructField("x", T.DoubleType())])
+    df = s.from_pydict({"g": ["a", "a", "b", "b"],
+                        "x": [1.0, 3.0, 10.0, 30.0]}, schema)
+    total = WindowExpression(Sum(col("x")),
+                             WindowSpec(partition_by=(col("g"),)))
+    out = df.select(col("g"), (col("x") * lit(100.0) / total).alias("pct"))
+    dev, host = _both(out)
+    assert dev == host
+    assert ("a", 25.0) in dev and ("b", 75.0) in dev
+
+
+def test_string_lead_lag_with_default():
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("g", T.IntegerType()),
+                       T.StructField("s", T.StringType())])
+    df = s.from_pydict({"g": [1, 1, 1, 2, 2],
+                        "s": ["aa", "bb", None, "long-string-x", "dd"]},
+                       schema)
+    spec = WindowSpec(partition_by=(col("g"),),
+                      order_by=((col("s"), True),))
+    out = df.select(
+        col("g"), col("s"),
+        WindowExpression(Lead(col("s"), 1, lit("END-OF-PARTITION")),
+                         spec).alias("nxt"),
+        WindowExpression(Lag(col("s"), 1, lit("!")), spec).alias("prv"))
+    dev, host = _both(out)
+    assert dev == host
+    m = {(r[0], r[1]): (r[2], r[3]) for r in dev}
+    # last row of each partition gets the default (order: nulls first asc)
+    assert m[(1, "bb")][0] == "END-OF-PARTITION"
+    assert m[(2, "long-string-x")][0] == "END-OF-PARTITION"
+    # first row of each partition gets the lag default
+    assert m[(1, None)][1] == "!"
+    assert m[(2, "dd")][1] == "!"
+
+
+def test_with_column_preserves_position():
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("a", T.IntegerType()),
+                       T.StructField("b", T.IntegerType()),
+                       T.StructField("c", T.IntegerType())])
+    df = s.from_pydict({"a": [1], "b": [2], "c": [3]}, schema)
+    out = df.with_column("b", col("b") * lit(10))
+    assert out.columns == ["a", "b", "c"]           # position kept
+    assert out.collect() == [(1, 20, 3)]
+    out2 = df.with_column("d", col("a") + col("c"))
+    assert out2.columns == ["a", "b", "c", "d"]     # new col appended
+
+
+def test_right_join_condition_error_names_right():
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("k", T.IntegerType())])
+    a = s.from_pydict({"k": [1]}, schema)
+    b = s.from_pydict({"k": [1]}, schema)
+    with pytest.raises(ValueError, match="right"):
+        a.join(b, on=[("k", "k")], how="right",
+               condition=col("k") > lit(0))._planned()
+
+
+def test_transition_arity_mismatch_fails_loudly():
+    from spark_rapids_tpu.plan.overrides import PlannedNode, TpuOverrides
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    scan = LocalScanExec.from_pydict(
+        {"x": [1]}, T.Schema([T.StructField("x", T.IntegerType())]))
+    # meta claims two children but the exec has none -> must raise
+    bad = PlannedNode(scan, [], [PlannedNode(scan), PlannedNode(scan)])
+    ov = TpuOverrides(TpuConf({}))
+    with pytest.raises(AssertionError, match="arity"):
+        ov._insert_transitions(bad)
