@@ -112,11 +112,14 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         return PlannedNode(ex, [node.generator], [c])
     if isinstance(node, L.Repartition):
         c = lower(node.child, conf)
-        if node.keys and conf.mesh_device_count > 1 \
-                and node.num_partitions == conf.mesh_device_count:
+        if node.keys and conf.mesh_device_count > 1:
+            # any hash-partition count rides the mesh collective (rows
+            # route to device pid % mesh; round-2 verdict dropped the
+            # num_partitions == deviceCount gate)
             from spark_rapids_tpu.exec.mesh_exec import MeshExchangeExec
             ex = MeshExchangeExec(node.keys, c.exec_node,
-                                  conf.mesh_device_count)
+                                  conf.mesh_device_count,
+                                  num_partitions=node.num_partitions)
             return PlannedNode(ex, list(node.keys), [c])
         if node.keys:
             part = HashPartitioning(node.keys, node.num_partitions)
