@@ -33,7 +33,21 @@ from spark_rapids_tpu.host.batch import HostBatch
 from spark_rapids_tpu.ops import host_kernels as hk
 from spark_rapids_tpu.ops import kernels as dk
 
-__all__ = ["ShuffleExchangeExec", "BroadcastExchangeExec"]
+__all__ = ["ShuffleExchangeExec", "BroadcastExchangeExec",
+           "AdaptiveShuffleReaderExec"]
+
+from spark_rapids_tpu.conf import ConfEntry, register, _bool
+
+ADAPTIVE_ENABLED = register(ConfEntry(
+    "spark.sql.adaptive.enabled", True,
+    "Adaptive execution: coalesce small shuffle output partitions using "
+    "the map-output sizes (reference GpuCustomShuffleReaderExec + "
+    "GpuTransitionOverrides.optimizeAdaptiveTransitions :51-94).",
+    conv=_bool))
+ADVISORY_PARTITION_BYTES = register(ConfEntry(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes", 64 << 20,
+    "Target post-shuffle partition size for adaptive coalescing.",
+    conv=int))
 
 
 @partial(jax.jit, static_argnames=("num_parts",))
@@ -133,6 +147,67 @@ class ShuffleExchangeExec(PlanNode):
     def node_desc(self) -> str:
         return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"
                 f"({self.partitioning.num_partitions})]")
+
+
+class AdaptiveShuffleReaderExec(PlanNode):
+    """Coalesced shuffle reader: groups adjacent small output partitions
+    using ACTUAL map-output sizes (the AQE analog; reference
+    GpuCustomShuffleReaderExec.scala:131 reading CoalescedPartitionSpecs).
+
+    The shuffle is its query-stage barrier: partition grouping is decided
+    AFTER the map side materializes, per execution.
+    """
+
+    def __init__(self, child: ShuffleExchangeExec):
+        super().__init__([child])
+        assert isinstance(child, ShuffleExchangeExec)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def _groups(self, ctx: ExecCtx) -> list[list[int]]:
+        return ctx.cached(("aqe_groups", id(self), ctx.backend),
+                          lambda: self._compute_groups(ctx))
+
+    def _compute_groups(self, ctx: ExecCtx) -> list[list[int]]:
+        child = self.children[0]
+        n = child.num_partitions(ctx)
+        identity = [[pid] for pid in range(n)]
+        # transition insertion may have wrapped the shuffle (backend
+        # switch); without direct access to map-output stats, do NOT
+        # coalesce — unknown sizes must not serialize the reduce side
+        if not ctx.is_device or not isinstance(child, ShuffleExchangeExec):
+            return identity
+        shuffled = child._shuffled(ctx)  # stage barrier: materialize maps
+        target = ctx.conf.get(ADVISORY_PARTITION_BYTES)
+        sizes = shuffled.partition_sizes(id(child)) \
+            if hasattr(shuffled, "partition_sizes") else None
+        if not sizes:
+            return identity
+        groups: list[list[int]] = []
+        cur: list[int] = []
+        cur_bytes = 0
+        for pid in range(n):
+            sz = sizes.get(pid, 0)
+            if cur and cur_bytes + sz > target:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(pid)
+            cur_bytes += sz
+        if cur:
+            groups.append(cur)
+        return groups or identity
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return len(self._groups(ctx))
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        for child_pid in self._groups(ctx)[pid]:
+            yield from self.children[0].partition_iter(ctx, child_pid)
+
+    def node_desc(self) -> str:
+        return "AdaptiveShuffleReaderExec"
 
 
 class BroadcastExchangeExec(PlanNode):

@@ -349,12 +349,16 @@ class MeshExchangeExec(PlanNode):
             yield from out[pid]
             return
         # device shard (pid % mesh) holds every row of output partition
-        # pid; slice it out locally
+        # pid; slice it out locally and right-size the capacity (the
+        # exchange shard capacity is p*C — passing it through would make
+        # every downstream op pay O(n_parts * p * C))
         shard = out[pid % self.mesh_size]
         b = ctx.dispatch(self._pick_jit(), shard,
                          jnp.asarray(pid, jnp.int32))
-        if b.host_num_rows() > 0 or self._num_parts == 1:
-            yield b
+        count = b.host_num_rows()
+        if count > 0 or self._num_parts == 1:
+            yield ctx.dispatch(dk.shrink_capacity, b,
+                               round_capacity(max(count, 1)))
 
     def node_desc(self) -> str:
         return (f"MeshExchangeExec[mesh={self.mesh_size}, "

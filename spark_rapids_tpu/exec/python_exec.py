@@ -1,0 +1,166 @@
+"""Arrow-eval Python (pandas) UDF exec.
+
+Reference: GpuArrowEvalPythonExec (GpuArrowEvalPythonExec.scala:46-456)
+streams device batches as Arrow IPC to external python workers running
+pandas scalar UDFs, reads Arrow results back to the device, with
+PythonWorkerSemaphore capping concurrent workers.  This engine is
+already a python process, so the data plane degenerates to an in-process
+Arrow conversion: device batch -> pandas Series -> vectorized UDF ->
+device column; the semaphore survives as a concurrency bound
+(spark.rapids.python.concurrentPythonWorkers) because pandas UDFs run on
+drain worker threads.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.expr.core import Expression, bind
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+
+__all__ = ["PandasUDF", "pandas_udf", "ArrowEvalPythonExec"]
+
+CONCURRENT_PYTHON = register(ConfEntry(
+    "spark.rapids.python.concurrentPythonWorkers", 2,
+    "Concurrent pandas-UDF evaluations (reference PythonWorkerSemaphore,"
+    " PythonWorkerSemaphore.scala:42-100).", conv=int))
+
+_sem_lock = threading.Lock()
+_sems: dict[int, threading.BoundedSemaphore] = {}
+
+
+def _py_semaphore(n: int) -> threading.BoundedSemaphore:
+    with _sem_lock:
+        if n not in _sems:
+            _sems[n] = threading.BoundedSemaphore(n)
+        return _sems[n]
+
+
+class PandasUDF(Expression):
+    """Vectorized python UDF over pandas Series — planned into an
+    ArrowEvalPythonExec, never evaluated inline (like WindowExpression)."""
+
+    sql_name = "PandasUDF"
+
+    def __init__(self, fn: Callable, children: Sequence[Expression],
+                 return_type: T.DataType):
+        self.fn = fn
+        self.children = tuple(children)
+        self.return_type = return_type
+
+    def with_new_children(self, children):
+        return PandasUDF(self.fn, children, self.return_type)
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _eval(self, vals, ctx):
+        raise ValueError(
+            "PandasUDF must be planned by ArrowEvalPythonExec "
+            "(use it directly inside select())")
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "<lambda>")
+        return f"PandasUDF({name}, {', '.join(map(repr, self.children))})"
+
+
+def pandas_udf(fn: Callable, return_type: T.DataType | None = None):
+    """``df.select(pandas_udf(lambda s: s * 2)(col("a")))`` — ``fn``
+    receives pandas Series and returns a Series/array of the same
+    length."""
+
+    def apply(*cols):
+        return PandasUDF(fn, list(cols), return_type or T.DoubleType())
+
+    return apply
+
+
+class ArrowEvalPythonExec(PlanNode):
+    """Append one column per pandas UDF to each child batch.
+
+    The child batch crosses D2H as Arrow, the UDFs run vectorized over
+    pandas Series, and results transfer back H2D (reference
+    GpuArrowPythonRunner's writeArrowIPCChunked round trip :376-432)."""
+
+    def __init__(self, udfs: Sequence, child: PlanNode):
+        super().__init__([child])
+        self._udfs = []  # (name, PandasUDF with bound children)
+        cs = child.output_schema
+        fields = list(cs.fields)
+        for name, u in udfs:
+            bound = [bind(c, cs) for c in u.children]
+            self._udfs.append((name, PandasUDF(u.fn, bound, u.return_type)))
+            fields.append(T.StructField(name, u.return_type, True))
+        self._schema = T.Schema(fields)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def bound_exprs(self):
+        # PandasUDF itself is exec-planned; expose only its INPUT
+        # expressions for tagging
+        return [c for _, u in self._udfs for c in u.children]
+
+    def _series_inputs(self, hb: HostBatch, u: PandasUDF):
+        import pandas as pd
+        from spark_rapids_tpu.expr.core import eval_host
+        out = []
+        for c in u.children:
+            v = eval_host(c, hb)
+            if isinstance(v.dtype, T.StringType):
+                out.append(pd.Series(v.data))
+            else:
+                data = v.data.astype("float64") if not np.all(v.validity) \
+                    and v.dtype.numeric else v.data
+                s = pd.Series(data)
+                if not np.all(v.validity):
+                    s[~v.validity] = None
+                out.append(s)
+        return out
+
+    def _apply_udfs(self, hb: HostBatch, ctx: ExecCtx) -> HostBatch:
+        import pandas as pd
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        cols = list(hb.columns)
+        for name, u in self._udfs:
+            with sem:
+                result = u.fn(*self._series_inputs(hb, u))
+            r = pd.Series(result)
+            if len(r) != hb.num_rows:
+                raise ValueError(
+                    f"pandas UDF {name} returned {len(r)} rows for "
+                    f"{hb.num_rows} input rows")
+            validity = ~r.isna().to_numpy()
+            if isinstance(u.return_type, T.StringType):
+                data = np.array([None if not v else str(x)
+                                 for x, v in zip(r, validity)], dtype=object)
+            else:
+                data = r.fillna(0).to_numpy().astype(u.return_type.np_dtype)
+            cols.append(HostColumn(data, validity, u.return_type))
+        return HostBatch(cols, self._schema)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        from spark_rapids_tpu.exec.core import device_to_host, host_to_device
+        for b in self.children[0].partition_iter(ctx, pid):
+            if ctx.is_device:
+                hb = device_to_host(b)
+                out = self._apply_udfs(hb, ctx)
+                yield host_to_device(out)
+            else:
+                yield self._apply_udfs(b, ctx)
+
+    def node_desc(self) -> str:
+        return (f"ArrowEvalPythonExec[{[n for n, _ in self._udfs]}]")

@@ -1,8 +1,11 @@
-"""Columnar file writers: Parquet / ORC / CSV.
+"""Columnar file writers: Parquet / ORC / CSV + dynamic partitioning.
 
 Reference: GpuParquetFileFormat.scala, GpuOrcFileFormat.scala,
 ColumnarOutputWriter (ColumnarFileFormat.scala:57), GpuFileFormatWriter
-(Spark write protocol: one part file per partition, _SUCCESS marker).
+(Spark write protocol incl. dynamic-partition writes,
+GpuFileFormatWriter.scala:338, GpuFileFormatDataWriter.scala:419 —
+single-directory and ``partitionBy`` concurrent-writer protocols) and
+BasicColumnarWriteStatsTracker (per-task files/rows/bytes stats).
 TPU path: batches come back D2H as Arrow and pyarrow writes them — the
 host-encode mirror of the host-decode scan path.
 """
@@ -10,13 +13,32 @@ from __future__ import annotations
 
 import os
 import uuid
-from typing import Iterator
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.host.batch import HostBatch
 
-__all__ = ["write_parquet", "write_orc", "write_csv"]
+__all__ = ["write_parquet", "write_orc", "write_csv", "WriteStats"]
+
+
+@dataclass
+class WriteStats:
+    """Job-level write statistics (reference
+    BasicColumnarWriteStatsTracker/BasicWriteJobStatsTracker)."""
+    num_files: int = 0
+    num_rows: int = 0
+    num_bytes: int = 0
+    partitions: list = field(default_factory=list)  # dynamic partition dirs
+
+    def _add_file(self, path: str, rows: int) -> None:
+        self.num_files += 1
+        self.num_rows += rows
+        try:
+            self.num_bytes += os.path.getsize(path)
+        except OSError:
+            pass
 
 
 def _arrow_batches(plan: PlanNode, ctx: ExecCtx, pid: int) -> Iterator:
@@ -53,53 +75,128 @@ def _host_to_arrow(b: HostBatch):
     return pa.RecordBatch.from_arrays(arrays, schema=b.schema.to_arrow())
 
 
+def _write_table(table, fname: str, fmt: str, **options) -> None:
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(table, fname, **options)
+    elif fmt == "orc":
+        import pyarrow.orc as orc
+        orc.write_table(table, fname)
+    elif fmt == "csv":
+        import pyarrow.csv as pc
+        pc.write_csv(table, fname)
+    else:
+        raise ValueError(fmt)
+
+
+def _partition_dir_value(v) -> str:
+    """Hive-style directory encoding (Spark __HIVE_DEFAULT_PARTITION__
+    for nulls)."""
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    s = str(v)
+    return "".join("%%%02X" % ord(ch) if ch in '/\\:*?"<>|%' else ch
+                   for ch in s)
+
+
 def _write(plan: PlanNode, path: str, fmt: str, ctx: ExecCtx | None = None,
-           **options) -> list[str]:
-    """Write the plan's output as one part file per partition under
-    ``path`` (Spark directory-output protocol), returning written files."""
+           partition_by: Sequence[str] | None = None,
+           stats: WriteStats | None = None, **options) -> list[str]:
+    """Write the plan's output under ``path`` (Spark directory-output
+    protocol), returning written files.
+
+    ``partition_by``: dynamic-partition writes — rows split by the named
+    columns into hive-style ``col=value/`` directories, the partition
+    columns dropped from the file contents (reference
+    GpuFileFormatWriter.scala:338 dynamic-partition protocol)."""
     import pyarrow as pa
     ctx = ctx or ExecCtx()
+    stats = stats if stats is not None else WriteStats()
     os.makedirs(path, exist_ok=True)
     job_id = uuid.uuid4().hex[:8]
     schema = plan.output_schema.to_arrow()
     written: list[str] = []
+    seen_dirs: set[str] = set()
+
+    if partition_by:
+        names = plan.output_schema.names
+        missing = [c for c in partition_by if c not in names]
+        if missing:
+            raise ValueError(f"partitionBy columns not in output: {missing}")
+        data_cols = [n for n in names if n not in partition_by]
+        if not data_cols:
+            raise ValueError("partitionBy cannot cover every column")
+
     for pid in range(plan.num_partitions(ctx)):
         batches = list(_arrow_batches(plan, ctx, pid))
-        if not batches and (written or pid != plan.num_partitions(ctx) - 1):
+        if not partition_by:
+            if not batches and (written or
+                                pid != plan.num_partitions(ctx) - 1):
+                continue
+            # empty result: still emit one schema-bearing empty part file
+            # (Spark's write protocol) so the output stays readable
+            fname = os.path.join(path, f"part-{pid:05d}-{job_id}.{fmt}")
+            table = pa.Table.from_batches(batches, schema=schema) \
+                if batches else schema.empty_table()
+            _write_table(table, fname, fmt, **options)
+            written.append(fname)
+            stats._add_file(fname, table.num_rows)
             continue
-        # empty result: still emit one schema-bearing empty part file
-        # (Spark's write protocol) so the output stays readable
-        fname = os.path.join(
-            path, f"part-{pid:05d}-{job_id}.{fmt}")
-        table = pa.Table.from_batches(batches, schema=schema) if batches \
-            else schema.empty_table()
-        if fmt == "parquet":
-            import pyarrow.parquet as pq
-            pq.write_table(table, fname, **options)
-        elif fmt == "orc":
-            import pyarrow.orc as orc
-            orc.write_table(table, fname)
-        elif fmt == "csv":
-            import pyarrow.csv as pc
-            pc.write_csv(table, fname)
-        else:
-            raise ValueError(fmt)
-        written.append(fname)
+        # dynamic-partition path: group each batch's rows by the
+        # partition-column tuple, append to per-directory part files
+        if not batches:
+            continue
+        table = pa.Table.from_batches(batches, schema=schema)
+        import pyarrow.compute as _pc  # host-side job driver, single thread
+        keys = [table.column(c) for c in partition_by]
+        combos = pa.Table.from_arrays(keys, names=list(partition_by)) \
+            .group_by(list(partition_by)).aggregate([]).to_pylist()
+        for combo in combos:
+            mask = None
+            for c in partition_by:
+                v = combo[c]
+                column = table.column(c)
+                if v is None:
+                    cm = _pc.is_null(column)
+                elif isinstance(v, float) and v != v:
+                    # NaN partition value: equal() matches nothing
+                    cm = _pc.is_nan(column)
+                else:
+                    cm = _pc.equal(column, pa.scalar(v))
+                mask = cm if mask is None else _pc.and_(mask, cm)
+            part = table.filter(mask).select(data_cols)
+            d = os.path.join(path, *(
+                f"{c}={_partition_dir_value(combo[c])}"
+                for c in partition_by))
+            os.makedirs(d, exist_ok=True)
+            if d not in seen_dirs:
+                seen_dirs.add(d)
+                stats.partitions.append(os.path.relpath(d, path))
+            fname = os.path.join(d, f"part-{pid:05d}-{job_id}.{fmt}")
+            _write_table(part, fname, fmt, **options)
+            written.append(fname)
+            stats._add_file(fname, part.num_rows)
     # commit marker (Spark's _SUCCESS protocol)
     open(os.path.join(path, "_SUCCESS"), "w").close()
     return written
 
 
 def write_parquet(plan: PlanNode, path: str, ctx: ExecCtx | None = None,
-                  **options) -> list[str]:
-    return _write(plan, path, "parquet", ctx, **options)
+                  partition_by: Sequence[str] | None = None,
+                  stats: WriteStats | None = None, **options) -> list[str]:
+    return _write(plan, path, "parquet", ctx, partition_by=partition_by,
+                  stats=stats, **options)
 
 
-def write_orc(plan: PlanNode, path: str, ctx: ExecCtx | None = None
-              ) -> list[str]:
-    return _write(plan, path, "orc", ctx)
+def write_orc(plan: PlanNode, path: str, ctx: ExecCtx | None = None,
+              partition_by: Sequence[str] | None = None,
+              stats: WriteStats | None = None) -> list[str]:
+    return _write(plan, path, "orc", ctx, partition_by=partition_by,
+                  stats=stats)
 
 
-def write_csv(plan: PlanNode, path: str, ctx: ExecCtx | None = None
-              ) -> list[str]:
-    return _write(plan, path, "csv", ctx)
+def write_csv(plan: PlanNode, path: str, ctx: ExecCtx | None = None,
+              partition_by: Sequence[str] | None = None,
+              stats: WriteStats | None = None) -> list[str]:
+    return _write(plan, path, "csv", ctx, partition_by=partition_by,
+                  stats=stats)

@@ -126,6 +126,9 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         else:
             part = RoundRobinPartitioning(node.num_partitions)
         ex = ShuffleExchangeExec(part, c.exec_node)
+        # NOTE: explicit repartition(n) keeps n partitions (Spark does not
+        # AQE-coalesce user-requested counts); only planner-inserted
+        # shuffles (aggregation) get the adaptive reader.
         return PlannedNode(ex, list(node.keys), [c])
     raise TypeError(f"cannot lower {node!r}")
 
@@ -152,19 +155,59 @@ def _split_window_exprs(exprs):
     for e in exprs:
         inner = e.children[0] if isinstance(e, Alias) else e
         if isinstance(inner, WindowExpression):
-            name = output_name(e)
-            windows.append(inner.alias(name) if not isinstance(e, Alias)
-                           else e)
-            plain.append(col(name))
+            # generated name + re-alias: naming the appended window column
+            # after an existing child column would shadow it at bind time
+            name = f"_we{counter[0]}"
+            counter[0] += 1
+            windows.append(inner.alias(name))
+            plain.append(col(name).alias(output_name(e)))
         else:
             plain.append(e.transform_up(hoist))
     return plain, windows
+
+
+def _split_pandas_udfs(exprs):
+    """Hoist PandasUDF occurrences (any depth) into generated columns
+    evaluated by one ArrowEvalPythonExec (reference: Spark plans
+    ArrowEvalPython below the projection)."""
+    from spark_rapids_tpu.exec.python_exec import PandasUDF
+    udfs, counter = [], [0]
+
+    def fresh(u):
+        if any(isinstance(s, PandasUDF) for c in u.children
+               for s in c.walk()):
+            raise ValueError(
+                "nested pandas UDFs are not supported; materialize the "
+                "inner UDF in a separate select() first")
+        name = f"_pyudf{counter[0]}"   # ALWAYS a generated name: reusing a
+        counter[0] += 1                # child column name would shadow it
+        udfs.append((name, u))
+        return name
+
+    def hoist(n):
+        if isinstance(n, PandasUDF):
+            return col(fresh(n))
+        return n
+
+    plain = []
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, PandasUDF):
+            plain.append(col(fresh(inner)).alias(output_name(e)))
+        else:
+            plain.append(e.transform_up(hoist))
+    return plain, udfs
 
 
 def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
     from spark_rapids_tpu.udf import maybe_compile_udfs
     exprs = maybe_compile_udfs(node.exprs, conf)
+    exprs, pandas_udfs = _split_pandas_udfs(exprs)
+    if pandas_udfs:
+        from spark_rapids_tpu.exec.python_exec import ArrowEvalPythonExec
+        ex = ArrowEvalPythonExec(pandas_udfs, c.exec_node)
+        c = PlannedNode(ex, [u for _, u in pandas_udfs], [c])
     plain, windows = _split_window_exprs(exprs)
     if not windows:
         ex = ProjectExec(exprs, c.exec_node)
@@ -199,7 +242,14 @@ def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
         shuffle = ShuffleExchangeExec(
             HashPartitioning(group_cols, conf.shuffle_partitions), partial)
         smeta = PlannedNode(shuffle, group_cols, [pmeta])
-        final = HashAggregateExec.final_from_partial(partial, shuffle)
+        from spark_rapids_tpu.exec.exchange import (ADAPTIVE_ENABLED,
+                                                    AdaptiveShuffleReaderExec)
+        agg_child = shuffle
+        if conf.get(ADAPTIVE_ENABLED):
+            reader = AdaptiveShuffleReaderExec(shuffle)
+            smeta = PlannedNode(reader, [], [smeta])
+            agg_child = reader
+        final = HashAggregateExec.final_from_partial(partial, agg_child)
         return PlannedNode(final, list(node.agg_exprs), [smeta])
     ex = HashAggregateExec(node.group_exprs, node.agg_exprs, c.exec_node,
                            mode="complete")

@@ -230,11 +230,19 @@ class DataFrame:
         ov, meta = self._overridden(quiet=True)
         return ov.explain(meta)
 
-    def write_parquet(self, path: str, **kw) -> None:
+    def write_parquet(self, path: str, partition_by=None, **kw):
+        """Directory write (Spark protocol).  ``partition_by`` enables
+        hive-style dynamic-partition output; returns WriteStats."""
         from spark_rapids_tpu.io import write_parquet
+        from spark_rapids_tpu.io.writer import WriteStats
         ov, meta = self._overridden()
-        ctx = ExecCtx(backend=meta.backend, conf=self._s.conf)
-        write_parquet(meta.exec_node, path, ctx=ctx, **kw)
+        stats = WriteStats()
+        if isinstance(partition_by, str):
+            partition_by = [partition_by]
+        with ExecCtx(backend=meta.backend, conf=self._s.conf) as ctx:
+            write_parquet(meta.exec_node, path, ctx=ctx,
+                          partition_by=partition_by, stats=stats, **kw)
+        return stats
 
     # -- internals -----------------------------------------------------
     def _schema_names(self) -> list[str]:

@@ -40,6 +40,7 @@ class LocalShuffleTransport:
         self._lock = threading.Lock()
         # (shuffle_id, part_id) -> list of stored items in map order
         self._store: dict[tuple, list] = {}
+        self._sizes: dict[tuple, int] = {}
         self.metrics = {"bytes_written": 0, "bytes_compressed": 0,
                         "batches_written": 0}
 
@@ -60,9 +61,22 @@ class LocalShuffleTransport:
                 item = ("bytes", comp, len(raw))
             else:
                 item = ("bytes", raw, len(raw))
+        if item[0] == "spillable":
+            size = batch.device_size_bytes()
+        else:
+            size = len(item[1])
         with self._lock:
             self._store.setdefault((shuffle_id, part_id), []).append(item)
+            self._sizes[(shuffle_id, part_id)] = \
+                self._sizes.get((shuffle_id, part_id), 0) + size
         self.metrics["batches_written"] += 1
+
+    def partition_sizes(self, shuffle_id: int) -> dict[int, int]:
+        """Map-output statistics per reduce partition (reference
+        MapStatus sizes feeding AQE's coalescing decisions)."""
+        with self._lock:
+            return {pid: sz for (sid, pid), sz in self._sizes.items()
+                    if sid == shuffle_id}
 
     def fetch_partition(self, shuffle_id: int, part_id: int) -> Iterable:
         with self._lock:
