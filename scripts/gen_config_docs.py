@@ -1,0 +1,18 @@
+"""Regenerate docs/configs.md from the conf registry."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from spark_rapids_tpu.conf import generate_docs  # noqa: E402
+
+out = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "configs.md")
+os.makedirs(os.path.dirname(out), exist_ok=True)
+with open(out, "w") as f:
+    f.write(generate_docs())
+print(f"wrote {out}")

@@ -308,3 +308,46 @@ def help_text(include_internal: bool = False) -> str:
         doc = e.doc.replace("\n", " ")
         lines.append(f"| {e.key} | {e.default} | {doc} |")
     return "\n".join(lines) + "\n"
+
+
+def generate_docs() -> str:
+    """Render every registered conf entry as markdown (the analog of
+    RapidsConf.help generating docs/configs.md, RapidsConf.scala:785).
+
+    Importing the package registers the core entries; exec/io/shuffle
+    modules register theirs on import, so the generator pulls them in
+    first."""
+    import importlib
+    for mod in ("spark_rapids_tpu.exec.core", "spark_rapids_tpu.io.scan",
+                "spark_rapids_tpu.memory.catalog",
+                "spark_rapids_tpu.exec.exchange",
+                "spark_rapids_tpu.exec.python_exec",
+                "spark_rapids_tpu.runtime"):
+        importlib.import_module(mod)
+    lines = [
+        "# Configuration",
+        "",
+        "Generated by `spark_rapids_tpu.conf.generate_docs()` "
+        "(`python scripts/gen_config_docs.py`). Do not edit by hand.",
+        "",
+        "Reference analog: docs/configs.md generated by RapidsConf.help.",
+        "",
+        "| Name | Default | Description |",
+        "|---|---|---|",
+    ]
+    for key in sorted(registered_entries()):
+        e = registered_entries()[key]
+        if e.internal:
+            continue
+        doc = " ".join(str(e.doc).split())
+        default = e.default
+        if isinstance(default, str) and not default:
+            default = "(unset)"
+        lines.append(f"| `{key}` | `{default}` | {doc} |")
+    lines.append("")
+    lines.append("Per-operation enable keys "
+                 "(`spark.rapids.sql.{exec,expression}.<Name>`) default to "
+                 "true and are generated from the registries "
+                 "(reference ReplacementRule.confKey, "
+                 "GpuOverrides.scala:132-137).")
+    return "\n".join(lines) + "\n"

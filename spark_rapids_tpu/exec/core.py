@@ -42,6 +42,13 @@ CONCURRENT_TASKS = register(ConfEntry(
     "Partitions execute on a worker pool bounded by this semaphore.",
     conv=int))
 
+PROFILE_DIR = register(ConfEntry(
+    "spark.rapids.tpu.profile.dir", "",
+    "When set, collect() records an xprof/PJRT trace of the execution "
+    "into this directory, with per-operator TraceAnnotation ranges "
+    "(reference NVTX ranges + NvtxWithMetrics.scala:27; view with "
+    "tensorboard or xprof)."))
+
 
 # ---------------------------------------------------------------------------
 # Batching contracts (reference CoalesceGoal algebra,
@@ -260,12 +267,17 @@ class PlanNode:
         yield from self.timed_iter(ctx, drain_partitions(ctx, self))
 
     def timed_iter(self, ctx: ExecCtx, it: Iterator) -> Iterator:
-        """Wrap an iterator with totalTime / output metrics."""
+        """Wrap an iterator with totalTime / output metrics and a
+        per-operator profiler range (the NVTX-range analog,
+        NvtxWithMetrics.scala:27 — visible in xprof/tensorboard traces)."""
+        import jax.profiler as _prof
         m = ctx.metrics_for(self)
+        label = type(self).__name__
         while True:
             t0 = time.perf_counter()
             try:
-                batch = next(it)
+                with _prof.TraceAnnotation(label):
+                    batch = next(it)
             except StopIteration:
                 return
             m.add("totalTime", time.perf_counter() - t0)
@@ -358,13 +370,22 @@ def collect_host(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
 
 
 def collect_device(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
-    """Run on the TPU path; rows as python tuples (D2H at the end only)."""
+    """Run on the TPU path; rows as python tuples (D2H at the end only).
+    With spark.rapids.tpu.profile.dir set, the whole execution records an
+    xprof trace (reference: nsight timelines over NVTX ranges)."""
+    import contextlib
     with ExecCtx(backend="device", conf=conf or TpuConf({})) as ctx:
-        out: list[tuple] = []
-        for b in plan.execute(ctx):
-            hb = device_to_host(b)
-            out.extend(_rows_from_host(hb))
-        return out
+        profile_dir = ctx.conf.get(PROFILE_DIR)
+        prof = contextlib.nullcontext()
+        if profile_dir:
+            import jax.profiler as _prof
+            prof = _prof.trace(profile_dir)
+        with prof:
+            out: list[tuple] = []
+            for b in plan.execute(ctx):
+                hb = device_to_host(b)
+                out.extend(_rows_from_host(hb))
+            return out
 
 
 def collect(plan: PlanNode, backend: str = "device",

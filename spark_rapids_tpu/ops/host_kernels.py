@@ -200,9 +200,13 @@ def _nan_aware_max(vals, dt: T.DataType):
     if isinstance(dt, T.StringType):
         return max(vals)
     if dt.fractional:
+        # Spark: NaN is the LARGEST value, so any NaN wins outright
+        # (fuzz-found: argmax over inf-masked values picked a real +inf
+        # when both +inf and NaN were present)
         f = vals.astype(np.float64)
-        return vals[np.argmax(np.where(np.isnan(f), np.inf, f))] \
-            if np.isnan(f).any() else np.max(vals)
+        if np.isnan(f).any():
+            return np.asarray(np.nan, dtype=vals.dtype)[()]
+        return np.max(vals)
     return np.max(vals)
 
 

@@ -76,3 +76,177 @@ def assert_tpu_and_cpu_equal(plan: PlanNode, ignore_order: bool = True,
         assert rows_equal(rc, rt, approximate_float), \
             f"row {i} differs:\n cpu={rc}\n tpu={rt}"
     return cpu
+
+
+# ---------------------------------------------------------------------------
+# Typed fuzzed data generators (reference integration_tests data_gen.py:26+:
+# per-type generators with deterministic seeds, null fractions, and
+# special-value injection)
+# ---------------------------------------------------------------------------
+
+class DataGen:
+    """Base typed generator: deterministic per (seed, n), ``nullable``
+    gives the null fraction, special values are injected at a fixed
+    rate like the reference's special_cases lists."""
+
+    data_type = None
+    special_values: list = []
+
+    def __init__(self, nullable: float = 0.1, special_rate: float = 0.05):
+        self.nullable = nullable
+        self.special_rate = special_rate
+
+    def generate(self, rng, n: int) -> list:
+        vals = [self._one(rng) for _ in range(n)]
+        if self.special_values and self.special_rate > 0:
+            for i in range(n):
+                if rng.random() < self.special_rate:
+                    vals[i] = self.special_values[
+                        int(rng.integers(0, len(self.special_values)))]
+        if self.nullable > 0:
+            vals = [None if rng.random() < self.nullable else v
+                    for v in vals]
+        return vals
+
+    def _one(self, rng):
+        raise NotImplementedError
+
+
+class IntegerGen(DataGen):
+    special_values = [0, 1, -1, 2**31 - 1, -(2**31)]
+
+    def __init__(self, lo=-(2**31), hi=2**31 - 1, **kw):
+        super().__init__(**kw)
+        self.lo, self.hi = lo, hi
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.IntegerType()
+
+    def _one(self, rng):
+        import numpy as np
+        # dtype=int64 enables the full 64-bit range; exclusive hi — the
+        # exact boundary values come in via special_values
+        return int(rng.integers(self.lo, self.hi, dtype=np.int64))
+
+
+class LongGen(IntegerGen):
+    special_values = [0, 1, -1, 2**63 - 1, -(2**63)]
+
+    def __init__(self, **kw):
+        super().__init__(lo=-(2**63), hi=2**63 - 1, **kw)
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.LongType()
+
+
+class DoubleGen(DataGen):
+    special_values = [0.0, -0.0, 1.0, -1.0, float("inf"), float("-inf"),
+                      float("nan"), 1.7976931348623157e308,
+                      4.9e-324]
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.DoubleType()
+
+    def _one(self, rng):
+        return float(rng.normal() * 10.0 ** int(rng.integers(-3, 6)))
+
+
+class BooleanGen(DataGen):
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.BooleanType()
+
+    def _one(self, rng):
+        return bool(rng.integers(0, 2))
+
+
+class StringGen(DataGen):
+    """ASCII + unicode + empty + whitespace special cases (reference
+    StringGen's sre_yield-driven generator with special_cases)."""
+
+    special_values = ["", " ", "  \t", "NULL", "null", "0", "-1",
+                      "éüñ", "你好", "a" * 60,
+                      "CaSeD mIx", "line\nbreak"]
+
+    def __init__(self, max_len: int = 12, **kw):
+        super().__init__(**kw)
+        self.max_len = max_len
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.StringType()
+
+    def _one(self, rng):
+        import string as _s
+        n = int(rng.integers(0, self.max_len + 1))
+        alphabet = _s.ascii_letters + _s.digits + "  _-"
+        return "".join(alphabet[int(i)] for i in
+                       rng.integers(0, len(alphabet), n))
+
+
+class DateGen(DataGen):
+    special_values = [0, -719162, 2932896, 1, -1]  # epoch, 0001, 9999
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.DateType()
+
+    def _one(self, rng):
+        return int(rng.integers(-25567, 47482))  # ~1900..2100
+
+
+class TimestampGen(DataGen):
+    special_values = [0, 1, -1, 253402300799_000000]
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.TimestampType()
+
+    def _one(self, rng):
+        return int(rng.integers(-2208988800, 4102444800)) * 1_000_000 \
+            + int(rng.integers(0, 1_000_000))
+
+
+def gen_df(session, columns, n: int = 256, seed: int = 0, partitions: int = 1,
+           rows_per_batch: int | None = None):
+    """Build a DataFrame of fuzzed columns: ``columns`` is a list of
+    (name, DataGen) pairs (reference gen_df, data_gen.py)."""
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    rng = np.random.default_rng(seed)
+    data = {}
+    fields = []
+    for name, g in columns:
+        data[name] = g.generate(rng, n)
+        fields.append(T.StructField(name, g.data_type, True))
+    return session.from_pydict(data, T.Schema(fields), partitions,
+                               rows_per_batch)
+
+
+def assert_fallback(df, fallback_names, run: bool = True):
+    """Assert the plan falls back to the host for the named exec/expr
+    classes AND (optionally) that results still match between backends
+    (reference assert_gpu_fallback_collect, asserts.py:241)."""
+    ov, meta = df._overridden(quiet=True)
+    text = ov.explain(meta)
+    fallen = [ln for ln in text.splitlines() if ln.lstrip().startswith("!")]
+    for name in ([fallback_names] if isinstance(fallback_names, str)
+                 else fallback_names):
+        assert any(name in ln for ln in fallen), \
+            f"expected fallback of {name}; explain:\n{text}"
+    if run:
+        from spark_rapids_tpu.exec.core import collect_host
+        dev = df.collect()
+        host = collect_host(meta.exec_node, df._s.conf)
+        assert len(dev) == len(host)
+    return text

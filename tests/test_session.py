@@ -153,3 +153,29 @@ def test_write_parquet_via_session(rng, tmp_path):
     back = s.read_parquet(out)
     assert sorted(back.collect(), key=_sort_key) == \
         sorted(df.collect(), key=_sort_key)
+
+
+def test_config_docs_generation():
+    """generate_docs renders every public conf (RapidsConf.help analog)."""
+    from spark_rapids_tpu.conf import generate_docs, registered_entries
+    md = generate_docs()
+    for key, e in registered_entries().items():
+        if not e.internal:
+            assert f"`{key}`" in md, key
+
+
+def test_profile_trace_dir(tmp_path):
+    """spark.rapids.tpu.profile.dir records an xprof trace."""
+    import os
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.core import col
+    d = str(tmp_path / "trace")
+    s = TpuSession({"spark.rapids.tpu.profile.dir": d})
+    schema = T.Schema([T.StructField("x", T.IntegerType())])
+    s.from_pydict({"x": [1, 2, 3]}, schema).select(col("x") + 1).collect()
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the dir
+    found = []
+    for root, _dirs, files in os.walk(d):
+        found.extend(files)
+    assert any(f.endswith(".xplane.pb") or "trace" in f for f in found), found
