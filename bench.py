@@ -3,17 +3,22 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Runs a scale-factor ladder (SF0.01 smoke -> SF1 -> SF10) of TPC-DS q6
+Runs a scale-factor ladder (SF0.1 smoke -> SF1 -> SF10) of TPC-DS q6
 through the real engine (parquet scan -> joins -> filter -> group-by ->
 having -> sort -> limit, spark_rapids_tpu.bench.runner), verifying each
 rung against the host oracle.  The emitted line is the LARGEST rung that
 completed, labeled with its scale factor — a smoke number is never
 reported under a bigger-SF metric name.
 
-Robustness (round-1 failure mode: tunnel hang): ALL device work runs on
-a daemon worker thread under init/total deadlines, so a JSON line is
-always printed (the reference treats init failure as fail-fast,
-Plugin.scala:146-153).
+Robustness (round-1 failure mode: the tunneled TPU backend can hang
+indefinitely inside PJRT init or any device call, and a hung thread
+cannot be killed): every rung runs in its OWN subprocess under a
+deadline, so a wedged backend is killed, not waited on.  If no rung
+completes on the TPU backend at all, the ladder re-runs on the CPU
+backend and the result is honestly labeled `backend: "cpu_fallback"` —
+a real measurement of the same engine is better evidence than a zero.
+(The reference treats executor init failure as fail-fast-and-relaunch,
+Plugin.scala:146-153; the relaunch analog here is the fallback ladder.)
 
 vs_baseline = speedup / 4.0 against BASELINE.json's >=4x-vs-CPU-Spark
 target.  The oracle is this repo's single-threaded numpy engine, NOT
@@ -23,13 +28,14 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
-import threading
 import time
-import traceback
 
-INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
 TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "540"))
+# reserved for the CPU-fallback ladder while the TPU ladder has not yet
+# produced a single successful rung
+FALLBACK_RESERVE_S = float(os.environ.get("BENCH_FALLBACK_RESERVE_S", "200"))
 MAX_SF = float(os.environ.get("BENCH_SF", "10"))
 DATA_DIR = os.environ.get("BENCH_DATA_DIR",
                           os.path.join(os.path.dirname(
@@ -39,10 +45,13 @@ DATA_DIR = os.environ.get("BENCH_DATA_DIR",
 LADDER = [sf for sf in (0.1, 1.0, 10.0) if sf <= MAX_SF] or [0.1]
 
 
-def _emit(value: float, sf: float, error: str | None = None,
+def _emit(value: float, sf: float, backend: str, error: str | None = None,
           extra: dict | None = None):
+    name = f"tpcds_q6_sf{sf:g}_speedup_vs_cpu_oracle"
+    if backend != "tpu":
+        name += f"_{backend}"
     rec = {
-        "metric": f"tpcds_q6_sf{sf:g}_speedup_vs_cpu_oracle",
+        "metric": name,
         "value": round(float(value), 3),
         "unit": "x",
         "vs_baseline": round(float(value) / 4.0, 3),
@@ -55,61 +64,129 @@ def _emit(value: float, sf: float, error: str | None = None,
     sys.stdout.flush()
 
 
-def main() -> None:
-    state: dict = {}
+_REPORT_PREFIX = "BENCH_REPORT:"
 
-    def _work():
+
+def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
+    """One ladder rung in a killable subprocess; returns its JSON report
+    or {"error": ...}."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--child", str(sf), platform]
+    # own session: on timeout kill the whole process GROUP, so wedged
+    # PJRT/tunnel helper children die with the rung instead of holding
+    # the TPU connection (and the stdout pipe) forever
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True,
+                         cwd=os.path.dirname(
+                             os.path.abspath(__file__)) or None)
+    try:
+        out, errout = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
         try:
-            from spark_rapids_tpu.runtime import enable_compilation_cache
-            enable_compilation_cache()
-            import jax
-            jax.devices()
-            state["init"] = True
-            from spark_rapids_tpu.bench.runner import run_benchmark
-            for sf in LADDER:
-                iters = 3 if sf <= 1 else 1
-                reports = run_benchmark(
-                    os.path.join(DATA_DIR, f"sf{sf:g}"), sf, ["q6"],
-                    iterations=iters, verify=True)
-                r = reports[0]
-                if "error" in r:
-                    state["error"] = f"sf{sf:g}: {r['error']}"
-                    break
-                if not r.get("ok", False):
-                    state["error"] = f"sf{sf:g}: device != oracle"
-                    break
-                if r.get("rows", 0) <= 0:
-                    state["error"] = f"sf{sf:g}: query produced 0 rows"
-                    break
-                state["best"] = (sf, r)
-        except BaseException as e:  # noqa: BLE001 - reported via JSON line
-            state["error"] = \
-                f"{type(e).__name__}: {e} | {traceback.format_exc(limit=3)}"
+            os.killpg(os.getpgid(p.pid), 9)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        try:
+            p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return {"error": f"rung sf{sf:g}/{platform} killed after "
+                         f"{timeout_s:.0f}s (backend hang)"}
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith(_REPORT_PREFIX):
+            try:
+                return json.loads(line[len(_REPORT_PREFIX):])
+            except json.JSONDecodeError:
+                break
+    tail = (errout or "")[-300:].replace("\n", " | ")
+    return {"error": f"rung sf{sf:g}/{platform} exited rc={p.returncode} "
+                     f"with no report; stderr tail: {tail}"}
 
-    t = threading.Thread(target=_work, daemon=True)
-    t.start()
-    t.join(INIT_TIMEOUT_S)
-    if t.is_alive() and "init" not in state:
-        _emit(0.0, LADDER[-1],
-              error=f"jax backend init did not return in {INIT_TIMEOUT_S}s")
+
+def _child(sf: float, platform: str) -> None:
+    """Run one rung in-process and print its report as the last line."""
+    import jax
+    if platform == "cpu":
+        # the axon sitecustomize re-pins jax at the tunneled TPU whatever
+        # JAX_PLATFORMS says in the environment; config.update after
+        # import is the authoritative override
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    # jax can silently fall back to CPU when accelerator init FAILS fast
+    # (vs hanging), and the sitecustomize can re-pin a cpu request at the
+    # TPU — neither mislabeling is acceptable in the emitted metric
+    if (platform == "tpu") != (backend != "cpu"):
+        print(_REPORT_PREFIX + json.dumps(
+            {"ok": False,
+             "error": f"requested {platform} but jax initialized "
+                      f"'{backend}'"}), flush=True)
         os._exit(1)
-    t.join(max(0.0, TOTAL_TIMEOUT_S - INIT_TIMEOUT_S))
-    err = state.get("error")
-    if t.is_alive():
-        err = (err or "") + f" deadline {TOTAL_TIMEOUT_S}s exceeded"
-    if "best" in state:
-        sf, r = state["best"]
-        _emit(r.get("speedup", 0.0), sf, error=err,
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    # 3 iterations at every SF: the median discards the one-time
+    # executable-cache load that dominates iteration 0, at the cost of
+    # ~2 extra warm runs — the per-rung subprocess budget (not an
+    # iteration count) is what bounds a slow backend here
+    reports = run_benchmark(os.path.join(DATA_DIR, f"sf{sf:g}"), sf, ["q6"],
+                            iterations=3, verify=True)
+    r = reports[0]
+    if r.get("ok") and r.get("rows", 0) <= 0:
+        r["ok"] = False
+        r["error"] = "query produced 0 rows"
+    print(_REPORT_PREFIX + json.dumps(r))
+    sys.stdout.flush()
+    # a wedged PJRT teardown must not eat the already-printed report
+    os._exit(0)
+
+
+def _ladder(platform: str, deadline: float, reserve: float):
+    """Climb the ladder on one backend; returns ((sf, report) | None,
+    err)."""
+    best = None
+    err = None
+    for sf in LADDER:
+        budget = deadline - time.monotonic() - (reserve if best is None
+                                                else 0.0)
+        if budget < 45:
+            err = (err or "") + f" (no budget for sf{sf:g})"
+            break
+        r = _run_rung(sf, platform, budget)
+        if r.get("ok") and not r.get("error"):
+            best = (sf, r)
+        else:
+            err = r.get("error") or f"sf{sf:g}: device != oracle"
+            break
+    return best, err
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(float(sys.argv[2]), sys.argv[3])
+        return
+    deadline = time.monotonic() + TOTAL_TIMEOUT_S
+    # cap the reserve so a small total budget still attempts the TPU
+    # ladder instead of silently skipping straight to the fallback
+    reserve = min(FALLBACK_RESERVE_S, TOTAL_TIMEOUT_S / 3.0)
+    best, err = _ladder("tpu", deadline, reserve)
+    backend = "tpu"
+    if best is None:
+        tpu_err = err
+        best, err = _ladder("cpu", deadline, 0.0)
+        backend = "cpu_fallback"
+        err = f"tpu ladder failed: {tpu_err}" + (f" ; {err}" if err else "")
+    if best is not None:
+        sf, r = best
+        _emit(r.get("speedup", 0.0), sf, backend, error=err,
               extra={"device_s": r.get("device_s"),
                      "oracle_s": r.get("oracle_s"),
                      "rows": r.get("rows")})
-        rc = 0
-    else:
-        _emit(0.0, LADDER[0], error=err or "no rung completed")
-        rc = 1
-    # worker thread may still hold native state; exit hard so a hung
-    # atexit teardown can't eat the already-printed JSON line.
-    os._exit(rc)
+        sys.exit(0)
+    _emit(0.0, LADDER[0], backend, error=err or "no rung completed")
+    sys.exit(1)
 
 
 if __name__ == "__main__":

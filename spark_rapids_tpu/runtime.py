@@ -77,13 +77,24 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """
     global _enabled_dir
     cache_dir = cache_dir or COMPILATION_CACHE_DIR.default
-    # partition by XLA_FLAGS: executables compiled under different flag
-    # sets (e.g. the virtual-device test mesh) trigger machine-feature
-    # mismatch warnings when loaded into a differently-flagged process
+    # partition by (XLA_FLAGS, platform, host CPU features): XLA:CPU AOT
+    # executables record the compile machine's feature set (AMX/AVX512…)
+    # and loading them on a lesser host warns "could lead to SIGILL";
+    # virtual-device test meshes similarly must not share entries with
+    # the plain backend.  One subdir per distinct compile environment.
     import hashlib
-    tag = hashlib.md5(
-        os.environ.get("XLA_FLAGS", "").encode()).hexdigest()[:8]
-    cache_dir = os.path.join(cache_dir, tag)
+    fp = hashlib.md5()
+    fp.update(os.environ.get("XLA_FLAGS", "").encode())
+    fp.update(os.environ.get("JAX_PLATFORMS", "").encode())
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    fp.update(line.encode())
+                    break
+    except OSError:
+        pass
+    cache_dir = os.path.join(cache_dir, fp.hexdigest()[:8])
     if _enabled_dir == cache_dir:
         return _enabled_dir
     try:
