@@ -119,11 +119,6 @@ BATCH_CAPACITY_ROWS = int_conf(
     "padded up to power-of-two capacities for static-shape XLA compilation "
     "(TPU-specific; no reference analog — cuDF supports dynamic shapes).")
 
-CONCURRENT_TPU_TASKS = int_conf(
-    "spark.rapids.sql.concurrentTpuTasks", 1,
-    "Number of tasks that can execute concurrently on the TPU chip. "
-    "(ref RapidsConf.scala:351 CONCURRENT_GPU_TASKS)")
-
 INCOMPATIBLE_OPS = bool_conf(
     "spark.rapids.sql.incompatibleOps.enabled", False,
     "Enable operators flagged as not bit-for-bit compatible with the CPU "
@@ -153,35 +148,16 @@ TEST_ALLOWED_NONTPU = conf(
     "spark.rapids.sql.test.allowedNonTpu", "",
     "Comma separated exec names allowed on CPU in test mode.", internal=True)
 
-MAX_READER_BATCH_SIZE_ROWS = int_conf(
-    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
-    "Soft cap on rows per scan batch. (ref RapidsConf.scala:370)")
-
 MAX_READER_BATCH_SIZE_BYTES = bytes_conf(
     "spark.rapids.sql.reader.batchSizeBytes", 1 << 30,
-    "Soft cap on bytes per scan batch. (ref RapidsConf.scala:378)")
-
-PARQUET_READER_TYPE = conf(
-    "spark.rapids.sql.format.parquet.reader.type", "COALESCING",
-    "Parquet reader mode: PERFILE, COALESCING or MULTITHREADED. "
-    "(ref RapidsConf.scala:510)",
-    check=lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED"),
-    check_doc="must be PERFILE|COALESCING|MULTITHREADED")
-
-MULTITHREAD_READ_NUM_THREADS = int_conf(
-    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 4,
-    "Thread pool size for the multithreaded cloud reader. "
-    "(ref RapidsConf.scala:548)")
+    "Soft cap on bytes per scan batch, converted to a row cap through a "
+    "static schema width estimate (io/scan.py). Combines with "
+    "spark.rapids.sql.reader.batchRows. (ref RapidsConf.scala:378)")
 
 HBM_ALLOC_FRACTION = float_conf(
     "spark.rapids.memory.tpu.allocFraction", 0.75,
     "Fraction of device HBM the buffer store may occupy before spilling. "
     "(ref RapidsConf.scala gpu.allocFraction, docs/configs.md:33)")
-
-HOST_SPILL_STORAGE_SIZE = bytes_conf(
-    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
-    "Bounded host memory for spilled device buffers before disk. "
-    "(ref RapidsConf.scala:330)")
 
 PINNED_POOL_SIZE = bytes_conf(
     "spark.rapids.memory.pinnedPool.size", 0,
@@ -260,9 +236,6 @@ class TpuConf:
 
     @property
     def batch_capacity_rows(self) -> int: return self.get(BATCH_CAPACITY_ROWS)
-
-    @property
-    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
 
     @property
     def incompatible_ops(self) -> bool: return self.get(INCOMPATIBLE_OPS)

@@ -20,7 +20,8 @@ from typing import Iterator, Sequence
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch
-from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.exec.core import (ExecCtx, PlanNode,
+                                        RequireSingleBatch, TargetSize)
 from spark_rapids_tpu.expr.aggregates import AggregateFunction
 from spark_rapids_tpu.expr.core import (Alias, BoundReference, Expression,
                                         bind, eval_device, eval_host,
@@ -176,6 +177,18 @@ class HashAggregateExec(PlanNode):
     @property
     def output_batching(self):
         return RequireSingleBatch
+
+    @property
+    def children_coalesce_goal(self):
+        # batch small scan output up to batchSizeBytes before aggregating
+        # (fewer, larger segment-reduce dispatches; reference: the
+        # aggregate's TargetSize child goal, GpuExec.scala:71-86).
+        # TargetSize(0) resolves to spark.rapids.sql.batchSizeBytes at
+        # planning.  Final mode reads shuffle output that the adaptive
+        # reader already coalesced.
+        if self.mode == "final":
+            return [None]
+        return [TargetSize(0)]
 
     def num_partitions(self, ctx: ExecCtx) -> int:
         # complete mode is a whole-input aggregation: collapse partitions

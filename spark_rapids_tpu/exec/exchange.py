@@ -48,6 +48,13 @@ ADVISORY_PARTITION_BYTES = register(ConfEntry(
     "spark.sql.adaptive.advisoryPartitionSizeInBytes", 64 << 20,
     "Target post-shuffle partition size for adaptive coalescing.",
     conv=int))
+SKEWED_PARTITION_THRESHOLD = register(ConfEntry(
+    "spark.sql.adaptive.skewedPartitionThresholdInBytes", 256 << 20,
+    "A shuffle output partition larger than this is skewed: the adaptive "
+    "reader splits it into multiple reader groups at map-batch "
+    "granularity targeting advisoryPartitionSizeInBytes each (the skew "
+    "half of Spark 3.0 AQE; small partitions are coalesced, large ones "
+    "split).", conv=int))
 
 
 @partial(jax.jit, static_argnames=("num_parts",))
@@ -138,11 +145,18 @@ class ShuffleExchangeExec(PlanNode):
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from self.partition_iter_slice(ctx, pid, 0, None)
+
+    def partition_iter_slice(self, ctx: ExecCtx, pid: int, lo: int,
+                             hi: int | None) -> Iterator:
+        """One reduce partition's batches, restricted to map-batch slice
+        [lo, hi) — each adaptive skew-split group materializes only its
+        own range."""
         shuffled = self._shuffled(ctx)
         if ctx.is_device:
-            yield from shuffled.fetch_partition(id(self), pid)
+            yield from shuffled.fetch_partition(id(self), pid, lo, hi)
         else:
-            yield from shuffled[pid]
+            yield from shuffled[pid][lo:hi]
 
     def node_desc(self) -> str:
         return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"
@@ -150,30 +164,52 @@ class ShuffleExchangeExec(PlanNode):
 
 
 class AdaptiveShuffleReaderExec(PlanNode):
-    """Coalesced shuffle reader: groups adjacent small output partitions
-    using ACTUAL map-output sizes (the AQE analog; reference
-    GpuCustomShuffleReaderExec.scala:131 reading CoalescedPartitionSpecs).
+    """Adaptive shuffle reader: re-plans the reduce side from ACTUAL
+    map-output sizes (the AQE analog; reference
+    GpuCustomShuffleReaderExec.scala:131 reading CoalescedPartitionSpecs,
+    plus Spark 3.0's skew-reader split).
 
-    The shuffle is its query-stage barrier: partition grouping is decided
-    AFTER the map side materializes, per execution.
+    * adjacent partitions smaller than advisoryPartitionSizeInBytes are
+      coalesced into one reader group;
+    * a partition larger than skewedPartitionThresholdInBytes is SPLIT
+      into several groups at map-batch granularity, each targeting the
+      advisory size, so one hot key range cannot serialize the stage.
+
+    The shuffle is its query-stage barrier: grouping is decided AFTER the
+    map side materializes, per execution.  Each group is a list of
+    ``(child_pid, lo, hi)`` map-batch slices (hi=None -> to the end).
+
+    ``allow_skew_split`` is only set by the planner where the consumer
+    has per-row semantics (join sides, writes): splitting one hash
+    partition into several reader groups between a partial and a final
+    aggregation would emit duplicate keys, so that path keeps
+    coalesce-only (Spark scopes its skew reader to joins the same way,
+    OptimizeSkewedJoin).  ``allow_coalesce=False`` makes the reader
+    split-only: user-requested partition counts are never REDUCED
+    (Spark's REPARTITION_BY_NUM contract), but a skewed partition may
+    still fan out.
     """
 
-    def __init__(self, child: ShuffleExchangeExec):
+    def __init__(self, child: ShuffleExchangeExec,
+                 allow_skew_split: bool = False,
+                 allow_coalesce: bool = True):
         super().__init__([child])
         assert isinstance(child, ShuffleExchangeExec)
+        self.allow_skew_split = allow_skew_split
+        self.allow_coalesce = allow_coalesce
 
     @property
     def output_schema(self) -> T.Schema:
         return self.children[0].output_schema
 
-    def _groups(self, ctx: ExecCtx) -> list[list[int]]:
+    def _groups(self, ctx: ExecCtx) -> list[list[tuple]]:
         return ctx.cached(("aqe_groups", id(self), ctx.backend),
                           lambda: self._compute_groups(ctx))
 
-    def _compute_groups(self, ctx: ExecCtx) -> list[list[int]]:
+    def _compute_groups(self, ctx: ExecCtx) -> list[list[tuple]]:
         child = self.children[0]
         n = child.num_partitions(ctx)
-        identity = [[pid] for pid in range(n)]
+        identity = [[(pid, 0, None)] for pid in range(n)]
         # transition insertion may have wrapped the shuffle (backend
         # switch); without direct access to map-output stats, do NOT
         # coalesce — unknown sizes must not serialize the reduce side
@@ -181,33 +217,57 @@ class AdaptiveShuffleReaderExec(PlanNode):
             return identity
         shuffled = child._shuffled(ctx)  # stage barrier: materialize maps
         target = ctx.conf.get(ADVISORY_PARTITION_BYTES)
+        skew_at = ctx.conf.get(SKEWED_PARTITION_THRESHOLD)
         sizes = shuffled.partition_sizes(id(child)) \
             if hasattr(shuffled, "partition_sizes") else None
         if not sizes:
             return identity
-        groups: list[list[int]] = []
-        cur: list[int] = []
+        groups: list[list[tuple]] = []
+        cur: list[tuple] = []
         cur_bytes = 0
+
+        def flush():
+            nonlocal cur, cur_bytes
+            if cur:
+                groups.append(cur)
+            cur, cur_bytes = [], 0
+
         for pid in range(n):
             sz = sizes.get(pid, 0)
+            per_batch = shuffled.batch_sizes(id(child), pid) \
+                if (self.allow_skew_split and sz > skew_at
+                    and hasattr(shuffled, "batch_sizes")) else None
+            if per_batch and len(per_batch) > 1:
+                flush()
+                lo, acc = 0, 0
+                for i, bsz in enumerate(per_batch):
+                    if acc > 0 and acc + bsz > target:
+                        groups.append([(pid, lo, i)])
+                        lo, acc = i, 0
+                    acc += bsz
+                groups.append([(pid, lo, None)])
+                continue
+            if not self.allow_coalesce:
+                groups.append([(pid, 0, None)])
+                continue
             if cur and cur_bytes + sz > target:
-                groups.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(pid)
+                flush()
+            cur.append((pid, 0, None))
             cur_bytes += sz
-        if cur:
-            groups.append(cur)
+        flush()
         return groups or identity
 
     def num_partitions(self, ctx: ExecCtx) -> int:
         return len(self._groups(ctx))
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        for child_pid in self._groups(ctx)[pid]:
-            yield from self.children[0].partition_iter(ctx, child_pid)
+        for child_pid, lo, hi in self._groups(ctx)[pid]:
+            yield from self.children[0].partition_iter_slice(
+                ctx, child_pid, lo, hi)
 
     def node_desc(self) -> str:
-        return "AdaptiveShuffleReaderExec"
+        return "AdaptiveShuffleReaderExec" + (
+            "[skew-split]" if self.allow_skew_split else "")
 
 
 class BroadcastExchangeExec(PlanNode):

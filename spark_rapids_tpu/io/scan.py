@@ -45,8 +45,28 @@ READER_THREADS = {
 }
 BATCH_ROWS = register(ConfEntry(
     "spark.rapids.sql.reader.batchRows", 1 << 16,
-    "Max rows per decoded batch (reference batchSizeBytes analog, "
-    "RapidsConf.scala:364).", conv=int))
+    "Max rows per decoded batch (reference "
+    "spark.rapids.sql.reader.batchSizeRows, RapidsConf.scala:370).",
+    conv=int))
+
+
+def _effective_batch_rows(schema: T.Schema, settings: dict) -> int:
+    """Row cap honoring BOTH reader.batchRows and reader.batchSizeBytes
+    (reference maxReadBatchSizeRows/maxReadBatchSizeBytes,
+    RapidsConf.scala:370-386): bytes are converted to rows through a
+    static per-row width estimate of the pruned schema."""
+    from spark_rapids_tpu.conf import MAX_READER_BATCH_SIZE_BYTES
+    rows = BATCH_ROWS.get(settings)
+    byte_cap = MAX_READER_BATCH_SIZE_BYTES.get(settings)
+    width = 1  # validity
+    for f in schema:
+        if isinstance(f.data_type, T.StringType):
+            width += 32          # offset + data estimate
+        else:
+            width += max(1, f.data_type.np_dtype.itemsize)
+    # the floor protects only the bytes-derived cap (a degenerate byte
+    # budget must not produce 0-row batches); an explicit row cap wins
+    return min(rows, max(256, byte_cap // width))
 
 
 def _expand_paths(paths) -> list[str]:
@@ -195,7 +215,7 @@ class FileScanExec(PlanNode):
                 if isinstance(f.data_type, T.StringType)}
 
     def _decode_iter(self, ctx: ExecCtx, files: list[str], mode: str):
-        batch_rows = BATCH_ROWS.get(ctx.conf.settings)
+        batch_rows = _effective_batch_rows(self._schema, ctx.conf.settings)
         if mode == "MULTITHREADED" and len(files) > 1:
             # prefetch pool: decode next files while current is consumed,
             # bounded to a numThreads-file window so host memory stays

@@ -75,6 +75,7 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
     if isinstance(node, L.Join):
         lc = lower(node.left, conf)
         rc = lower(node.right, conf)
+        lc, rc = (_aqe_join_reader(c, conf) for c in (lc, rc))
         if node.how == "cross":
             ex = CrossJoinExec(lc.exec_node, rc.exec_node, node.condition)
         else:
@@ -126,11 +127,35 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         else:
             part = RoundRobinPartitioning(node.num_partitions)
         ex = ShuffleExchangeExec(part, c.exec_node)
-        # NOTE: explicit repartition(n) keeps n partitions (Spark does not
-        # AQE-coalesce user-requested counts); only planner-inserted
-        # shuffles (aggregation) get the adaptive reader.
+        # NOTE: explicit repartition(n) is never coalesced below n
+        # (Spark does not AQE-coalesce user-requested counts); only
+        # planner-inserted shuffles (aggregation) get the coalescing
+        # reader.  A downstream JOIN may still wrap this exchange in a
+        # split-only skew reader (_aqe_join_reader), which can raise —
+        # never lower — the effective partition count.
         return PlannedNode(ex, list(node.keys), [c])
     raise TypeError(f"cannot lower {node!r}")
+
+
+def _aqe_join_reader(c: PlannedNode, conf: TpuConf) -> PlannedNode:
+    """Joins read shuffles through a SPLIT-ONLY adaptive reader (Spark's
+    OptimizeSkewedJoin scope): join sides have per-row semantics, so
+    fanning a skewed hash partition out into several reader groups is
+    safe — the stream side probes per batch and a build side is fully
+    materialized either way.  Coalescing is disabled because the only
+    shuffles reaching a join today are explicit ``repartition(n)``s,
+    whose partition count must never be REDUCED below the user's request
+    (REPARTITION_BY_NUM contract; a skewed partition may still fan out,
+    which preserves the requested parallelism floor)."""
+    from spark_rapids_tpu.exec.exchange import (ADAPTIVE_ENABLED,
+                                                AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    if not conf.get(ADAPTIVE_ENABLED) or \
+            not isinstance(c.exec_node, ShuffleExchangeExec):
+        return c
+    reader = AdaptiveShuffleReaderExec(c.exec_node, allow_skew_split=True,
+                                       allow_coalesce=False)
+    return PlannedNode(reader, [], [c])
 
 
 def _split_window_exprs(exprs):
@@ -268,6 +293,7 @@ class TpuOverrides:
 
     def apply(self, root: PlannedNode) -> PlanNode:
         self._tag(root)
+        self._insert_coalesce(root)
         self._insert_transitions(root)
         explain_mode = self.conf.explain
         if explain_mode and explain_mode != "NONE":
@@ -323,6 +349,40 @@ class TpuOverrides:
                         dt, T.StringType):
                     meta.will_not_work(
                         "windowed min/max over strings has no device kernel")
+
+    # -- coalesce insertion (reference GpuTransitionOverrides
+    # insertCoalesce :224-244 / optimizeCoalesce :96-116) ---------------
+    def _insert_coalesce(self, meta: PlannedNode) -> None:
+        """Insert CoalesceBatchesExec where an operator's
+        children_coalesce_goal demands batching its child does not
+        already satisfy.  A declared ``TargetSize(0)`` resolves to
+        ``spark.rapids.sql.batchSizeBytes`` (reference: the goal is
+        built from conf at planning, GpuExec.scala:71-86 +
+        RapidsConf.scala:364)."""
+        from spark_rapids_tpu.exec import CoalesceBatchesExec
+        from spark_rapids_tpu.exec.core import TargetSize
+        for ch in meta.children:
+            self._insert_coalesce(ch)
+        goals = meta.exec_node.children_coalesce_goal
+        if not any(g is not None for g in goals):
+            return
+        new_children = []
+        new_metas = []
+        for ch, goal in zip(meta.children, goals):
+            if goal is None or ch.exec_node.output_batching is not None \
+                    and ch.exec_node.output_batching.satisfies(goal):
+                new_children.append(ch.exec_node)
+                new_metas.append(ch)
+                continue
+            if isinstance(goal, TargetSize) and goal.size <= 0:
+                goal = TargetSize(self.conf.batch_size_bytes)
+            co = CoalesceBatchesExec(goal, ch.exec_node)
+            cometa = PlannedNode(co, [], [ch], backend=ch.backend)
+            new_children.append(co)
+            new_metas.append(cometa)
+        assert len(new_children) == len(meta.exec_node.children)
+        meta.exec_node.children = tuple(new_children)
+        meta.children = new_metas
 
     # -- transitions ---------------------------------------------------
     def _insert_transitions(self, meta: PlannedNode) -> None:

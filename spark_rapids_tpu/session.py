@@ -259,6 +259,7 @@ class DataFrame:
         ov = TpuOverrides(self._s.conf)
         if quiet:
             ov._tag(meta)
+            ov._insert_coalesce(meta)
             ov._insert_transitions(meta)
         else:
             ov.apply(meta)

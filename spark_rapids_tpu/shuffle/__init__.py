@@ -36,8 +36,11 @@ class ShuffleTransport(Protocol):
         """Store one map-output batch for (shuffle, map, partition)."""
         ...
 
-    def fetch_partition(self, shuffle_id: int, part_id: int) -> Iterable:
-        """All batches of one reduce partition (any map order)."""
+    def fetch_partition(self, shuffle_id: int, part_id: int,
+                        lo: int = 0, hi: int | None = None) -> Iterable:
+        """Batches of one reduce partition in a stable map order,
+        restricted to the batch slice [lo, hi) (hi=None -> end).  The
+        adaptive reader uses sub-ranges to split skewed partitions."""
         ...
 
     def close(self) -> None:

@@ -41,6 +41,7 @@ class LocalShuffleTransport:
         # (shuffle_id, part_id) -> list of stored items in map order
         self._store: dict[tuple, list] = {}
         self._sizes: dict[tuple, int] = {}
+        self._batch_sizes: dict[tuple, list[int]] = {}
         self.metrics = {"bytes_written": 0, "bytes_compressed": 0,
                         "batches_written": 0}
 
@@ -69,6 +70,8 @@ class LocalShuffleTransport:
             self._store.setdefault((shuffle_id, part_id), []).append(item)
             self._sizes[(shuffle_id, part_id)] = \
                 self._sizes.get((shuffle_id, part_id), 0) + size
+            self._batch_sizes.setdefault((shuffle_id, part_id),
+                                         []).append(size)
         self.metrics["batches_written"] += 1
 
     def partition_sizes(self, shuffle_id: int) -> dict[int, int]:
@@ -78,14 +81,29 @@ class LocalShuffleTransport:
             return {pid: sz for (sid, pid), sz in self._sizes.items()
                     if sid == shuffle_id}
 
-    def fetch_partition(self, shuffle_id: int, part_id: int) -> Iterable:
+    def batch_sizes(self, shuffle_id: int, part_id: int) -> list[int]:
+        """Per-map-batch sizes of one reduce partition, in fetch order —
+        the granularity the adaptive reader splits skewed partitions at."""
+        with self._lock:
+            return list(self._batch_sizes.get((shuffle_id, part_id), ()))
+
+    def fetch_partition(self, shuffle_id: int, part_id: int,
+                        lo: int = 0, hi: int | None = None) -> Iterable:
+        """Stream one reduce partition's batches, optionally only the
+        map-batch slice [lo, hi) — the adaptive reader's skew-split
+        groups fetch their own range without materializing the rest."""
         with self._lock:
             items = list(self._store.get((shuffle_id, part_id), ()))
-        for item in items:
+        for item in items[lo:hi]:
             if item[0] == "spillable":
                 b = item[1].get()
-                yield b
-                item[1].unpin()
+                try:
+                    yield b
+                finally:
+                    # unpin on GeneratorExit too: a consumer breaking out
+                    # mid-iteration must not leave the batch pinned
+                    # (unspillable) for the rest of the execution
+                    item[1].unpin()
             else:
                 _, data, raw_size = item
                 raw = self.codec.decompress(data, raw_size) \

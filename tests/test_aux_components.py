@@ -162,3 +162,32 @@ def test_partitioned_write_nan_values(tmp_path):
     stats = df.write_parquet(out, partition_by=["p"])
     assert stats.num_rows == 4  # NaN row written, not dropped
     assert any("nan" in p for p in stats.partitions)
+
+
+def test_join_reads_shuffle_through_adaptive_reader():
+    """Joins over a repartition read through the skew-capable adaptive
+    reader (Spark OptimizeSkewedJoin scope); results match the oracle."""
+    s = TpuSession({})
+    left = _df(s).repartition(4, "cat")
+    right = s.from_pydict(
+        {"cat": ["a", "b", "c"], "w": [1.0, 2.0, 3.0]},
+        T.Schema([T.StructField("cat", T.StringType()),
+                  T.StructField("w", T.DoubleType())]))
+    out = left.join(right, on="cat", how="inner")
+    plan = out.explain()
+    assert "AdaptiveShuffleReaderExec" in plan
+    dev = sorted(out.collect(), key=str)
+    ov, meta = out._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf), key=str)
+    assert dev == host and len(dev) > 0
+
+
+def test_join_adaptive_reader_respects_disable():
+    s = TpuSession({"spark.sql.adaptive.enabled": False})
+    left = _df(s).repartition(4, "cat")
+    right = s.from_pydict(
+        {"cat": ["a", "b"], "w": [1.0, 2.0]},
+        T.Schema([T.StructField("cat", T.StringType()),
+                  T.StructField("w", T.DoubleType())]))
+    out = left.join(right, on="cat", how="inner")
+    assert "AdaptiveShuffleReaderExec" not in out.explain()

@@ -131,3 +131,63 @@ def test_broadcast_exchange_caches(rng):
     two = b.materialize(ctx)
     assert one is two
     assert_tpu_and_cpu_equal(b)
+
+
+def test_adaptive_reader_skew_split(rng):
+    """A skewed reduce partition is split into multiple reader groups at
+    map-batch granularity (AQE skew reader, join-side scope), and the
+    data read through the split groups is exactly the shuffle output."""
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+
+    # 90% of rows share key 0 -> one hot hash partition; many small map
+    # batches so the skewed partition has sub-partition granularity
+    n = 400
+    hot = [0 if i % 10 else int(rng.integers(1, 30)) for i in range(n)]
+    scan = LocalScanExec.from_pydict(
+        {"k": hot, "v": [int(x) for x in rng.integers(-50, 50, n)],
+         "s": [f"s{i%7}" for i in range(n)]},
+        SCHEMA, partitions=4, rows_per_batch=16)
+    shuffle = ShuffleExchangeExec(HashPartitioning([col("k")], 4), scan)
+    reader = AdaptiveShuffleReaderExec(shuffle, allow_skew_split=True)
+    conf = TpuConf({
+        "spark.sql.adaptive.skewedPartitionThresholdInBytes": 4096,
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": 2048,
+    })
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        groups = reader._groups(ctx)
+        nparts = shuffle.num_partitions(ctx)
+        # the hot partition must have been split
+        assert len(groups) > 1
+        split_pids = {spec[0] for g in groups for spec in g
+                      if not (spec[1] == 0 and spec[2] is None)}
+        assert split_pids, f"no partition was split: {groups}"
+        rows = []
+        for b in reader.execute(ctx):
+            rows.extend(device_to_host(b).to_rows())
+    want = collect_host(shuffle)
+    assert sorted(rows, key=_sort_key) == sorted(want, key=_sort_key)
+
+
+def test_adaptive_skew_split_disabled_for_aggregation(rng):
+    """The reader feeding a final aggregation must NOT split partitions
+    (duplicate keys otherwise); default allow_skew_split=False."""
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+
+    n = 400
+    hot = [0 if i % 10 else int(rng.integers(1, 30)) for i in range(n)]
+    scan = LocalScanExec.from_pydict(
+        {"k": hot, "v": [int(x) for x in rng.integers(-50, 50, n)],
+         "s": [f"s{i%7}" for i in range(n)]},
+        SCHEMA, partitions=4, rows_per_batch=16)
+    shuffle = ShuffleExchangeExec(HashPartitioning([col("k")], 4), scan)
+    reader = AdaptiveShuffleReaderExec(shuffle)
+    conf = TpuConf({
+        "spark.sql.adaptive.skewedPartitionThresholdInBytes": 4096,
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": 2048,
+    })
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        for g in reader._groups(ctx):
+            for pid, lo, hi in g:
+                assert lo == 0 and hi is None

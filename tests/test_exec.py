@@ -204,3 +204,25 @@ def test_complete_agg_multi_partition(rng):
     plan = HashAggregateExec([], [CountStar().alias("c")], scan)
     rows = assert_tpu_and_cpu_equal(plan)
     assert rows == [(100,)]
+
+
+def test_coalesce_goal_insertion(rng):
+    """The planner inserts CoalesceBatchesExec per children_coalesce_goal
+    (reference GpuTransitionOverrides.insertCoalesce :224-244): an
+    aggregation over many small scan batches sees batched input."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.aggregates import Sum
+
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("k", T.IntegerType()),
+                       T.StructField("v", T.LongType())])
+    df = s.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 5, 200)],
+         "v": list(range(200))}, schema, partitions=1, rows_per_batch=10)
+    out = df.group_by("k").agg(Sum(col("v")).alias("sv"))
+    plan = out.explain()
+    assert "CoalesceBatchesExec" in plan
+    dev = sorted(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    from spark_rapids_tpu.exec.core import collect_host as _ch
+    assert dev == sorted(_ch(meta.exec_node, s.conf))

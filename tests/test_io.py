@@ -216,3 +216,20 @@ def test_coalescing_merges_small_files(tmp_path):
     ctx = ExecCtx(backend="host", conf=conf)
     batches = list(scan.partition_iter(ctx, 0))
     assert len(batches) == 1 and batches[0].num_rows == 60
+
+
+def test_reader_batch_size_bytes_cap(pq_dir):
+    """reader.batchSizeBytes converts to a row cap via the schema width
+    estimate (reference maxReadBatchSizeBytes, RapidsConf.scala:378)."""
+    from spark_rapids_tpu.io.scan import _effective_batch_rows
+    scan = ParquetScanExec(pq_dir)
+    wide = _effective_batch_rows(scan.output_schema, {})
+    tight = _effective_batch_rows(
+        scan.output_schema, {"spark.rapids.sql.reader.batchSizeBytes": 4096})
+    assert tight < wide
+    assert tight >= 256
+    conf = TpuConf({"spark.rapids.sql.reader.batchSizeBytes": 4096})
+    ctx = ExecCtx(backend="host", conf=conf)
+    for pid in range(scan.num_partitions(ctx)):
+        for b in scan.partition_iter(ctx, pid):
+            assert b.num_rows <= tight
