@@ -287,16 +287,20 @@ def generate_docs() -> str:
     """Render every registered conf entry as markdown (the analog of
     RapidsConf.help generating docs/configs.md, RapidsConf.scala:785).
 
-    Importing the package registers the core entries; exec/io/shuffle
-    modules register theirs on import, so the generator pulls them in
-    first."""
+    Modules register entries at import near their consumers, so the
+    generator imports EVERY package module first — a hand-kept list
+    here silently drops new modules' keys from the docs."""
     import importlib
-    for mod in ("spark_rapids_tpu.exec.core", "spark_rapids_tpu.io.scan",
-                "spark_rapids_tpu.memory.catalog",
-                "spark_rapids_tpu.exec.exchange",
-                "spark_rapids_tpu.exec.python_exec",
-                "spark_rapids_tpu.runtime"):
-        importlib.import_module(mod)
+    import pkgutil
+    import spark_rapids_tpu
+    for m in pkgutil.walk_packages(spark_rapids_tpu.__path__,
+                                   "spark_rapids_tpu."):
+        if "._native" in m.name or m.name.endswith("_native"):
+            continue
+        try:
+            importlib.import_module(m.name)
+        except ImportError:
+            pass
     lines = [
         "# Configuration",
         "",

@@ -242,6 +242,20 @@ class PlanNode:
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         raise NotImplementedError
 
+    def partition_iter_slice(self, ctx: ExecCtx, pid: int, lo: int,
+                             hi: int | None) -> Iterator:
+        """Batches [lo, hi) of one partition.  Default: enumerate-and-skip
+        over partition_iter; ShuffleExchangeExec overrides with a sliced
+        transport fetch that skips materializing the rest.  Keeps the
+        adaptive reader safe over ANY child (e.g. a BackendSwitchExec
+        inserted by transition overrides)."""
+        for i, b in enumerate(self.partition_iter(ctx, pid)):
+            if i < lo:
+                continue
+            if hi is not None and i >= hi:
+                break
+            yield b
+
     #: bound (fully-typed) expressions this operator evaluates — the
     #: planner's tagging pass checks device_supported on these, since
     #: dtype-dependent checks can't run on unresolved trees

@@ -79,7 +79,17 @@ class BufferCatalog:
         self._lock = threading.RLock()
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
-        self.device_limit = device_limit or DEVICE_SPILL_LIMIT.get(settings)
+        if device_limit:
+            self.device_limit = device_limit
+        elif DEVICE_SPILL_LIMIT.key in settings:
+            self.device_limit = DEVICE_SPILL_LIMIT.get(settings)
+        else:
+            # no explicit budget: size from the initialized device's HBM
+            # via allocFraction/reserve (reference computeRmmInitSizes,
+            # GpuDeviceManager.scala:159-194); conf default otherwise
+            from spark_rapids_tpu.device import device_pool_limit
+            self.device_limit = (device_pool_limit()
+                                 or DEVICE_SPILL_LIMIT.get(settings))
         self.device_used = 0
         # the C++ arena maps its full capacity up front (~0.3s for 1GB),
         # so it is created on FIRST SPILL, not per catalog/query — unless

@@ -119,9 +119,12 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
 
 def ensure_runtime(conf=None) -> None:
     """Session-start runtime init (reference RapidsExecutorPlugin.init,
-    Plugin.scala:124-154): compilation cache + arrow thread pinning;
-    device pool / semaphore wiring lives in memory/catalog.py."""
+    Plugin.scala:124-154): compilation cache + arrow thread pinning +
+    fail-fast device acquisition with HBM pool sizing (device.py);
+    semaphore wiring lives in memory/catalog.py."""
     pin_arrow_threads()
     settings = getattr(conf, "settings", None) or {}
     if COMPILATION_CACHE_ENABLED.get(settings):
         enable_compilation_cache(COMPILATION_CACHE_DIR.get(settings))
+    from spark_rapids_tpu.device import initialize_device
+    initialize_device(conf)

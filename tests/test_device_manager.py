@@ -1,0 +1,78 @@
+"""Device manager: fail-fast init, version gate, HBM pool math.
+
+Reference: GpuDeviceManager.scala:120-262 (init + computeRmmInitSizes),
+Plugin.scala:146-201 (fail-fast executor init + version check with
+override flag).
+"""
+import time
+
+import pytest
+
+from spark_rapids_tpu import device as D
+from spark_rapids_tpu.conf import TpuConf
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    D._reset_for_tests()
+    yield
+    D._reset_for_tests()
+    # leave the process initialized for later tests in the session
+    D.initialize_device(TpuConf({}))
+
+
+def test_initialize_populates_info():
+    D.initialize_device(TpuConf({}))
+    info = D.device_info()
+    assert info["initialized"]
+    assert info["device_count"] >= 1
+    assert info["platform"] == "cpu"  # conftest pins the CPU backend
+
+
+def test_init_timeout_fails_fast():
+    conf = TpuConf({"spark.rapids.tpu.initTimeoutSeconds": 1})
+    with pytest.raises(D.TpuInitError, match="did not complete"):
+        D.initialize_device(conf, probe=lambda: time.sleep(30))
+
+
+def test_init_probe_error_fails_fast():
+    def boom():
+        raise RuntimeError("PJRT exploded")
+    with pytest.raises(D.TpuInitError, match="PJRT exploded"):
+        D.initialize_device(TpuConf({}), probe=boom)
+
+
+def test_version_gate_and_override(monkeypatch):
+    import jax
+    monkeypatch.setattr(jax, "__version__", "0.3.0")
+    with pytest.raises(D.TpuInitError, match="jax 0.3.0"):
+        D.initialize_device(TpuConf({}))
+    # override flag continues with a warning (reference Plugin.scala:198)
+    conf = TpuConf({"spark.rapids.tpu.allowIncompatibleRuntime": True})
+    with pytest.warns(RuntimeWarning, match="incompatible runtime"):
+        D.initialize_device(conf)
+    assert D.device_info()["initialized"]
+
+
+def test_pool_limit_math():
+    # 16 GB HBM, 75% alloc fraction, 256 MB reserve
+    got = D._compute_pool_limit(16 << 30, 0.75, 256 << 20)
+    assert got == int((16 << 30) * 0.75) - (256 << 20)
+    # degenerate budget floors at 64 MB instead of going negative
+    assert D._compute_pool_limit(1 << 20, 0.5, 1 << 30) == 64 << 20
+
+
+def test_catalog_uses_device_pool_limit():
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    D.initialize_device(TpuConf({}))
+    # CPU backend exposes no bytes_limit: simulate an initialized TPU
+    D._State.hbm_bytes_limit = 8 << 30
+    D._State.pool_limit = D._compute_pool_limit(8 << 30, 0.75, 256 << 20)
+    cat = BufferCatalog(conf=TpuConf({}))
+    assert cat.device_limit == D._State.pool_limit
+    # an explicit spillStoreSize always wins over the derived budget
+    cat2 = BufferCatalog(conf=TpuConf(
+        {"spark.rapids.memory.tpu.spillStoreSize": 123 << 20}))
+    assert cat2.device_limit == 123 << 20
+    cat.close()
+    cat2.close()

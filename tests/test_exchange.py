@@ -191,3 +191,24 @@ def test_adaptive_skew_split_disabled_for_aggregation(rng):
         for g in reader._groups(ctx):
             for pid, lo, hi in g:
                 assert lo == 0 and hi is None
+
+
+def test_adaptive_reader_over_non_shuffle_child(rng):
+    """The reader must degrade to identity groups and plain iteration
+    when its child is not a bare ShuffleExchangeExec (review finding:
+    partition_iter_slice AttributeError over BackendSwitchExec)."""
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+    from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+
+    shuffle = ShuffleExchangeExec(HashPartitioning([col("k")], 3),
+                                  _scan(rng, n=90))
+    reader = AdaptiveShuffleReaderExec(shuffle, allow_skew_split=True)
+    # simulate transition insertion wrapping the shuffle
+    reader.children = (BackendSwitchExec(shuffle, "host"),)
+    with ExecCtx(backend="device", conf=TpuConf({})) as ctx:
+        rows = []
+        for b in reader.execute(ctx):
+            rows.extend(device_to_host(b).to_rows())
+    want = collect_host(shuffle)
+    assert sorted(rows, key=_sort_key) == sorted(want, key=_sort_key)
