@@ -299,8 +299,13 @@ def generate_docs() -> str:
             continue
         try:
             importlib.import_module(m.name)
-        except ImportError:
-            pass
+        except ImportError as e:
+            # a skipped module silently drops its keys from the docs —
+            # make that loud instead of invisible
+            import warnings
+            warnings.warn(f"generate_docs: could not import {m.name} "
+                          f"({e}); its conf keys are missing from the "
+                          "generated docs", RuntimeWarning)
     lines = [
         "# Configuration",
         "",

@@ -35,7 +35,8 @@ class LocalShuffleTransport:
     def __init__(self, conf: TpuConf, ctx=None):
         self.conf = conf
         self.ctx = ctx
-        self.codec = get_codec(conf.get(SHUFFLE_COMPRESSION_CODEC))
+        self.codec_name = conf.get(SHUFFLE_COMPRESSION_CODEC)
+        self.codec = get_codec(self.codec_name)
         self.max_metadata = conf.get(SHUFFLE_MAX_METADATA_SIZE)
         self._lock = threading.Lock()
         # (shuffle_id, part_id) -> list of stored items in map order
@@ -109,6 +110,37 @@ class LocalShuffleTransport:
                 raw = self.codec.decompress(data, raw_size) \
                     if self.codec is not None else data
                 yield deserialize_batch(raw, device=True)
+
+    def fetch_partition_serialized(self, shuffle_id: int, part_id: int,
+                                   lo: int = 0,
+                                   hi: int | None = None) -> Iterable[bytes]:
+        """Wire frames for one reduce partition's map-batch slice: Arrow
+        IPC bytes, codec-compressed with a 4-byte raw-size prefix when a
+        codec is configured.  Spillable (device-resident) items serialize
+        on demand — the TCP server's send path (reference
+        RapidsShuffleServer: acquire from catalog -> copy to bounce
+        buffer -> send)."""
+        import struct
+        with self._lock:
+            items = list(self._store.get((shuffle_id, part_id), ()))
+        for item in items[lo:hi]:
+            if item[0] == "spillable":
+                b = item[1].get()
+                try:
+                    raw = serialize_batch(b, self.max_metadata)
+                finally:
+                    item[1].unpin()
+                if self.codec is not None:
+                    yield struct.pack(">I", len(raw)) + \
+                        self.codec.compress(raw)
+                else:
+                    yield raw
+            else:
+                _, data, raw_size = item
+                if self.codec is not None:
+                    yield struct.pack(">I", raw_size) + data
+                else:
+                    yield data
 
     def close(self) -> None:
         with self._lock:

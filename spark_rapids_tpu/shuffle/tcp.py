@@ -1,0 +1,258 @@
+"""TcpShuffleTransport: the cross-process / cross-host shuffle plane.
+
+Reference mapping (SURVEY §2.6, §5.8): the UCX transport module — a TCP
+management/metadata plane plus a tagged data plane moving partition
+buffers peer-to-peer, with an inflight-bytes throttle
+(UCX.scala:192-328, UCXShuffleTransport.scala:365-391) — behind the
+`RapidsShuffleTransport` SPI.  The TPU engine's cross-slice analog is a
+host TCP plane (DCN-style): map output stays spillable in the local
+store (the `LocalShuffleTransport` it wraps), a server thread serves
+partition ranges on demand, and peers fetch with a length-prefixed,
+type-tagged frame protocol:
+
+    request  (JSON frame): {"op": "fetch", "shuffle_id": .., "part_id":
+              .., "lo": .., "hi": .., "window": <client ack window>}
+              | {"op": "meta", "shuffle_id": ..}
+    response: [8-byte big-endian length][1-byte tag][payload] frames:
+              tag 0x03 = JSON header/metadata (fetch headers carry the
+              server's codec, so compression is negotiated, not
+              assumed), 0x00 = batch data (Arrow IPC bytes, codec-
+              compressed with a 4-byte raw-size prefix when the header
+              says so), 0x01 = end of stream, 0x02 = server-side error
+              (payload is the message — a store failure reaches the
+              client as a diagnosable ShuffleFetchError, not a
+              connection reset).
+
+The server throttles at the CLIENT-declared ``window`` (carried in the
+request), so both endpoints count the same bytes and a conf mismatch
+cannot deadlock the ack exchange.
+
+Within a slice the mesh collective path (parallel/mesh_shuffle.py) is
+the ICI plane; this module is the inter-process/DCN plane.  The
+listener binds ``spark.rapids.shuffle.tcp.bindAddress`` (loopback by
+default; set 0.0.0.0 — plus advertiseAddress — for real multi-host).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Iterable
+
+from spark_rapids_tpu.conf import ConfEntry, register, parse_bytes
+from spark_rapids_tpu.shuffle.compression import get_codec
+from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
+from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+
+__all__ = ["TcpShuffleTransport", "TcpShuffleServer", "ShuffleFetchError",
+           "fetch_remote", "remote_partition_sizes"]
+
+TCP_PORT = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.port", 0,
+    "Listen port for the TCP shuffle server (0 = ephemeral). The bound "
+    "address is exposed as transport.address, the analog of the UCX "
+    "management port carried in MapStatus "
+    "(RapidsShuffleInternalManager.scala:173-186).", conv=int))
+TCP_BIND_ADDRESS = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.bindAddress", "127.0.0.1",
+    "Interface the TCP shuffle server binds. Loopback by default "
+    "(single-host); set 0.0.0.0 (with advertiseAddress) so peers on "
+    "other hosts can fetch over DCN."))
+TCP_ADVERTISE_ADDRESS = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.advertiseAddress", "",
+    "Host peers should dial (when binding 0.0.0.0 the bound address is "
+    "not routable). Empty = the bind address."))
+TCP_INFLIGHT_LIMIT = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.maxBytesInFlight", 64 << 20,
+    "Client fetch window: the server sends at most this many payload "
+    "bytes ahead of the client's acks. Carried in each fetch request, "
+    "so both endpoints always use the same window (reference "
+    "inflight-bytes throttle, UCXShuffleTransport.scala:365-391).",
+    conv=parse_bytes))
+
+_LEN = struct.Struct(">Q")
+_TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
+
+
+class ShuffleFetchError(RuntimeError):
+    """A peer reported a server-side failure while serving a fetch."""
+
+
+def _send_frame(sock: socket.socket, tag: bytes, payload: bytes = b"") -> None:
+    sock.sendall(_LEN.pack(len(payload) + 1) + tag + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes]:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    body = _recv_exact(sock, n)
+    return body[:1], body[1:]
+
+
+class TcpShuffleServer:
+    """Serves a LocalShuffleTransport's map output over TCP (reference
+    RapidsShuffleServer.scala:67: serve metadata + buffer-send requests
+    from the catalog-backed store)."""
+
+    def __init__(self, store: LocalShuffleTransport, bind: str = "127.0.0.1",
+                 port: int = 0, advertise: str = ""):
+        self._store = store
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind, port))
+        self._sock.listen(16)
+        host, bound_port = self._sock.getsockname()
+        self.address = (advertise or host, bound_port)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="tpu-shuffle-srv")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    try:
+                        _, body = _recv_frame(conn)
+                        req = json.loads(body.decode())
+                    except (ConnectionError, ValueError):
+                        return
+                    try:
+                        self._serve_one(conn, req)
+                    except (ConnectionError, OSError):
+                        return
+                    except Exception as e:  # noqa: BLE001 - sent to peer
+                        # store/codec failures must reach the client as a
+                        # diagnosable error frame, not a connection reset
+                        _send_frame(conn, _TAG_ERROR,
+                                    f"{type(e).__name__}: {e}".encode())
+        except (ConnectionError, OSError):
+            pass
+
+    def _serve_one(self, conn: socket.socket, req: dict) -> None:
+        if req.get("op") == "meta":
+            sizes = self._store.partition_sizes(req["shuffle_id"])
+            batches = {str(p): self._store.batch_sizes(req["shuffle_id"], p)
+                       for p in sizes}
+            _send_frame(conn, _TAG_JSON, json.dumps(
+                {"sizes": {str(k): v for k, v in sizes.items()},
+                 "batch_sizes": batches,
+                 "codec": self._store.codec_name}).encode())
+            return
+        if req.get("op") != "fetch":
+            _send_frame(conn, _TAG_ERROR,
+                        f"unknown op {req.get('op')!r}".encode())
+            return
+        window = int(req.get("window") or TCP_INFLIGHT_LIMIT.default)
+        _send_frame(conn, _TAG_JSON, json.dumps(
+            {"codec": self._store.codec_name}).encode())
+        sent_window = 0
+        for raw in self._store.fetch_partition_serialized(
+                req["shuffle_id"], req["part_id"],
+                req.get("lo", 0), req.get("hi")):
+            _send_frame(conn, _TAG_DATA, raw)
+            sent_window += len(raw)
+            if sent_window >= window:
+                # wait for the client before sending further frames
+                # (inflight throttle at the client-declared window)
+                tag, _ = _recv_frame(conn)
+                if tag != _TAG_JSON:
+                    return
+                sent_window = 0
+        _send_frame(conn, _TAG_END)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpShuffleTransport(LocalShuffleTransport):
+    """SPI transport = local spillable store + TCP server for peers.
+
+    In-process consumers read straight from the store (the reference's
+    local-block path, RapidsCachingReader.scala:49); remote consumers
+    connect to ``transport.address`` and stream frames (`fetch_remote`).
+    """
+
+    def __init__(self, conf, ctx=None):
+        super().__init__(conf, ctx)
+        self._server = TcpShuffleServer(
+            self, bind=conf.get(TCP_BIND_ADDRESS),
+            port=conf.get(TCP_PORT),
+            advertise=conf.get(TCP_ADVERTISE_ADDRESS))
+        self.address = self._server.address
+
+    def close(self) -> None:
+        self._server.close()
+        super().close()
+
+
+def remote_partition_sizes(address, shuffle_id: int) -> tuple[dict, dict]:
+    """Metadata plane: (partition_sizes, batch_sizes) from a peer
+    (reference MetadataRequest/Response flatbuffer RPC)."""
+    with socket.create_connection(tuple(address)) as sock:
+        _send_frame(sock, _TAG_JSON, json.dumps(
+            {"op": "meta", "shuffle_id": shuffle_id}).encode())
+        tag, body = _recv_frame(sock)
+        if tag == _TAG_ERROR:
+            raise ShuffleFetchError(body.decode())
+        meta = json.loads(body.decode())
+    return ({int(k): v for k, v in meta["sizes"].items()},
+            {int(k): v for k, v in meta["batch_sizes"].items()})
+
+
+def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
+                 hi: int | None = None, device: bool = True,
+                 inflight_limit: int | None = None) -> Iterable:
+    """Data plane: stream one reduce partition's batches from a peer
+    (reference RapidsShuffleClient.scala: TransferRequest -> bounce
+    buffers -> reassembled device buffers).  The wire codec comes from
+    the server's response header — never assumed by the client."""
+    window = int(inflight_limit or TCP_INFLIGHT_LIMIT.default)
+    with socket.create_connection(tuple(address)) as sock:
+        _send_frame(sock, _TAG_JSON, json.dumps(
+            {"op": "fetch", "shuffle_id": shuffle_id, "part_id": part_id,
+             "lo": lo, "hi": hi, "window": window}).encode())
+        tag, body = _recv_frame(sock)
+        if tag == _TAG_ERROR:
+            raise ShuffleFetchError(body.decode())
+        if tag != _TAG_JSON:
+            raise ShuffleFetchError(f"bad fetch header tag {tag!r}")
+        codec = get_codec(json.loads(body.decode()).get("codec", "none"))
+        recv_window = 0
+        while True:
+            tag, frame = _recv_frame(sock)
+            if tag == _TAG_END:
+                return
+            if tag == _TAG_ERROR:
+                raise ShuffleFetchError(frame.decode())
+            recv_window += len(frame)
+            if recv_window >= window:
+                _send_frame(sock, _TAG_JSON, b"{}")
+                recv_window = 0
+            if codec is not None:
+                (raw_size,) = struct.unpack(">I", frame[:4])
+                frame = codec.decompress(frame[4:], raw_size)
+            yield deserialize_batch(frame, device=device)

@@ -1,0 +1,203 @@
+"""TCP shuffle plane: in-process, cross-process, compressed, throttled.
+
+Reference: the UCX transport module (UCX.scala:192-328 management port +
+tag protocol, UCXShuffleTransport.scala:365-391 inflight throttle,
+RapidsShuffleServer/Client) — multi-peer behavior is tested without a
+cluster, as the reference does with mocked transports
+(RapidsShuffleTestHelper.scala:26-95); here the network is real
+(loopback) and the peer is a real second process.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+from spark_rapids_tpu.shuffle.tcp import (TcpShuffleTransport, fetch_remote,
+                                          remote_partition_sizes)
+
+SCHEMA = T.Schema([T.StructField("x", T.IntegerType()),
+                   T.StructField("s", T.StringType())])
+
+
+def _hb(vals, tags):
+    return HostBatch(
+        [HostColumn(np.asarray(vals, np.int32), np.ones(len(vals), bool),
+                    T.IntegerType()),
+         HostColumn(np.asarray(tags, object), np.ones(len(tags), bool),
+                    T.StringType())], SCHEMA)
+
+
+def _rows(batches):
+    from spark_rapids_tpu.exec.core import device_to_host
+    out = []
+    for b in batches:
+        hb = device_to_host(b)
+        out.extend(zip(*[c.to_list() for c in hb.columns]))
+    return out
+
+
+@pytest.mark.parametrize("codec", ["none", "lz4"])
+def test_tcp_roundtrip_in_process(codec):
+    conf = TpuConf({"spark.rapids.shuffle.compression.codec": codec})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            for m in range(3):
+                t.write_partition(9, m, 0, host_to_device(
+                    _hb([m, m + 10], [f"a{m}", f"b{m}"])))
+            t.write_partition(9, 0, 1, host_to_device(_hb([99], ["z"])))
+            sizes, batch_sizes = remote_partition_sizes(t.address, 9)
+            assert set(sizes) == {0, 1} and len(batch_sizes[0]) == 3
+            got = _rows(fetch_remote(t.address, 9, 0))
+            assert sorted(got) == sorted(
+                [(0, "a0"), (10, "b0"), (1, "a1"), (11, "b1"),
+                 (2, "a2"), (12, "b2")])
+            # sliced fetch: only map batches [1, 3)
+            got = _rows(fetch_remote(t.address, 9, 0, lo=1, hi=3))
+            assert sorted(got) == sorted(
+                [(1, "a1"), (11, "b1"), (2, "a2"), (12, "b2")])
+        finally:
+            t.close()
+
+
+def test_tcp_inflight_throttle():
+    """A tiny window forces server/client acks mid-stream; every frame
+    still arrives intact (reference inflight-bytes throttle)."""
+    conf = TpuConf({"spark.rapids.shuffle.tcp.maxBytesInFlight": 512})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            for m in range(8):
+                t.write_partition(1, m, 0, host_to_device(
+                    _hb(list(range(m * 50, m * 50 + 50)), ["s"] * 50)))
+            got = _rows(fetch_remote(t.address, 1, 0, inflight_limit=512))
+            assert len(got) == 400
+            assert sorted(r[0] for r in got) == list(range(400))
+        finally:
+            t.close()
+
+
+CHILD_SCRIPT = textwrap.dedent("""
+    import sys, json
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
+    from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+    SCHEMA = T.Schema([T.StructField("x", T.IntegerType()),
+                       T.StructField("s", T.StringType())])
+    conf = TpuConf({})
+    ctx = ExecCtx(backend="device", conf=conf)
+    t = TcpShuffleTransport(conf, ctx)
+    for m in range(4):
+        hb = HostBatch(
+            [HostColumn(np.arange(m * 10, m * 10 + 10, dtype=np.int32),
+                        np.ones(10, bool), T.IntegerType()),
+             HostColumn(np.asarray([f"m{m}r{i}" for i in range(10)],
+                                   object), np.ones(10, bool),
+                        T.StringType())], SCHEMA)
+        t.write_partition(5, m, m % 2, host_to_device(hb))
+    print(json.dumps({"port": t.address[1]}), flush=True)
+    sys.stdin.readline()   # parent closes stdin when done
+    t.close()
+""")
+
+
+def test_tcp_cross_process_fetch():
+    """A REAL second process serves its map output over the wire — the
+    multi-host DCN-plane shape (map side stays resident at the producer,
+    reduce side pulls, RapidsShuffleClient/Server)."""
+    p = subprocess.Popen([sys.executable, "-c", CHILD_SCRIPT],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    try:
+        line = p.stdout.readline()
+        port = json.loads(line)["port"]
+        addr = ("127.0.0.1", port)
+        sizes, _ = remote_partition_sizes(addr, 5)
+        assert set(sizes) == {0, 1}
+        even = _rows(fetch_remote(addr, 5, 0))
+        odd = _rows(fetch_remote(addr, 5, 1))
+        assert sorted(r[0] for r in even) == [x for m in (0, 2)
+                                              for x in range(m * 10,
+                                                             m * 10 + 10)]
+        assert sorted(r[0] for r in odd) == [x for m in (1, 3)
+                                             for x in range(m * 10,
+                                                            m * 10 + 10)]
+        assert ("m2r3" in [r[1] for r in even])
+    finally:
+        try:
+            p.stdin.close()
+        except OSError:
+            pass
+        p.wait(timeout=30)
+
+
+def test_tcp_transport_via_reflection_conf():
+    """The engine loads the TCP transport through transport.class and a
+    shuffle query runs through it end to end."""
+    from spark_rapids_tpu.exec.core import collect_host
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({
+        "spark.rapids.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpShuffleTransport"})
+    schema = T.Schema([T.StructField("k", T.IntegerType()),
+                       T.StructField("v", T.LongType())])
+    rng = np.random.default_rng(11)
+    df = s.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 7, 300)],
+         "v": list(range(300))}, schema, partitions=3, rows_per_batch=32)
+    out = df.group_by("k").agg(Sum(col("v")).alias("sv"))
+    dev = sorted(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    assert dev == sorted(collect_host(meta.exec_node, s.conf))
+
+
+def test_tcp_server_error_reaches_client():
+    """A store failure mid-fetch surfaces as ShuffleFetchError with the
+    real cause, not a connection reset (review finding)."""
+    from spark_rapids_tpu.shuffle.tcp import ShuffleFetchError
+
+    conf = TpuConf({})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            def boom(*a, **k):
+                raise RuntimeError("store exploded")
+                yield  # pragma: no cover - generator shape
+            t.fetch_partition_serialized = boom
+            with pytest.raises(ShuffleFetchError, match="store exploded"):
+                list(fetch_remote(t.address, 1, 0))
+        finally:
+            t.close()
+
+
+def test_tcp_window_negotiated_from_client():
+    """Server throttles at the client-declared window even when its own
+    conf differs (review finding: mismatch used to deadlock)."""
+    conf = TpuConf({"spark.rapids.shuffle.tcp.maxBytesInFlight": 1 << 20})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            for m in range(8):
+                t.write_partition(2, m, 0, host_to_device(
+                    _hb(list(range(m * 30, m * 30 + 30)), ["t"] * 30)))
+            # client asks for a much smaller window than the server conf
+            got = _rows(fetch_remote(t.address, 2, 0, inflight_limit=256))
+            assert sorted(r[0] for r in got) == list(range(240))
+        finally:
+            t.close()
