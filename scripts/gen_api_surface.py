@@ -1,0 +1,85 @@
+"""Regenerate tests/api_surface.json — the public-API snapshot.
+
+Reference: api_validation/ (ApiValidation.scala:26-60) reflection-diffs
+each Gpu exec's constructor against its Spark counterpart to catch API
+drift.  Standalone analog: snapshot the engine's own public surface
+(conf keys, exec constructor signatures, expression registry, DataFrame
+methods) so accidental drift fails a test and intentional change is an
+explicit regeneration of this file.
+"""
+import inspect
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def collect_surface() -> dict:
+    import importlib
+    import pkgutil
+
+    import spark_rapids_tpu
+    from spark_rapids_tpu.conf import registered_entries
+    from spark_rapids_tpu.exec.core import PlanNode
+    from spark_rapids_tpu.expr.core import Expression
+    from spark_rapids_tpu.session import DataFrame, TpuSession
+
+    for m in pkgutil.walk_packages(spark_rapids_tpu.__path__,
+                                   "spark_rapids_tpu."):
+        if "._native" in m.name:
+            continue
+        try:
+            importlib.import_module(m.name)
+        except ImportError:
+            pass
+
+    def subclasses(base):
+        out = {}
+        for c in _walk_subclasses(base):
+            try:
+                sig = str(inspect.signature(c.__init__))
+            except (TypeError, ValueError):
+                sig = "?"
+            out[f"{c.__module__}.{c.__name__}"] = sig
+        return dict(sorted(out.items()))
+
+    def methods(cls):
+        return sorted(n for n, v in vars(cls).items()
+                      if not n.startswith("_") and callable(v)
+                      or isinstance(v, property) and not n.startswith("_"))
+
+    return {
+        "conf_keys": sorted(registered_entries()),
+        "execs": subclasses(PlanNode),
+        "expressions": sorted(
+            f"{c.__module__}.{c.__name__}"
+            for c in _walk_subclasses(Expression)),
+        "dataframe_methods": methods(DataFrame),
+        "session_methods": methods(TpuSession),
+    }
+
+
+def _walk_subclasses(base):
+    seen = set()
+    stack = list(base.__subclasses__())
+    while stack:
+        c = stack.pop()
+        if c in seen or not c.__module__.startswith("spark_rapids_tpu"):
+            continue
+        seen.add(c)
+        stack.extend(c.__subclasses__())
+        yield c
+
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "api_surface.json")
+    with open(out, "w") as f:
+        json.dump(collect_surface(), f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out}")

@@ -1,0 +1,137 @@
+"""ML interop: export query results as device arrays / tensors.
+
+Reference: ColumnarRdd + InternalColumnarRddConverter
+(ColumnarRdd.scala:42-49, InternalColumnarRddConverter.scala:470) expose
+``RDD[cudf.Table]`` from a DataFrame so ML libraries (XGBoost,
+docs/ml-integration.md:8-11) consume GPU-resident data without a
+host round trip.  The TPU analog exports the engine's device batches:
+
+* :func:`device_batches` — per-partition ``ColumnBatch`` iterator, data
+  staying in HBM (the direct ColumnarRdd analog);
+* :func:`to_jax` — one dict of jax arrays (+ validity masks), trimmed
+  to the logical row count, ready for jit-compiled ML code;
+* :func:`to_torch` — CPU torch tensors via numpy handoff (torch in this
+  image is CPU-only; a device round trip is inherent);
+* :func:`from_jax` — the reverse: jax/numpy arrays -> DataFrame
+  (InternalColumnarRddConverter's batch-import direction).
+
+String columns export as (byte-matrix, lengths) pairs in
+``device_batches`` and are materialized as python lists by ``to_jax``
+only on request — ML consumers overwhelmingly take numeric features.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+
+__all__ = ["device_batches", "to_jax", "to_torch", "from_jax"]
+
+
+def device_batches(df) -> Iterator:
+    """Iterate the query's device ``ColumnBatch``es partition by
+    partition (no D2H).  The plan runs on the device backend regardless
+    of fallback tagging for the FINAL operator chain only when the whole
+    plan is device-capable; otherwise host batches are uploaded at the
+    boundary (the reference's HostColumnarToGpu transition)."""
+    from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
+    ov, meta = df._overridden(quiet=True)
+    with ExecCtx(backend=meta.backend, conf=df._s.conf) as ctx:
+        for b in meta.exec_node.execute(ctx):
+            if meta.backend != "device":
+                b = host_to_device(b)
+            yield b
+
+
+def to_jax(df, include_strings: bool = False) -> dict:
+    """Run the query and return ``{name: (values, validity)}`` of jax
+    arrays trimmed to the result's row count.  Numeric/temporal columns
+    only unless ``include_strings`` (strings come back as python lists,
+    via host)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exec.core import device_to_host
+
+    out: dict = {}
+    parts: dict = {}
+    schema = df.schema
+    want_strings = include_strings and any(
+        isinstance(f.data_type, T.StringType) for f in schema)
+    for b in device_batches(df):
+        n = b.host_num_rows()
+        # ONE D2H per batch when strings are requested, not one per
+        # string column (each device_to_host copies every column)
+        hb = device_to_host(b) if want_strings else None
+        for i, (f, c) in enumerate(zip(schema, b.columns)):
+            if isinstance(f.data_type, T.StringType):
+                if not include_strings:
+                    continue
+                parts.setdefault(f.name, []).append(
+                    ("str", hb.columns[i].to_list()))
+            else:
+                parts.setdefault(f.name, []).append(
+                    ("num", (c.data[:n], c.validity[:n])))
+    for name, chunks in parts.items():
+        if chunks[0][0] == "str":
+            vals: list = []
+            for _, lst in chunks:
+                vals.extend(lst)
+            out[name] = vals
+        else:
+            out[name] = (jnp.concatenate([v for _, (v, _) in chunks]),
+                         jnp.concatenate([m for _, (_, m) in chunks]))
+    if not parts:  # empty result: zero-length arrays with the right dtypes
+        for f in schema:
+            if isinstance(f.data_type, T.StringType):
+                if include_strings:
+                    out[f.name] = []
+                continue
+            out[f.name] = (jnp.zeros((0,), f.data_type.np_dtype),
+                           jnp.zeros((0,), bool))
+    return out
+
+
+def to_torch(df) -> dict:
+    """Run the query and return ``{name: torch.Tensor}`` (CPU) for
+    numeric/temporal columns; null validity is exported alongside as
+    ``{name}__valid``."""
+    import numpy as np
+    import torch
+
+    arrays = to_jax(df)
+    out = {}
+    for name, val in arrays.items():
+        if isinstance(val, list):
+            continue
+        data, valid = val
+        # copy: jax device_get hands back read-only buffers and torch
+        # tensors are mutable views
+        out[name] = torch.from_numpy(np.array(data))
+        out[f"{name}__valid"] = torch.from_numpy(np.array(valid))
+    return out
+
+
+def from_jax(session, arrays: dict, schema: T.Schema | None = None,
+             partitions: int = 1):
+    """jax/numpy arrays -> DataFrame (the import direction).  ``arrays``
+    maps column name to values or (values, validity)."""
+    import numpy as np
+
+    data = {}
+    fields = []
+    for name, val in arrays.items():
+        validity = None
+        if isinstance(val, tuple):
+            val, validity = val
+        a = np.asarray(val)
+        if schema is not None:
+            dt = schema.field(name).data_type
+        else:
+            dt = T.from_numpy_dtype(a.dtype)
+        vals = a.tolist()
+        if validity is not None:
+            mask = np.asarray(validity, dtype=bool)
+            vals = [v if m else None for v, m in zip(vals, mask)]
+        data[name] = vals
+        fields.append(T.StructField(name, dt, True))
+    return session.from_pydict(data, schema or T.Schema(fields),
+                               partitions=partitions)

@@ -226,6 +226,23 @@ class DataFrame:
         rows = self.agg(CountStar().alias("count")).collect()
         return rows[0][0]
 
+    # -- ML interop (reference ColumnarRdd.scala:42-49) ----------------
+    def device_batches(self):
+        """Iterate device ColumnBatches without a final D2H — the
+        ColumnarRdd analog for ML consumers (interop.py)."""
+        from spark_rapids_tpu.interop import device_batches
+        return device_batches(self)
+
+    def to_jax(self, include_strings: bool = False) -> dict:
+        """{name: (jax values, validity)} of the query result."""
+        from spark_rapids_tpu.interop import to_jax
+        return to_jax(self, include_strings=include_strings)
+
+    def to_torch(self) -> dict:
+        """{name: torch.Tensor} (CPU) of the numeric result columns."""
+        from spark_rapids_tpu.interop import to_torch
+        return to_torch(self)
+
     def explain(self) -> str:
         ov, meta = self._overridden(quiet=True)
         return ov.explain(meta)

@@ -73,6 +73,10 @@ TCP_INFLIGHT_LIMIT = register(ConfEntry(
 
 _LEN = struct.Struct(">Q")
 _TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
+#: frame sanity cap: a frame is one batch's bytes (batchSizeBytes-scale);
+#: a desynced/non-protocol peer must produce a clean error, not a
+#: multi-GB allocation from a garbage length
+_MAX_FRAME = 2 << 30
 
 
 class ShuffleFetchError(RuntimeError):
@@ -95,6 +99,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n < 1 or n > _MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n} (desynced or "
+                              "non-protocol peer)")
     body = _recv_exact(sock, n)
     return body[:1], body[1:]
 
@@ -203,6 +210,16 @@ class TcpShuffleTransport(LocalShuffleTransport):
             port=conf.get(TCP_PORT),
             advertise=conf.get(TCP_ADVERTISE_ADDRESS))
         self.address = self._server.address
+
+    def fetch_from(self, address, shuffle_id: int, part_id: int,
+                   lo: int = 0, hi: int | None = None,
+                   device: bool = True) -> Iterable:
+        """Client entry honoring this transport's conf: the fetch window
+        comes from spark.rapids.shuffle.tcp.maxBytesInFlight (reference:
+        the transport owns its inflight throttle, not the call site)."""
+        return fetch_remote(address, shuffle_id, part_id, lo=lo, hi=hi,
+                            device=device,
+                            inflight_limit=self.conf.get(TCP_INFLIGHT_LIMIT))
 
     def close(self) -> None:
         self._server.close()

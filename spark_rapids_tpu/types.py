@@ -135,6 +135,23 @@ def numeric_promote(a: DataType, b: DataType) -> DataType:
 # Arrow interop
 # ---------------------------------------------------------------------------
 
+def from_numpy_dtype(dtype) -> DataType:
+    """numpy dtype -> engine type (ML-interop import direction;
+    reference InternalColumnarRddConverter's type mapping)."""
+    m = {
+        np.dtype(np.bool_): BooleanType(), np.dtype(np.int8): ByteType(),
+        np.dtype(np.int16): ShortType(), np.dtype(np.int32): IntegerType(),
+        np.dtype(np.int64): LongType(), np.dtype(np.float32): FloatType(),
+        np.dtype(np.float64): DoubleType(),
+    }
+    dt = m.get(np.dtype(dtype))
+    if dt is None:
+        if np.dtype(dtype).kind in ("U", "O", "S"):
+            return StringType()
+        raise TypeError(f"no engine type for numpy dtype {dtype}")
+    return dt
+
+
 def to_arrow(dt: DataType):
     import pyarrow as pa
     m = {

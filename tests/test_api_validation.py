@@ -1,0 +1,41 @@
+"""Public-API drift gate (reference api_validation/ApiValidation.scala:
+26-60: reflection-diff of exec constructor signatures per Spark version;
+here the diff is against the committed snapshot, so accidental surface
+changes fail loudly and intentional ones are an explicit regeneration).
+"""
+import json
+import os
+import sys
+
+
+def test_api_surface_matches_snapshot():
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        from gen_api_surface import collect_surface
+    finally:
+        sys.path.remove(scripts)
+    got = collect_surface()
+    snap_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "api_surface.json")
+    with open(snap_path) as f:
+        want = json.load(f)
+    problems = []
+    for section in want:
+        g, w = got.get(section), want[section]
+        if g == w:
+            continue
+        if isinstance(w, dict):
+            added = sorted(set(g) - set(w))
+            removed = sorted(set(w) - set(g))
+            changed = sorted(k for k in set(g) & set(w) if g[k] != w[k])
+            problems.append(f"{section}: +{added} -{removed} ~{changed}")
+        else:
+            added = sorted(set(g) - set(w))
+            removed = sorted(set(w) - set(g))
+            problems.append(f"{section}: +{added} -{removed}")
+    assert not problems, (
+        "public API surface drifted from tests/api_surface.json:\n  "
+        + "\n  ".join(problems)
+        + "\nIf intentional, regenerate: python scripts/gen_api_surface.py")

@@ -1,0 +1,91 @@
+"""ML interop: device-batch export, jax/torch handoff, jax import.
+
+Reference: ColumnarRdd.scala:42-49 + InternalColumnarRddConverter
+(export RDD[Table] for XGBoost, docs/ml-integration.md:8-11) — here the
+exported unit is the engine's device ColumnBatch / jax arrays.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.session import TpuSession
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType()),
+                   T.StructField("v", T.DoubleType()),
+                   T.StructField("s", T.StringType())])
+
+
+def _df(s, n=100, parts=2):
+    return s.from_pydict(
+        {"k": list(range(n)),
+         "v": [None if i % 10 == 3 else float(i) * 0.5 for i in range(n)],
+         "s": [f"r{i}" for i in range(n)]},
+        SCHEMA, partitions=parts, rows_per_batch=16)
+
+
+def test_device_batches_stay_on_device():
+    import jax
+    s = TpuSession({})
+    total = 0
+    for b in _df(s).device_batches():
+        from spark_rapids_tpu.columnar.batch import ColumnBatch
+        assert isinstance(b, ColumnBatch)
+        assert isinstance(b.columns[0].data, jax.Array)
+        total += b.host_num_rows()
+    assert total == 100
+
+
+def test_to_jax_values_and_validity():
+    s = TpuSession({})
+    out = _df(s).to_jax()
+    assert set(out) == {"k", "v"}  # strings skipped by default
+    vals, valid = out["v"]
+    assert vals.shape == (100,) and valid.shape == (100,)
+    arr = np.asarray(vals)
+    mask = np.asarray(valid)
+    assert not mask[3] and mask[4]
+    assert arr[4] == pytest.approx(2.0)
+    ks = np.asarray(out["k"][0])
+    assert sorted(ks.tolist()) == list(range(100))
+
+
+def test_to_jax_after_query_and_strings():
+    s = TpuSession({})
+    df = _df(s).where(col("k") < 10)
+    out = df.to_jax(include_strings=True)
+    assert len(out["s"]) == 10
+    assert set(out["s"]) == {f"r{i}" for i in range(10)}
+
+
+def test_to_torch():
+    torch = pytest.importorskip("torch")
+    s = TpuSession({})
+    out = _df(s, n=20).to_torch()
+    assert isinstance(out["v"], torch.Tensor)
+    assert out["v"].shape == (20,)
+    assert bool(out["v__valid"][3]) is False
+    assert out["k"].dtype == torch.int32
+
+
+def test_from_jax_roundtrip():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.interop import from_jax
+
+    s = TpuSession({})
+    df = from_jax(s, {
+        "a": jnp.arange(8, dtype=jnp.int32),
+        "b": (jnp.linspace(0.0, 1.0, 8),
+              jnp.asarray([True] * 7 + [False])),
+    })
+    rows = sorted(df.collect())
+    assert rows[0] == (0, 0.0)
+    assert rows[-1] == (7, None)
+    assert df.schema.field("a").data_type == T.IntegerType()
+
+
+def test_to_jax_empty_result():
+    s = TpuSession({})
+    out = _df(s).where(col("k") < 0).to_jax()
+    assert out["k"][0].shape == (0,)
+    assert out["v"][1].shape == (0,)

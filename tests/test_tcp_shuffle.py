@@ -77,7 +77,8 @@ def test_tcp_inflight_throttle():
             for m in range(8):
                 t.write_partition(1, m, 0, host_to_device(
                     _hb(list(range(m * 50, m * 50 + 50)), ["s"] * 50)))
-            got = _rows(fetch_remote(t.address, 1, 0, inflight_limit=512))
+            # conf-driven window via the transport's own client entry
+            got = _rows(t.fetch_from(t.address, 1, 0))
             assert len(got) == 400
             assert sorted(r[0] for r in got) == list(range(400))
         finally:
