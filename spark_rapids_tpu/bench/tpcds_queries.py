@@ -612,10 +612,431 @@ def q96(session, data_dir: str):
         .agg(CountStar().alias("cnt"))
 
 
-QUERIES = {"q3": q3, "q6": q6, "q7": q7, "q12": q12, "q15": q15,
-           "q19": q19, "q20": q20, "q26": q26, "q27": q27, "q36": q36,
-           "q42": q42, "q43": q43, "q52": q52, "q53": q53, "q55": q55,
-           "q63": q63, "q69": q69, "q89": q89, "q96": q96, "q98": q98}
+# ---------------------------------------------------------------------------
+# round-3 breadth, second tranche: ternary-OR demographic filters (q13/
+# q48), tri-channel unions (q33/q60), cross-join ratios (q61/q65/q88),
+# ticket-grain aggregations (q68/q73/q79).  Where the pruned generator
+# lacks a column (e.g. ss_addr_sk), the address leg rides the customer's
+# current address — noted per query.
+# ---------------------------------------------------------------------------
+
+def q13(session, data_dir: str):
+    """TPC-DS q13: sales averages under OR'd demographic x price bands
+    (address leg via customer current address: generator has no
+    ss_addr_sk)."""
+    from spark_rapids_tpu.expr.predicates import In, Or
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_cdemo_sk", "ss_hdemo_sk",
+             "ss_customer_sk", "ss_quantity", "ss_sales_price",
+             "ss_ext_sales_price", "ss_ext_wholesale_cost", "ss_net_profit"])
+    st = _t(session, data_dir, "store", ["s_store_sk"])
+    dt = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2001)).select(col("d_date_sk"))
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"])
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_dep_count"])
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"])
+    base = ss.join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(cd, on=[("ss_cdemo_sk", "cd_demo_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")])
+    demo = Or(Or(
+        (col("cd_marital_status") == lit("M"))
+        & (col("cd_education_status") == lit("Advanced Degree"))
+        & (col("ss_sales_price") >= lit(100.0))
+        & (col("ss_sales_price") <= lit(150.0))
+        & (col("hd_dep_count") == lit(3)),
+        (col("cd_marital_status") == lit("S"))
+        & (col("cd_education_status") == lit("College"))
+        & (col("ss_sales_price") >= lit(50.0))
+        & (col("ss_sales_price") <= lit(100.0))
+        & (col("hd_dep_count") == lit(1))),
+        (col("cd_marital_status") == lit("W"))
+        & (col("cd_education_status") == lit("2 yr Degree"))
+        & (col("ss_sales_price") >= lit(150.0))
+        & (col("ss_sales_price") <= lit(200.0))
+        & (col("hd_dep_count") == lit(1)))
+    addr = Or(Or(
+        In(col("ca_state"), [lit(s) for s in ("TX", "OH", "MI")])
+        & (col("ss_net_profit") >= lit(100.0))
+        & (col("ss_net_profit") <= lit(200.0)),
+        In(col("ca_state"), [lit(s) for s in ("OR", "NM", "KY")])
+        & (col("ss_net_profit") >= lit(150.0))
+        & (col("ss_net_profit") <= lit(300.0))),
+        In(col("ca_state"), [lit(s) for s in ("VA", "TX", "MS")])
+        & (col("ss_net_profit") >= lit(50.0))
+        & (col("ss_net_profit") <= lit(250.0)))
+    return base.where(demo & addr).agg(
+        Average(col("ss_quantity")).alias("avg_qty"),
+        Average(col("ss_ext_sales_price")).alias("avg_esp"),
+        Average(col("ss_ext_wholesale_cost")).alias("avg_ewc"),
+        Sum(col("ss_ext_wholesale_cost")).alias("sum_ewc"))
+
+
+def q48(session, data_dir: str):
+    """TPC-DS q48: quantity sum under OR'd demographic/state bands
+    (address leg via customer current address)."""
+    from spark_rapids_tpu.expr.predicates import In, Or
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_cdemo_sk",
+             "ss_customer_sk", "ss_quantity", "ss_sales_price",
+             "ss_net_profit"])
+    st = _t(session, data_dir, "store", ["s_store_sk"])
+    dt = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2000)).select(col("d_date_sk"))
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"])
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"])
+    base = ss.join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(cd, on=[("ss_cdemo_sk", "cd_demo_sk")]) \
+        .join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")])
+    demo = Or(Or(
+        (col("cd_marital_status") == lit("M"))
+        & (col("cd_education_status") == lit("4 yr Degree"))
+        & (col("ss_sales_price") >= lit(100.0))
+        & (col("ss_sales_price") <= lit(150.0)),
+        (col("cd_marital_status") == lit("D"))
+        & (col("cd_education_status") == lit("2 yr Degree"))
+        & (col("ss_sales_price") >= lit(50.0))
+        & (col("ss_sales_price") <= lit(100.0))),
+        (col("cd_marital_status") == lit("S"))
+        & (col("cd_education_status") == lit("College"))
+        & (col("ss_sales_price") >= lit(150.0))
+        & (col("ss_sales_price") <= lit(200.0)))
+    addr = Or(Or(
+        In(col("ca_state"), [lit(s) for s in ("CO", "OH", "TX")])
+        & (col("ss_net_profit") >= lit(0.0))
+        & (col("ss_net_profit") <= lit(2000.0)),
+        In(col("ca_state"), [lit(s) for s in ("OR", "MN", "KY")])
+        & (col("ss_net_profit") >= lit(150.0))
+        & (col("ss_net_profit") <= lit(3000.0))),
+        In(col("ca_state"), [lit(s) for s in ("VA", "CA", "MS")])
+        & (col("ss_net_profit") >= lit(50.0))
+        & (col("ss_net_profit") <= lit(25000.0)))
+    return base.where(demo & addr).agg(Sum(col("ss_quantity")).alias("q"))
+
+
+def _channel_agg(session, data_dir, sales, date_col, item_col, price_col,
+                 group_col, group_vals, year, moy):
+    """One channel's month revenue grouped by an item attribute — the
+    shared pipeline of q33 (i_manufact_id) and q60 (i_item_id)."""
+    from spark_rapids_tpu.expr.predicates import In
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(year)) & (col("d_moy") == lit(moy))) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", group_col]) \
+        .where(In(col(group_col), [lit(v) for v in group_vals]))
+    return sales.join(dt, on=[(date_col, "d_date_sk")]) \
+        .join(it, on=[(item_col, "i_item_sk")]) \
+        .group_by(group_col) \
+        .agg(Sum(col(price_col)).alias("total_sales"))
+
+
+def q33(session, data_dir: str):
+    """TPC-DS q33: Electronics manufacturer revenue summed across the
+    three sales channels (union of per-channel aggregates).  The
+    manufacturer-id set is the eagerly-folded scalar subquery (house
+    pattern for subqueries)."""
+    ids_rows = _t(session, data_dir, "item",
+                  ["i_category", "i_manufact_id"]) \
+        .where(col("i_category") == lit("Electronics")) \
+        .group_by("i_manufact_id").agg(CountStar().alias("c")).collect()
+    ids = sorted({r[0] for r in ids_rows})[:40]
+    if not ids:
+        ids = [-1]
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_item_sk", "cs_ext_sales_price"])
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_item_sk", "ws_ext_sales_price"])
+    u = _channel_agg(session, data_dir, ss, "ss_sold_date_sk",
+                     "ss_item_sk", "ss_ext_sales_price", "i_manufact_id",
+                     ids, 1998, 5) \
+        .union(_channel_agg(session, data_dir, cs, "cs_sold_date_sk",
+                            "cs_item_sk", "cs_ext_sales_price",
+                            "i_manufact_id", ids, 1998, 5)) \
+        .union(_channel_agg(session, data_dir, ws, "ws_sold_date_sk",
+                            "ws_item_sk", "ws_ext_sales_price",
+                            "i_manufact_id", ids, 1998, 5))
+    return u.group_by("i_manufact_id") \
+        .agg(Sum(col("total_sales")).alias("total_sales")) \
+        .order_by(("total_sales", True)).limit(100)
+
+
+def q60(session, data_dir: str):
+    """TPC-DS q60: Music item revenue across the three channels (union
+    of per-channel aggregates by item id)."""
+    ids_rows = _t(session, data_dir, "item",
+                  ["i_category", "i_item_id"]) \
+        .where(col("i_category") == lit("Music")) \
+        .group_by("i_item_id").agg(CountStar().alias("c")).collect()
+    ids = sorted({r[0] for r in ids_rows})[:60]
+    if not ids:
+        ids = ["<none>"]
+
+    def channel(sales, date_col, item_col, price_col):
+        return _channel_agg(session, data_dir, sales, date_col, item_col,
+                            price_col, "i_item_id", ids, 1998, 9)
+
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_item_sk", "cs_ext_sales_price"])
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_item_sk", "ws_ext_sales_price"])
+    u = channel(ss, "ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price") \
+        .union(channel(cs, "cs_sold_date_sk", "cs_item_sk",
+                       "cs_ext_sales_price")) \
+        .union(channel(ws, "ws_sold_date_sk", "ws_item_sk",
+                       "ws_ext_sales_price"))
+    return u.group_by("i_item_id") \
+        .agg(Sum(col("total_sales")).alias("total_sales")) \
+        .order_by(("i_item_id", True), ("total_sales", True)).limit(100)
+
+
+def q61(session, data_dir: str):
+    """TPC-DS q61: promotional-to-total sales ratio for one category and
+    month (two aggregate branches cross-joined)."""
+    from spark_rapids_tpu.expr.predicates import Or
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(1998)) & (col("d_moy") == lit(11))) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_category"]) \
+        .where(col("i_category") == lit("Jewelry")).select(col("i_item_sk"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_gmt_offset"]) \
+        .where(col("s_gmt_offset") == lit(-5.0)).select(col("s_store_sk"))
+    ss_cols = ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+               "ss_promo_sk", "ss_ext_sales_price"]
+    base = _t(session, data_dir, "store_sales", ss_cols) \
+        .join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")])
+    pr = _t(session, data_dir, "promotion",
+            ["p_promo_sk", "p_channel_dmail", "p_channel_email",
+             "p_channel_tv"]) \
+        .where(Or(Or(col("p_channel_dmail") == lit("Y"),
+                     col("p_channel_email") == lit("Y")),
+                  col("p_channel_tv") == lit("Y"))) \
+        .select(col("p_promo_sk"))
+    promo = base.join(pr, on=[("ss_promo_sk", "p_promo_sk")]) \
+        .agg(Sum(col("ss_ext_sales_price")).alias("promotions"))
+    total = base.agg(Sum(col("ss_ext_sales_price")).alias("total"))
+    return promo.join(total, how="cross").select(
+        col("promotions"), col("total"),
+        (col("promotions") * lit(100.0) / col("total")).alias("ratio"))
+
+
+def q65(session, data_dir: str):
+    """TPC-DS q65: items whose store revenue is <= 10% of that store's
+    average item revenue (aggregate-over-aggregate join)."""
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1176))
+               & (col("d_month_seq") <= lit(1187))) \
+        .select(col("d_date_sk"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_item_sk",
+             "ss_sales_price"]) \
+        .join(dt, on=[("ss_sold_date_sk", "d_date_sk")])
+    sc = ss.group_by("ss_store_sk", "ss_item_sk") \
+        .agg(Sum(col("ss_sales_price")).alias("revenue"))
+    sb = sc.group_by("ss_store_sk") \
+        .agg(Average(col("revenue")).alias("ave")) \
+        .select(col("ss_store_sk").alias("b_store_sk"), col("ave"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_store_name"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_desc", "i_current_price", "i_brand"])
+    return sc.join(sb, on=[("ss_store_sk", "b_store_sk")]) \
+        .where(col("revenue") <= lit(0.1) * col("ave")) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .select(col("s_store_name"), col("i_item_desc"), col("revenue"),
+                col("i_current_price"), col("i_brand")) \
+        .order_by(("s_store_name", True), ("i_item_desc", True),
+                  ("revenue", True), ("i_current_price", True),
+                  ("i_brand", True)) \
+        .limit(100)
+
+
+def q68(session, data_dir: str):
+    """TPC-DS q68: ticket-grain totals for dep-4/vehicle-3 households in
+    two cities (the bought-city <> current-city predicate is omitted:
+    the pruned generator has no ss_addr_sk; the current address supplies
+    the reported city)."""
+    from spark_rapids_tpu.expr.predicates import In, Or
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+             "ss_customer_sk", "ss_ticket_number", "ss_ext_sales_price",
+             "ss_ext_wholesale_cost"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_dom", "d_year"]) \
+        .where((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(2))
+               & In(col("d_year"), [lit(1998), lit(1999), lit(2000)])) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_city"]) \
+        .where(In(col("s_city"), [lit("City001"), lit("City002")])) \
+        .select(col("s_store_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count"]) \
+        .where(Or(col("hd_dep_count") == lit(4),
+                  col("hd_vehicle_count") == lit(3))) \
+        .select(col("hd_demo_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk", "c_first_name",
+             "c_last_name"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_city"])
+    grouped = ss.join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .group_by("ss_ticket_number", "ss_customer_sk") \
+        .agg(Sum(col("ss_ext_sales_price")).alias("extended_price"),
+             Sum(col("ss_ext_wholesale_cost")).alias("extended_cost"))
+    joined = grouped.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")])
+    return joined.select(
+        col("c_last_name"), col("c_first_name"), col("ca_city"),
+        col("ss_ticket_number"), col("extended_price"),
+        col("extended_cost")) \
+        .order_by(("c_last_name", True), ("ss_ticket_number", True),
+                  ("c_first_name", True), ("ca_city", True),
+                  ("extended_price", True), ("extended_cost", True)) \
+        .limit(100)
+
+
+def q73(session, data_dir: str):
+    """TPC-DS q73: customers with 1-5 item tickets for high-buy-potential
+    households (ticket-grain count + having)."""
+    from spark_rapids_tpu.expr.predicates import In, Or
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+             "ss_customer_sk", "ss_ticket_number"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_dom", "d_year"]) \
+        .where((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(2))
+               & In(col("d_year"), [lit(1999), lit(2000), lit(2001)])) \
+        .select(col("d_date_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_buy_potential", "hd_vehicle_count",
+             "hd_dep_count"]) \
+        .where(Or(col("hd_buy_potential") == lit(">10000"),
+                  col("hd_buy_potential") == lit("Unknown"))
+               & (col("hd_vehicle_count") > lit(0))) \
+        .select(col("hd_demo_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_first_name", "c_last_name"])
+    grouped = ss.join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .group_by("ss_ticket_number", "ss_customer_sk") \
+        .agg(CountStar().alias("cnt")) \
+        .where((col("cnt") >= lit(1)) & (col("cnt") <= lit(5)))
+    return grouped.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .select(col("c_last_name"), col("c_first_name"),
+                col("ss_ticket_number"), col("cnt")) \
+        .order_by(("cnt", False), ("c_last_name", True),
+                  ("c_first_name", True), ("ss_ticket_number", True)) \
+        .limit(100)
+
+
+def q79(session, data_dir: str):
+    """TPC-DS q79: per-ticket profit/coupon totals for dep-6-or-2-vehicle
+    households on weekdays."""
+    from spark_rapids_tpu.expr.predicates import Or
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+             "ss_customer_sk", "ss_ticket_number", "ss_coupon_amt",
+             "ss_net_profit"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_dow", "d_year"]) \
+        .where((col("d_dow") == lit(1))
+               & (col("d_year") >= lit(1998))
+               & (col("d_year") <= lit(2000))) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_city"])
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count"]) \
+        .where(Or(col("hd_dep_count") == lit(6),
+                  col("hd_vehicle_count") > lit(2))) \
+        .select(col("hd_demo_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_first_name", "c_last_name"])
+    grouped = ss.join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .group_by("ss_ticket_number", "ss_customer_sk", "s_city") \
+        .agg(Sum(col("ss_coupon_amt")).alias("amt"),
+             Sum(col("ss_net_profit")).alias("profit"))
+    return grouped.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .select(col("c_last_name"), col("c_first_name"), col("s_city"),
+                col("ss_ticket_number"), col("amt"), col("profit")) \
+        .order_by(("c_last_name", True), ("ss_ticket_number", True),
+                  ("c_first_name", True), ("s_city", True),
+                  ("amt", True), ("profit", True)) \
+        .limit(100)
+
+
+def q88(session, data_dir: str):
+    """TPC-DS q88: store-hour traffic pivot — eight independent
+    time-window counts cross-joined into one row."""
+    from spark_rapids_tpu.expr.predicates import Or
+
+    def window_count(alias, hour, half):
+        ss = _t(session, data_dir, "store_sales",
+                ["ss_sold_time_sk", "ss_hdemo_sk", "ss_store_sk"])
+        hd = _t(session, data_dir, "household_demographics",
+                ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count"]) \
+            .where(Or(Or(
+                (col("hd_dep_count") == lit(4))
+                & (col("hd_vehicle_count") <= lit(6)),
+                (col("hd_dep_count") == lit(2))
+                & (col("hd_vehicle_count") <= lit(4))),
+                (col("hd_dep_count") == lit(0))
+                & (col("hd_vehicle_count") <= lit(2)))) \
+            .select(col("hd_demo_sk"))
+        lo, hi = (30, 59) if half else (0, 29)
+        td = _t(session, data_dir, "time_dim",
+                ["t_time_sk", "t_hour", "t_minute"]) \
+            .where((col("t_hour") == lit(hour))
+                   & (col("t_minute") >= lit(lo))
+                   & (col("t_minute") <= lit(hi))) \
+            .select(col("t_time_sk"))
+        st = _t(session, data_dir, "store",
+                ["s_store_sk", "s_store_name"]) \
+            .where(col("s_store_name") == lit("ese")).select(col("s_store_sk"))
+        return ss.join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+            .join(td, on=[("ss_sold_time_sk", "t_time_sk")]) \
+            .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+            .agg(CountStar().alias(alias))
+
+    out = window_count("h8_30", 8, True)
+    for alias, hour, half in (("h9_00", 9, False), ("h9_30", 9, True),
+                              ("h10_00", 10, False), ("h10_30", 10, True),
+                              ("h11_00", 11, False), ("h11_30", 11, True),
+                              ("h12_00", 12, False)):
+        out = out.join(window_count(alias, hour, half), how="cross")
+    return out
+
+
+QUERIES = {"q3": q3, "q6": q6, "q7": q7, "q12": q12, "q13": q13,
+           "q15": q15, "q19": q19, "q20": q20, "q26": q26, "q27": q27,
+           "q33": q33, "q36": q36, "q42": q42, "q43": q43, "q48": q48,
+           "q52": q52, "q53": q53, "q55": q55, "q60": q60, "q61": q61,
+           "q63": q63, "q65": q65, "q68": q68, "q69": q69, "q73": q73,
+           "q79": q79, "q88": q88, "q89": q89, "q96": q96, "q98": q98}
 
 
 def build_query(name: str, session, data_dir: str):

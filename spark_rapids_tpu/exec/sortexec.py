@@ -66,6 +66,19 @@ class SortExec(PlanNode):
     def children_coalesce_goal(self) -> list[CoalesceGoal | None]:
         return [RequireSingleBatch if self._global else None]
 
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        # a global sort is a TOTAL order: the output is one partition.
+        # Sorting each input partition independently and letting a limit
+        # read them in partition order silently breaks the order across
+        # partitions (caught by q65/q68/q73/q79 at SF1: a sort below a
+        # join kept the join's partitioning).  The reference establishes
+        # total order via a range exchange + per-partition sort; here the
+        # final sort collapses partitions (range-partitioned distributed
+        # sort remains available explicitly via RangePartitioning).
+        if self._global:
+            return 1
+        return self.children[0].num_partitions(ctx)
+
     def _jit_fn(self):
         if not hasattr(self, "_sort_jit"):
             import jax
@@ -74,17 +87,21 @@ class SortExec(PlanNode):
         return self._sort_jit
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        child_it = self.children[0].partition_iter(ctx, pid)
+        from spark_rapids_tpu.exec.core import drain_partitions
+        child = self.children[0]
+        if self._global:
+            # concurrent drain + spillable parking, not a serial loop
+            # (review finding: completed partitions must be able to
+            # spill while later ones are still producing)
+            batches = list(drain_partitions(ctx, child))
+        else:
+            batches = list(child.partition_iter(ctx, pid))
+        if not batches:
+            return
         if ctx.is_device:
-            batches = list(child_it)
-            if not batches:
-                return
             b = batches[0] if len(batches) == 1 else dk.concat_batches(batches)
             yield ctx.dispatch(self._jit_fn(), b)
         else:
-            batches = list(child_it)
-            if not batches:
-                return
             b = batches[0] if len(batches) == 1 else hk.host_concat(batches)
             yield hk.host_sort(b, self._orders)
 

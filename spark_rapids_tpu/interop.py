@@ -35,12 +35,23 @@ def device_batches(df) -> Iterator:
     plan is device-capable; otherwise host batches are uploaded at the
     boundary (the reference's HostColumnarToGpu transition)."""
     from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
-    ov, meta = df._overridden(quiet=True)
-    with ExecCtx(backend=meta.backend, conf=df._s.conf) as ctx:
+    # NOTE: execution resources (shuffle server sockets, spill files,
+    # buffer catalog) are released when this generator is exhausted OR
+    # closed; if you stop early, call .close() on the generator (or let
+    # it fall out of scope promptly) rather than keeping it alive.
+    _, meta = df._overridden(quiet=True)
+    ctx = ExecCtx(backend=meta.backend, conf=df._s.conf)
+    try:
         for b in meta.exec_node.execute(ctx):
             if meta.backend != "device":
                 b = host_to_device(b)
             yield b
+    finally:
+        # runs on exhaustion AND on generator close/GC, so an abandoned
+        # iterator still releases shuffle sockets, spill files, and the
+        # catalog (review finding: don't defer resource teardown to GC
+        # of an open `with` frame)
+        ctx.close()
 
 
 def to_jax(df, include_strings: bool = False) -> dict:

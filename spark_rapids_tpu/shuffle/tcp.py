@@ -73,10 +73,17 @@ TCP_INFLIGHT_LIMIT = register(ConfEntry(
 
 _LEN = struct.Struct(">Q")
 _TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
-#: frame sanity cap: a frame is one batch's bytes (batchSizeBytes-scale);
-#: a desynced/non-protocol peer must produce a clean error, not a
-#: multi-GB allocation from a garbage length
-_MAX_FRAME = 2 << 30
+#: frame sanity floor: a frame is one batch's bytes; the effective cap
+#: is max(this, 2x spark.rapids.sql.batchSizeBytes) so oversized-batch
+#: configs stay fetchable while a desynced/non-protocol peer still gets
+#: a clean error instead of a garbage-length allocation
+_MAX_FRAME_MIN = 2 << 30
+
+
+def _max_frame(conf=None) -> int:
+    if conf is None:
+        return _MAX_FRAME_MIN
+    return max(_MAX_FRAME_MIN, 2 * conf.batch_size_bytes)
 
 
 class ShuffleFetchError(RuntimeError):
@@ -97,9 +104,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes]:
+def _recv_frame(sock: socket.socket,
+                max_frame: int = _MAX_FRAME_MIN) -> tuple[bytes, bytes]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    if n < 1 or n > _MAX_FRAME:
+    if n < 1 or n > max_frame:
         raise ConnectionError(f"bad frame length {n} (desynced or "
                               "non-protocol peer)")
     body = _recv_exact(sock, n)
@@ -219,7 +227,8 @@ class TcpShuffleTransport(LocalShuffleTransport):
         the transport owns its inflight throttle, not the call site)."""
         return fetch_remote(address, shuffle_id, part_id, lo=lo, hi=hi,
                             device=device,
-                            inflight_limit=self.conf.get(TCP_INFLIGHT_LIMIT))
+                            inflight_limit=self.conf.get(TCP_INFLIGHT_LIMIT),
+                            max_frame=_max_frame(self.conf))
 
     def close(self) -> None:
         self._server.close()
@@ -242,7 +251,8 @@ def remote_partition_sizes(address, shuffle_id: int) -> tuple[dict, dict]:
 
 def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
                  hi: int | None = None, device: bool = True,
-                 inflight_limit: int | None = None) -> Iterable:
+                 inflight_limit: int | None = None,
+                 max_frame: int = _MAX_FRAME_MIN) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
     (reference RapidsShuffleClient.scala: TransferRequest -> bounce
     buffers -> reassembled device buffers).  The wire codec comes from
@@ -260,7 +270,7 @@ def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
         codec = get_codec(json.loads(body.decode()).get("codec", "none"))
         recv_window = 0
         while True:
-            tag, frame = _recv_frame(sock)
+            tag, frame = _recv_frame(sock, max_frame)
             if tag == _TAG_END:
                 return
             if tag == _TAG_ERROR:

@@ -22,8 +22,11 @@ def test_api_surface_matches_snapshot():
     with open(snap_path) as f:
         want = json.load(f)
     problems = []
-    for section in want:
-        g, w = got.get(section), want[section]
+    if set(got) != set(want):
+        problems.append(f"sections drifted: +{sorted(set(got) - set(want))} "
+                        f"-{sorted(set(want) - set(got))}")
+    for section in sorted(set(got) & set(want)):
+        g, w = got[section], want[section]
         if g == w:
             continue
         if isinstance(w, dict):

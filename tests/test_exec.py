@@ -226,3 +226,29 @@ def test_coalesce_goal_insertion(rng):
     ov, meta = out._overridden(quiet=True)
     from spark_rapids_tpu.exec.core import collect_host as _ch
     assert dev == sorted(_ch(meta.exec_node, s.conf))
+
+
+def test_global_sort_total_order_across_partitions(rng):
+    """order_by establishes a TOTAL order even over multi-partition
+    input (SF1 regression: per-partition sort + partition-ordered limit
+    returned the wrong top-k when the child kept join partitioning)."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.exec.core import collect_host as _ch
+
+    s = TpuSession({"spark.sql.shuffle.partitions": 5})
+    schema = T.Schema([T.StructField("k", T.IntegerType()),
+                       T.StructField("s", T.StringType())])
+    n = 500
+    df = s.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 1000, n)],
+         "s": [None if i % 7 == 0 else f"s{i%13}" for i in range(n)]},
+        schema, partitions=4, rows_per_batch=32)
+    out = df.order_by(("s", True), ("k", True)).limit(20)
+    dev = out.collect()
+    ov, meta = out._overridden(quiet=True)
+    host = _ch(meta.exec_node, s.conf)
+    assert dev == host                       # ordered compare, not a set
+    # the global top-20 by (s asc nulls-first, k asc), from all rows
+    allr = sorted(df.collect(),
+                  key=lambda r: (r[1] is not None, r[1] or "", r[0]))
+    assert dev == allr[:20]
