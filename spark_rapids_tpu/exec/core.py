@@ -87,13 +87,17 @@ RequireSingleBatch = RequireSingleBatchT()
 # ---------------------------------------------------------------------------
 
 class Metrics:
-    """Per-operator metric map (reference GpuMetricNames)."""
+    """Per-operator metric map (reference GpuMetricNames).  add() is
+    called concurrently from drain_partitions worker threads, so the
+    read-modify-write is locked."""
 
     def __init__(self):
         self.values: dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, v: float):
-        self.values[name] = self.values.get(name, 0.0) + v
+        with self._lock:
+            self.values[name] = self.values.get(name, 0.0) + v
 
     def __getitem__(self, name: str) -> float:
         return self.values.get(name, 0.0)
@@ -128,6 +132,13 @@ class ExecCtx:
     @property
     def is_device(self) -> bool:
         return self.backend == "device"
+
+    @property
+    def metrics_enabled(self) -> bool:
+        if "metrics_enabled" not in self.cache:
+            from spark_rapids_tpu.conf import METRICS_ENABLED
+            self.cache["metrics_enabled"] = self.conf.get(METRICS_ENABLED)
+        return self.cache["metrics_enabled"]
 
     # -- device runtime ----------------------------------------------------
     @property
@@ -229,6 +240,44 @@ class PlanNode:
     def __init__(self, children: Sequence["PlanNode"]):
         self.children = tuple(children)
 
+    def __init_subclass__(cls, **kw):
+        """Auto-instrument every operator's partition_iter with the
+        standard metric set (totalTime / numOutputBatches /
+        numOutputRows + an xprof TraceAnnotation range) — the reference
+        wires GpuMetricNames into every GpuExec (GpuExec.scala:27-56);
+        here the base class does it so operators cannot forget.
+        totalTime is inclusive of children, as in the reference.
+        numOutputRows is recorded on the host backend only: reading a
+        device batch's row count would force a D2H sync per batch."""
+        super().__init_subclass__(**kw)
+        impl = cls.__dict__.get("partition_iter")
+        if impl is None:
+            return
+
+        def timed_partition_iter(self, ctx, pid, _impl=impl):
+            if not ctx.metrics_enabled:
+                yield from _impl(self, ctx, pid)
+                return
+            import jax.profiler as _prof
+            m = ctx.metrics_for(self)
+            label = type(self).__name__
+            it = _impl(self, ctx, pid)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    with _prof.TraceAnnotation(label):
+                        batch = next(it)
+                except StopIteration:
+                    return
+                m.add("totalTime", time.perf_counter() - t0)
+                m.add("numOutputBatches", 1)
+                if not ctx.is_device:
+                    m.add("numOutputRows", batch.num_rows)
+                yield batch
+
+        timed_partition_iter.__wrapped__ = impl
+        cls.partition_iter = timed_partition_iter
+
     # -- contract ----------------------------------------------------------
     @property
     def output_schema(self) -> T.Schema:
@@ -248,8 +297,13 @@ class PlanNode:
         over partition_iter; ShuffleExchangeExec overrides with a sliced
         transport fetch that skips materializing the rest.  Keeps the
         adaptive reader safe over ANY child (e.g. a BackendSwitchExec
-        inserted by transition overrides)."""
-        for i, b in enumerate(self.partition_iter(ctx, pid)):
+        inserted by transition overrides).  Uses the UNinstrumented
+        implementation: repeated slice windows must not inflate this
+        operator's output metrics with skipped batches (the consumer's
+        own wrapper records what is actually emitted)."""
+        fn = type(self).partition_iter
+        fn = getattr(fn, "__wrapped__", fn)
+        for i, b in enumerate(fn(self, ctx, pid)):
             if i < lo:
                 continue
             if hi is not None and i >= hi:
@@ -274,29 +328,12 @@ class PlanNode:
 
     # -- execution helpers -------------------------------------------------
     def execute(self, ctx: ExecCtx) -> Iterator:
-        """All partitions' batches, in partition order, with output
-        metrics recorded for this (root) node.  On the device backend
-        partitions run concurrently on a worker pool (reference: Spark's
-        task scheduler running doExecuteColumnar RDD partitions)."""
-        yield from self.timed_iter(ctx, drain_partitions(ctx, self))
-
-    def timed_iter(self, ctx: ExecCtx, it: Iterator) -> Iterator:
-        """Wrap an iterator with totalTime / output metrics and a
-        per-operator profiler range (the NVTX-range analog,
-        NvtxWithMetrics.scala:27 — visible in xprof/tensorboard traces)."""
-        import jax.profiler as _prof
-        m = ctx.metrics_for(self)
-        label = type(self).__name__
-        while True:
-            t0 = time.perf_counter()
-            try:
-                with _prof.TraceAnnotation(label):
-                    batch = next(it)
-            except StopIteration:
-                return
-            m.add("totalTime", time.perf_counter() - t0)
-            m.add("numOutputBatches", 1)
-            yield batch
+        """All partitions' batches, in partition order.  On the device
+        backend partitions run concurrently on a worker pool (reference:
+        Spark's task scheduler running doExecuteColumnar RDD
+        partitions).  Metrics/trace ranges are recorded per operator by
+        the auto-instrumented partition_iter (see __init_subclass__)."""
+        yield from drain_partitions(ctx, self)
 
     # -- plan introspection ------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:

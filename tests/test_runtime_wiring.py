@@ -126,3 +126,73 @@ def test_dispatch_concurrency_semaphore_bound():
     for t in threads:
         t.join()
     assert peak[0] <= 2
+
+
+def test_per_operator_metrics_recorded():
+    """Every operator in the plan records totalTime/numOutputBatches
+    (reference GpuMetricNames wired into every GpuExec,
+    GpuExec.scala:27-56) — not just the root."""
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exec.core import ExecCtx
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("k", T.IntegerType()),
+                       T.StructField("v", T.LongType())])
+    rng = np.random.default_rng(3)
+    df = s.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 5, 200)],
+         "v": list(range(200))}, schema, partitions=2, rows_per_batch=32)
+    out = df.where(col("v") >= 0).group_by("k").agg(
+        Sum(col("v")).alias("sv"))
+    ov, meta = out._overridden(quiet=True)
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        list(meta.exec_node.execute(ctx))
+        names = {k.split("@")[0] for k, m in ctx.metrics.items()
+                 if m["numOutputBatches"] > 0}
+    assert "FilterExec" in names
+    assert any("Aggregate" in n for n in names)
+    assert any("Scan" in n for n in names)
+    # host backend additionally counts rows
+    with ExecCtx(backend="host", conf=s.conf) as ctx:
+        list(meta.exec_node.execute(ctx))
+        rows = {k.split("@")[0]: m["numOutputRows"]
+                for k, m in ctx.metrics.items()}
+    assert any(v > 0 for v in rows.values())
+
+
+def test_metrics_disabled_conf():
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exec.core import ExecCtx
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.metrics.enabled": False})
+    schema = T.Schema([T.StructField("v", T.LongType())])
+    df = s.from_pydict({"v": list(range(50))}, schema)
+    ov, meta = df._overridden(quiet=True)
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        list(meta.exec_node.execute(ctx))
+        assert not any(m.values for m in ctx.metrics.values())
+
+
+def test_xprof_trace_capture(tmp_path):
+    """spark.rapids.tpu.profile.dir records an xprof trace of the
+    execution (reference: NVTX ranges + nsight timelines, §5.1)."""
+    import glob
+    import os
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+
+    d = str(tmp_path / "xprof")
+    s = TpuSession({"spark.rapids.tpu.profile.dir": d})
+    schema = T.Schema([T.StructField("v", T.LongType())])
+    df = s.from_pydict({"v": list(range(100))}, schema)
+    assert len(df.collect()) == 100
+    traces = glob.glob(os.path.join(d, "**", "*.xplane.pb"),
+                       recursive=True) + \
+        glob.glob(os.path.join(d, "**", "*.trace.json.gz"), recursive=True)
+    assert traces, f"no trace files under {d}"
