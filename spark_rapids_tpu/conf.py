@@ -200,6 +200,13 @@ UDF_COMPILER_ENABLED = bool_conf(
     "Compile Python UDF bytecode to native expressions when possible. "
     "(ref udf-compiler Plugin.scala:29-35)")
 
+FALLBACK_ON_DEVICE_ERROR = bool_conf(
+    "spark.rapids.sql.fallbackOnDeviceError", False,
+    "Re-run a query on the host engine when device execution raises at "
+    "runtime (loud warning). Off by default: the reference only falls "
+    "back at plan time, and silent runtime masking would defeat "
+    "differential testing.")
+
 SPILL_ENABLED = bool_conf(
     "spark.rapids.memory.spill.enabled", True,
     "Enable HBM->host->disk spill of catalog-registered buffers. "

@@ -1,0 +1,119 @@
+"""df.cache(): materialized columnar caching.
+
+Reference: the spark310 shim's ParquetCachedBatchSerializer
+(shims/spark310/.../ParquetCachedBatchSerializer.scala, SURVEY §5.4)
+implements ``df.cache()`` as compressed columnar blobs written by the
+GPU and rebuilt on read.  Here a cached DataFrame materializes its plan
+ONCE (on first use, on the plan's tagged backend) into codec-compressed
+Arrow IPC blobs held on the host — backend-independent, compact, and
+re-uploaded H2D per execution on the device path — then serves every
+subsequent execution as a leaf scan.  ``unpersist()`` frees the blobs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+
+__all__ = ["CachedScanExec"]
+
+CACHE_CODEC = register(ConfEntry(
+    "spark.rapids.sql.cache.compression.codec", "lz4",
+    "Codec for df.cache() columnar blobs: none, lz4 or zstd (reference "
+    "ParquetCachedBatchSerializer stores compressed columnar parquet "
+    "blobs).",
+    check=lambda v: v in ("none", "lz4", "zstd"),
+    check_doc="must be none|lz4|zstd"))
+
+
+class CachedScanExec(PlanNode):
+    """Leaf serving a materialized (cached) query result."""
+
+    def __init__(self, source: PlanNode, source_backend: str, conf):
+        super().__init__([])
+        self._source = source
+        self._source_backend = source_backend
+        self._conf = conf
+        from spark_rapids_tpu.shuffle.compression import get_codec
+        self._codec_name = conf.get(CACHE_CODEC)
+        self._codec = get_codec(self._codec_name)
+        self._lock = threading.Lock()
+        # per partition: list of (blob, raw_size) compressed Arrow IPC
+        self._blobs: list[list[tuple[bytes, int]]] | None = None
+        self.metrics = {"cached_bytes": 0, "raw_bytes": 0}
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._source.output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        # planning calls num_partitions (e.g. _lower_aggregate); it must
+        # NOT force materialization — blob lists are built 1:1 per
+        # source partition, so the source's count is always right
+        with self._lock:
+            blobs = self._blobs
+        if blobs is not None:
+            return max(1, len(blobs))
+        return self._source.num_partitions(ctx)
+
+    # -- materialization ----------------------------------------------
+    def _ensure(self) -> None:
+        with self._lock:
+            if self._blobs is not None:
+                return
+            from spark_rapids_tpu.shuffle.serializer import serialize_batch
+            blobs: list[list[tuple[bytes, int]]] = []
+            with ExecCtx(backend=self._source_backend,
+                         conf=self._conf) as ctx:
+                for pid in range(self._source.num_partitions(ctx)):
+                    part: list[tuple[bytes, int]] = []
+                    for b in self._source.partition_iter(ctx, pid):
+                        # both batch kinds expose to_arrow(); the
+                        # serializer D2Hs device batches itself
+                        raw = serialize_batch(b)
+                        self.metrics["raw_bytes"] += len(raw)
+                        if self._codec is not None:
+                            blob = self._codec.compress(raw)
+                        else:
+                            blob = raw
+                        self.metrics["cached_bytes"] += len(blob)
+                        part.append((blob, len(raw)))
+                    blobs.append(part)
+            self._blobs = blobs
+
+    def unpersist(self) -> None:
+        """Free the cached blobs; the next use re-materializes
+        (reference: unpersist drops the cached RDD blocks)."""
+        with self._lock:
+            self._blobs = None
+            self.metrics["cached_bytes"] = 0
+            self.metrics["raw_bytes"] = 0
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._blobs is not None
+
+    # -- serving -------------------------------------------------------
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        self._ensure()
+        from spark_rapids_tpu.io.scan import _arrow_to_host
+        from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+        with self._lock:
+            # snapshot: a concurrent unpersist() must not crash an
+            # in-progress scan mid-iteration
+            part = list(self._blobs[pid]) if self._blobs is not None else []
+        for blob, raw_size in part:
+            raw = self._codec.decompress(blob, raw_size) \
+                if self._codec is not None else blob
+            if ctx.is_device:
+                yield deserialize_batch(raw, device=True)
+            else:
+                yield _arrow_to_host(deserialize_batch(raw, device=False),
+                                     self.output_schema)
+
+    def node_desc(self) -> str:
+        state = "materialized" if self.is_materialized else "lazy"
+        return f"CachedScanExec[{state}, codec={self._codec_name}]"

@@ -195,6 +195,26 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self._s, L.Union([self._plan, other._plan]))
 
+    def cache(self) -> "DataFrame":
+        """Materialized columnar caching (reference
+        ParquetCachedBatchSerializer, SURVEY §5.4): the plan runs once
+        on its tagged backend into codec-compressed Arrow blobs; every
+        later execution scans the cache.  Lazy: materializes on first
+        use.  Call ``unpersist()`` on the RETURNED frame to free it."""
+        from spark_rapids_tpu.exec.cache_exec import CachedScanExec
+        ov, meta = self._overridden(quiet=True)
+        cached = CachedScanExec(meta.exec_node, meta.backend, self._s.conf)
+        return DataFrame(self._s, L.Scan(cached))
+
+    def unpersist(self) -> "DataFrame":
+        """Free this frame's cache blobs (no-op unless the plan root is
+        a cache scan)."""
+        from spark_rapids_tpu.exec.cache_exec import CachedScanExec
+        node = getattr(self._plan, "exec_node", None)
+        if isinstance(node, CachedScanExec):
+            node.unpersist()
+        return self
+
     def repartition(self, num_partitions: int, *keys) -> "DataFrame":
         return DataFrame(self._s, L.Repartition(
             num_partitions, [self._col_or_expr(k) for k in keys],
@@ -203,9 +223,24 @@ class DataFrame:
     # -- actions -------------------------------------------------------
     def collect(self) -> list[tuple]:
         ov, meta = self._overridden()
-        if meta.backend == "device":
+        if meta.backend != "device":
+            return collect_host(meta.exec_node, self._s.conf)
+        from spark_rapids_tpu.conf import FALLBACK_ON_DEVICE_ERROR
+        if not self._s.conf.get(FALLBACK_ON_DEVICE_ERROR):
             return collect_device(meta.exec_node, self._s.conf)
-        return collect_host(meta.exec_node, self._s.conf)
+        try:
+            return collect_device(meta.exec_node, self._s.conf)
+        except Exception as e:  # noqa: BLE001 - opt-in resilience path
+            # opt-in runtime resilience beyond the reference (which only
+            # falls back at PLAN time): rerun the whole query on the
+            # host oracle with a loud warning. Off by default — masking
+            # device bugs silently would defeat differential testing.
+            import warnings
+            warnings.warn(
+                f"device execution failed ({type(e).__name__}: {e}); "
+                "re-running on the host engine per "
+                "spark.rapids.sql.fallbackOnDeviceError", RuntimeWarning)
+            return collect_host(meta.exec_node, self._s.conf)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -1,0 +1,110 @@
+"""df.cache() columnar caching + runtime fallback conf.
+
+Reference: ParquetCachedBatchSerializer (shims/spark310, SURVEY §5.4)
+— df.cache() as compressed columnar blobs — and the engine's opt-in
+runtime host fallback (beyond the reference's plan-time-only fallback).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType()),
+                   T.StructField("v", T.DoubleType()),
+                   T.StructField("s", T.StringType())])
+
+
+def _df(s, n=150):
+    rng = np.random.default_rng(9)
+    return s.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 8, n)],
+         "v": [None if i % 11 == 5 else float(i) for i in range(n)],
+         "s": [None if i % 13 == 6 else f"x{i%19}" for i in range(n)]},
+        SCHEMA, partitions=3, rows_per_batch=25)
+
+
+@pytest.mark.parametrize("codec", ["none", "lz4", "zstd"])
+def test_cache_roundtrip_both_backends(codec):
+    s = TpuSession({"spark.rapids.sql.cache.compression.codec": codec})
+    base = _df(s).where(col("k") < lit(6))
+    cached = base.cache()
+    dev = sorted(cached.collect(), key=str)
+    want = sorted(base.collect(), key=str)
+    assert dev == want and len(dev) > 0
+    ov, meta = cached._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf), key=str)
+    assert host == want
+
+
+def test_cache_materializes_once_and_unpersists():
+    from spark_rapids_tpu.exec.cache_exec import CachedScanExec
+    s = TpuSession({})
+    cached = _df(s).cache()
+    node = cached._plan.exec_node
+    assert isinstance(node, CachedScanExec)
+    assert not node.is_materialized        # lazy until first use
+    cached.collect()
+    assert node.is_materialized
+    blobs_id = id(node._blobs)
+    cached.collect()
+    assert id(node._blobs) == blobs_id      # served from cache, not rerun
+    assert node.metrics["cached_bytes"] > 0
+    cached.unpersist()
+    assert not node.is_materialized
+    assert len(cached.collect()) == 150     # re-materializes on demand
+
+
+def test_cache_downstream_query():
+    s = TpuSession({})
+    cached = _df(s).cache()
+    out = cached.group_by("k").agg(Sum(col("v")).alias("sv"))
+    dev = sorted(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    assert dev == sorted(collect_host(meta.exec_node, s.conf))
+    assert "CachedScanExec" in out.explain()
+
+
+def test_runtime_fallback_on_device_error():
+    """With fallbackOnDeviceError, a device runtime failure re-runs on
+    the host engine with a warning; without it, the error propagates."""
+    from spark_rapids_tpu.exec import basic as basic_mod
+
+    class BoomExec(basic_mod.LocalScanExec):
+        def partition_iter(self, ctx, pid):
+            if ctx.is_device:
+                raise RuntimeError("device exploded")
+            yield from super().partition_iter.__wrapped__(self, ctx, pid)
+
+    import spark_rapids_tpu.plan.logical as L
+    s = TpuSession({"spark.rapids.sql.fallbackOnDeviceError": True})
+    boom = BoomExec.from_pydict({"v": [1, 2, 3]},
+                                T.Schema([T.StructField("v",
+                                                        T.LongType())]))
+    boom.__class__ = BoomExec
+    df_ok = s.from_pydict({"v": [1, 2, 3]},
+                          T.Schema([T.StructField("v", T.LongType())]))
+    from spark_rapids_tpu.session import DataFrame
+    df = DataFrame(s, L.Scan(boom))
+    with pytest.warns(RuntimeWarning, match="device execution failed"):
+        assert sorted(df.collect()) == [(1,), (2,), (3,)]
+    s2 = TpuSession({})
+    df2 = DataFrame(s2, L.Scan(boom))
+    with pytest.raises(RuntimeError, match="device exploded"):
+        df2.collect()
+
+
+def test_cache_plan_time_does_not_materialize():
+    """explain()/planning must not execute the source (review finding:
+    num_partitions used to force materialization at plan time)."""
+    from spark_rapids_tpu.exec.cache_exec import CachedScanExec
+    s = TpuSession({})
+    cached = _df(s).cache()
+    out = cached.group_by("k").agg(Sum(col("v")).alias("sv"))
+    _ = out.explain()
+    node = cached._plan.exec_node
+    assert isinstance(node, CachedScanExec)
+    assert not node.is_materialized
