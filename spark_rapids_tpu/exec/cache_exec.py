@@ -43,6 +43,7 @@ class CachedScanExec(PlanNode):
         self._lock = threading.Lock()
         # per partition: list of (blob, raw_size) compressed Arrow IPC
         self._blobs: list[list[tuple[bytes, int]]] | None = None
+        self._nparts: int | None = None
         self.metrics = {"cached_bytes": 0, "raw_bytes": 0}
 
     @property
@@ -51,13 +52,20 @@ class CachedScanExec(PlanNode):
 
     def num_partitions(self, ctx: ExecCtx) -> int:
         # planning calls num_partitions (e.g. _lower_aggregate); it must
-        # NOT force materialization — blob lists are built 1:1 per
-        # source partition, so the source's count is always right
+        # NOT force materialization.  The count is the SOURCE's count on
+        # the MATERIALIZATION backend — never the serving ctx's backend:
+        # a mesh exec reports different counts per backend, and serving
+        # host-first with the host count while blobs were built with the
+        # device count silently dropped partitions (review repro)
         with self._lock:
-            blobs = self._blobs
-        if blobs is not None:
-            return max(1, len(blobs))
-        return self._source.num_partitions(ctx)
+            if self._blobs is not None:
+                return max(1, len(self._blobs))
+            if self._nparts is None:
+                with ExecCtx(backend=self._source_backend,
+                             conf=self._conf) as mctx:
+                    self._nparts = max(
+                        1, self._source.num_partitions(mctx))
+            return self._nparts
 
     # -- materialization ----------------------------------------------
     def _ensure(self) -> None:
@@ -66,6 +74,7 @@ class CachedScanExec(PlanNode):
                 return
             from spark_rapids_tpu.shuffle.serializer import serialize_batch
             blobs: list[list[tuple[bytes, int]]] = []
+            raw_total = comp_total = 0
             with ExecCtx(backend=self._source_backend,
                          conf=self._conf) as ctx:
                 for pid in range(self._source.num_partitions(ctx)):
@@ -74,15 +83,19 @@ class CachedScanExec(PlanNode):
                         # both batch kinds expose to_arrow(); the
                         # serializer D2Hs device batches itself
                         raw = serialize_batch(b)
-                        self.metrics["raw_bytes"] += len(raw)
+                        raw_total += len(raw)
                         if self._codec is not None:
                             blob = self._codec.compress(raw)
                         else:
                             blob = raw
-                        self.metrics["cached_bytes"] += len(blob)
+                        comp_total += len(blob)
                         part.append((blob, len(raw)))
                     blobs.append(part)
+            # metrics assigned only on SUCCESS: a failed materialization
+            # must not leave partial counts that a retry double-counts
             self._blobs = blobs
+            self.metrics["raw_bytes"] = raw_total
+            self.metrics["cached_bytes"] = comp_total
 
     def unpersist(self) -> None:
         """Free the cached blobs; the next use re-materializes
@@ -98,13 +111,17 @@ class CachedScanExec(PlanNode):
 
     # -- serving -------------------------------------------------------
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        self._ensure()
         from spark_rapids_tpu.io.scan import _arrow_to_host
         from spark_rapids_tpu.shuffle.serializer import deserialize_batch
-        with self._lock:
-            # snapshot: a concurrent unpersist() must not crash an
-            # in-progress scan mid-iteration
-            part = list(self._blobs[pid]) if self._blobs is not None else []
+        # snapshot under the lock, re-materializing if a concurrent
+        # unpersist() raced in between: yielding an empty partition
+        # would be silently wrong results, not just a crash
+        while True:
+            self._ensure()
+            with self._lock:
+                if self._blobs is not None:
+                    part = list(self._blobs[pid])
+                    break
         for blob, raw_size in part:
             raw = self._codec.decompress(blob, raw_size) \
                 if self._codec is not None else blob

@@ -242,8 +242,22 @@ class JoinExec(PlanNode):
             return rb2, rkeys, prep
         return ctx.cached((id(self), "build"), build)
 
+    def _stream_batches(self, ctx: ExecCtx, pid: int):
+        """Stream-side batches for one output partition (hook:
+        MeshJoinExec serves device-placed shards instead)."""
+        child = self.children[0]
+        pids = range(child.num_partitions(ctx)) \
+            if self.join_type == "full" else [pid]
+        for lpid in pids:
+            yield from child.partition_iter(ctx, lpid)
+
+    def _device_build(self, ctx: ExecCtx, pid: int):
+        """(build batch, key idx, prep) for one output partition (hook:
+        MeshJoinExec replicates the build side onto partition devices)."""
+        return self._build_device(ctx)
+
     def _run_device_stream(self, ctx: ExecCtx, pid: int):
-        rb2, rkeys, prep = self._build_device(ctx)
+        rb2, rkeys, prep = self._device_build(ctx, pid)
         jt = self.join_type
         stream_jt = "left" if jt == "full" else jt
         n_right_raw = len(self.children[1].output_schema.fields)
@@ -251,41 +265,38 @@ class JoinExec(PlanNode):
               + (list(rb2.schema.fields) if self.include_right else []))
         kf_schema = T.Schema(kf)
         matched = None
-        child = self.children[0]
-        pids = range(child.num_partitions(ctx)) if jt == "full" else [pid]
-        for lpid in pids:
-            for lb in child.partition_iter(ctx, lpid):
-                lb2, lkeys = self._augment_device(lb, self._lkeys_b)
-                if prep is not None:
-                    probe_arrays, total_dev = ctx.dispatch(
-                        _jit_probe_fast, lb2, prep, lkeys[0], stream_jt)
-                else:
-                    probe_arrays, total_dev = ctx.dispatch(
-                        _jit_probe, lb2, rb2, lkeys, rkeys, stream_jt)
-                total = int(jax.device_get(total_dev))
-                if total == 0:
-                    if jt == "full" and matched is None:
-                        matched = jnp.zeros(rb2.capacity, jnp.bool_)
-                    continue
-                out_cap = round_capacity(max(total, 1))
-                if jt == "full":
-                    out, bm = ctx.dispatch(
-                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
-                        stream_jt, out_cap, self.include_right, kf_schema,
-                        track_matched=True)
-                    matched = bm if matched is None else matched | bm
-                else:
-                    out = ctx.dispatch(
-                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
-                        stream_jt, out_cap, self.include_right, kf_schema)
-                out = self._project_out(
-                    out, lb.num_columns, lb2.num_columns, n_right_raw,
-                    device=True)
-                if self._condition is not None:
-                    out = self._condition_jit()(out)
-                if self._swapped and self.include_right:
-                    out = self._reorder_device(out, lb.num_columns)
-                yield ColumnBatch(out.columns, out.num_rows, self._schema)
+        for lb in self._stream_batches(ctx, pid):
+            lb2, lkeys = self._augment_device(lb, self._lkeys_b)
+            if prep is not None:
+                probe_arrays, total_dev = ctx.dispatch(
+                    _jit_probe_fast, lb2, prep, lkeys[0], stream_jt)
+            else:
+                probe_arrays, total_dev = ctx.dispatch(
+                    _jit_probe, lb2, rb2, lkeys, rkeys, stream_jt)
+            total = int(jax.device_get(total_dev))
+            if total == 0:
+                if jt == "full" and matched is None:
+                    matched = jnp.zeros(rb2.capacity, jnp.bool_)
+                continue
+            out_cap = round_capacity(max(total, 1))
+            if jt == "full":
+                out, bm = ctx.dispatch(
+                    _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                    stream_jt, out_cap, self.include_right, kf_schema,
+                    track_matched=True)
+                matched = bm if matched is None else matched | bm
+            else:
+                out = ctx.dispatch(
+                    _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                    stream_jt, out_cap, self.include_right, kf_schema)
+            out = self._project_out(
+                out, lb.num_columns, lb2.num_columns, n_right_raw,
+                device=True)
+            if self._condition is not None:
+                out = self._condition_jit()(out)
+            if self._swapped and self.include_right:
+                out = self._reorder_device(out, lb.num_columns)
+            yield ColumnBatch(out.columns, out.num_rows, self._schema)
         if jt == "full":
             if matched is None:
                 matched = jnp.zeros(rb2.capacity, jnp.bool_)

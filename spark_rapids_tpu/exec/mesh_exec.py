@@ -31,6 +31,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec, _relabel_d
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.joins import JoinExec
 from spark_rapids_tpu.expr.core import Expression, bind, eval_device
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops.segmented import sorted_group_by
@@ -40,7 +41,8 @@ from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
                                                     exchange_local,
                                                     partition_ids_for_keys)
 
-__all__ = ["MeshAggregateExec", "MeshExchangeExec", "mesh_for"]
+__all__ = ["MeshAggregateExec", "MeshExchangeExec", "MeshJoinExec",
+           "mesh_for"]
 
 
 def mesh_for(ctx: ExecCtx, size: int, axis_name: str = "data"):
@@ -69,7 +71,24 @@ def place_shards(batches: Sequence[ColumnBatch], p: int):
     """
     groups: list[list[ColumnBatch]] = [[] for _ in range(p)]
     loads = [0] * p
-    for b in sorted(batches, key=lambda b: -b.capacity):
+    # device affinity first: batches already committed to a mesh device
+    # (e.g. MeshJoinExec probe output) stay on it — cross-device concat
+    # is both an error and a needless ICI hop
+    devs = jax.devices()[:p]
+    dev_index = {repr(d): i for i, d in enumerate(devs)}
+    rest = []
+    for b in batches:
+        i = None
+        if b.columns and getattr(b.columns[0].data, "committed", False):
+            bdevs = b.columns[0].data.devices()
+            if len(bdevs) == 1:
+                i = dev_index.get(repr(next(iter(bdevs))))
+        if i is not None:
+            groups[i].append(b)
+            loads[i] += b.capacity
+        else:
+            rest.append(b)
+    for b in sorted(rest, key=lambda b: -b.capacity):
         i = loads.index(min(loads))
         groups[i].append(b)
         loads[i] += b.capacity
@@ -372,3 +391,71 @@ def output_name_safe(e: Expression) -> str:
         return output_name(e)
     except Exception:  # noqa: BLE001 - descriptive label only
         return repr(e)
+
+
+class MeshJoinExec(JoinExec):
+    """Broadcast-build equi-join distributed over the mesh.
+
+    The TPU-native shape of GpuBroadcastHashJoinExec (SURVEY §2.4): the
+    build side is materialized once and REPLICATED to every mesh device
+    (the torrent-broadcast analog — small table resident per chip);
+    the stream side is placed as per-device shards (place_shards, no
+    central gather) and each device probes its own shard with the
+    standard streaming join kernels.  The probe needs no collectives at
+    all; one output partition per device, consumed in place by the
+    downstream mesh aggregation.
+
+    Full outer joins keep the in-process path (their unmatched-build
+    tail needs a cross-shard matched union).
+    """
+
+    def __init__(self, left: PlanNode, right: PlanNode, left_keys,
+                 right_keys, join_type: str, mesh_size: int,
+                 condition=None):
+        assert join_type != "full", "full outer stays in-process"
+        super().__init__(left, right, left_keys, right_keys, join_type,
+                         condition)
+        self.mesh_size = mesh_size
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        if not ctx.is_device:
+            return self.children[0].num_partitions(ctx)
+        return self.mesh_size
+
+    # -- hooks ---------------------------------------------------------
+    def _shard_devices(self, ctx: ExecCtx):
+        devs = jax.devices()
+        p = min(self.mesh_size, len(devs))
+        return devs[:p]
+
+    def _mesh_shards(self, ctx: ExecCtx):
+        def make():
+            from spark_rapids_tpu.exec.core import drain_partitions
+            devs = self._shard_devices(ctx)
+            batches = list(drain_partitions(ctx, self.children[0]))
+            if not batches:
+                from spark_rapids_tpu.exec.core import host_to_device
+                from spark_rapids_tpu.host.batch import HostBatch
+                batches = [host_to_device(
+                    HostBatch.empty(self.children[0].output_schema))]
+            shards = place_shards(batches, len(devs))
+            return [jax.device_put(s, d) for s, d in zip(shards, devs)]
+        return ctx.cached((id(self), "mesh_stream_shards"), make)
+
+    def _device_build(self, ctx: ExecCtx, pid: int):
+        rb2, rkeys, prep = self._build_device(ctx)
+        devs = self._shard_devices(ctx)
+        d = devs[pid % len(devs)]
+        def rep():
+            return (jax.device_put(rb2, d), rkeys,
+                    None if prep is None else jax.device_put(prep, d))
+        return ctx.cached((id(self), "mesh_build", repr(d)), rep)
+
+    def _stream_batches(self, ctx: ExecCtx, pid: int):
+        shards = self._mesh_shards(ctx)
+        if pid < len(shards):
+            yield shards[pid]
+
+    def node_desc(self) -> str:
+        jt = "right" if self._swapped else self.join_type
+        return f"MeshJoinExec[{jt}, mesh={self.mesh_size}]"

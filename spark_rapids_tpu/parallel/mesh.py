@@ -54,9 +54,19 @@ def shard_batches(batches: Sequence[ColumnBatch], mesh: Mesh,
         raise ValueError(f"need {p} shards, got {len(batches)}")
     schema = batches[0].schema
     sharding = NamedSharding(mesh, P(axis_name))
+    devs = list(mesh.devices.flat)
 
     def place(*leaves):
-        return jax.device_put(jnp.stack(leaves), sharding)
+        # build the global array from per-device shards: each leaf is
+        # device_put straight to ITS mesh device (a no-op when the
+        # shard — e.g. MeshJoinExec probe output — already lives there);
+        # a central jnp.stack would both error on mixed committed
+        # devices and funnel every shard through one device
+        shards = [jax.device_put(leaf[None], d)
+                  for leaf, d in zip(leaves, devs)]
+        global_shape = (p,) + leaves[0].shape
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards)
 
     stacked = jax.tree_util.tree_map(place, *batches)
     # tree_map over ColumnBatch pytrees rebuilds a ColumnBatch (schema aux

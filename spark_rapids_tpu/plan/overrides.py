@@ -78,13 +78,26 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         lc, rc = (_aqe_join_reader(c, conf) for c in (lc, rc))
         if node.how == "cross":
             ex = CrossJoinExec(lc.exec_node, rc.exec_node, node.condition)
+        elif conf.mesh_device_count > 1 and node.how != "full":
+            # mesh mode: replicated-build join, one probe shard per
+            # device (the GpuBroadcastHashJoinExec analog over ICI)
+            from spark_rapids_tpu.exec.mesh_exec import MeshJoinExec
+            ex = MeshJoinExec(lc.exec_node, rc.exec_node, node.left_on,
+                              node.right_on, node.how,
+                              conf.mesh_device_count, node.condition)
         else:
             ex = JoinExec(lc.exec_node, rc.exec_node, node.left_on,
                           node.right_on, node.how, node.condition)
         exprs = list(node.left_on) + list(node.right_on)
         if node.condition is not None:
             exprs.append(node.condition)
-        return PlannedNode(ex, exprs, [lc, rc])
+        # meta children MUST mirror exec children: JoinExec runs a right
+        # join side-swapped, and a tree-rewrite pass (coalesce /
+        # transition insertion) reassigns exec children from meta order —
+        # un-swapped metas silently flipped the join back (latent until a
+        # right join with asymmetric schemas hit a rewrite pass)
+        metas = [rc, lc] if getattr(ex, "_swapped", False) else [lc, rc]
+        return PlannedNode(ex, exprs, metas)
     if isinstance(node, L.Sort):
         c = lower(node.child, conf)
         ex = SortExec(node.orders, c.exec_node, global_sort=True)

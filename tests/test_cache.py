@@ -108,3 +108,19 @@ def test_cache_plan_time_does_not_materialize():
     node = cached._plan.exec_node
     assert isinstance(node, CachedScanExec)
     assert not node.is_materialized
+
+
+def test_cache_mesh_source_partition_count():
+    """Backend-dependent source partition counts (mesh execs) must not
+    desync serving from the materialized blobs (review repro: host-first
+    reads of a mesh-aggregated cache returned [] silently)."""
+    s = TpuSession({"spark.rapids.tpu.mesh.deviceCount": 8})
+    base = _df(s).group_by("k").agg(Sum(col("v")).alias("sv"))
+    want = sorted(base.collect(), key=str)
+    cached = base.cache()
+    ov, meta = cached._overridden(quiet=True)
+    # host-first read of a device-materialized cache
+    host = sorted(collect_host(meta.exec_node, s.conf), key=str)
+    assert host == want and len(host) > 0
+    dev = sorted(cached.collect(), key=str)
+    assert dev == want

@@ -160,3 +160,34 @@ def test_condition_rejected_for_outer():
     with pytest.raises(ValueError):
         JoinExec(left, right, [col("lk")], [col("rk")], "left",
                  condition=col("lv") > lit(0))
+
+
+def test_session_right_join_asymmetric_schemas():
+    """Session-level right join with different schemas per side
+    (regression: the planner's rewrite passes reassigned exec children
+    in meta order, clobbering JoinExec's internal side swap — columns
+    came back misaligned and rows were a left join's)."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.exec.core import collect_host as _ch
+    s = TpuSession({})
+    fact_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                            T.StructField("g", T.StringType(), True),
+                            T.StructField("v", T.LongType(), True)])
+    dim_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                           T.StructField("name", T.StringType(), True)])
+    fact = s.from_pydict({"k": [1, 2, 3, 4] * 10, "g": ["a"] * 40,
+                          "v": list(range(40))}, fact_schema,
+                         partitions=2, rows_per_batch=8)
+    dim = s.from_pydict({"k": [1, 2, 9], "name": ["x", "y", "z"]},
+                        dim_schema)
+    out = fact.join(dim, on="k", how="right")
+    dev = sorted(out.collect(), key=str)
+    ov, meta = out._overridden(quiet=True)
+    host = sorted(_ch(meta.exec_node, s.conf), key=str)
+    assert dev == host
+    # k=9 is unmatched: null-extended fact side, dim columns present
+    assert (None, None, None, 9, "z") in dev
+    # every matched row keeps fact columns aligned (g is the string)
+    matched = [r for r in dev if r[0] is not None]
+    assert all(r[1] == "a" and r[4] in ("x", "y") for r in matched)
+    assert len(matched) == 20
