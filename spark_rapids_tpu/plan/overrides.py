@@ -314,12 +314,35 @@ class TpuOverrides:
                                                      == "NOT_ON_TPU"))
             if text:
                 print(text)
-        if root.backend == "device":
-            return root.exec_node
+        if self.conf.test_enabled:
+            self._assert_on_tpu(root)
         return root.exec_node
 
     def root_backend(self, root: PlannedNode) -> str:
         return root.backend
+
+    def _assert_on_tpu(self, meta: PlannedNode) -> None:
+        """Test mode (spark.rapids.sql.test.enabled): the WHOLE plan
+        must run on the device, except exec names listed in
+        spark.rapids.sql.test.allowedNonTpu (reference
+        GpuTransitionOverrides.assertIsOnTheGpu, :322-367)."""
+        from spark_rapids_tpu.conf import TEST_ALLOWED_NONTPU
+        allowed = {n.strip() for n in
+                   self.conf.get(TEST_ALLOWED_NONTPU).split(",")
+                   if n.strip()}
+        bad = []
+
+        def walk(m: PlannedNode):
+            if m.backend != "device" and m.name not in allowed:
+                bad.append(f"{m.name}: {'; '.join(m.reasons) or 'host'}")
+            for ch in m.children:
+                walk(ch)
+
+        walk(meta)
+        if bad:
+            raise AssertionError(
+                "plan is not fully on the TPU (spark.rapids.sql.test."
+                "enabled):\n  " + "\n  ".join(bad))
 
     # -- tagging -------------------------------------------------------
     def _tag(self, meta: PlannedNode) -> None:

@@ -313,6 +313,10 @@ class DataFrame:
             ov._tag(meta)
             ov._insert_coalesce(meta)
             ov._insert_transitions(meta)
+            if self._s.conf.test_enabled:
+                # quiet path (cache/explain/internal) must not bypass
+                # test-mode's on-device assertion
+                ov._assert_on_tpu(meta)
         else:
             ov.apply(meta)
         return ov, meta

@@ -179,3 +179,31 @@ def test_profile_trace_dir(tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert any(f.endswith(".xplane.pb") or "trace" in f for f in found), found
+
+
+def test_assert_on_tpu_test_mode():
+    """spark.rapids.sql.test.enabled asserts the whole plan is on the
+    device (reference assertIsOnTheGpu, GpuTransitionOverrides:322-367);
+    allowedNonTpu whitelists named execs."""
+    schema = T.Schema([T.StructField("v", T.LongType())])
+    data = {"v": list(range(20))}
+
+    # fully-on-device plan passes
+    s = TpuSession({"spark.rapids.sql.test.enabled": True})
+    assert len(s.from_pydict(data, schema).where(
+        col("v") > 5).collect()) == 14
+
+    # a disabled exec forces host fallback -> assertion fires
+    s2 = TpuSession({"spark.rapids.sql.test.enabled": True,
+                     "spark.rapids.sql.exec.FilterExec": False})
+    df2 = s2.from_pydict(data, schema).where(col("v") > 5)
+    with pytest.raises(AssertionError, match="FilterExec"):
+        df2.collect()
+
+    # ...unless whitelisted
+    s3 = TpuSession({"spark.rapids.sql.test.enabled": True,
+                     "spark.rapids.sql.exec.FilterExec": False,
+                     "spark.rapids.sql.test.allowedNonTpu":
+                         "FilterExec, LocalScanExec"})
+    assert len(s3.from_pydict(data, schema).where(
+        col("v") > 5).collect()) == 14
