@@ -70,6 +70,13 @@ TCP_INFLIGHT_LIMIT = register(ConfEntry(
     "so both endpoints always use the same window (reference "
     "inflight-bytes throttle, UCXShuffleTransport.scala:365-391).",
     conv=parse_bytes))
+TCP_TIMEOUT = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.timeoutSeconds", 120,
+    "Socket timeout for shuffle fetches: a wedged peer raises "
+    "ShuffleFetchError instead of hanging the reduce task forever "
+    "(reference: fetch timeout via spark.network.timeout, "
+    "GpuShuffleEnv.scala:60-62, propagated through "
+    "RapidsShuffleIterator).", conv=float))
 
 _LEN = struct.Struct(">Q")
 _TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
@@ -228,23 +235,38 @@ class TcpShuffleTransport(LocalShuffleTransport):
         return fetch_remote(address, shuffle_id, part_id, lo=lo, hi=hi,
                             device=device,
                             inflight_limit=self.conf.get(TCP_INFLIGHT_LIMIT),
-                            max_frame=_max_frame(self.conf))
+                            max_frame=_max_frame(self.conf),
+                            timeout=self.conf.get(TCP_TIMEOUT))
 
     def close(self) -> None:
         self._server.close()
         super().close()
 
 
-def remote_partition_sizes(address, shuffle_id: int) -> tuple[dict, dict]:
+def _resolve_timeout(timeout: float | None) -> float | None:
+    """None -> conf default; 0 -> no timeout (blocking), the usual
+    convention for disabling it."""
+    t = TCP_TIMEOUT.default if timeout is None else float(timeout)
+    return t if t > 0 else None
+
+
+def remote_partition_sizes(address, shuffle_id: int,
+                           timeout: float | None = None) -> tuple[dict, dict]:
     """Metadata plane: (partition_sizes, batch_sizes) from a peer
-    (reference MetadataRequest/Response flatbuffer RPC)."""
-    with socket.create_connection(tuple(address)) as sock:
-        _send_frame(sock, _TAG_JSON, json.dumps(
-            {"op": "meta", "shuffle_id": shuffle_id}).encode())
-        tag, body = _recv_frame(sock)
-        if tag == _TAG_ERROR:
-            raise ShuffleFetchError(body.decode())
-        meta = json.loads(body.decode())
+    (reference MetadataRequest/Response flatbuffer RPC).  A wedged peer
+    raises ShuffleFetchError after ``timeout`` seconds."""
+    tmo = _resolve_timeout(timeout)
+    try:
+        with socket.create_connection(tuple(address), timeout=tmo) as sock:
+            _send_frame(sock, _TAG_JSON, json.dumps(
+                {"op": "meta", "shuffle_id": shuffle_id}).encode())
+            tag, body = _recv_frame(sock)
+    except TimeoutError as e:
+        raise ShuffleFetchError(
+            f"metadata fetch from {address} stalled past {tmo}s") from e
+    if tag == _TAG_ERROR:
+        raise ShuffleFetchError(body.decode())
+    meta = json.loads(body.decode())
     return ({int(k): v for k, v in meta["sizes"].items()},
             {int(k): v for k, v in meta["batch_sizes"].items()})
 
@@ -252,34 +274,46 @@ def remote_partition_sizes(address, shuffle_id: int) -> tuple[dict, dict]:
 def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
                  hi: int | None = None, device: bool = True,
                  inflight_limit: int | None = None,
-                 max_frame: int = _MAX_FRAME_MIN) -> Iterable:
+                 max_frame: int = _MAX_FRAME_MIN,
+                 timeout: float | None = None) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
     (reference RapidsShuffleClient.scala: TransferRequest -> bounce
     buffers -> reassembled device buffers).  The wire codec comes from
-    the server's response header — never assumed by the client."""
+    the server's response header — never assumed by the client.  A peer
+    that stalls past ``timeout`` seconds (connect, send, or receive)
+    raises ShuffleFetchError instead of wedging the reduce task;
+    timeout=0 disables the deadline."""
     window = int(inflight_limit or TCP_INFLIGHT_LIMIT.default)
-    with socket.create_connection(tuple(address)) as sock:
-        _send_frame(sock, _TAG_JSON, json.dumps(
-            {"op": "fetch", "shuffle_id": shuffle_id, "part_id": part_id,
-             "lo": lo, "hi": hi, "window": window}).encode())
-        tag, body = _recv_frame(sock)
-        if tag == _TAG_ERROR:
-            raise ShuffleFetchError(body.decode())
-        if tag != _TAG_JSON:
-            raise ShuffleFetchError(f"bad fetch header tag {tag!r}")
-        codec = get_codec(json.loads(body.decode()).get("codec", "none"))
-        recv_window = 0
-        while True:
-            tag, frame = _recv_frame(sock, max_frame)
-            if tag == _TAG_END:
-                return
+    tmo = _resolve_timeout(timeout)
+    try:
+        with socket.create_connection(tuple(address), timeout=tmo) as sock:
+            _send_frame(sock, _TAG_JSON, json.dumps(
+                {"op": "fetch", "shuffle_id": shuffle_id,
+                 "part_id": part_id, "lo": lo, "hi": hi,
+                 "window": window}).encode())
+            tag, body = _recv_frame(sock)
             if tag == _TAG_ERROR:
-                raise ShuffleFetchError(frame.decode())
-            recv_window += len(frame)
-            if recv_window >= window:
-                _send_frame(sock, _TAG_JSON, b"{}")
-                recv_window = 0
-            if codec is not None:
-                (raw_size,) = struct.unpack(">I", frame[:4])
-                frame = codec.decompress(frame[4:], raw_size)
-            yield deserialize_batch(frame, device=device)
+                raise ShuffleFetchError(body.decode())
+            if tag != _TAG_JSON:
+                raise ShuffleFetchError(f"bad fetch header tag {tag!r}")
+            codec = get_codec(json.loads(body.decode()).get("codec",
+                                                            "none"))
+            recv_window = 0
+            while True:
+                tag, frame = _recv_frame(sock, max_frame)
+                if tag == _TAG_END:
+                    return
+                if tag == _TAG_ERROR:
+                    raise ShuffleFetchError(frame.decode())
+                recv_window += len(frame)
+                if recv_window >= window:
+                    _send_frame(sock, _TAG_JSON, b"{}")
+                    recv_window = 0
+                if codec is not None:
+                    (raw_size,) = struct.unpack(">I", frame[:4])
+                    frame = codec.decompress(frame[4:], raw_size)
+                yield deserialize_batch(frame, device=device)
+    except TimeoutError as e:
+        raise ShuffleFetchError(
+            f"fetch of shuffle {shuffle_id} part {part_id} from "
+            f"{address} stalled past {tmo}s") from e

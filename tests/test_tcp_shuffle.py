@@ -202,3 +202,24 @@ def test_tcp_window_negotiated_from_client():
             assert sorted(r[0] for r in got) == list(range(240))
         finally:
             t.close()
+
+
+def test_tcp_fetch_timeout_on_stalled_peer():
+    """A peer that accepts the connection but never responds raises
+    ShuffleFetchError within the timeout, not a forever-hang (reference
+    fetch timeout, spark.network.timeout via RapidsShuffleIterator)."""
+    import socket as _socket
+    import time
+    from spark_rapids_tpu.shuffle.tcp import ShuffleFetchError
+
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = srv.getsockname()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ShuffleFetchError, match="stalled"):
+            list(fetch_remote(addr, 1, 0, timeout=1.5))
+        assert time.monotonic() - t0 < 30
+    finally:
+        srv.close()
