@@ -20,14 +20,30 @@ import time
 __all__ = ["run_benchmark"]
 
 
-def _collect_rows(df, backend: str, plan=None):
-    from spark_rapids_tpu.exec.core import collect_device, collect_host
+def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None):
+    from spark_rapids_tpu.exec.core import (ExecCtx, collect_device,
+                                            collect_host, device_to_host,
+                                            _rows_from_host)
     if plan is None:
         ov, meta = df._overridden(quiet=True)
         plan = meta.exec_node
-    if backend == "host":
-        return collect_host(plan, df._s.conf)
-    return collect_device(plan, df._s.conf)
+    if metrics_out is None:
+        if backend == "host":
+            return collect_host(plan, df._s.conf)
+        return collect_device(plan, df._s.conf)
+    # metrics-capturing run (reference BenchUtils JSON reports include
+    # per-exec SQL metrics, docs/benchmarks.md:149-163)
+    with ExecCtx(backend=backend, conf=df._s.conf) as ctx:
+        out = []
+        for b in plan.execute(ctx):
+            hb = device_to_host(b) if backend == "device" else b
+            out.extend(_rows_from_host(hb))
+        for key, m in ctx.metrics.items():
+            name = key.split("@")[0]
+            agg = metrics_out.setdefault(name, {})
+            for k, v in m.values.items():
+                agg[k] = round(agg.get(k, 0.0) + v, 4)
+        return out
 
 
 def _plan_of(df):
@@ -77,14 +93,21 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
             # a fresh expression tree per run
             df = build_query(name, session, data_dir)
             plan = _plan_of(df)
-            for _ in range(max(1, iterations)):
+            metrics: dict = {}
+            for it in range(max(1, iterations)):
                 t0 = time.perf_counter()
-                rows = _collect_rows(df, "device", plan)
+                # last iteration captures per-operator metrics + plan
+                # (reference BenchmarkRunner JSON reports)
+                rows = _collect_rows(
+                    df, "device", plan,
+                    metrics_out=metrics if it == iterations - 1 else None)
                 times.append(time.perf_counter() - t0)
             times.sort()
             rec["device_s"] = round(times[len(times) // 2], 4)
             rec["device_s_all"] = [round(t, 4) for t in times]
             rec["rows"] = len(rows)
+            rec["plan"] = plan.tree_string().strip().splitlines()
+            rec["metrics"] = metrics
             if verify:
                 t0 = time.perf_counter()
                 oracle = _collect_rows(df, "host", plan)
