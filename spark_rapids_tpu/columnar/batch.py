@@ -123,6 +123,10 @@ class ColumnBatch:
                 w = (string_widths or {}).get(field.name)
                 bm, lens = _strings_to_matrix(arr, w)
                 cols.append(DeviceColumn.strings_from_numpy(bm, lens, validity, cap))
+            elif isinstance(field.data_type, T.ArrayType):
+                m, lens = _lists_to_matrix(arr, field.data_type)
+                cols.append(DeviceColumn.arrays_from_numpy(
+                    m, lens, validity, cap, field.data_type))
             else:
                 data = T.arrow_fixed_to_numpy(arr, field.data_type)
                 cols.append(DeviceColumn.from_numpy(data, validity, field.data_type, cap))
@@ -158,6 +162,12 @@ class ColumnBatch:
                 py = [None if not v[i] else bytes(bm[i, :lens[i]]).decode("utf-8", "replace")
                       for i in range(n)]
                 arrays.append(pa.array(py, type=pa.string()))
+            elif isinstance(field.data_type, T.ArrayType):
+                m = np.asarray(data[:n])
+                lens = np.asarray(lengths[:n])
+                py = [None if not v[i] else m[i, :lens[i]].tolist()
+                      for i in range(n)]
+                arrays.append(pa.array(py, type=T.to_arrow(field.data_type)))
             else:
                 d = np.asarray(data[:n])
                 at = T.to_arrow(field.data_type)
@@ -180,6 +190,40 @@ class ColumnBatch:
             if c.lengths is not None:
                 total += c.lengths.size * 4
         return total
+
+
+def _lists_to_matrix(arr, dtype):
+    """Arrow list array -> (elem[n, w] padded matrix, int32[n] lengths).
+    Same static-shape layout as strings; element nulls are rejected
+    (they have no device representation — such columns stay on host)."""
+    import pyarrow as pa
+    arr = arr.cast(pa.large_list(T.to_arrow(dtype.element_type)))
+    n = len(arr)
+    offsets = np.frombuffer(arr.buffers()[1], dtype=np.int64, count=n + 1,
+                            offset=arr.offset * 8)
+    # trim values to THIS slice's offset window — .values spans the
+    # whole child buffer and would reject element nulls outside the
+    # slice; slicing (not flatten) keeps offset alignment even if a
+    # null list row had a nonzero offset span
+    values = arr.values.slice(int(offsets[0]),
+                              int(offsets[-1] - offsets[0]))
+    if values.null_count:
+        raise ValueError("arrays with null elements have no device "
+                         "representation")
+    offsets = offsets - offsets[0]
+    flat = T.arrow_fixed_to_numpy(values, dtype.element_type)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if arr.null_count:
+        valid = np.asarray(arr.is_valid(), dtype=np.bool_)
+        lens = np.where(valid, lens, 0)
+    maxw = int(lens.max()) if n else 0
+    w = round_string_width(max(maxw, 1))
+    out = np.zeros((n, w), dtype=dtype.np_dtype)
+    if n and flat.size:
+        pos = offsets[:-1, None] + np.arange(w, dtype=np.int64)[None, :]
+        mask = np.arange(w, dtype=np.int32)[None, :] < lens[:, None]
+        out[mask] = flat[np.minimum(pos[mask], flat.size - 1)]
+    return out, lens
 
 
 def _strings_to_matrix(arr, width: int | None = None):

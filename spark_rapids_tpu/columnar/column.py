@@ -78,8 +78,18 @@ class DeviceColumn:
         return isinstance(self.dtype, T.StringType)
 
     @property
+    def is_array(self) -> bool:
+        return isinstance(self.dtype, T.ArrayType)
+
+    @property
+    def is_var_width(self) -> bool:
+        """Matrix-layout column (strings, arrays): data[capacity,
+        max_len] + lengths[capacity]."""
+        return self.lengths is not None
+
+    @property
     def max_len(self) -> int:
-        assert self.is_string
+        assert self.is_var_width
         return self.data.shape[1]
 
     def with_validity(self, validity: jax.Array) -> "DeviceColumn":
@@ -101,6 +111,27 @@ class DeviceColumn:
         # zero out null slots for deterministic padding semantics
         dfull[:n][~validity] = 0
         return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull), dtype)
+
+    @staticmethod
+    def arrays_from_numpy(matrix: np.ndarray, lengths: np.ndarray,
+                          validity: np.ndarray | None, capacity: int,
+                          dtype: T.ArrayType) -> "DeviceColumn":
+        """Array column from a padded [n, max_len] element matrix."""
+        n = matrix.shape[0]
+        width = matrix.shape[1] if matrix.ndim == 2 else 1
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vfull = np.zeros(capacity, dtype=np.bool_)
+        vfull[:n] = validity
+        dfull = np.zeros((capacity, width), dtype=dtype.np_dtype)
+        lfull = np.zeros(capacity, dtype=np.int32)
+        if n:
+            dfull[:n] = matrix
+            lfull[:n] = lengths
+            dfull[:n][~validity] = 0
+            lfull[:n][~validity] = 0
+        return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
+                            dtype, jnp.asarray(lfull))
 
     @staticmethod
     def strings_from_numpy(byte_matrix: np.ndarray, lengths: np.ndarray,

@@ -61,6 +61,9 @@ class HashAggregateExec(PlanNode):
 
         self._group_bound = [bind(_strip_alias(g), child_schema)
                              for g in group_exprs]
+        for g in self._group_bound:
+            if isinstance(g.dtype, T.ArrayType):
+                raise ValueError("cannot group by an array column")
         self._group_names = [output_name(g) for g in group_exprs]
         self._result_raw = list(result_exprs)
         self._result_bound = [bind(r, child_schema) for r in self._result_raw]

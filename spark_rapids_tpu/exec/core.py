@@ -465,6 +465,13 @@ def device_to_host(b: ColumnBatch) -> HostBatch:
                 py[i] = bytes(bm[i, :ln[i]]).decode("utf-8", "replace") \
                     if v[i] else None
             cols.append(HostColumn(py, v, f.data_type))
+        elif isinstance(f.data_type, T.ArrayType):
+            m = np.asarray(data[:n])
+            ln = np.asarray(lengths[:n])
+            py = np.empty(n, dtype=object)
+            for i in range(n):
+                py[i] = m[i, :ln[i]].tolist() if v[i] else None
+            cols.append(HostColumn(py, v, f.data_type))
         else:
             cols.append(HostColumn(np.asarray(data[:n]), v, f.data_type))
     return HostBatch(cols, b.schema)
@@ -493,6 +500,17 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
                 lens[i] = len(e)
             cols.append(DeviceColumn.strings_from_numpy(
                 bm, lens, col.validity, cap))
+        elif isinstance(f.data_type, T.ArrayType):
+            vals = [(v if v is not None else []) for v in col.data]
+            maxw = max((len(v) for v in vals), default=1)
+            w = round_string_width(max(maxw, 1))
+            m = np.zeros((n, w), dtype=f.data_type.np_dtype)
+            lens = np.zeros(n, dtype=np.int32)
+            for i, v in enumerate(vals):
+                m[i, :len(v)] = v
+                lens[i] = len(v)
+            cols.append(DeviceColumn.arrays_from_numpy(
+                m, lens, col.validity, cap, f.data_type))
         else:
             cols.append(DeviceColumn.from_numpy(
                 col.data, col.validity, f.data_type, cap))

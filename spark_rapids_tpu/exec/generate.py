@@ -5,14 +5,13 @@ generator emits 0..n output rows; the child columns are repeated per
 generated row, optionally with a position column, and ``outer`` keeps
 rows whose generator yields nothing (null-extended).
 
-The engine's columnar layer has no standalone array column type (scans
-produce scalars + strings), so the canonical array producer here is
-``split(string, delimiter)`` — the generator is fused: ``SplitExplode``
-splits and explodes in one device program.  TPU design: delimiter
-positions come from a cumulative-sum over the padded byte matrix, output
-row -> (source row, piece index) via the same offsets/searchsorted plan
-as the join gather, and piece bytes are sliced with take_along_axis —
-all static shapes, one host sync for the output total.
+Two generators: ``Explode`` over real ArrayType columns (padded element
+matrix + lengths, columnar/column.py) and the fused ``SplitExplode`` =
+explode(split(string, delimiter)) in one device program.  TPU design:
+per-row counts (array lengths / delimiter cumulative-sums over the
+padded byte matrix), output row -> (source row, element index) via the
+same offsets/searchsorted plan as the join gather — all static shapes,
+one host sync for the output total.
 """
 from __future__ import annotations
 
@@ -58,6 +57,80 @@ class SplitExplode(Expression):
 
     def __repr__(self):
         return f"SplitExplode({self.children[0]!r}, {self.delimiter!r})"
+
+
+class Explode(Expression):
+    """Generator: explode(array_col) (reference GpuGenerateExec explode
+    over LIST columns)."""
+
+    sql_name = "Explode"
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        at = self.children[0].dtype
+        assert isinstance(at, T.ArrayType), at
+        return at.element_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def with_new_children(self, children):
+        return Explode(children[0])
+
+    def __repr__(self):
+        return f"Explode({self.children[0]!r})"
+
+
+@partial(jax.jit, static_argnames=("out_cap", "pos_col", "outer"))
+def _jit_generate_array(batch: ColumnBatch, col: DeviceColumn,
+                        out_cap: int, pos_col: bool, outer: bool):
+    """Explode an array column: one output row per element, child
+    columns gathered per output row + [pos] + element column."""
+    cap = batch.capacity
+    w = col.max_len
+    real = batch.row_mask()
+    counts = jnp.where(col.validity & real, col.lengths, 0)
+    emit = jnp.maximum(counts, 1) if outer else counts
+    emit = jnp.where(real, emit, 0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(emit)[:-1].astype(jnp.int32)])
+    total = jnp.sum(emit, dtype=jnp.int32)
+
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    in_range = j < total
+    src = (jnp.searchsorted(offsets, j, side="right") - 1).astype(jnp.int32)
+    src = jnp.clip(src, 0, cap - 1)
+    k = j - offsets[src]
+    has_elem = in_range & (k < counts[src])
+
+    kc = jnp.clip(k, 0, w - 1)
+    # fused single-element gather: col.data[src, kc] avoids
+    # materializing the [out_cap, w] row-gather intermediate
+    elem = col.data[src, kc]
+    elem = jnp.where(has_elem, elem, jnp.zeros((), col.data.dtype))
+    elem_col = DeviceColumn(elem, has_elem, col.dtype.element_type)
+
+    out_cols = []
+    for c in batch.columns:
+        v = c.validity[src] & in_range
+        if c.is_var_width:
+            out_cols.append(DeviceColumn(
+                jnp.where(v[:, None], c.data[src], 0), v, c.dtype,
+                jnp.where(v, c.lengths[src], 0)))
+        else:
+            out_cols.append(DeviceColumn(
+                jnp.where(v, c.data[src], jnp.zeros((), c.data.dtype)),
+                v, c.dtype))
+    if pos_col:
+        out_cols.append(DeviceColumn(
+            jnp.where(has_elem, k.astype(jnp.int32), 0), has_elem,
+            T.IntegerType()))
+    out_cols.append(elem_col)
+    return out_cols, total
 
 
 @partial(jax.jit, static_argnames=())
@@ -150,20 +223,26 @@ class GenerateExec(PlanNode):
                  outer: bool = False, pos: bool = False,
                  output_names=("col",)):
         super().__init__([child])
-        assert isinstance(generator, SplitExplode), \
-            "only SplitExplode generators are supported"
+        assert isinstance(generator, (SplitExplode, Explode)), \
+            "only SplitExplode/Explode generators are supported"
         self.generator = generator
         self.outer = outer
         self.pos = pos
         self._gen_bound = bind(generator.children[0], child.output_schema)
-        assert isinstance(self._gen_bound.dtype, T.StringType), \
-            "SplitExplode input must be a string"
+        if isinstance(generator, SplitExplode):
+            assert isinstance(self._gen_bound.dtype, T.StringType), \
+                "SplitExplode input must be a string"
+            out_dtype = T.StringType()
+        else:
+            assert isinstance(self._gen_bound.dtype, T.ArrayType), \
+                "Explode input must be an array"
+            out_dtype = self._gen_bound.dtype.element_type
         names = list(output_names)
         fields = list(child.output_schema.fields)
         if pos:
             fields.append(T.StructField(
                 names[0] if len(names) > 1 else "pos", T.IntegerType(), True))
-        fields.append(T.StructField(names[-1], T.StringType(), True))
+        fields.append(T.StructField(names[-1], out_dtype, True))
         self._schema = T.Schema(fields)
 
     @property
@@ -176,28 +255,45 @@ class GenerateExec(PlanNode):
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child_it = self.children[0].partition_iter(ctx, pid)
-        delim = self.generator.delimiter.encode("utf-8")[0]
-        if ctx.is_device:
+        if not ctx.is_device:
+            for b in child_it:
+                yield self._host_generate(b)
+            return
+        if isinstance(self.generator, Explode):
             for b in child_it:
                 gcol = self._eval_jit()(b)
                 real = b.row_mask()
-                counts, total_d = _jit_counts(gcol, real, delim)
+                counts = jnp.where(gcol.validity & real, gcol.lengths, 0)
                 if self.outer:
-                    total = int(jax.device_get(
-                        jnp.sum(jnp.where(real, jnp.maximum(counts, 1), 0),
-                                dtype=jnp.int64)))
-                else:
-                    total = int(jax.device_get(total_d))
+                    counts = jnp.where(real, jnp.maximum(counts, 1), 0)
+                total = int(jax.device_get(
+                    jnp.sum(counts, dtype=jnp.int64)))
                 if total == 0:
                     continue
                 out_cap = round_capacity(total)
                 cols, tot = ctx.dispatch(
-                    _jit_generate, b, gcol, counts, delim, out_cap,
-                    self.pos, self.outer)
+                    _jit_generate_array, b, gcol, out_cap, self.pos,
+                    self.outer)
                 yield ColumnBatch(cols, tot, self._schema)
-        else:
-            for b in child_it:
-                yield self._host_generate(b)
+            return
+        delim = self.generator.delimiter.encode("utf-8")[0]
+        for b in child_it:
+            gcol = self._eval_jit()(b)
+            real = b.row_mask()
+            counts, total_d = _jit_counts(gcol, real, delim)
+            if self.outer:
+                total = int(jax.device_get(
+                    jnp.sum(jnp.where(real, jnp.maximum(counts, 1), 0),
+                            dtype=jnp.int64)))
+            else:
+                total = int(jax.device_get(total_d))
+            if total == 0:
+                continue
+            out_cap = round_capacity(total)
+            cols, tot = ctx.dispatch(
+                _jit_generate, b, gcol, counts, delim, out_cap,
+                self.pos, self.outer)
+            yield ColumnBatch(cols, tot, self._schema)
 
     def _eval_jit(self):
         if not hasattr(self, "_gen_jit"):
@@ -206,6 +302,7 @@ class GenerateExec(PlanNode):
 
     def _host_generate(self, b: HostBatch) -> HostBatch:
         gv = eval_host(self._gen_bound, b)
+        is_array = isinstance(self.generator, Explode)
         src_idx, poss, pieces = [], [], []
         for i in range(b.num_rows):
             if not gv.validity[i]:
@@ -214,7 +311,15 @@ class GenerateExec(PlanNode):
                     poss.append(None)
                     pieces.append(None)
                 continue
-            parts = str(gv.data[i]).split(self.generator.delimiter)
+            if is_array:
+                parts = list(gv.data[i])
+                if not parts and self.outer:
+                    src_idx.append(i)
+                    poss.append(None)
+                    pieces.append(None)
+                    continue
+            else:
+                parts = str(gv.data[i]).split(self.generator.delimiter)
             for k, p in enumerate(parts):
                 src_idx.append(i)
                 poss.append(k)
@@ -230,10 +335,17 @@ class GenerateExec(PlanNode):
             pd = np.asarray([0 if p is None else p for p in poss], np.int32)
             cols.append(HostColumn(pd, pv, T.IntegerType()))
         sv = np.asarray([p is not None for p in pieces], np.bool_)
-        sd = np.empty(len(pieces), dtype=object)
-        for i, p in enumerate(pieces):
-            sd[i] = p
-        cols.append(HostColumn(sd, sv, T.StringType()))
+        out_dtype = self._schema.fields[-1].data_type
+        if isinstance(out_dtype, T.StringType):
+            sd = np.empty(len(pieces), dtype=object)
+            for i, p in enumerate(pieces):
+                sd[i] = p
+        else:
+            sd = np.zeros(len(pieces), dtype=out_dtype.np_dtype)
+            for i, p in enumerate(pieces):
+                if p is not None:
+                    sd[i] = p
+        cols.append(HostColumn(sd, sv, out_dtype))
         return HostBatch(cols, self._schema)
 
     def node_desc(self) -> str:

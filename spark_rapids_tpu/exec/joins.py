@@ -121,6 +121,8 @@ class JoinExec(PlanNode):
             if type(a.dtype) is not type(b.dtype):
                 raise ValueError(f"join key type mismatch: {a.dtype} vs "
                                  f"{b.dtype} (planner must insert casts)")
+            if isinstance(a.dtype, T.ArrayType):
+                raise ValueError("cannot join on an array column")
         self.include_right = join_type not in ("semi", "anti")
 
         lf = list(left.output_schema.fields)
@@ -338,9 +340,13 @@ class JoinExec(PlanNode):
                 null_cols = []
                 for f in left_fields:
                     validity = jnp.zeros(cap, jnp.bool_)
-                    if isinstance(f.data_type, T.StringType):
+                    if isinstance(f.data_type,
+                                  (T.StringType, T.ArrayType)):
+                        elem = np.uint8 if isinstance(
+                            f.data_type, T.StringType) \
+                            else f.data_type.np_dtype
                         null_cols.append(DeviceColumn(
-                            jnp.zeros((cap, 1), jnp.uint8), validity,
+                            jnp.zeros((cap, 1), elem), validity,
                             f.data_type, jnp.zeros(cap, jnp.int32)))
                     else:
                         null_cols.append(DeviceColumn(

@@ -42,6 +42,8 @@ def resolve_orders(orders: Sequence, schema: T.Schema) -> list[SortOrder]:
             idx = schema.index_of(name)
         asc = rest[0] if rest else True
         nf = rest[1] if len(rest) > 1 else None
+        if isinstance(schema.fields[idx].data_type, T.ArrayType):
+            raise ValueError("cannot sort by an array column")
         out.append(SortOrder(idx, asc, nf))
     return out
 

@@ -15,6 +15,7 @@ from spark_rapids_tpu.expr.core import (
 from spark_rapids_tpu.expr import arithmetic, predicates, conditional, cast  # noqa: F401
 from spark_rapids_tpu.expr import strings, datetime_ops, math_ops, hashing  # noqa: F401
 from spark_rapids_tpu.expr import aggregates, null_ops, regexp, misc  # noqa: F401
+from spark_rapids_tpu.expr import collections  # noqa: F401
 
 __all__ = [
     "Expression", "Literal", "BoundReference", "UnresolvedAttribute", "Alias",

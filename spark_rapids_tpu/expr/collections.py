@@ -1,0 +1,119 @@
+"""Array (collection) expressions over ArrayType columns.
+
+Reference: complexTypeExtractors — GetArrayItem / GetMapValue
+(SURVEY §2.4, sql-plugin complexTypeExtractors) plus the collection
+functions Spark exposes (size, array_contains).  Device arrays are
+padded element matrices + lengths (columnar/column.py), so every op
+here is a dense vectorized kernel — a row-indexed gather
+(element_at), a length read (size), or a masked any-compare
+(array_contains).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression
+
+__all__ = ["GetArrayItem", "Size", "ArrayContains"]
+
+
+class GetArrayItem(Expression):
+    """arr[index] (0-based ordinal, Spark GetArrayItem semantics):
+    null when the input is null, the index is null, or out of range."""
+
+    sql_name = "GetArrayItem"
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    @property
+    def dtype(self):
+        at = self.children[0].dtype
+        assert isinstance(at, T.ArrayType), at
+        return at.element_type
+
+    def _eval(self, vals, ctx):
+        arr, idx = vals
+        elem = self.dtype
+        if not ctx.is_device:
+            n = ctx.capacity
+            out = np.zeros(n, dtype=elem.np_dtype)
+            validity = np.zeros(n, dtype=np.bool_)
+            for i in range(n):
+                if not (arr.validity[i] and idx.validity[i]):
+                    continue
+                j = int(idx.data[i])
+                a = arr.data[i]
+                if 0 <= j < len(a):
+                    out[i] = a[j]
+                    validity[i] = True
+            return ctx.canonical(out, validity, elem)
+        xp = ctx.xp
+        w = arr.data.shape[1]
+        j = idx.data.astype(np.int32)
+        in_range = (j >= 0) & (j < arr.lengths)
+        validity = arr.validity & idx.validity & in_range
+        jc = xp.clip(j, 0, w - 1)
+        picked = xp.take_along_axis(arr.data, jc[:, None], axis=1)[:, 0]
+        data = xp.where(validity, picked, xp.zeros((), arr.data.dtype))
+        return ctx.canonical(data, validity, elem)
+
+
+class Size(Expression):
+    """size(arr): element count; Spark's legacy default returns -1 for
+    null input (spark.sql.legacy.sizeOfNull, the 3.0 default the
+    reference runs under)."""
+
+    sql_name = "Size"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.IntegerType()
+
+    def _eval(self, vals, ctx):
+        a = vals[0]
+        if not ctx.is_device:
+            data = np.array([len(v) if ok else -1
+                             for v, ok in zip(a.data, a.validity)], np.int32)
+            return ctx.canonical(data, np.ones(ctx.capacity, np.bool_),
+                                 T.IntegerType())
+        xp = ctx.xp
+        data = xp.where(a.validity, a.lengths, -1).astype(np.int32)
+        validity = xp.ones(ctx.capacity, bool)
+        return ctx.canonical(data, validity, T.IntegerType())
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value): value is a literal-evaluable child;
+    null input array -> null (value nulls likewise)."""
+
+    sql_name = "ArrayContains"
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    @property
+    def dtype(self):
+        return T.BooleanType()
+
+    def _eval(self, vals, ctx):
+        arr, val = vals
+        if not ctx.is_device:
+            n = ctx.capacity
+            data = np.zeros(n, dtype=np.bool_)
+            validity = arr.validity & val.validity
+            for i in range(n):
+                if validity[i]:
+                    data[i] = val.data[i] in arr.data[i]
+            return ctx.canonical(data, validity, T.BooleanType())
+        xp = ctx.xp
+        w = arr.data.shape[1]
+        in_len = xp.arange(w, dtype=np.int32)[None, :] < arr.lengths[:, None]
+        hit = xp.any((arr.data == val.data[:, None]) & in_len, axis=1)
+        validity = arr.validity & val.validity
+        data = xp.where(validity, hit, False)
+        return ctx.canonical(data, validity, T.BooleanType())

@@ -15,6 +15,9 @@ __all__ = ["If", "CaseWhen", "Coalesce"]
 def _select(pred_data, t: Val, f: Val, dtype, ctx: EvalCtx) -> Val:
     """where(pred, t, f) handling string matrices on device."""
     xp = ctx.xp
+    if isinstance(dtype, T.ArrayType):
+        raise ValueError("conditional selection over array columns is "
+                         "not supported")
     validity = xp.where(pred_data, t.validity, f.validity)
     if isinstance(dtype, T.StringType) and ctx.is_device:
         td, fd = t.data, f.data

@@ -97,11 +97,12 @@ class EvalCtx:
     def canonical(self, data, validity, dtype: T.DataType, lengths=None) -> Val:
         """Zero data at invalid slots (padding discipline, see columnar/)."""
         xp = self.xp
-        if isinstance(dtype, T.StringType) and self.is_device:
+        var_width = isinstance(dtype, (T.StringType, T.ArrayType))
+        if var_width and self.is_device:
             data = xp.where(validity[:, None], data, 0)
             lengths = xp.where(validity, lengths, 0)
             return Val(data, validity, lengths, dtype)
-        if isinstance(dtype, T.StringType):
+        if var_width:
             return Val(data, validity, None, dtype)
         data = xp.where(validity, data, xp.zeros((), data.dtype))
         return Val(data, validity, None, dtype)

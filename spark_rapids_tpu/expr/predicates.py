@@ -22,6 +22,9 @@ __all__ = ["EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
 
 def compare_eq(a: Val, b: Val, ctx: EvalCtx):
     xp = ctx.xp
+    if isinstance(a.dtype, T.ArrayType):
+        raise ValueError("array comparisons are not supported; compare "
+                         "elements via GetArrayItem/ArrayContains")
     if a.is_string:
         return _string_eq(a, b, ctx)
     if a.dtype.fractional:
@@ -31,6 +34,9 @@ def compare_eq(a: Val, b: Val, ctx: EvalCtx):
 
 def compare_lt(a: Val, b: Val, ctx: EvalCtx):
     xp = ctx.xp
+    if isinstance(a.dtype, T.ArrayType):
+        raise ValueError("array comparisons are not supported; compare "
+                         "elements via GetArrayItem/ArrayContains")
     if a.is_string:
         return _string_lt(a, b, ctx)
     if a.dtype.fractional:

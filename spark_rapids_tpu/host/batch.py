@@ -53,6 +53,10 @@ class HostColumn:
         if isinstance(dtype, T.StringType):
             data = np.array([v if v is not None else None for v in values],
                             dtype=object)
+        elif isinstance(dtype, T.ArrayType):
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = None if v is None else list(v)
         else:
             npdt = dtype.np_dtype
             data = np.zeros(n, dtype=npdt)
@@ -88,6 +92,8 @@ class HostColumn:
                 out.append(None)
             elif self.is_string:
                 out.append(self.data[i])
+            elif isinstance(self.dtype, T.ArrayType):
+                out.append(list(self.data[i]))
             elif is_date:
                 out.append(_dt.date(1970, 1, 1)
                            + _dt.timedelta(days=int(self.data[i])))
@@ -161,6 +167,10 @@ class HostBatch:
             dt = field.data_type
             if isinstance(dt, T.StringType):
                 data = np.array(arr.to_pylist(), dtype=object)
+            elif isinstance(dt, T.ArrayType):
+                data = np.empty(n, dtype=object)
+                for j, v in enumerate(arr.to_pylist()):
+                    data[j] = v
             else:
                 data = T.arrow_fixed_to_numpy(arr, dt)
             cols.append(HostColumn(data, validity, dt))
@@ -175,6 +185,9 @@ class HostBatch:
             if c.is_string:
                 py = [None if m else v for v, m in zip(c.data, mask)]
                 arrays.append(pa.array(py, type=pa.string()))
+            elif isinstance(f.data_type, T.ArrayType):
+                py = [None if m else list(v) for v, m in zip(c.data, mask)]
+                arrays.append(pa.array(py, type=at))
             elif isinstance(f.data_type, (T.DateType, T.TimestampType)):
                 base = pa.array(c.data, mask=mask)
                 arrays.append(base.cast(at))
@@ -213,7 +226,7 @@ class HostBatch:
         cols = []
         for ci in range(batches[0].num_columns):
             parts = [b.columns[ci] for b in batches]
-            if parts[0].is_string:
+            if parts[0].data.dtype == object:
                 data = np.concatenate([p.data for p in parts]) if parts else \
                     np.zeros(0, object)
             else:
@@ -226,7 +239,7 @@ class HostBatch:
     def empty(schema: T.Schema) -> "HostBatch":
         cols = []
         for f in schema:
-            if isinstance(f.data_type, T.StringType):
+            if isinstance(f.data_type, (T.StringType, T.ArrayType)):
                 data = np.zeros(0, dtype=object)
             else:
                 data = np.zeros(0, dtype=f.data_type.np_dtype)

@@ -282,6 +282,10 @@ def _arrow_to_host(rb, schema: T.Schema):
         if isinstance(f.data_type, T.StringType):
             data = np.array([x if x is not None else None
                              for x in arr.to_pylist()], dtype=object)
+        elif isinstance(f.data_type, T.ArrayType):
+            data = np.empty(n, dtype=object)
+            for j, x in enumerate(arr.to_pylist()):
+                data[j] = x
         else:
             data = T.arrow_fixed_to_numpy(arr, f.data_type)
         cols.append(HostColumn(data, validity, f.data_type))

@@ -49,7 +49,7 @@ def _combined_key_column(lc: DeviceColumn, rc: DeviceColumn) -> DeviceColumn:
     to the max of the two)."""
     assert type(lc.dtype) is type(rc.dtype), (lc.dtype, rc.dtype)
     validity = jnp.concatenate([lc.validity, rc.validity])
-    if lc.is_string:
+    if lc.is_var_width:
         w = max(lc.max_len, rc.max_len)
         ld = jnp.pad(lc.data, ((0, 0), (0, w - lc.max_len)))
         rd = jnp.pad(rc.data, ((0, 0), (0, w - rc.max_len)))
@@ -273,7 +273,7 @@ def gather_join_output(lbatch: ColumnBatch, rbatch: ColumnBatch,
 
 def _take_side(c: DeviceColumn, idx, take) -> DeviceColumn:
     validity = c.validity[idx] & take
-    if c.is_string:
+    if c.is_var_width:
         data = jnp.where(validity[:, None], c.data[idx], 0)
         return DeviceColumn(data, validity, c.dtype,
                             jnp.where(validity, c.lengths[idx], 0))

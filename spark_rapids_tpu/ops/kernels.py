@@ -30,7 +30,7 @@ def _gather_column(col: DeviceColumn, perm: jax.Array,
     """Gather rows of ``col`` by ``perm`` then canonicalize padding by
     ``out_mask`` (bool[capacity], True = real row)."""
     validity = col.validity[perm] & out_mask
-    if col.is_string:
+    if col.is_var_width:
         data = jnp.where(validity[:, None], col.data[perm], 0)
         lengths = jnp.where(validity, col.lengths[perm], 0)
         return DeviceColumn(data, validity, col.dtype, lengths)
@@ -92,7 +92,7 @@ def shrink_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:
 def _shrink_jit(batch: ColumnBatch, cap: int) -> ColumnBatch:
     cols = []
     for c in batch.columns:
-        if c.is_string:
+        if c.is_var_width:
             cols.append(DeviceColumn(c.data[:cap], c.validity[:cap],
                                      c.dtype, c.lengths[:cap]))
         else:
@@ -114,9 +114,9 @@ def _pad_jit(batch: ColumnBatch, cap: int) -> ColumnBatch:
     cols = []
     for c in batch.columns:
         validity = jnp.concatenate([c.validity, jnp.zeros(pad, jnp.bool_)])
-        if c.is_string:
+        if c.is_var_width:
             data = jnp.concatenate(
-                [c.data, jnp.zeros((pad, c.max_len), jnp.uint8)])
+                [c.data, jnp.zeros((pad, c.max_len), c.data.dtype)])
             lengths = jnp.concatenate([c.lengths, jnp.zeros(pad, jnp.int32)])
             cols.append(DeviceColumn(data, validity, c.dtype, lengths))
         else:
@@ -152,14 +152,15 @@ def concat_batches(batches: Sequence[ColumnBatch],
     for ci in range(ncols):
         parts = [b.columns[ci] for b in batches]
         dtype = parts[0].dtype
-        if parts[0].is_string:
+        if parts[0].is_var_width:
             w = max(p.max_len for p in parts)
             datas = [jnp.pad(p.data, ((0, 0), (0, w - p.max_len))) for p in parts]
             data = jnp.concatenate(datas)
             lengths = jnp.concatenate([p.lengths for p in parts])
             validity = jnp.concatenate([p.validity for p in parts])
             if pad:
-                data = jnp.concatenate([data, jnp.zeros((pad, w), jnp.uint8)])
+                data = jnp.concatenate([data,
+                                        jnp.zeros((pad, w), data.dtype)])
                 lengths = jnp.concatenate([lengths, jnp.zeros(pad, jnp.int32)])
                 validity = jnp.concatenate([validity, jnp.zeros(pad, jnp.bool_)])
             validity = validity[perm] & out_mask

@@ -105,8 +105,9 @@ def sorted_group_by(batch: ColumnBatch, key_indices: list[int],
         pos = jnp.where(flag & real, seg_id, cap)  # scatter target (drop pad)
         validity = jnp.zeros(cap, jnp.bool_).at[pos].set(col.validity, mode="drop")
         validity = validity & out_mask
-        if col.is_string:
-            data = jnp.zeros((cap, col.max_len), jnp.uint8).at[pos].set(col.data, mode="drop")
+        if col.is_var_width:
+            data = jnp.zeros((cap, col.max_len),
+                             col.data.dtype).at[pos].set(col.data, mode="drop")
             lengths = jnp.zeros(cap, jnp.int32).at[pos].set(col.lengths, mode="drop")
             out_cols.append(DeviceColumn(jnp.where(validity[:, None], data, 0),
                                          validity, col.dtype,
@@ -252,7 +253,7 @@ def _compute_agg(spec: AggSpec, col: DeviceColumn | None, seg_id, real, cap,
         pick = jnp.clip(pick, 0, cap - 1)
         has_eligible = cnt_valid > 0 if ignore_nulls else seg_real_cnt > 0
         validity = col.validity[pick] & out_mask & has_eligible
-        if col.is_string:
+        if col.is_var_width:
             data = jnp.where(validity[:, None], col.data[pick], 0)
             return DeviceColumn(data, validity, col.dtype,
                                 jnp.where(validity, col.lengths[pick], 0)), col.dtype

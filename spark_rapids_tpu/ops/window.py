@@ -222,8 +222,9 @@ def running_or_bounded_agg(op: str, col: DeviceColumn, seg: SegmentInfo,
                 data = jnp.where(nan_cnt > 0, jnp.full((), jnp.nan, x.dtype),
                                  res)
             return data, seg.real & (cnt > 0), col.dtype
-        if col.is_string:
-            raise NotImplementedError("windowed min/max over strings")
+        if col.is_var_width:
+            raise NotImplementedError(
+                "windowed min/max over strings/arrays")
         d = col.data.astype(jnp.int64) if col.data.dtype == jnp.bool_ \
             else col.data
         info = jnp.iinfo(d.dtype)
@@ -276,7 +277,7 @@ def lead_lag(col: DeviceColumn, seg: SegmentInfo, offset: int,
     in_seg = (src >= seg.seg_start) & (src <= seg.seg_end) & seg.real
     srcc = jnp.clip(src, 0, cap - 1)
     validity = jnp.where(in_seg, col.validity[srcc], False)
-    if col.is_string:
+    if col.is_var_width:
         cdata = col.data
         if default_data is not None and \
                 default_data.shape[1] > cdata.shape[1]:

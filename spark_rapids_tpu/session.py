@@ -173,6 +173,18 @@ class DataFrame:
         return DataFrame(self._s, L.Join(self._plan, other._plan, how,
                                          left_on, right_on, condition))
 
+    def explode(self, expr, output_name: str = "col", pos: bool = False,
+                outer: bool = False) -> "DataFrame":
+        """explode(array_col): one output row per element, child columns
+        repeated; ``pos`` adds the element index, ``outer`` keeps
+        null/empty-array rows (reference GpuGenerateExec explode over
+        LIST columns)."""
+        from spark_rapids_tpu.exec.generate import Explode
+        gen = Explode(self._col_or_expr(expr))
+        names = (["pos", output_name] if pos else [output_name])
+        return DataFrame(self._s, L.Generate(gen, self._plan, outer=outer,
+                                             pos=pos, output_names=names))
+
     def explode_split(self, expr, delimiter: str, output_name: str = "col",
                       pos: bool = False, outer: bool = False) -> "DataFrame":
         """explode(split(expr, delimiter)): one output row per piece, child

@@ -114,6 +114,39 @@ class NullType(DataType):
     name = "null"
 
 
+class ArrayType(DataType):
+    """Array of fixed-width elements (reference: cuDF LIST columns used
+    by complexTypeExtractors / GetArrayItem).  Device layout mirrors
+    strings: padded ``[capacity, max_len]`` element matrix + int32
+    lengths — static shapes for XLA, power-of-two-bucketed widths.
+    Element nulls are not modeled (Spark arrays may hold nulls; such
+    data stays on the host scan path)."""
+
+    name = "array"
+
+    def __new__(cls, element_type: DataType):
+        # parameterized: NOT a singleton like the scalar types
+        self = object.__new__(cls)
+        return self
+
+    def __init__(self, element_type: DataType):
+        assert element_type.numeric or isinstance(
+            element_type, (BooleanType, DateType, TimestampType)), \
+            f"device arrays need fixed-width elements, got {element_type}"
+        self.element_type = element_type
+        self.np_dtype = element_type.np_dtype
+
+    def __repr__(self) -> str:
+        return f"array<{self.element_type!r}>"
+
+    def __eq__(self, other) -> bool:
+        return (type(self) is type(other)
+                and self.element_type == other.element_type)
+
+    def __hash__(self) -> int:
+        return hash((ArrayType, self.element_type))
+
+
 def all_types() -> list[DataType]:
     return [BooleanType(), ByteType(), ShortType(), IntegerType(), LongType(),
             FloatType(), DoubleType(), StringType(), DateType(), TimestampType()]
@@ -154,6 +187,8 @@ def from_numpy_dtype(dtype) -> DataType:
 
 def to_arrow(dt: DataType):
     import pyarrow as pa
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element_type))
     m = {
         BooleanType(): pa.bool_(), ByteType(): pa.int8(), ShortType(): pa.int16(),
         IntegerType(): pa.int32(), LongType(): pa.int64(), FloatType(): pa.float32(),
@@ -185,6 +220,8 @@ def from_arrow(at) -> DataType:
         return DateType()
     if pa.types.is_timestamp(at):
         return TimestampType()
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
     raise TypeError(f"unsupported arrow type {at}")
 
 

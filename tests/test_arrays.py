@@ -1,0 +1,127 @@
+"""ArrayType columns: ingest, extract, size, contains, explode.
+
+Reference: cuDF LIST columns + complexTypeExtractors (GetArrayItem) and
+GpuGenerateExec explode (SURVEY §2.4).  Device arrays use the padded
+element-matrix + lengths layout (same static-shape design as strings).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.expr.collections import (ArrayContains, GetArrayItem,
+                                               Size)
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType()),
+    T.StructField("a", T.ArrayType(T.IntegerType())),
+    T.StructField("d", T.ArrayType(T.DoubleType())),
+])
+
+
+def _df(s, n=40):
+    rng = np.random.default_rng(21)
+    return s.from_pydict(
+        {"k": list(range(n)),
+         "a": [None if i % 9 == 4 else
+               [int(x) for x in rng.integers(-5, 20, i % 6)]
+               for i in range(n)],
+         "d": [[float(i), i * 0.5] for i in range(n)]},
+        SCHEMA, partitions=2, rows_per_batch=8)
+
+
+def _both(df):
+    dev = sorted(df.collect(), key=str)
+    ov, meta = df._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, df._s.conf), key=str)
+    assert dev == host
+    return dev
+
+
+def test_array_roundtrip_collect():
+    s = TpuSession({})
+    rows = _both(_df(s))
+    assert len(rows) == 40
+    by_k = {r[0]: r for r in rows}
+    assert by_k[4][1] is None           # null array survives
+    assert by_k[0][1] == []             # empty array survives
+    assert by_k[1][2] == [1.0, 0.5]
+
+
+def test_get_array_item_and_size():
+    s = TpuSession({})
+    out = _df(s).select(
+        col("k"),
+        GetArrayItem(col("a"), lit(0)).alias("first"),
+        GetArrayItem(col("a"), col("k") % lit(3)).alias("dyn"),
+        GetArrayItem(col("d"), lit(1)).alias("d1"),
+        Size(col("a")).alias("sz"))
+    rows = _both(out)
+    by_k = {r[0]: r for r in rows}
+    assert by_k[4][1] is None and by_k[4][4] == -1   # null arr: null / -1
+    assert by_k[0][1] is None and by_k[0][4] == 0    # empty arr: OOB -> null
+    assert by_k[1][3] == 0.5
+    for k, first, dyn, d1, sz in rows:
+        if sz is not None and sz > 0 and first is not None:
+            assert isinstance(first, int)
+
+
+def test_array_contains():
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("a", T.ArrayType(T.LongType()))])
+    df = s.from_pydict({"a": [[1, 2, 3], [4, 5], None, []]}, schema)
+    out = df.select(ArrayContains(col("a"), lit(2)).alias("has2"))
+    rows = _both(out)
+    assert sorted(rows, key=str) == sorted(
+        [(True,), (False,), (None,), (False,)], key=str)
+
+
+@pytest.mark.parametrize("outer,pos", [(False, False), (True, True)])
+def test_explode_array(outer, pos):
+    s = TpuSession({})
+    out = _df(s).explode(col("a"), output_name="e", pos=pos, outer=outer)
+    rows = _both(out)
+    # element count: sum of lengths (+1 per null/empty row when outer)
+    base = _df(s).collect()
+    want = sum(len(r[1]) for r in base if r[1] is not None)
+    if outer:
+        want += sum(1 for r in base if r[1] is None or r[1] == [])
+    assert len(rows) == want
+    if pos:
+        # pos column precedes the element column
+        for r in rows:
+            if r[-1] is not None:
+                assert r[-2] is not None
+
+
+def test_array_keys_rejected():
+    s = TpuSession({})
+    df = _df(s)
+    with pytest.raises(ValueError, match="array"):
+        df.order_by(("a", True)).collect()
+    with pytest.raises(ValueError, match="array"):
+        from spark_rapids_tpu.expr.aggregates import CountStar
+        df.group_by("a").agg(CountStar().alias("c")).collect()
+
+
+def test_array_arrow_roundtrip(tmp_path):
+    """Arrow export/import and the parquet scan path carry list columns
+    (device matrix <-> Arrow ListArray)."""
+    import pyarrow.parquet as pq
+    s = TpuSession({})
+    table = _df(s).to_arrow()
+    assert table.num_rows == 40
+    p = str(tmp_path / "arr.parquet")
+    pq.write_table(table, p)
+    back = s.read_parquet(p)
+    rows = _both(back.select(col("k"), Size(col("a")).alias("sz")))
+    assert len(rows) == 40
+
+
+def test_array_cache_roundtrip():
+    s = TpuSession({})
+    cached = _df(s).cache()
+    assert sorted(cached.collect(), key=str) == \
+        sorted(_df(s).collect(), key=str)
