@@ -192,6 +192,27 @@ class StringGen(DataGen):
                        rng.integers(0, len(alphabet), n))
 
 
+class ArrayGen(DataGen):
+    """Arrays of a fixed-width element generator (reference data_gen.py
+    ArrayGen): empty and single-element arrays injected as specials."""
+
+    def __init__(self, element_gen: DataGen | None = None, max_len: int = 6,
+                 **kw):
+        super().__init__(**kw)
+        self.element = element_gen or IntegerGen(lo=-100, hi=100,
+                                                 nullable=0.0)
+        self.max_len = max_len
+
+    @property
+    def data_type(self):
+        from spark_rapids_tpu import types as T
+        return T.ArrayType(self.element.data_type)
+
+    def _one(self, rng):
+        k = int(rng.integers(0, self.max_len + 1))
+        return [self.element._one(rng) for _ in range(k)]
+
+
 class DateGen(DataGen):
     special_values = [0, -719162, 2932896, 1, -1]  # epoch, 0001, 9999
 

@@ -103,3 +103,23 @@ def test_fallback_assert_harness():
     from spark_rapids_tpu.expr.strings import Upper
     df2 = gen_df(s2, [("s", StringGen())], n=50)
     assert_fallback(df2.select(Upper(col("s")).alias("u")), "ProjectExec")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_arrays(seed):
+    """Fuzzed array columns through extract/size/explode (reference
+    data_gen.py ArrayGen + per-family differential files)."""
+    from spark_rapids_tpu.expr.collections import GetArrayItem, Size
+    from spark_rapids_tpu.testing import ArrayGen
+
+    s = TpuSession({})
+    df = gen_df(s, [("i", IntegerGen()),
+                    ("a", ArrayGen()),
+                    ("ad", ArrayGen(DoubleGen(nullable=0.0)))],
+                 n=200, seed=seed, partitions=2, rows_per_batch=32)
+    out = df.select(col("i"), Size(col("a")).alias("sz"),
+                    GetArrayItem(col("a"), lit(1)).alias("a1"),
+                    GetArrayItem(col("ad"), col("i") % lit(4)).alias("dd"))
+    _both(out)
+    exploded = df.explode(col("a"), output_name="e", outer=(seed % 2 == 0))
+    _both(exploded)
