@@ -30,7 +30,7 @@ import jax
 import numpy as np
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
-from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.conf import ConfEntry, register, _bool
 
 __all__ = ["BufferCatalog", "SpillPriority", "SpillableColumnarBatch",
            "DeviceSemaphore", "run_with_spill_retry"]
@@ -45,6 +45,12 @@ HOST_SPILL_LIMIT = register(ConfEntry(
     "spark.rapids.memory.host.spillStorageSize", 1 << 30,
     "Host arena size for spilled buffers (reference "
     "RapidsConf.scala:330)."))
+MEMORY_DEBUG = register(ConfEntry(
+    "spark.rapids.memory.debug", False,
+    "Leak tracking: warn with per-buffer detail when catalog buffers "
+    "are still registered at close (reference "
+    "spark.rapids.memory.gpu.debug -> cudf MemoryCleaner, "
+    "RapidsConf.scala:288).", conv=_bool))
 
 
 class SpillPriority:
@@ -79,6 +85,7 @@ class BufferCatalog:
         self._lock = threading.RLock()
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
+        self._debug = MEMORY_DEBUG.get(settings)
         if device_limit:
             self.device_limit = device_limit
         elif DEVICE_SPILL_LIMIT.key in settings:
@@ -314,7 +321,25 @@ class BufferCatalog:
             return self._entries[buffer_id].tier
 
     def close(self) -> None:
+        """Free everything.  With spark.rapids.memory.debug, buffers
+        still registered (or pinned) at close are reported — the leak
+        tracker analog of cudf's MemoryCleaner behind
+        spark.rapids.memory.gpu.debug (RapidsConf.scala:288): a buffer
+        alive at executor teardown means some operator failed to
+        release it."""
         with self._lock:
+            if self._debug and self._entries:
+                leaks = [f"id={i} tier={e.tier} size={e.size} "
+                         f"refcount={e.refcount} priority={e.priority}"
+                         for i, e in sorted(self._entries.items())]
+                import warnings
+                # UserWarning, not ResourceWarning: the default filters
+                # silently drop ResourceWarning, which would make the
+                # debug flag a no-op in normal runs
+                warnings.warn(
+                    f"BufferCatalog leak check: {len(leaks)} buffer(s) "
+                    "still registered at close:\n  " + "\n  ".join(leaks),
+                    UserWarning)
             for e in list(self._entries.values()):
                 self._drop_storage_locked(e)
             self._entries.clear()

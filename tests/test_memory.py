@@ -186,3 +186,33 @@ def test_arena_close_then_view_raises():
     a.close()
     with pytest.raises(ValueError):
         a.view(off, 64)
+
+
+def test_leak_check_on_close():
+    """spark.rapids.memory.debug reports buffers still registered at
+    catalog close (reference memory.gpu.debug leak tracking)."""
+    import warnings as _w
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.core import host_to_device
+    from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    schema = T.Schema([T.StructField("x", T.IntegerType())])
+    hb = HostBatch([HostColumn(np.arange(8, dtype=np.int32),
+                               np.ones(8, bool), T.IntegerType())], schema)
+    cat = BufferCatalog(conf=TpuConf({"spark.rapids.memory.debug": True}))
+    cat.add_batch(host_to_device(hb), priority=0)   # never released
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        cat.close()
+    assert any("leak check" in str(w.message) for w in rec)
+    # clean close stays silent
+    cat2 = BufferCatalog(conf=TpuConf({"spark.rapids.memory.debug": True}))
+    bid = cat2.add_batch(host_to_device(hb), priority=0)
+    cat2.remove(bid)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        cat2.close()
+    assert not any("leak check" in str(w.message) for w in rec)
