@@ -13,9 +13,46 @@ from __future__ import annotations
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.expr.core import Expression, Val
 
-__all__ = ["GetArrayItem", "Size", "ArrayContains"]
+__all__ = ["GetArrayItem", "Size", "ArrayContains", "GetMapValue"]
+
+
+class GetMapValue(Expression):
+    """map[key] (reference GetMapValue, complexTypeExtractors).
+
+    HOST-ONLY: MapType has no device representation (types.MapType), so
+    the planner tags any plan node evaluating this as host — explain
+    shows the fallback reason, the reference's degradation model."""
+
+    sql_name = "GetMapValue"
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    @property
+    def dtype(self):
+        mt = self.children[0].dtype
+        assert isinstance(mt, T.MapType), mt
+        return mt.value_type
+
+    @property
+    def device_supported(self) -> bool:
+        return False
+
+    def _eval(self, vals, ctx):
+        assert not ctx.is_device, "GetMapValue is host-only"
+        from spark_rapids_tpu.host.batch import HostColumn
+        m, k = vals
+        vt = self.dtype
+        # route through HostColumn.from_values so value types get the
+        # engine's encodings (date -> days, timestamp -> micros, arrays
+        # -> lists) instead of raw python objects in a typed buffer
+        values = [m.data[i].get(k.data[i])
+                  if (m.validity[i] and k.validity[i]) else None
+                  for i in range(ctx.capacity)]
+        hc = HostColumn.from_values(values, vt)
+        return Val(hc.data, hc.validity, None, vt)
 
 
 class GetArrayItem(Expression):

@@ -57,6 +57,10 @@ class HostColumn:
             data = np.empty(n, dtype=object)
             for i, v in enumerate(values):
                 data[i] = None if v is None else list(v)
+        elif isinstance(dtype, T.MapType):
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = None if v is None else dict(v)
         else:
             npdt = dtype.np_dtype
             data = np.zeros(n, dtype=npdt)
@@ -94,6 +98,8 @@ class HostColumn:
                 out.append(self.data[i])
             elif isinstance(self.dtype, T.ArrayType):
                 out.append(list(self.data[i]))
+            elif isinstance(self.dtype, T.MapType):
+                out.append(dict(self.data[i]))
             elif is_date:
                 out.append(_dt.date(1970, 1, 1)
                            + _dt.timedelta(days=int(self.data[i])))
@@ -171,6 +177,8 @@ class HostBatch:
                 data = np.empty(n, dtype=object)
                 for j, v in enumerate(arr.to_pylist()):
                     data[j] = v
+            elif isinstance(dt, T.MapType):
+                data = T.arrow_map_to_numpy(arr)
             else:
                 data = T.arrow_fixed_to_numpy(arr, dt)
             cols.append(HostColumn(data, validity, dt))
@@ -187,6 +195,10 @@ class HostBatch:
                 arrays.append(pa.array(py, type=pa.string()))
             elif isinstance(f.data_type, T.ArrayType):
                 py = [None if m else list(v) for v, m in zip(c.data, mask)]
+                arrays.append(pa.array(py, type=at))
+            elif isinstance(f.data_type, T.MapType):
+                py = [None if m else sorted(v.items())
+                      for v, m in zip(c.data, mask)]
                 arrays.append(pa.array(py, type=at))
             elif isinstance(f.data_type, (T.DateType, T.TimestampType)):
                 base = pa.array(c.data, mask=mask)
@@ -239,7 +251,8 @@ class HostBatch:
     def empty(schema: T.Schema) -> "HostBatch":
         cols = []
         for f in schema:
-            if isinstance(f.data_type, (T.StringType, T.ArrayType)):
+            if isinstance(f.data_type,
+                          (T.StringType, T.ArrayType, T.MapType)):
                 data = np.zeros(0, dtype=object)
             else:
                 data = np.zeros(0, dtype=f.data_type.np_dtype)

@@ -60,7 +60,7 @@ def _effective_batch_rows(schema: T.Schema, settings: dict) -> int:
     byte_cap = MAX_READER_BATCH_SIZE_BYTES.get(settings)
     width = 1  # validity
     for f in schema:
-        if isinstance(f.data_type, T.StringType):
+        if f.data_type.np_dtype is None:   # strings, maps
             width += 32          # offset + data estimate
         else:
             width += max(1, f.data_type.np_dtype.itemsize)
@@ -286,6 +286,8 @@ def _arrow_to_host(rb, schema: T.Schema):
             data = np.empty(n, dtype=object)
             for j, x in enumerate(arr.to_pylist()):
                 data[j] = x
+        elif isinstance(f.data_type, T.MapType):
+            data = T.arrow_map_to_numpy(arr)
         else:
             data = T.arrow_fixed_to_numpy(arr, f.data_type)
         cols.append(HostColumn(data, validity, f.data_type))

@@ -388,6 +388,19 @@ class TpuOverrides:
 
     def _tag_special(self, meta: PlannedNode) -> None:
         ex = meta.exec_node
+        # MapType has no device representation (types.MapType): a node
+        # whose OWN output carries a map runs on the host, and so does a
+        # node whose CHILD outputs one — the host->device transition
+        # would otherwise have to upload the map column (review repro:
+        # df.select(k) over a map-carrying scan crashed in
+        # host_to_device).  The node ABOVE the map-dropping projection
+        # returns to the device (reference: unsupported-type tagging,
+        # RapidsMeta.willNotWorkOnGpu).
+        if any(isinstance(f.data_type, T.MapType)
+               for f in ex.output_schema) or \
+           any(isinstance(f.data_type, T.MapType)
+               for ch in ex.children for f in ch.output_schema):
+            meta.will_not_work("map columns are host-only")
         if isinstance(ex, WindowExec):
             from spark_rapids_tpu.expr import aggregates as A
             for w, dt in zip(ex._wexprs, ex._out_dtypes):

@@ -114,6 +114,38 @@ class NullType(DataType):
     name = "null"
 
 
+class MapType(DataType):
+    """Map columns (reference GetMapValue, complexTypeExtractors).
+
+    HOST-ONLY: maps have no device representation here (two aligned
+    var-width buffers per row do not fit the single-matrix column
+    layout), so the planner tags every operator whose schema carries a
+    map as host — the reference's own degradation model for
+    unsupported types (RapidsMeta.willNotWorkOnGpu).  Host rows hold
+    python dicts."""
+
+    name = "map"
+    np_dtype = None
+
+    def __new__(cls, key_type: DataType, value_type: DataType):
+        return object.__new__(cls)
+
+    def __init__(self, key_type: DataType, value_type: DataType):
+        self.key_type = key_type
+        self.value_type = value_type
+
+    def __repr__(self) -> str:
+        return f"map<{self.key_type!r},{self.value_type!r}>"
+
+    def __eq__(self, other) -> bool:
+        return (type(self) is type(other)
+                and self.key_type == other.key_type
+                and self.value_type == other.value_type)
+
+    def __hash__(self) -> int:
+        return hash((MapType, self.key_type, self.value_type))
+
+
 class ArrayType(DataType):
     """Array of fixed-width elements (reference: cuDF LIST columns used
     by complexTypeExtractors / GetArrayItem).  Device layout mirrors
@@ -185,10 +217,22 @@ def from_numpy_dtype(dtype) -> DataType:
     return dt
 
 
+def arrow_map_to_numpy(arr) -> "np.ndarray":
+    """Arrow MapArray -> object ndarray of python dicts (shared by
+    every host ingest path so the decode cannot diverge, like
+    arrow_fixed_to_numpy for fixed-width)."""
+    out = np.empty(len(arr), dtype=object)
+    for j, x in enumerate(arr.to_pylist()):
+        out[j] = None if x is None else dict(x)
+    return out
+
+
 def to_arrow(dt: DataType):
     import pyarrow as pa
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow(dt.key_type), to_arrow(dt.value_type))
     m = {
         BooleanType(): pa.bool_(), ByteType(): pa.int8(), ShortType(): pa.int16(),
         IntegerType(): pa.int32(), LongType(): pa.int64(), FloatType(): pa.float32(),
@@ -222,6 +266,8 @@ def from_arrow(at) -> DataType:
         return TimestampType()
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
     raise TypeError(f"unsupported arrow type {at}")
 
 

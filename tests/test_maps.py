@@ -1,0 +1,106 @@
+"""MapType (host-only) + GetMapValue with fallback tagging.
+
+Reference: GetMapValue (complexTypeExtractors) and the
+unsupported-type degradation model (RapidsMeta.willNotWorkOnGpu): map
+columns run on the host with explain reasons; once projected away the
+plan returns to the device.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.expr.collections import GetMapValue
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType()),
+    T.StructField("m", T.MapType(T.StringType(), T.LongType())),
+])
+
+
+def _df(s, n=30):
+    return s.from_pydict(
+        {"k": list(range(n)),
+         "m": [None if i % 7 == 3 else
+               {"a": i, "b": i * 10} if i % 2 else {"a": i}
+               for i in range(n)]},
+        SCHEMA, partitions=2, rows_per_batch=8)
+
+
+def test_map_roundtrip_and_fallback_tagging():
+    s = TpuSession({})
+    df = _df(s)
+    plan = df.explain()
+    assert "map columns are host-only" in plan
+    rows = sorted(df.collect())
+    assert rows[3][1] is None
+    assert rows[1][1] == {"a": 1, "b": 10}
+
+
+def test_get_map_value():
+    s = TpuSession({})
+    out = _df(s).select(col("k"),
+                        GetMapValue(col("m"), lit("b")).alias("b"))
+    rows = sorted(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf))
+    assert rows == host
+    assert rows[1] == (1, 10)      # has "b"
+    assert rows[2] == (2, None)    # missing key -> null
+    assert rows[3] == (3, None)    # null map -> null
+
+
+def test_plan_returns_to_device_after_dropping_map():
+    """Projecting the map away puts downstream operators back on the
+    device (transition inserted at the boundary)."""
+    from spark_rapids_tpu.expr.aggregates import Sum
+    s = TpuSession({})
+    out = _df(s).select(col("k"), GetMapValue(col("m"), lit("a"))
+                        .alias("a")) \
+        .where(col("a") >= lit(0)) \
+        .group_by().agg(Sum(col("a")).alias("sa"))
+    plan = out.explain()
+    assert "BackendSwitch" in plan or "*" in plan.splitlines()[0]
+    rows = out.collect()
+    ov, meta = out._overridden(quiet=True)
+    assert rows == collect_host(meta.exec_node, s.conf)
+
+
+def test_map_arrow_roundtrip(tmp_path):
+    import pyarrow.parquet as pq
+    s = TpuSession({})
+    table = _df(s).to_arrow()
+    p = str(tmp_path / "m.parquet")
+    pq.write_table(table, p)
+    back = s.read_parquet(p)
+    rows = sorted(back.collect())
+    assert rows == sorted(_df(s).collect())
+
+
+def test_device_plan_above_dropped_map_column():
+    """A device node directly above a map-carrying host child must not
+    force a map upload (review repro: df.select(k) over a map scan
+    crashed in host_to_device)."""
+    s = TpuSession({})
+    out = _df(s).select(col("k")).where(col("k") > lit(5))
+    rows = sorted(out.collect())
+    assert rows == [(i,) for i in range(6, 30)]
+    ov, meta = out._overridden(quiet=True)
+    assert rows == sorted(collect_host(meta.exec_node, s.conf))
+
+
+def test_get_map_value_date_values():
+    """Date/timestamp map values get the engine encodings through
+    HostColumn.from_values (review repro: raw datetime.date crashed the
+    int buffer assignment)."""
+    import datetime as dt
+    schema = T.Schema([
+        T.StructField("m", T.MapType(T.StringType(), T.DateType()))])
+    s = TpuSession({})
+    df = s.from_pydict(
+        {"m": [{"d": dt.date(2020, 1, i + 1)} for i in range(5)]}, schema)
+    out = df.select(GetMapValue(col("m"), lit("d")).alias("d"))
+    rows = sorted(out.collect())
+    assert rows[0] == (dt.date(2020, 1, 1),)
