@@ -97,6 +97,8 @@ class ProjectExec(PlanNode):
     input columns computed per batch from (pid, row offset) — reference
     GpuSparkPartitionID/GpuMonotonicallyIncreasingID."""
 
+    combines_batches = False
+
     def __init__(self, exprs: Sequence[Expression], child: PlanNode):
         super().__init__([child])
         self._raw = list(exprs)
@@ -206,6 +208,8 @@ class ProjectExec(PlanNode):
 class FilterExec(PlanNode):
     """Boolean condition -> compact kept rows (GpuFilterExec:
     Table.filter via front-packing permutation on device)."""
+
+    combines_batches = False
 
     def __init__(self, condition: Expression, child: PlanNode):
         super().__init__([child])
@@ -360,6 +364,8 @@ class LocalLimitExec(PlanNode):
 
 class GlobalLimitExec(PlanNode):
     """Whole-query limit: single output partition (GpuGlobalLimitExec)."""
+
+    combines_batches = False
 
     def __init__(self, limit: int, child: PlanNode):
         super().__init__([child])

@@ -317,6 +317,13 @@ class PlanNode:
     def bound_exprs(self) -> list:
         return []
 
+    #: True when this operator JITs multiple input batches together
+    #: (concat, merge, build-side materialization) — such programs need
+    #: same-device inputs, so the planner aligns mesh-committed batches
+    #: flowing into them.  Per-batch operators (project/filter/limit)
+    #: override to False and pass placement through untouched.
+    combines_batches: bool = True
+
     # -- batching contracts (reference GpuExec.scala:71-86) ----------------
     @property
     def children_coalesce_goal(self) -> list[CoalesceGoal | None]:

@@ -45,6 +45,27 @@ __all__ = ["MeshAggregateExec", "MeshExchangeExec", "MeshJoinExec",
            "mesh_for"]
 
 
+class _MeshOutputMixin:
+    """Mesh execs yield per-device committed batches.  When the planner
+    sees a NON-mesh consumer above (which would mix devices inside its
+    own jitted programs — per-batch join probes, window kernels), it
+    sets ``align_output`` and the exec moves each yielded batch to the
+    default device at the mesh->single-device boundary (review finding:
+    patching individual consumers is whack-a-mole)."""
+
+    align_output: bool = False
+
+    def _aligned(self, it):
+        if not self.align_output:
+            yield from it
+            return
+        target = jax.devices()[0]
+        for b in it:
+            # host-backend batches (oracle path) carry no placement
+            yield jax.device_put(b, target) \
+                if isinstance(b, ColumnBatch) else b
+
+
 def mesh_for(ctx: ExecCtx, size: int, axis_name: str = "data"):
     """The ctx-cached 1-D device mesh, or None if < size devices exist."""
     key = ("mesh", size, axis_name)
@@ -140,7 +161,7 @@ def _pad_widths(b: ColumnBatch, widths) -> ColumnBatch:
     return ColumnBatch(cols, b.num_rows, b.schema) if changed else b
 
 
-class MeshAggregateExec(PlanNode):
+class MeshAggregateExec(_MeshOutputMixin, PlanNode):
     """Grouped aggregation as ONE distributed program over the mesh.
 
     Device plan per shard: pre-project -> partial sorted group-by ->
@@ -247,7 +268,7 @@ class MeshAggregateExec(PlanNode):
         if not ctx.is_device:
             yield from self._complete_exec().partition_iter(ctx, pid)
             return
-        yield from self._outputs(ctx)[pid]
+        yield from self._aligned(iter(self._outputs(ctx)[pid]))
 
     def node_desc(self) -> str:
         return (f"MeshAggregateExec[mesh={self.mesh_size}, "
@@ -255,7 +276,7 @@ class MeshAggregateExec(PlanNode):
                 f"out={self._output_schema.names}]")
 
 
-class MeshExchangeExec(PlanNode):
+class MeshExchangeExec(_MeshOutputMixin, PlanNode):
     """Hash repartition as an all-to-all collective over the mesh.
 
     Device path: pack child output into per-device shards, then ONE
@@ -363,6 +384,9 @@ class MeshExchangeExec(PlanNode):
         return ("mesh", unshard_batch(result))
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from self._aligned(self._partition_iter_mesh(ctx, pid))
+
+    def _partition_iter_mesh(self, ctx: ExecCtx, pid: int) -> Iterator:
         kind, out = self._outputs(ctx)
         if kind == "host":
             yield from out[pid]
@@ -393,7 +417,7 @@ def output_name_safe(e: Expression) -> str:
         return repr(e)
 
 
-class MeshJoinExec(JoinExec):
+class MeshJoinExec(_MeshOutputMixin, JoinExec):
     """Broadcast-build equi-join distributed over the mesh.
 
     The TPU-native shape of GpuBroadcastHashJoinExec (SURVEY §2.4): the
@@ -425,8 +449,12 @@ class MeshJoinExec(JoinExec):
     # -- hooks ---------------------------------------------------------
     def _shard_devices(self, ctx: ExecCtx):
         devs = jax.devices()
-        p = min(self.mesh_size, len(devs))
-        return devs[:p]
+        if len(devs) < self.mesh_size:
+            # degrade like mesh_for/MeshAggregateExec: with fewer real
+            # devices than the configured mesh, run single-device so a
+            # downstream fallback consumer never sees mixed placements
+            return devs[:1]
+        return devs[:self.mesh_size]
 
     def _mesh_shards(self, ctx: ExecCtx):
         def make():
@@ -455,6 +483,11 @@ class MeshJoinExec(JoinExec):
         shards = self._mesh_shards(ctx)
         if pid < len(shards):
             yield shards[pid]
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        fn = JoinExec.partition_iter
+        fn = getattr(fn, "__wrapped__", fn)
+        yield from self._aligned(fn(self, ctx, pid))
 
     def node_desc(self) -> str:
         jt = "right" if self._swapped else self.join_type

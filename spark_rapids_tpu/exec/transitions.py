@@ -22,6 +22,8 @@ class BackendSwitchExec(PlanNode):
     """Run the child subtree on ``inner_backend``; convert its output
     batches to the enclosing context's backend."""
 
+    combines_batches = False
+
     def __init__(self, child: PlanNode, inner_backend: str):
         super().__init__([child])
         assert inner_backend in ("device", "host")

@@ -135,6 +135,19 @@ def concat_batches(batches: Sequence[ColumnBatch],
     """
     assert batches, "concat of zero batches"
     schema = batches[0].schema
+    # align devices: inputs committed to different mesh devices (e.g. a
+    # mesh join's per-device probe outputs consumed by a non-mesh
+    # operator) cannot feed one jitted concat; move strays to the first
+    # batch's device (no-op when aligned, impossible-and-unneeded when
+    # already tracing inside a jit — tracers carry no placement)
+    if batches[0].columns and not isinstance(
+            batches[0].columns[0].data, jax.core.Tracer):
+        devs = {repr(d) for b in batches if b.columns
+                for d in [next(iter(b.columns[0].data.devices()))]
+                if getattr(b.columns[0].data, "committed", False)}
+        if len(devs) > 1:
+            target = next(iter(batches[0].columns[0].data.devices()))
+            batches = [jax.device_put(b, target) for b in batches]
     cap = out_capacity or round_capacity(sum(b.capacity for b in batches))
     ncols = batches[0].num_columns
     # per-column concat with per-batch real-row masks

@@ -276,8 +276,12 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
 
 def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
-    if node.group_exprs and conf.mesh_device_count > 1 \
-            and not _schema_has_arrays(c.exec_node):
+    if conf.mesh_device_count > 1 and not _schema_has_arrays(c.exec_node):
+        # grouped AND grand aggregates both lower to the mesh program
+        # (grand: partials merge on device 0 inside the shard_map) — a
+        # grand aggregate over a mesh join's per-device outputs must
+        # not fall into the single-device complete path (matrix-sweep
+        # finding: q96 under mesh8 mixed devices in one jit)
         from spark_rapids_tpu.exec.mesh_exec import MeshAggregateExec
         ex = MeshAggregateExec(node.group_exprs, node.agg_exprs, c.exec_node,
                                conf.mesh_device_count)
@@ -315,12 +319,16 @@ class TpuOverrides:
     def __init__(self, conf: TpuConf):
         self.conf = conf
 
-    def apply(self, root: PlannedNode) -> PlanNode:
+    def prepare(self, root: PlannedNode, explain: bool = False) -> PlanNode:
+        """The full planning pipeline; ``apply`` and the quiet plan
+        builds both run THIS, so every future pass reaches both paths
+        (review finding: a hand-duplicated pass list diverged)."""
         self._tag(root)
         self._insert_coalesce(root)
         self._insert_transitions(root)
+        self._align_mesh_outputs(root)
         explain_mode = self.conf.explain
-        if explain_mode and explain_mode != "NONE":
+        if explain and explain_mode and explain_mode != "NONE":
             text = self.explain(root, only_fallback=(explain_mode
                                                      == "NOT_ON_TPU"))
             if text:
@@ -328,6 +336,9 @@ class TpuOverrides:
         if self.conf.test_enabled:
             self._assert_on_tpu(root)
         return root.exec_node
+
+    def apply(self, root: PlannedNode) -> PlanNode:
+        return self.prepare(root, explain=True)
 
     def root_backend(self, root: PlannedNode) -> str:
         return root.backend
@@ -409,6 +420,35 @@ class TpuOverrides:
                         dt, T.StringType):
                     meta.will_not_work(
                         "windowed min/max over strings has no device kernel")
+
+    # -- mesh output alignment ------------------------------------------
+    def _align_mesh_outputs(self, meta: PlannedNode) -> None:
+        """Set align_output on mesh execs whose per-device batches flow
+        (possibly through per-batch operators, which preserve placement)
+        into a non-mesh BATCH-COMBINING consumer — a program jitting
+        batches from different devices crashes (q96-under-mesh matrix
+        finding).  Per-batch consumers (filter/project/limit) pass
+        placement through so the distributed pipeline is not funneled
+        through one chip; unconsumed producers at the root stay
+        unaligned — collect's per-batch D2H handles any device."""
+        from spark_rapids_tpu.exec.mesh_exec import _MeshOutputMixin
+
+        def walk(m: PlannedNode) -> list:
+            # returns mesh execs whose (unaligned) per-device output
+            # reaches m's own output
+            producers = [p for ch in m.children for p in walk(ch)]
+            ex = m.exec_node
+            if isinstance(ex, _MeshOutputMixin):
+                # a mesh exec consumes its children mesh-aware (device
+                # affinity in place_shards); only ITS output escapes
+                return [ex]
+            if producers and ex.combines_batches:
+                for p in producers:
+                    p.align_output = True
+                return []
+            return producers
+
+        walk(meta)
 
     # -- coalesce insertion (reference GpuTransitionOverrides
     # insertCoalesce :224-244 / optimizeCoalesce :96-116) ---------------

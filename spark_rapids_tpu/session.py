@@ -321,16 +321,7 @@ class DataFrame:
     def _overridden(self, quiet: bool = False):
         meta = self._planned()
         ov = TpuOverrides(self._s.conf)
-        if quiet:
-            ov._tag(meta)
-            ov._insert_coalesce(meta)
-            ov._insert_transitions(meta)
-            if self._s.conf.test_enabled:
-                # quiet path (cache/explain/internal) must not bypass
-                # test-mode's on-device assertion
-                ov._assert_on_tpu(meta)
-        else:
-            ov.apply(meta)
+        ov.prepare(meta, explain=not quiet)
         return ov, meta
 
 

@@ -259,3 +259,43 @@ def test_mesh_full_join_stays_in_process(rng):
     out = fact.join(_dim_df(sm), on="k", how="full")
     plan = out.explain()
     assert "MeshJoinExec" not in plan and "JoinExec" in plan
+
+
+def test_mesh_grand_aggregate_over_join(rng):
+    """q96 shape under mesh: joins feeding a GRAND aggregate (no group
+    keys) must lower to the mesh program — per-device join outputs in
+    the single-device complete path mixed devices (matrix finding)."""
+    from spark_rapids_tpu.exec.core import collect_host
+    sm, _ = _sessions()
+    fact = sm.from_pydict(_data(rng), SCHEMA, partitions=4,
+                          rows_per_batch=64)
+    out = fact.join(_dim_df(sm), on="k", how="inner") \
+        .agg(CountStar().alias("cnt"), Sum(col("v")).alias("sv"))
+    plan = out.explain()
+    assert "MeshAggregateExec" in plan
+    dev = out.collect()
+    ov, meta = out._overridden(quiet=True)
+    assert dev == collect_host(meta.exec_node, sm.conf)
+    assert dev[0][0] > 0
+
+
+def test_mesh_join_feeding_non_mesh_consumer(rng):
+    """Review repro: a non-mesh device operator above mesh outputs (a
+    full join stays in-process) must not mix devices inside its jitted
+    programs — the planner aligns mesh outputs at the boundary."""
+    from spark_rapids_tpu.exec.core import collect_host
+    sm, _ = _sessions()
+    fact = sm.from_pydict(_data(rng), SCHEMA, partitions=4,
+                          rows_per_batch=64)
+    dim2_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                            T.StructField("w", T.DoubleType(), True)])
+    dim2 = sm.from_pydict({"k": [0, 1, 2, 99],
+                           "w": [0.5, 1.5, 2.5, 9.9]}, dim2_schema)
+    out = fact.join(_dim_df(sm), on="k", how="inner") \
+        .join(dim2, on="k", how="full")
+    plan = out.explain()
+    assert "MeshJoinExec" in plan and "JoinExec[full" in plan
+    dev = _sorted_rows(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    host = _sorted_rows(collect_host(meta.exec_node, sm.conf))
+    assert dev == host and len(dev) > 0
