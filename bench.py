@@ -36,6 +36,11 @@ TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "540"))
 # reserved for the CPU-fallback ladder while the TPU ladder has not yet
 # produced a single successful rung
 FALLBACK_RESERVE_S = float(os.environ.get("BENCH_FALLBACK_RESERVE_S", "200"))
+# quick backend-liveness probe budget: a wedged tunnel hangs jax.devices()
+# forever inside PJRT client creation, so spending ~1 min here saves the
+# whole rung timeout (round-3 failure mode: 360s burned discovering the
+# hang, leaving no budget for a labeled-honest CPU ladder)
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 MAX_SF = float(os.environ.get("BENCH_SF", "10"))
 DATA_DIR = os.environ.get("BENCH_DATA_DIR",
                           os.path.join(os.path.dirname(
@@ -67,30 +72,91 @@ def _emit(value: float, sf: float, backend: str, error: str | None = None,
 _REPORT_PREFIX = "BENCH_REPORT:"
 
 
-def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
-    """One ladder rung in a killable subprocess; returns its JSON report
-    or {"error": ...}."""
-    cmd = [sys.executable, os.path.abspath(__file__),
-           "--child", str(sf), platform]
-    # own session: on timeout kill the whole process GROUP, so wedged
-    # PJRT/tunnel helper children die with the rung instead of holding
-    # the TPU connection (and the stdout pipe) forever
+def _probe_backend(platform: str, timeout_s: float) -> tuple[bool, str]:
+    """Cheaply check the backend can initialize at all.
+
+    Runs ``jax.devices()`` plus one tiny device computation in a killable
+    subprocess with a faulthandler watchdog.  A wedged axon tunnel hangs
+    inside ``make_c_api_client`` — that stack signature (when present) is
+    returned in the detail string so the emitted artifact records WHY the
+    TPU ladder was skipped, not just that it was.
+    """
+    watchdog = max(5.0, timeout_s - 10.0)
+    code = (
+        "import faulthandler, os, sys\n"
+        f"faulthandler.dump_traceback_later({watchdog:.0f}, exit=True)\n"
+        "import jax\n"
+    )
+    if platform == "cpu":
+        code += ("os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                 "jax.config.update('jax_platforms', 'cpu')\n")
+    code += (
+        "ds = jax.devices()\n"
+        "import jax.numpy as jnp\n"
+        "x = jnp.arange(8); x.block_until_ready()\n"
+        "print('PROBE_OK', ds[0].platform, len(ds), flush=True)\n"
+        "os._exit(0)\n"
+    )
+    rc, out, errout = _run_killable([sys.executable, "-c", code], timeout_s)
+    out = (out or "") + (errout or "")
+    if rc is None:
+        # even in the kill path, scan the drained output: the watchdog
+        # dump may already name the wedged frame
+        if "make_c_api_client" in out:
+            return False, ("tunnel wedged: jax.devices() hung in "
+                           "make_c_api_client (killed by probe timeout)")
+        return False, f"probe killed after {timeout_s:.0f}s (no traceback)"
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            parts = line.split()
+            got = parts[1] if len(parts) > 1 else "?"
+            want_cpu = platform == "cpu"
+            if want_cpu != (got == "cpu"):
+                return False, f"probe initialized '{got}' not '{platform}'"
+            return True, f"backend '{got}' x{parts[2] if len(parts) > 2 else '?'}"
+    if "make_c_api_client" in out:
+        return False, ("tunnel wedged: jax.devices() hung in "
+                       "make_c_api_client (watchdog fired)")
+    tail = out.strip().splitlines()[-1][:200] if out.strip() else "no output"
+    return False, f"probe rc={rc}: {tail}"
+
+
+def _run_killable(cmd: list[str], timeout_s: float,
+                  **popen_kw) -> tuple[int | None, str, str]:
+    """Spawn ``cmd`` in its own session and wait up to ``timeout_s``.
+
+    On timeout the whole process GROUP is killed (wedged PJRT/tunnel
+    helper children die with it instead of holding the TPU connection
+    and the stdout pipe forever) and whatever output was produced is
+    still drained and returned.  Returns (returncode|None-if-killed,
+    stdout, stderr)."""
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                          stderr=subprocess.PIPE, text=True,
-                         start_new_session=True,
-                         cwd=os.path.dirname(
-                             os.path.abspath(__file__)) or None)
+                         start_new_session=True, **popen_kw)
     try:
         out, errout = p.communicate(timeout=timeout_s)
+        return p.returncode, out or "", errout or ""
     except subprocess.TimeoutExpired:
         try:
             os.killpg(os.getpgid(p.pid), 9)
         except (ProcessLookupError, PermissionError):
             p.kill()
         try:
-            p.communicate(timeout=10)
+            out, errout = p.communicate(timeout=10)
         except subprocess.TimeoutExpired:
-            pass
+            out, errout = "", ""
+        return None, out or "", errout or ""
+
+
+def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
+    """One ladder rung in a killable subprocess; returns its JSON report
+    or {"error": ...}."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--child", str(sf), platform]
+    rc, out, errout = _run_killable(
+        cmd, timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+    if rc is None:
         return {"error": f"rung sf{sf:g}/{platform} killed after "
                          f"{timeout_s:.0f}s (backend hang)"}
     for line in reversed(out.splitlines()):
@@ -101,7 +167,7 @@ def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
             except json.JSONDecodeError:
                 break
     tail = (errout or "")[-300:].replace("\n", " | ")
-    return {"error": f"rung sf{sf:g}/{platform} exited rc={p.returncode} "
+    return {"error": f"rung sf{sf:g}/{platform} exited rc={rc} "
                      f"with no report; stderr tail: {tail}"}
 
 
@@ -143,9 +209,10 @@ def _child(sf: float, platform: str) -> None:
     os._exit(0)
 
 
-def _ladder(platform: str, deadline: float, reserve: float):
+def _ladder(platform: str, deadline: float, reserve: float, rungs: list):
     """Climb the ladder on one backend; returns ((sf, report) | None,
-    err)."""
+    err).  Every rung attempt (pass or fail) is appended to ``rungs`` so
+    the emitted artifact shows the partial ladder, not just the summit."""
     best = None
     err = None
     for sf in LADDER:
@@ -155,7 +222,15 @@ def _ladder(platform: str, deadline: float, reserve: float):
             err = (err or "") + f" (no budget for sf{sf:g})"
             break
         r = _run_rung(sf, platform, budget)
-        if r.get("ok") and not r.get("error"):
+        rung = {"sf": sf, "backend": platform,
+                "ok": bool(r.get("ok")) and not r.get("error")}
+        for k in ("speedup", "device_s", "oracle_s", "rows"):
+            if k in r:
+                rung[k] = r[k]
+        if r.get("error"):
+            rung["error"] = str(r["error"])[:300]
+        rungs.append(rung)
+        if rung["ok"]:
             best = (sf, r)
         else:
             err = r.get("error") or f"sf{sf:g}: device != oracle"
@@ -163,29 +238,59 @@ def _ladder(platform: str, deadline: float, reserve: float):
     return best, err
 
 
+def _prewarm(sf: float) -> None:
+    """Resumable compile-cache warmer: run the engine once on the TPU at
+    a small SF purely to populate the persistent XLA executable cache
+    (~/.cache/spark_rapids_tpu/xla), so a later bench run measures
+    execution instead of compilation.  Safe to re-run; each invocation
+    adds whatever entries the previous one didn't reach before being
+    killed.  Exits 0 if the rung completed, 1 otherwise."""
+    ok, detail = _probe_backend("tpu", PROBE_TIMEOUT_S)
+    print(f"prewarm: tpu probe: {detail}", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+    budget = TOTAL_TIMEOUT_S
+    r = _run_rung(sf, "tpu", budget)
+    print(f"prewarm: rung sf{sf:g} -> "
+          f"{'ok' if r.get('ok') else r.get('error')}", file=sys.stderr)
+    sys.exit(0 if r.get("ok") else 1)
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child(float(sys.argv[2]), sys.argv[3])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--prewarm":
+        _prewarm(float(sys.argv[2]) if len(sys.argv) > 2 else 0.1)
         return
     deadline = time.monotonic() + TOTAL_TIMEOUT_S
     # cap the reserve so a small total budget still attempts the TPU
     # ladder instead of silently skipping straight to the fallback
     reserve = min(FALLBACK_RESERVE_S, TOTAL_TIMEOUT_S / 3.0)
-    best, err = _ladder("tpu", deadline, reserve)
+    rungs: list[dict] = []
+    probe_ok, probe_detail = _probe_backend("tpu", PROBE_TIMEOUT_S)
+    if probe_ok:
+        best, err = _ladder("tpu", deadline, reserve, rungs)
+    else:
+        # don't burn a full rung timeout on a backend that can't even
+        # enumerate devices — skip straight to the honest fallback
+        best, err = None, f"tpu probe failed: {probe_detail}"
     backend = "tpu"
     if best is None:
         tpu_err = err
-        best, err = _ladder("cpu", deadline, 0.0)
+        best, err = _ladder("cpu", deadline, 0.0, rungs)
         backend = "cpu_fallback"
         err = f"tpu ladder failed: {tpu_err}" + (f" ; {err}" if err else "")
+    extra = {"ladder": rungs, "tpu_probe": probe_detail}
     if best is not None:
         sf, r = best
-        _emit(r.get("speedup", 0.0), sf, backend, error=err,
-              extra={"device_s": r.get("device_s"),
-                     "oracle_s": r.get("oracle_s"),
-                     "rows": r.get("rows")})
+        extra.update({"device_s": r.get("device_s"),
+                      "oracle_s": r.get("oracle_s"),
+                      "rows": r.get("rows")})
+        _emit(r.get("speedup", 0.0), sf, backend, error=err, extra=extra)
         sys.exit(0)
-    _emit(0.0, LADDER[0], backend, error=err or "no rung completed")
+    _emit(0.0, LADDER[0], backend, error=err or "no rung completed",
+          extra=extra)
     sys.exit(1)
 
 

@@ -61,8 +61,16 @@ class WindowExec(PlanNode):
     sharing the same WindowSpec.
     """
 
-    def __init__(self, window_exprs: Sequence[Expression], child: PlanNode):
+    def __init__(self, window_exprs: Sequence[Expression], child: PlanNode,
+                 keys_partitioned: bool = False):
         super().__init__([child])
+        # when the planner hash-partitioned the child on the window
+        # partition keys, each child partition holds whole partition
+        # groups and the window program runs per partition, preserving
+        # upstream task parallelism (reference GpuWindowExec requires a
+        # single batch only per partition GROUP, GpuWindowExec.scala:92;
+        # collapsing the world was the round-3 scaling cliff)
+        self._keys_partitioned = bool(keys_partitioned)
         from spark_rapids_tpu.expr.core import Alias
         self._names = [output_name(e) for e in window_exprs]
         self._wexprs: list[WindowExpression] = []
@@ -109,14 +117,21 @@ class WindowExec(PlanNode):
         return RequireSingleBatch
 
     def num_partitions(self, ctx: ExecCtx) -> int:
+        if self._keys_partitioned:
+            return self.children[0].num_partitions(ctx)
         return 1
 
     # ------------------------------------------------------------------
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child = self.children[0]
-        batches = []
-        for p in range(child.num_partitions(ctx)):
-            batches.extend(child.partition_iter(ctx, p))
+        if self._keys_partitioned:
+            batches = list(child.partition_iter(ctx, pid))
+            if not batches:
+                return
+        else:
+            batches = []
+            for p in range(child.num_partitions(ctx)):
+                batches.extend(child.partition_iter(ctx, p))
         if ctx.is_device:
             if not batches:
                 from spark_rapids_tpu.exec.core import host_to_device

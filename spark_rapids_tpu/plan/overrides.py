@@ -111,8 +111,15 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         return PlannedNode(UnionExec([c.exec_node for c in cs]), [], cs)
     if isinstance(node, L.Window):
         c = lower(node.child, conf)
-        ex = WindowExec(node.window_exprs, c.exec_node)
-        return PlannedNode(ex, list(node.window_exprs), [c])
+        # partition on the first expression's spec; WindowExec itself
+        # validates that every expression shares it (window.py)
+        first = node.window_exprs[0]
+        inner = first.children[0] if isinstance(first, Alias) else first
+        cur, keys_partitioned = _ensure_window_distribution(
+            c, inner.spec, conf)
+        ex = WindowExec(node.window_exprs, cur.exec_node,
+                        keys_partitioned=keys_partitioned)
+        return PlannedNode(ex, list(node.window_exprs), [cur])
     if isinstance(node, L.Expand):
         c = lower(node.child, conf)
         from spark_rapids_tpu.exec.expand import ExpandExec
@@ -247,6 +254,52 @@ def _split_pandas_udfs(exprs):
     return plain, udfs
 
 
+def _window_key_names(keys) -> tuple | None:
+    """Canonical column-name tuple for a key list, or None when any key
+    is not a plain column reference (structural comparison is then not
+    attempted and an exchange is inserted conservatively)."""
+    from spark_rapids_tpu.expr.core import UnresolvedAttribute
+    names = []
+    for k in keys:
+        if isinstance(k, Alias):
+            k = k.children[0]
+        if not isinstance(k, UnresolvedAttribute):
+            return None
+        names.append(k.name)
+    return tuple(names)
+
+
+def _ensure_window_distribution(cur: PlannedNode, spec,
+                                conf: TpuConf) -> tuple[PlannedNode, bool]:
+    """Hash-partition on the window partition keys so the window program
+    runs per partition instead of collapsing all upstream parallelism
+    into one global batch (Spark's EnsureRequirements inserts the same
+    exchange for ClusteredDistribution; reference GpuWindowExec.scala:92
+    needs one batch per partition GROUP only).  Skips the exchange when
+    the child is already hash-partitioned on a subset of the window keys
+    — rows equal on the window keys are then already co-located."""
+    if not spec.partition_by:
+        return cur, False
+    if cur.exec_node.num_partitions(ExecCtx(backend="host")) <= 1:
+        return cur, False
+    want = _window_key_names(spec.partition_by)
+    if want is not None:
+        node = cur.exec_node
+        # window output preserves its child's distribution: look through
+        # WindowExecs stacked by earlier specs of the same projection
+        while isinstance(node, WindowExec) and node._keys_partitioned:
+            node = node.children[0]
+        if isinstance(node, ShuffleExchangeExec) and \
+                isinstance(node.partitioning, HashPartitioning):
+            have = _window_key_names(node.partitioning._keys)
+            if have and set(have) <= set(want):
+                return cur, True
+    part = HashPartitioning(list(spec.partition_by),
+                            conf.shuffle_partitions)
+    exch = ShuffleExchangeExec(part, cur.exec_node)
+    return PlannedNode(exch, list(spec.partition_by), [cur]), True
+
+
 def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
     from spark_rapids_tpu.udf import maybe_compile_udfs
@@ -267,8 +320,10 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
         inner = w.children[0] if isinstance(w, Alias) else w
         by_spec.setdefault(inner.spec, []).append(w)
     cur = c
-    for spec_windows in by_spec.values():
-        ex = WindowExec(spec_windows, cur.exec_node)
+    for spec, spec_windows in by_spec.items():
+        cur, keys_partitioned = _ensure_window_distribution(cur, spec, conf)
+        ex = WindowExec(spec_windows, cur.exec_node,
+                        keys_partitioned=keys_partitioned)
         cur = PlannedNode(ex, list(spec_windows), [cur])
     ex = ProjectExec(plain, cur.exec_node)
     return PlannedNode(ex, list(plain), [cur])
