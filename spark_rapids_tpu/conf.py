@@ -306,8 +306,10 @@ def generate_docs() -> str:
             continue
         try:
             importlib.import_module(m.name)
-        except ImportError as e:
-            # a skipped module silently drops its keys from the docs —
+        except Exception as e:  # noqa: BLE001 - any import-time failure
+            # (not just ImportError: device/backend init in a module
+            # must not abort the whole generator) skips ONE module; a
+            # skipped module silently drops its keys from the docs —
             # make that loud instead of invisible
             import warnings
             warnings.warn(f"generate_docs: could not import {m.name} "

@@ -44,6 +44,10 @@ class CachedScanExec(PlanNode):
         # per partition: list of (blob, raw_size) compressed Arrow IPC
         self._blobs: list[list[tuple[bytes, int]]] | None = None
         self._nparts: int | None = None
+        # last partition count handed to a planner; a re-materialization
+        # that produces a DIFFERENT count is refused loudly (a consumer
+        # iterating the old count would silently miss or duplicate rows)
+        self._advertised: int | None = None
         self.metrics = {"cached_bytes": 0, "raw_bytes": 0}
 
     @property
@@ -59,12 +63,14 @@ class CachedScanExec(PlanNode):
         # device count silently dropped partitions (review repro)
         with self._lock:
             if self._blobs is not None:
-                return max(1, len(self._blobs))
+                self._advertised = max(1, len(self._blobs))
+                return self._advertised
             if self._nparts is None:
                 with ExecCtx(backend=self._source_backend,
                              conf=self._conf) as mctx:
                     self._nparts = max(
                         1, self._source.num_partitions(mctx))
+            self._advertised = self._nparts
             return self._nparts
 
     # -- materialization ----------------------------------------------
@@ -91,6 +97,13 @@ class CachedScanExec(PlanNode):
                         comp_total += len(blob)
                         part.append((blob, len(raw)))
                     blobs.append(part)
+            if self._advertised is not None and \
+                    max(1, len(blobs)) != self._advertised:
+                raise RuntimeError(
+                    f"cache re-materialized with {len(blobs)} partitions "
+                    f"but a plan was built against {self._advertised}; "
+                    "a consumer would silently miss rows — re-plan the "
+                    "query after unpersist()")
             # metrics assigned only on SUCCESS: a failed materialization
             # must not leave partial counts that a retry double-counts
             self._blobs = blobs
@@ -102,6 +115,10 @@ class CachedScanExec(PlanNode):
         (reference: unpersist drops the cached RDD blocks)."""
         with self._lock:
             self._blobs = None
+            # a re-materialization may yield a different partition count;
+            # a stale cached count would let consumers index past the
+            # new blob list
+            self._nparts = None
             self.metrics["cached_bytes"] = 0
             self.metrics["raw_bytes"] = 0
 
@@ -120,6 +137,13 @@ class CachedScanExec(PlanNode):
             self._ensure()
             with self._lock:
                 if self._blobs is not None:
+                    if pid >= len(self._blobs):
+                        # backstop: count changes across re-materialize
+                        # are refused loudly in _ensure(); reaching here
+                        # means the consumer's pid never existed
+                        raise IndexError(
+                            f"cache partition {pid} out of range "
+                            f"({len(self._blobs)} materialized)")
                     part = list(self._blobs[pid])
                     break
         for blob, raw_size in part:

@@ -65,6 +65,17 @@ def to_jax(df, include_strings: bool = False) -> dict:
     out: dict = {}
     parts: dict = {}
     schema = df.schema
+    # result schemas can legally carry duplicate names (e.g. after a
+    # join), but chunk accumulation and the returned dict are name-keyed
+    # — duplicates would silently merge mismatched columns, so they are
+    # refused up front
+    seen: dict = {}
+    for i, f in enumerate(schema):
+        if f.name in seen:
+            raise ValueError(
+                f"to_jax cannot export duplicate column name {f.name!r} "
+                f"(positions {seen[f.name]} and {i}); alias one side")
+        seen[f.name] = i
     want_strings = include_strings and any(
         isinstance(f.data_type, T.StringType) for f in schema)
     for b in device_batches(df):

@@ -60,7 +60,11 @@ def _effective_batch_rows(schema: T.Schema, settings: dict) -> int:
     byte_cap = MAX_READER_BATCH_SIZE_BYTES.get(settings)
     width = 1  # validity
     for f in schema:
-        if f.data_type.np_dtype is None:   # strings, maps
+        # ArrayType.np_dtype is the ELEMENT dtype — one element's
+        # itemsize would undercount a row by up to max_len x, so arrays
+        # use the variable-width estimate like strings and maps
+        if f.data_type.np_dtype is None or \
+                isinstance(f.data_type, T.ArrayType):
             width += 32          # offset + data estimate
         else:
             width += max(1, f.data_type.np_dtype.itemsize)

@@ -310,7 +310,15 @@ def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
                     _send_frame(sock, _TAG_JSON, b"{}")
                     recv_window = 0
                 if codec is not None:
+                    if len(frame) < 4:
+                        raise ShuffleFetchError(
+                            f"malformed compressed frame: {len(frame)} "
+                            "bytes, need >= 4 for the raw-size prefix")
                     (raw_size,) = struct.unpack(">I", frame[:4])
+                    if raw_size > max_frame:
+                        raise ShuffleFetchError(
+                            f"compressed frame claims raw size {raw_size} "
+                            f"> max frame {max_frame}")
                     frame = codec.decompress(frame[4:], raw_size)
                 yield deserialize_batch(frame, device=device)
     except TimeoutError as e:

@@ -99,3 +99,18 @@ def test_transition_arity_mismatch_fails_loudly():
     ov = TpuOverrides(TpuConf({}))
     with pytest.raises(AssertionError, match="arity"):
         ov._insert_transitions(bad)
+
+
+def test_to_jax_rejects_duplicate_column_names():
+    """Round-3 advisor: to_jax keyed chunks by name, silently merging
+    duplicate output columns (legal in Spark, e.g. after a join)."""
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("k", T.LongType()),
+                       T.StructField("v", T.LongType())])
+    df = s.from_pydict({"k": [1, 2], "v": [10, 20]}, schema)
+    dup = df.select(col("k"), col("v").alias("k"))
+    with pytest.raises(ValueError, match="duplicate column name"):
+        dup.to_jax()
+    # distinct names still export fine
+    out = df.to_jax()
+    assert set(out) == {"k", "v"}
