@@ -23,10 +23,20 @@ __all__ = ["generate_tpcds", "table_row_counts", "TABLES"]
 
 TABLES = ("date_dim", "time_dim", "item", "customer", "customer_address",
           "store", "customer_demographics", "household_demographics",
-          "promotion", "store_sales", "catalog_sales", "web_sales")
+          "promotion", "warehouse", "ship_mode", "reason", "income_band",
+          "call_center", "web_site", "web_page", "catalog_page",
+          "inventory", "store_sales", "store_returns",
+          "catalog_sales", "catalog_returns", "web_sales", "web_returns")
 
 #: bump when generated schemas change; tables regenerate on mismatch
-_SCHEMA_VERSION = "v4"
+_SCHEMA_VERSION = "v5"
+
+#: returns tables are sampled FROM their parent's rows so that joins on
+#: (item_sk, ticket/order number) actually match (dsdgen links them the
+#: same way); generated right after the parent from its in-memory data
+_RETURNS_PARENT = {"store_returns": "store_sales",
+                   "catalog_returns": "catalog_sales",
+                   "web_returns": "web_sales"}
 
 _DATE_SK_EPOCH = 2415022            # dsdgen: d_date_sk of 1900-01-01
 _DATE_DIM_DAYS = 73049              # 1900-01-01 .. 2099-12-31
@@ -59,6 +69,9 @@ def table_row_counts(sf: float) -> dict[str, int]:
     sublinear (item SF1=18k, customer SF1=100k)."""
     sf = max(sf, 0.001)
     n_cust = max(200, int(100_000 * sf ** 0.7))
+    n_ss = max(1000, int(2_880_000 * sf))
+    n_cs = max(500, int(1_440_000 * sf))
+    n_ws = max(250, int(720_000 * sf))
     return {
         "date_dim": _DATE_DIM_DAYS,
         "time_dim": 86_400,
@@ -69,9 +82,23 @@ def table_row_counts(sf: float) -> dict[str, int]:
         "customer_demographics": max(500, int(50_000 * sf ** 0.5)),
         "household_demographics": 7_200,
         "promotion": max(30, int(300 * sf ** 0.5)),
-        "store_sales": max(1000, int(2_880_000 * sf)),
-        "catalog_sales": max(500, int(1_440_000 * sf)),
-        "web_sales": max(250, int(720_000 * sf)),
+        "warehouse": max(2, int(5 * sf ** 0.5)),
+        "ship_mode": 20,
+        "reason": 35,
+        "income_band": 20,
+        "call_center": max(2, int(6 * sf ** 0.25)),
+        "web_site": max(2, int(30 * sf ** 0.25)),
+        "web_page": max(10, int(60 * sf ** 0.25)),
+        "catalog_page": max(100, int(11_000 * sf ** 0.25)),
+        # dsdgen inventory is (items x warehouses x weeks); sampled to a
+        # bench-sized subset that still exercises the same join/agg shapes
+        "inventory": max(5000, int(1_200_000 * sf)),
+        "store_sales": n_ss,
+        "store_returns": max(100, n_ss // 10),
+        "catalog_sales": n_cs,
+        "catalog_returns": max(50, n_cs // 10),
+        "web_sales": n_ws,
+        "web_returns": max(25, n_ws // 10),
     }
 
 
@@ -82,6 +109,9 @@ def _gen_date_dim(counts) -> dict[str, np.ndarray]:
     m = dates.astype("datetime64[M]").astype(int) % 12 + 1
     dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
     dow = (days + 1) % 7            # 1900-01-01 was a Monday; 0 = Sunday
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"], dtype=object)
+    q = ((m - 1) // 3 + 1)
     return {
         "d_date_sk": (days + _DATE_SK_EPOCH).astype(np.int32),
         "d_date": (days - _UNIX_EPOCH_OFF).astype(np.int32),  # DateType
@@ -90,7 +120,12 @@ def _gen_date_dim(counts) -> dict[str, np.ndarray]:
         "d_dom": dom.astype(np.int32),
         "d_dow": dow.astype(np.int32),
         "d_month_seq": ((y - 1900) * 12 + (m - 1)).astype(np.int32),
-        "d_qoy": ((m - 1) // 3 + 1).astype(np.int32),
+        "d_qoy": q.astype(np.int32),
+        # weeks start Sunday (dow 0); 1900-01-01 (Monday) is in week 1
+        "d_week_seq": ((days + 1) // 7 + 1).astype(np.int32),
+        "d_day_name": day_names[dow],
+        "d_quarter_name": np.array([f"{yy}Q{qq}" for yy, qq in zip(y, q)],
+                                   dtype=object),
     }
 
 
@@ -137,6 +172,19 @@ def _gen_item(rng, n: int) -> dict[str, np.ndarray]:
         "i_manufact_id": manu,
         "i_manufact": np.array([f"manufact#{v}" for v in manu], dtype=object),
         "i_manager_id": rng.integers(1, 101, n).astype(np.int32),
+        "i_size": np.array([("small", "medium", "large", "extra large",
+                             "economy", "N/A", "petite")[v]
+                            for v in rng.integers(0, 7, n)], dtype=object),
+        "i_color": np.array([("red", "blue", "green", "yellow", "pale",
+                              "chiffon", "smoke", "orchid", "peach",
+                              "saddle", "powder", "burnished")[v]
+                             for v in rng.integers(0, 12, n)], dtype=object),
+        "i_units": np.array([("Each", "Dozen", "Case", "Pallet", "Gross",
+                              "Oz", "Lb", "Ton")[v]
+                             for v in rng.integers(0, 8, n)], dtype=object),
+        "i_product_name": np.array([f"product{k}" for k in range(1, n + 1)],
+                                   dtype=object),
+        "i_wholesale_cost": np.round(rng.uniform(0.05, 80.0, n), 2),
     }
 
 
@@ -160,6 +208,32 @@ def _gen_customer(rng, n: int, n_addr: int, n_cdemo: int,
             rng, np.array([_LAST[i] for i in
                            rng.integers(0, len(_LAST), n)], dtype=object),
             0.01),
+        "c_salutation": _with_nulls(
+            rng, np.array([("Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir")[v]
+                           for v in rng.integers(0, 6, n)], dtype=object),
+            0.01),
+        "c_preferred_cust_flag": _with_nulls(
+            rng, np.array([("Y", "N")[v] for v in rng.integers(0, 2, n)],
+                          dtype=object), 0.03),
+        "c_birth_year": _with_nulls(
+            rng, rng.integers(1924, 1993, n).astype(np.int32), 0.02),
+        "c_birth_month": _with_nulls(
+            rng, rng.integers(1, 13, n).astype(np.int32), 0.02),
+        "c_birth_day": _with_nulls(
+            rng, rng.integers(1, 29, n).astype(np.int32), 0.02),
+        "c_birth_country": _with_nulls(
+            rng, np.array([("UNITED STATES", "CANADA", "MEXICO", "FRANCE",
+                            "GERMANY", "JAPAN", "BRAZIL", "INDIA")[v]
+                           for v in rng.integers(0, 8, n)], dtype=object),
+            0.02),
+        "c_first_sales_date_sk": _with_nulls(
+            rng, (rng.integers(_SALES_DATE_LO - 1500, _SALES_DATE_HI - 300,
+                               n) + _DATE_SK_EPOCH).astype(np.int32), 0.03),
+        "c_first_shipto_date_sk": _with_nulls(
+            rng, (rng.integers(_SALES_DATE_LO - 1400, _SALES_DATE_HI - 200,
+                               n) + _DATE_SK_EPOCH).astype(np.int32), 0.03),
+        "c_email_address": np.array(
+            [f"user{k}@example.com" for k in range(1, n + 1)], dtype=object),
     }
 
 
@@ -178,6 +252,17 @@ def _gen_customer_address(rng, n: int) -> dict[str, np.ndarray]:
                             rng.integers(10000, 99999, n)], dtype=object),
         "ca_gmt_offset": rng.choice([-10.0, -9.0, -8.0, -7.0, -6.0, -5.0],
                                     n),
+        "ca_country": _with_nulls(
+            rng, np.array(["United States"] * n, dtype=object), 0.005),
+        "ca_street_number": np.array([f"{v}" for v in
+                                      rng.integers(1, 1000, n)],
+                                     dtype=object),
+        "ca_street_name": np.array([f"Street{v:03d}" for v in
+                                    rng.integers(0, 300, n)], dtype=object),
+        "ca_location_type": _with_nulls(
+            rng, np.array([("apartment", "condo", "single family")[v]
+                           for v in rng.integers(0, 3, n)], dtype=object),
+            0.01),
     }
 
 
@@ -199,6 +284,18 @@ def _gen_store(rng, n: int) -> dict[str, np.ndarray]:
         "s_company_name": np.array(["Unknown"] * n, dtype=object),
         "s_gmt_offset": np.array([(-8.0, -7.0, -6.0, -5.0)[k % 4]
                                   for k in range(n)]),
+        "s_number_employees": rng.integers(200, 301, n).astype(np.int32),
+        "s_floor_space": rng.integers(5_000_000, 10_000_000,
+                                      n).astype(np.int32),
+        "s_market_id": rng.integers(1, 11, n).astype(np.int32),
+        "s_zip": np.array([f"{v:05d}" for v in
+                           rng.integers(10000, 99999, n)], dtype=object),
+        "s_street_number": np.array([f"{v}" for v in
+                                     rng.integers(1, 1000, n)], dtype=object),
+        "s_street_name": np.array([f"Street{v:03d}" for v in
+                                   rng.integers(0, 300, n)], dtype=object),
+        "s_suite_number": np.array([f"Suite {v}" for v in
+                                    rng.integers(0, 100, n)], dtype=object),
     }
 
 
@@ -230,6 +327,117 @@ def _gen_household_demographics(rng, n: int) -> dict[str, np.ndarray]:
         "hd_buy_potential": np.array(
             [(">10000", "5001-10000", "1001-5000", "501-1000", "0-500",
               "Unknown")[v] for v in rng.integers(0, 6, n)], dtype=object),
+        "hd_income_band_sk": rng.integers(1, 21, n).astype(np.int32),
+    }
+
+
+def _gen_warehouse(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "w_warehouse_sk": np.arange(1, n + 1, dtype=np.int32),
+        "w_warehouse_name": np.array([f"Warehouse {k}" for k in
+                                      range(1, n + 1)], dtype=object),
+        "w_warehouse_sq_ft": rng.integers(50_000, 1_000_000,
+                                          n).astype(np.int32),
+        "w_city": np.array([f"City{v:03d}" for v in
+                            rng.integers(0, 40, n)], dtype=object),
+        "w_county": np.array([f"County{v:03d}" for v in
+                              rng.integers(0, 30, n)], dtype=object),
+        "w_state": np.array([_STATES[i] for i in rng.integers(0, 10, n)],
+                            dtype=object),
+        "w_country": np.array(["United States"] * n, dtype=object),
+    }
+
+
+def _gen_ship_mode(rng, n: int) -> dict[str, np.ndarray]:
+    types = ("EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY")
+    carriers = ("UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+                "LATVIAN", "DIAMOND", "BARIAN")
+    return {
+        "sm_ship_mode_sk": np.arange(1, n + 1, dtype=np.int32),
+        "sm_type": np.array([types[k % len(types)] for k in range(n)],
+                            dtype=object),
+        "sm_carrier": np.array([carriers[k % len(carriers)]
+                                for k in range(n)], dtype=object),
+        "sm_code": np.array([("AIR", "SURFACE", "SEA", "LIBRARY")[k % 4]
+                             for k in range(n)], dtype=object),
+    }
+
+
+def _gen_reason(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "r_reason_sk": np.arange(1, n + 1, dtype=np.int32),
+        "r_reason_desc": np.array(
+            [f"reason {k}" for k in range(1, n + 1)], dtype=object),
+    }
+
+
+def _gen_income_band(rng, n: int) -> dict[str, np.ndarray]:
+    sk = np.arange(1, n + 1, dtype=np.int32)
+    return {
+        "ib_income_band_sk": sk,
+        "ib_lower_bound": ((sk - 1) * 10_000).astype(np.int32),
+        "ib_upper_bound": (sk * 10_000 - 1).astype(np.int32),
+    }
+
+
+def _gen_call_center(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "cc_call_center_sk": np.arange(1, n + 1, dtype=np.int32),
+        "cc_call_center_id": np.array(
+            [f"AAAAAAAA{k:08d}" for k in range(1, n + 1)], dtype=object),
+        "cc_name": np.array([f"call center {k}" for k in range(1, n + 1)],
+                            dtype=object),
+        "cc_manager": np.array(
+            [f"{_FIRST[rng.integers(0, len(_FIRST))]} "
+             f"{_LAST[rng.integers(0, len(_LAST))]}" for _ in range(n)],
+            dtype=object),
+        "cc_county": np.array([f"County{v:03d}" for v in
+                               rng.integers(0, 30, n)], dtype=object),
+    }
+
+
+def _gen_web_site(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "web_site_sk": np.arange(1, n + 1, dtype=np.int32),
+        "web_site_id": np.array(
+            [f"AAAAAAAA{k:08d}" for k in range(1, n + 1)], dtype=object),
+        "web_name": np.array([f"site_{k % 30}" for k in range(n)],
+                             dtype=object),
+        "web_company_name": np.array(
+            [("pri", "ought", "able", "ese", "anti", "cally")[k % 6]
+             for k in range(n)], dtype=object),
+    }
+
+
+def _gen_web_page(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "wp_web_page_sk": np.arange(1, n + 1, dtype=np.int32),
+        "wp_char_count": rng.integers(100, 8_000, n).astype(np.int32),
+    }
+
+
+def _gen_catalog_page(rng, n: int) -> dict[str, np.ndarray]:
+    return {
+        "cp_catalog_page_sk": np.arange(1, n + 1, dtype=np.int32),
+        "cp_catalog_page_id": np.array(
+            [f"AAAAAAAA{k:08d}" for k in range(1, n + 1)], dtype=object),
+    }
+
+
+def _gen_inventory(rng, n: int, counts) -> dict[str, np.ndarray]:
+    # weekly snapshot dates across the sales window (dsdgen convention);
+    # (date, item, warehouse) triples sampled instead of the full cross
+    # product (bench-sized; the join/agg shapes are what matter)
+    weeks = np.arange(_SALES_DATE_LO, _SALES_DATE_HI + 1, 7, dtype=np.int64)
+    return {
+        "inv_date_sk": (rng.choice(weeks, n)
+                        + _DATE_SK_EPOCH).astype(np.int32),
+        "inv_item_sk": rng.integers(1, counts["item"] + 1,
+                                    n).astype(np.int32),
+        "inv_warehouse_sk": rng.integers(1, counts["warehouse"] + 1,
+                                         n).astype(np.int32),
+        "inv_quantity_on_hand": _with_nulls(
+            rng, rng.integers(0, 1_000, n).astype(np.int32), 0.02),
     }
 
 
@@ -279,31 +487,69 @@ def _gen_store_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
             0.02),
         "ss_ticket_number": rng.integers(1, max(n // 3, 2),
                                          n).astype(np.int64),
+        "ss_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              n).astype(np.int32), 0.03),
         "ss_quantity": qty,
         "ss_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
         "ss_sales_price": price,
         "ss_ext_sales_price": ext,
+        "ss_ext_list_price": np.round(price * rng.uniform(1.0, 1.5, n)
+                                      * qty, 2),
+        "ss_ext_discount_amt": np.round(
+            ext * rng.choice([0.0, 0.0, 0.05, 0.2], n), 2),
+        "ss_ext_tax": np.round(ext * 0.08, 2),
         "ss_wholesale_cost": wholesale,
         "ss_ext_wholesale_cost": np.round(wholesale * qty, 2),
         "ss_coupon_amt": np.round(
             ext * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3], n), 2),
+        "ss_net_paid": np.round(ext * rng.uniform(0.7, 1.0, n), 2),
+        "ss_net_paid_inc_tax": np.round(ext * 1.08, 2),
         "ss_net_profit": np.round(ext - wholesale * qty, 2),
     }
 
 
 def _gen_catalog_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
     qty, price, wholesale, ext = _sales_common(rng, n, counts, "cs")
+    sold = (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
+            + _DATE_SK_EPOCH).astype(np.int64)
     return {
-        "cs_sold_date_sk": _with_nulls(
-            rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
-                  + _DATE_SK_EPOCH).astype(np.int32), 0.02),
+        "cs_sold_date_sk": _with_nulls(rng, sold.astype(np.int32), 0.02),
+        "cs_ship_date_sk": _with_nulls(
+            rng, (sold + rng.integers(1, 120, n)).astype(np.int32), 0.02),
         "cs_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
+        "cs_order_number": rng.integers(1, max(n // 2, 2),
+                                        n).astype(np.int64),
         "cs_bill_customer_sk": _with_nulls(
             rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
             0.03),
         "cs_bill_cdemo_sk": _with_nulls(
             rng, rng.integers(1, counts["customer_demographics"] + 1,
                               n).astype(np.int32), 0.03),
+        "cs_bill_hdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["household_demographics"] + 1,
+                              n).astype(np.int32), 0.03),
+        "cs_bill_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              n).astype(np.int32), 0.03),
+        "cs_ship_customer_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
+            0.03),
+        "cs_ship_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              n).astype(np.int32), 0.03),
+        "cs_ship_mode_sk": _with_nulls(
+            rng, rng.integers(1, counts["ship_mode"] + 1,
+                              n).astype(np.int32), 0.02),
+        "cs_warehouse_sk": _with_nulls(
+            rng, rng.integers(1, counts["warehouse"] + 1,
+                              n).astype(np.int32), 0.02),
+        "cs_call_center_sk": _with_nulls(
+            rng, rng.integers(1, counts["call_center"] + 1,
+                              n).astype(np.int32), 0.02),
+        "cs_catalog_page_sk": _with_nulls(
+            rng, rng.integers(1, counts["catalog_page"] + 1,
+                              n).astype(np.int32), 0.02),
         "cs_promo_sk": _with_nulls(
             rng, rng.integers(1, counts["promotion"] + 1, n).astype(np.int32),
             0.02),
@@ -311,25 +557,195 @@ def _gen_catalog_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
         "cs_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
         "cs_sales_price": price,
         "cs_ext_sales_price": ext,
+        "cs_ext_list_price": np.round(price * rng.uniform(1.0, 1.5, n)
+                                      * qty, 2),
+        "cs_ext_discount_amt": np.round(
+            ext * rng.choice([0.0, 0.0, 0.05, 0.2], n), 2),
+        "cs_ext_ship_cost": np.round(ext * rng.uniform(0.01, 0.1, n), 2),
+        "cs_wholesale_cost": wholesale,
+        "cs_ext_wholesale_cost": np.round(wholesale * qty, 2),
         "cs_coupon_amt": np.round(
             ext * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3], n), 2),
+        "cs_net_paid": np.round(ext * rng.uniform(0.7, 1.0, n), 2),
+        "cs_net_profit": np.round(ext - wholesale * qty, 2),
     }
 
 
 def _gen_web_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
     qty, price, wholesale, ext = _sales_common(rng, n, counts, "ws")
+    sold = (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
+            + _DATE_SK_EPOCH).astype(np.int64)
     return {
-        "ws_sold_date_sk": _with_nulls(
-            rng, (rng.integers(_SALES_DATE_LO, _SALES_DATE_HI + 1, n)
-                  + _DATE_SK_EPOCH).astype(np.int32), 0.02),
+        "ws_sold_date_sk": _with_nulls(rng, sold.astype(np.int32), 0.02),
+        "ws_sold_time_sk": _with_nulls(
+            rng, rng.integers(0, 86_400, n).astype(np.int32), 0.02),
+        "ws_ship_date_sk": _with_nulls(
+            rng, (sold + rng.integers(1, 120, n)).astype(np.int32), 0.02),
         "ws_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
+        "ws_order_number": rng.integers(1, max(n // 2, 2),
+                                        n).astype(np.int64),
         "ws_bill_customer_sk": _with_nulls(
             rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
             0.03),
+        "ws_bill_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              n).astype(np.int32), 0.03),
+        "ws_ship_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              n).astype(np.int32), 0.03),
+        "ws_web_site_sk": _with_nulls(
+            rng, rng.integers(1, counts["web_site"] + 1,
+                              n).astype(np.int32), 0.02),
+        "ws_web_page_sk": _with_nulls(
+            rng, rng.integers(1, counts["web_page"] + 1,
+                              n).astype(np.int32), 0.02),
+        "ws_ship_mode_sk": _with_nulls(
+            rng, rng.integers(1, counts["ship_mode"] + 1,
+                              n).astype(np.int32), 0.02),
+        "ws_promo_sk": _with_nulls(
+            rng, rng.integers(1, counts["promotion"] + 1, n).astype(np.int32),
+            0.02),
         "ws_quantity": qty,
         "ws_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
         "ws_sales_price": price,
         "ws_ext_sales_price": ext,
+        "ws_ext_list_price": np.round(price * rng.uniform(1.0, 1.5, n)
+                                      * qty, 2),
+        "ws_ext_discount_amt": np.round(
+            ext * rng.choice([0.0, 0.0, 0.05, 0.2], n), 2),
+        "ws_ext_ship_cost": np.round(ext * rng.uniform(0.01, 0.1, n), 2),
+        "ws_wholesale_cost": wholesale,
+        "ws_ext_wholesale_cost": np.round(wholesale * qty, 2),
+        "ws_net_paid": np.round(ext * rng.uniform(0.7, 1.0, n), 2),
+        "ws_net_profit": np.round(ext - wholesale * qty, 2),
+    }
+
+
+def _pick(col, idx):
+    """Sample parent column values at row indices ``idx`` (object arrays
+    keep their Nones)."""
+    return np.asarray(col)[idx]
+
+
+def _ret_date_col(rng, ret_date: np.ndarray, null_frac: float):
+    """returned_date_sk column: sentinel 0 (parent sold date was NULL)
+    becomes None — dsdgen emits NULL there, and a non-null 0 would be
+    unjoinable-but-countable in IS NULL / outer-join queries."""
+    out = ret_date.astype(object)
+    out[ret_date == 0] = None
+    return _with_nulls(rng, out, null_frac)
+
+
+def _returns_common(rng, parent: dict, n: int, item_col: str,
+                    date_col: str, qty_col: str, price_col: str):
+    """Sample n parent rows; returned date = sold date + U(1,90) days,
+    return qty <= sold qty, amounts derived from the parent price."""
+    pn = len(parent[item_col])
+    idx = rng.choice(pn, size=min(n, pn), replace=False)
+    idx.sort()
+    sold = parent[date_col]
+    sold_days = np.array([0 if v is None else int(v) for v in
+                          np.asarray(sold, dtype=object)[idx]]
+                         if np.asarray(sold).dtype == object
+                         else np.asarray(sold)[idx], dtype=np.int64)
+    ret_date = np.where(sold_days > 0,
+                        sold_days + rng.integers(1, 91, len(idx)),
+                        0).astype(np.int64)
+    qty = np.asarray(parent[qty_col])[idx].astype(np.int64)
+    rqty = rng.integers(1, np.maximum(qty, 1) + 1).astype(np.int32)
+    price = np.asarray(parent[price_col])[idx].astype(np.float64)
+    amt = np.round(price * rqty, 2)
+    return idx, ret_date, rqty, amt
+
+
+def _gen_store_returns(rng, counts, parent: dict) -> dict[str, np.ndarray]:
+    n = counts["store_returns"]
+    idx, ret_date, rqty, amt = _returns_common(
+        rng, parent, n, "ss_item_sk",
+        "ss_sold_date_sk", "ss_quantity", "ss_sales_price")
+    return {
+        "sr_returned_date_sk": _ret_date_col(rng, ret_date, 0.02),
+        "sr_item_sk": _pick(parent["ss_item_sk"], idx).astype(np.int32),
+        "sr_ticket_number": _pick(parent["ss_ticket_number"],
+                                  idx).astype(np.int64),
+        "sr_customer_sk": _pick(parent["ss_customer_sk"], idx),
+        "sr_store_sk": _pick(parent["ss_store_sk"], idx),
+        "sr_reason_sk": _with_nulls(
+            rng, rng.integers(1, counts["reason"] + 1,
+                              len(idx)).astype(np.int32), 0.02),
+        "sr_return_quantity": _with_nulls(rng, rqty, 0.02),
+        "sr_return_amt": amt,
+        "sr_net_loss": np.round(amt * rng.uniform(0.3, 1.1, len(idx)), 2),
+        "sr_fee": np.round(rng.uniform(0.5, 100.0, len(idx)), 2),
+        "sr_refunded_cash": np.round(amt * rng.uniform(0.0, 1.0, len(idx)),
+                                     2),
+        "sr_return_amt_inc_tax": np.round(amt * 1.08, 2),
+    }
+
+
+def _gen_catalog_returns(rng, counts, parent: dict) -> dict[str, np.ndarray]:
+    n = counts["catalog_returns"]
+    idx, ret_date, rqty, amt = _returns_common(
+        rng, parent, n, "cs_item_sk",
+        "cs_sold_date_sk", "cs_quantity", "cs_sales_price")
+    return {
+        "cr_returned_date_sk": _ret_date_col(rng, ret_date, 0.02),
+        "cr_item_sk": _pick(parent["cs_item_sk"], idx).astype(np.int32),
+        "cr_order_number": _pick(parent["cs_order_number"],
+                                 idx).astype(np.int64),
+        "cr_returning_customer_sk": _pick(parent["cs_bill_customer_sk"],
+                                          idx),
+        "cr_refunded_customer_sk": _pick(parent["cs_bill_customer_sk"], idx),
+        "cr_returning_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              len(idx)).astype(np.int32), 0.03),
+        "cr_call_center_sk": _pick(parent["cs_call_center_sk"], idx),
+        "cr_catalog_page_sk": _pick(parent["cs_catalog_page_sk"], idx),
+        "cr_reason_sk": _with_nulls(
+            rng, rng.integers(1, counts["reason"] + 1,
+                              len(idx)).astype(np.int32), 0.02),
+        "cr_return_quantity": _with_nulls(rng, rqty, 0.02),
+        "cr_return_amount": amt,
+        "cr_return_amt_inc_tax": np.round(amt * 1.08, 2),
+        "cr_net_loss": np.round(amt * rng.uniform(0.3, 1.1, len(idx)), 2),
+    }
+
+
+def _gen_web_returns(rng, counts, parent: dict) -> dict[str, np.ndarray]:
+    n = counts["web_returns"]
+    idx, ret_date, rqty, amt = _returns_common(
+        rng, parent, n, "ws_item_sk",
+        "ws_sold_date_sk", "ws_quantity", "ws_sales_price")
+    return {
+        "wr_returned_date_sk": _ret_date_col(rng, ret_date, 0.02),
+        "wr_item_sk": _pick(parent["ws_item_sk"], idx).astype(np.int32),
+        "wr_order_number": _pick(parent["ws_order_number"],
+                                 idx).astype(np.int64),
+        "wr_returning_customer_sk": _pick(parent["ws_bill_customer_sk"],
+                                          idx),
+        "wr_refunded_customer_sk": _pick(parent["ws_bill_customer_sk"], idx),
+        "wr_returning_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              len(idx)).astype(np.int32), 0.03),
+        "wr_refunded_addr_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_address"] + 1,
+                              len(idx)).astype(np.int32), 0.03),
+        "wr_refunded_cdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_demographics"] + 1,
+                              len(idx)).astype(np.int32), 0.03),
+        "wr_returning_cdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer_demographics"] + 1,
+                              len(idx)).astype(np.int32), 0.03),
+        "wr_web_page_sk": _pick(parent["ws_web_page_sk"], idx),
+        "wr_reason_sk": _with_nulls(
+            rng, rng.integers(1, counts["reason"] + 1,
+                              len(idx)).astype(np.int32), 0.02),
+        "wr_return_quantity": _with_nulls(rng, rqty, 0.02),
+        "wr_return_amt": amt,
+        "wr_fee": np.round(rng.uniform(0.5, 100.0, len(idx)), 2),
+        "wr_refunded_cash": np.round(amt * rng.uniform(0.0, 1.0, len(idx)),
+                                     2),
+        "wr_net_loss": np.round(amt * rng.uniform(0.3, 1.1, len(idx)), 2),
     }
 
 
@@ -349,12 +765,33 @@ _GENERATORS = {
     "household_demographics": lambda rng, counts:
         _gen_household_demographics(rng, counts["household_demographics"]),
     "promotion": lambda rng, counts: _gen_promotion(rng, counts["promotion"]),
+    "warehouse": lambda rng, counts: _gen_warehouse(
+        rng, counts["warehouse"]),
+    "ship_mode": lambda rng, counts: _gen_ship_mode(
+        rng, counts["ship_mode"]),
+    "reason": lambda rng, counts: _gen_reason(rng, counts["reason"]),
+    "income_band": lambda rng, counts: _gen_income_band(
+        rng, counts["income_band"]),
+    "call_center": lambda rng, counts: _gen_call_center(
+        rng, counts["call_center"]),
+    "web_site": lambda rng, counts: _gen_web_site(rng, counts["web_site"]),
+    "web_page": lambda rng, counts: _gen_web_page(rng, counts["web_page"]),
+    "catalog_page": lambda rng, counts: _gen_catalog_page(
+        rng, counts["catalog_page"]),
+    "inventory": lambda rng, counts: _gen_inventory(
+        rng, counts["inventory"], counts),
     "store_sales": lambda rng, counts: _gen_store_sales(
         rng, counts["store_sales"], counts),
     "catalog_sales": lambda rng, counts: _gen_catalog_sales(
         rng, counts["catalog_sales"], counts),
     "web_sales": lambda rng, counts: _gen_web_sales(
         rng, counts["web_sales"], counts),
+}
+
+_RETURNS_GENERATORS = {
+    "store_returns": _gen_store_returns,
+    "catalog_returns": _gen_catalog_returns,
+    "web_returns": _gen_web_returns,
 }
 
 
@@ -400,20 +837,51 @@ def generate_tpcds(data_dir: str, sf: float = 0.01, seed: int = 42,
     schema version (marker file); regenerates on version mismatch.
     """
     counts = table_row_counts(sf)
+    # returns rows are sampled from their parent's rows, so the on-disk
+    # parent must match THIS (sf, seed) — the marker encodes all three
+    # (a schema-only marker let a different seed/sf regenerate returns
+    # that join to nothing)
+    stamp = f"_{_SCHEMA_VERSION}_sf{sf:g}_seed{seed}"
     written = {}
+
+    def _needs_gen(t: str) -> bool:
+        return not os.path.exists(os.path.join(data_dir, t, stamp))
+
+    # parent sales data kept in memory only between a parent and its
+    # returns table (the returns rows are sampled from the parent's)
+    parents: dict[str, dict] = {}
     for t in tables:
         out = os.path.join(data_dir, t)
         written[t] = counts[t]
-        marker = os.path.join(out, f"_{_SCHEMA_VERSION}")
-        if os.path.isdir(out) and os.path.exists(marker):
+        if not _needs_gen(t):
             continue
         if os.path.isdir(out):
             import shutil
             shutil.rmtree(out)
         rng = np.random.default_rng(seed + zlib.crc32(t.encode()) % 1000)
-        data = _GENERATORS[t](rng, counts)
+        if t in _RETURNS_GENERATORS:
+            pname = _RETURNS_PARENT[t]
+            parent = parents.pop(pname, None)
+            if parent is None:
+                # parent already on disk from an earlier run at the SAME
+                # (version, sf, seed): deterministic, so regenerate it in
+                # memory for sampling
+                prng = np.random.default_rng(
+                    seed + zlib.crc32(pname.encode()) % 1000)
+                parent = _GENERATORS[pname](prng, counts)
+            data = _RETURNS_GENERATORS[t](rng, counts, parent)
+            del parent
+        else:
+            data = _GENERATORS[t](rng, counts)
+            retname = next((r for r, p in _RETURNS_PARENT.items()
+                            if p == t), None)
+            # hold the parent in memory only if its returns table is
+            # about to be generated in this run (else multi-GB of object
+            # arrays would sit resident for the rest of the loop)
+            if retname in tables and _needs_gen(retname):
+                parents[t] = data
         _write_parquet(out, data, rows_per_file,
                        date_cols=("d_date",) if t == "date_dim" else ())
-        with open(marker, "w") as f:
-            f.write(_SCHEMA_VERSION + "\n")
+        with open(os.path.join(out, stamp), "w") as f:
+            f.write(stamp + "\n")
     return written

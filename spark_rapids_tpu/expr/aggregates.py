@@ -17,7 +17,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr.core import Expression, BoundReference, Literal
 
 __all__ = ["AggregateFunction", "Sum", "Count", "CountStar", "Min", "Max",
-           "Average", "First", "Last", "is_aggregate", "has_aggregate"]
+           "Average", "First", "Last", "CountDistinct", "stddev_samp",
+           "is_aggregate", "has_aggregate"]
 
 
 class AggregateFunction(Expression):
@@ -192,6 +193,63 @@ class Average(AggregateFunction):
         c = BoundReference(offsets[1], T.LongType(), True)
         # Divide yields null when count == 0 (DivModLike) — exactly Spark avg
         return Divide(s, Cast(c, T.DoubleType()))
+
+
+class CountDistinct(Expression):
+    """count(DISTINCT e[, e2, ...]) — a marker rewritten by
+    ``GroupedData.agg`` into dedupe-then-count plans (Spark plans the same
+    via Expand + two-phase aggregation).  It never reaches an aggregate
+    exec directly."""
+    sql_name = "CountDistinct"
+
+    def __init__(self, *children: Expression):
+        assert children, "count(distinct) needs at least one expression"
+        self.children = tuple(children)
+
+    def with_new_children(self, children):
+        return CountDistinct(*children)
+
+    @property
+    def dtype(self):
+        return T.LongType()
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, vals, ctx):
+        raise TypeError(
+            "count(distinct) is only valid directly inside "
+            "GroupedData.agg(...), which rewrites it; it cannot be "
+            "evaluated elementwise or nested in other expressions")
+
+
+def stddev_samp(e: Expression) -> Expression:
+    """Sample standard deviation as composed aggregates:
+    sqrt((sum(x^2) - sum(x)^2/n) / (n-1)); null on empty input, NaN for a
+    single row (Spark CentralMomentAgg semantics).  Composed from
+    Sum/Count so the three-phase aggregate machinery needs no new op
+    (reference expresses stddev over cuDF's M2; here the sum-of-squares
+    form keeps the segmented-op set minimal and differential tests
+    compare doubles approximately)."""
+    from spark_rapids_tpu.expr.cast import Cast
+    from spark_rapids_tpu.expr.conditional import If
+    from spark_rapids_tpu.expr.math_ops import Sqrt
+    from spark_rapids_tpu.expr.predicates import EqualTo
+    from spark_rapids_tpu.expr.predicates import LessThan
+    d = Cast(e, T.DoubleType())
+    n = Count(d)
+    nd = Cast(n, T.DoubleType())
+    s = Sum(d)
+    s2 = Sum(d * d)
+    var = (s2 - s * s / nd) / (nd - Literal(1.0, T.DoubleType()))
+    # catastrophic cancellation on a constant column can leave var a tiny
+    # negative; Spark's M2 form returns exactly 0.0 there, so clamp
+    # (LessThan is false for NaN, which passes through untouched)
+    zero = Literal(0.0, T.DoubleType())
+    var = If(LessThan(var, zero), zero, var)
+    return If(EqualTo(n, Literal(1, T.LongType())),
+              Literal(float("nan"), T.DoubleType()), Sqrt(var))
 
 
 class First(AggregateFunction):

@@ -16,8 +16,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.conf import TpuConf
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, collect_device, \
     collect_host
-from spark_rapids_tpu.expr.core import (Alias, Expression, col, lit,
-                                        output_name)
+from spark_rapids_tpu.expr.core import (Alias, Expression, Literal, col,
+                                        lit, output_name)
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.overrides import PlannedNode, TpuOverrides, lower
 
@@ -204,6 +204,54 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self._s, L.Limit(n, self._plan))
 
+    def distinct(self) -> "DataFrame":
+        """Deduplicate rows — a group-by on every column, so nulls and
+        NaNs compare equal the way Spark's set operations require."""
+        return self.group_by(*self.columns).agg()
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Set intersection (distinct rows present in BOTH inputs).
+
+        Implemented as union + marker max + group-by on all columns
+        instead of a join: group-by keys are null-safe, matching Spark's
+        INTERSECT semantics where NULL == NULL (a plain join would drop
+        null-keyed rows)."""
+        return self._set_op(other, want_a=True, want_b=True)
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Set difference (distinct rows of self not in other); Spark's
+        ``EXCEPT [DISTINCT]`` / ``DataFrame.exceptAll``-less cousin."""
+        return self._set_op(other, want_a=True, want_b=False)
+
+    def _set_op(self, other: "DataFrame", want_a: bool,
+                want_b: bool) -> "DataFrame":
+        from spark_rapids_tpu.expr.aggregates import Max
+        names = self.columns
+        if len(names) != len(other.columns):
+            raise ValueError(
+                f"set operation arity mismatch: {len(names)} vs "
+                f"{len(other.columns)} columns")
+
+        def uniq(stem: str) -> str:
+            nm, i = stem, 0
+            while nm in names:
+                nm, i = f"{stem}{i}", i + 1
+            return nm
+
+        ma, mb = uniq("_sop_a"), uniq("_sop_b")
+        ia, ib = uniq("_sop_ia"), uniq("_sop_ib")
+        a = self.select(*[col(n) for n in names],
+                        lit(1).alias(ma), lit(0).alias(mb))
+        b = other.select(*[col(bn).alias(an)
+                           for an, bn in zip(names, other.columns)],
+                         lit(0).alias(ma), lit(1).alias(mb))
+        g = a.union(b).group_by(*names).agg(
+            Max(col(ma)).alias(ia), Max(col(mb)).alias(ib))
+        cond = (col(ia) == lit(1))
+        cond = cond & ((col(ib) == lit(1)) if want_b
+                       else (col(ib) == lit(0)))
+        return g.where(cond).select(*[col(n) for n in names])
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self._s, L.Union([self._plan, other._plan]))
 
@@ -332,11 +380,83 @@ class GroupedData:
         self._sets = grouping_sets  # list[set[int]] of ACTIVE key indices
 
     def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import CountDistinct
+        if any(isinstance(a.children[0] if isinstance(a, Alias) else a,
+                          CountDistinct) for a in aggs):
+            return self._agg_with_distinct(list(aggs))
         if self._sets is None:
             exprs = list(self._keys) + list(aggs)
             return DataFrame(self._df._s, L.Aggregate(
                 list(self._keys), exprs, self._df._plan))
         return self._agg_grouping_sets(list(aggs))
+
+    def _agg_with_distinct(self, aggs: list) -> DataFrame:
+        """Rewrite count(DISTINCT ...) into dedupe-then-count plans
+        (Spark plans the same shape via Expand + two-phase aggregation;
+        reference distinct-workaround projections, aggregate.scala).
+
+        Supported: any number of CountDistinct aggs (a) with no group
+        keys — each becomes a 1-row frame combined by cross join — or
+        (b) grouped WITHOUT plain aggs alongside (dedupe on keys+value,
+        then count per key).  Grouped mixing of distinct and plain aggs
+        would need a null-safe key join; not yet implemented."""
+        from spark_rapids_tpu.expr.aggregates import Count, CountDistinct
+        from spark_rapids_tpu.expr.predicates import IsNotNull
+        if self._sets is not None:
+            raise NotImplementedError(
+                "count(distinct) with grouping sets is not supported")
+        plain, cds = [], []
+        for a in aggs:
+            inner = a.children[0] if isinstance(a, Alias) else a
+            if isinstance(inner, CountDistinct):
+                cds.append((output_name(a), inner))
+            else:
+                plain.append(a)
+        base = self._df
+        key_names = [output_name(k) for k in self._keys]
+
+        def distinct_count_frame(name: str, cd: CountDistinct,
+                                 keys: list) -> DataFrame:
+            tmps = [f"_cdv_{name}_{j}" for j in range(len(cd.children))]
+            dd = GroupedData(base, list(keys) + [
+                Alias(c, t) for c, t in zip(cd.children, tmps)]).agg()
+            # count the deduped tuples whose components are ALL non-null
+            # WITHOUT filtering rows out first: a group whose values are
+            # all null must still appear with count 0 (Spark semantics)
+            if len(tmps) == 1:
+                cnt_in = col(tmps[0])
+            else:
+                from spark_rapids_tpu.expr.conditional import If
+                cond = None
+                for t in tmps:
+                    p = IsNotNull(col(t))
+                    cond = p if cond is None else cond & p
+                cnt_in = If(cond, lit(1),
+                            Literal(None, T.LongType()))
+            knames = [output_name(k) for k in keys]
+            return GroupedData(dd, [col(k) for k in knames]).agg(
+                Count(cnt_in).alias(name))
+
+        if not key_names:
+            frames = []
+            if plain:
+                frames.append(GroupedData(base, []).agg(*plain))
+            frames.extend(distinct_count_frame(n, cd, []) for n, cd in cds)
+            cur = frames[0]
+            for f in frames[1:]:
+                cur = cur.join(f, how="cross")
+            order = [output_name(a) for a in aggs]
+            return cur.select(*[col(n) for n in order])
+        if plain:
+            raise NotImplementedError(
+                "grouped count(distinct) mixed with other aggregates "
+                "needs a null-safe key join; split into separate "
+                "aggregations and join explicitly")
+        if len(cds) > 1:
+            raise NotImplementedError(
+                "one count(distinct) per grouped aggregation")
+        name, cd = cds[0]
+        return distinct_count_frame(name, cd, list(self._keys))
 
     def _agg_grouping_sets(self, aggs: list) -> DataFrame:
         """Rollup/cube/grouping-sets: Expand with nulled-out key columns +

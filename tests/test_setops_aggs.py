@@ -1,0 +1,113 @@
+"""distinct / intersect / subtract / count(distinct) / stddev_samp
+(reference: Spark set-op NULL semantics and CentralMomentAgg; TpcdsLike
+queries q16/q28/q38/q87/q17/q39 are the consumers)."""
+import math
+
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import (CountDistinct, Sum,
+                                              stddev_samp)
+from spark_rapids_tpu.expr.core import col
+
+
+def _s():
+    return TpuSession({"spark.sql.shuffle.partitions": 2})
+
+
+def _df(s, data, names, types):
+    return s.from_pydict(
+        dict(zip(names, data)),
+        T.Schema([T.StructField(n, t, True)
+                  for n, t in zip(names, types)]))
+
+
+def test_distinct_nulls_and_dups():
+    s = _s()
+    df = _df(s, [[1, 1, None, None, 2], [5, 5, 7, 7, None]],
+             ["a", "b"], [T.IntegerType(), T.LongType()])
+    assert sorted(df.distinct().collect(), key=str) == \
+        sorted([(1, 5), (None, 7), (2, None)], key=str)
+
+
+def test_intersect_and_subtract_null_safe():
+    s = _s()
+    a = _df(s, [[1, 2, None, 3], [10, 20, 30, 40]],
+            ["k", "v"], [T.IntegerType(), T.LongType()])
+    b = _df(s, [[2, None, 4], [20, 30, 99]],
+            ["k", "v"], [T.IntegerType(), T.LongType()])
+    # NULL == NULL inside set operations (Spark INTERSECT/EXCEPT)
+    assert sorted(a.intersect(b).collect(), key=str) == \
+        sorted([(2, 20), (None, 30)], key=str)
+    assert sorted(a.subtract(b).collect(), key=str) == \
+        sorted([(1, 10), (3, 40)], key=str)
+
+
+def test_set_op_marker_name_collision():
+    s = _s()
+    a = _df(s, [[1, 2], [1, 1]], ["_sop_a", "_sop_ia"],
+            [T.IntegerType(), T.IntegerType()])
+    b = _df(s, [[2], [1]], ["_sop_a", "_sop_ia"],
+            [T.IntegerType(), T.IntegerType()])
+    assert a.intersect(b).collect() == [(2, 1)]
+
+
+def test_count_distinct_grouped_keeps_all_null_group():
+    s = _s()
+    df = _df(s, [[1, 1, 2, 2, 3], [10, 10, 20, 30, None]],
+             ["k", "v"], [T.IntegerType(), T.LongType()])
+    rows = sorted(df.group_by("k").agg(
+        CountDistinct(col("v")).alias("c")).collect())
+    # k=3 has only NULL v: Spark keeps the group with count 0
+    assert rows == [(1, 1), (2, 2), (3, 0)]
+
+
+def test_count_distinct_global_mixed_with_plain():
+    s = _s()
+    df = _df(s, [[1, 1, 2, 2, 3], [10, 10, 20, 30, None]],
+             ["k", "v"], [T.IntegerType(), T.LongType()])
+    rows = df.group_by().agg(CountDistinct(col("v")).alias("c"),
+                             Sum(col("v")).alias("sv"),
+                             CountDistinct(col("k")).alias("ck")).collect()
+    assert rows == [(3, 70, 3)]
+
+
+def test_count_distinct_multi_column():
+    s = _s()
+    df = _df(s, [[1, 1, 2, None], [5, 5, 6, 7]],
+             ["a", "b"], [T.IntegerType(), T.LongType()])
+    # tuples with any NULL component are not counted (Spark)
+    rows = df.group_by().agg(
+        CountDistinct(col("a"), col("b")).alias("c")).collect()
+    assert rows == [(2,)]
+
+
+def test_stddev_samp_matches_statistics():
+    import statistics
+    s = _s()
+    vals = [3.0, 7.0, 7.0, 19.0]
+    df = _df(s, [[1] * 4, vals], ["k", "v"],
+             [T.IntegerType(), T.DoubleType()])
+    (row,) = df.group_by("k").agg(stddev_samp(col("v")).alias("sd")) \
+        .collect()
+    assert row[1] == pytest.approx(statistics.stdev(vals), rel=1e-12)
+
+
+def test_stddev_samp_constant_column_is_zero_not_nan():
+    s = _s()
+    df = _df(s, [[1] * 3, [0.1] * 3], ["k", "v"],
+             [T.IntegerType(), T.DoubleType()])
+    (row,) = df.group_by("k").agg(stddev_samp(col("v")).alias("sd")) \
+        .collect()
+    assert row[1] == 0.0
+
+
+def test_stddev_samp_single_row_nan_empty_null():
+    s = _s()
+    df = _df(s, [[1, 2, 2], [5.0, None, None]], ["k", "v"],
+             [T.IntegerType(), T.DoubleType()])
+    rows = sorted(df.group_by("k").agg(
+        stddev_samp(col("v")).alias("sd")).collect())
+    assert rows[0][0] == 1 and math.isnan(rows[0][1])
+    assert rows[1][0] == 2 and rows[1][1] is None
