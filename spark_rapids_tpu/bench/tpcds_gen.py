@@ -29,7 +29,7 @@ TABLES = ("date_dim", "time_dim", "item", "customer", "customer_address",
           "catalog_sales", "catalog_returns", "web_sales", "web_returns")
 
 #: bump when generated schemas change; tables regenerate on mismatch
-_SCHEMA_VERSION = "v5"
+_SCHEMA_VERSION = "v6"
 
 #: returns tables are sampled FROM their parent's rows so that joins on
 #: (item_sk, ticket/order number) actually match (dsdgen links them the
@@ -133,8 +133,15 @@ def _gen_time_dim(_counts) -> dict[str, np.ndarray]:
     secs = np.arange(86_400, dtype=np.int64)
     return {
         "t_time_sk": secs.astype(np.int32),
+        "t_time": secs.astype(np.int32),  # seconds since midnight (dsdgen)
         "t_hour": (secs // 3600).astype(np.int32),
         "t_minute": ((secs // 60) % 60).astype(np.int32),
+        # dsdgen meal-time bands; NULL outside them
+        "t_meal_time": np.where(
+            (secs >= 6 * 3600) & (secs < 9 * 3600), "breakfast",
+            np.where((secs >= 12 * 3600) & (secs < 14 * 3600), "lunch",
+                     np.where((secs >= 17 * 3600) & (secs < 21 * 3600),
+                              "dinner", None))).astype(object),
     }
 
 
@@ -234,6 +241,15 @@ def _gen_customer(rng, n: int, n_addr: int, n_cdemo: int,
                                n) + _DATE_SK_EPOCH).astype(np.int32), 0.03),
         "c_email_address": np.array(
             [f"user{k}@example.com" for k in range(1, n + 1)], dtype=object),
+        # dsdgen leaves c_login almost entirely NULL
+        "c_login": _with_nulls(
+            rng, np.array([f"login{k}" for k in range(1, n + 1)],
+                          dtype=object), 0.95),
+        # StringType in the reference schema (TpcdsLikeSpark.scala:442)
+        "c_last_review_date": _with_nulls(
+            rng, np.array([str(_DATE_SK_EPOCH + int(v)) for v in
+                           rng.integers(_SALES_DATE_LO, _SALES_DATE_HI, n)],
+                          dtype=object), 0.05),
     }
 
 
@@ -259,6 +275,13 @@ def _gen_customer_address(rng, n: int) -> dict[str, np.ndarray]:
                                      dtype=object),
         "ca_street_name": np.array([f"Street{v:03d}" for v in
                                     rng.integers(0, 300, n)], dtype=object),
+        "ca_street_type": _with_nulls(
+            rng, np.array([("Street", "Ave", "Blvd", "Ct", "Dr", "Ln")[v]
+                           for v in rng.integers(0, 6, n)], dtype=object),
+            0.01),
+        "ca_suite_number": _with_nulls(
+            rng, np.array([f"Suite {v}" for v in rng.integers(0, 100, n)],
+                          dtype=object), 0.01),
         "ca_location_type": _with_nulls(
             rng, np.array([("apartment", "condo", "single family")[v]
                            for v in rng.integers(0, 3, n)], dtype=object),
@@ -294,6 +317,8 @@ def _gen_store(rng, n: int) -> dict[str, np.ndarray]:
                                      rng.integers(1, 1000, n)], dtype=object),
         "s_street_name": np.array([f"Street{v:03d}" for v in
                                    rng.integers(0, 300, n)], dtype=object),
+        "s_street_type": np.array([("Street", "Ave", "Blvd", "Ct")[k % 4]
+                                   for k in range(n)], dtype=object),
         "s_suite_number": np.array([f"Suite {v}" for v in
                                     rng.integers(0, 100, n)], dtype=object),
     }
@@ -316,6 +341,9 @@ def _gen_customer_demographics(rng, n: int) -> dict[str, np.ndarray]:
         "cd_credit_rating": np.array(
             [("Low Risk", "Good", "High Risk", "Unknown")[v]
              for v in rng.integers(0, 4, n)], dtype=object),
+        "cd_dep_count": rng.integers(0, 7, n).astype(np.int32),
+        "cd_dep_employed_count": rng.integers(0, 7, n).astype(np.int32),
+        "cd_dep_college_count": rng.integers(0, 7, n).astype(np.int32),
     }
 
 
@@ -515,6 +543,8 @@ def _gen_catalog_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
             + _DATE_SK_EPOCH).astype(np.int64)
     return {
         "cs_sold_date_sk": _with_nulls(rng, sold.astype(np.int32), 0.02),
+        "cs_sold_time_sk": _with_nulls(
+            rng, rng.integers(0, 86_400, n).astype(np.int32), 0.02),
         "cs_ship_date_sk": _with_nulls(
             rng, (sold + rng.integers(1, 120, n)).astype(np.int32), 0.02),
         "cs_item_sk": rng.integers(1, counts["item"] + 1, n).astype(np.int32),
@@ -567,6 +597,7 @@ def _gen_catalog_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
         "cs_coupon_amt": np.round(
             ext * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3], n), 2),
         "cs_net_paid": np.round(ext * rng.uniform(0.7, 1.0, n), 2),
+        "cs_net_paid_inc_tax": np.round(ext * 1.08, 2),
         "cs_net_profit": np.round(ext - wholesale * qty, 2),
     }
 
@@ -605,6 +636,15 @@ def _gen_web_sales(rng, n: int, counts) -> dict[str, np.ndarray]:
         "ws_promo_sk": _with_nulls(
             rng, rng.integers(1, counts["promotion"] + 1, n).astype(np.int32),
             0.02),
+        "ws_warehouse_sk": _with_nulls(
+            rng, rng.integers(1, counts["warehouse"] + 1,
+                              n).astype(np.int32), 0.02),
+        "ws_ship_customer_sk": _with_nulls(
+            rng, rng.integers(1, counts["customer"] + 1, n).astype(np.int32),
+            0.03),
+        "ws_ship_hdemo_sk": _with_nulls(
+            rng, rng.integers(1, counts["household_demographics"] + 1,
+                              n).astype(np.int32), 0.03),
         "ws_quantity": qty,
         "ws_list_price": np.round(price * rng.uniform(1.0, 1.5, n), 2),
         "ws_sales_price": price,
@@ -669,6 +709,7 @@ def _gen_store_returns(rng, counts, parent: dict) -> dict[str, np.ndarray]:
         "sr_ticket_number": _pick(parent["ss_ticket_number"],
                                   idx).astype(np.int64),
         "sr_customer_sk": _pick(parent["ss_customer_sk"], idx),
+        "sr_cdemo_sk": _pick(parent["ss_cdemo_sk"], idx),
         "sr_store_sk": _pick(parent["ss_store_sk"], idx),
         "sr_reason_sk": _with_nulls(
             rng, rng.integers(1, counts["reason"] + 1,
@@ -708,6 +749,12 @@ def _gen_catalog_returns(rng, counts, parent: dict) -> dict[str, np.ndarray]:
         "cr_return_amount": amt,
         "cr_return_amt_inc_tax": np.round(amt * 1.08, 2),
         "cr_net_loss": np.round(amt * rng.uniform(0.3, 1.1, len(idx)), 2),
+        "cr_refunded_cash": np.round(amt * rng.uniform(0.0, 0.6, len(idx)),
+                                     2),
+        "cr_reversed_charge": np.round(
+            amt * rng.uniform(0.0, 0.3, len(idx)), 2),
+        "cr_store_credit": np.round(amt * rng.uniform(0.0, 0.3, len(idx)),
+                                    2),
     }
 
 
