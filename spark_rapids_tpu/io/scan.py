@@ -44,9 +44,13 @@ READER_THREADS = {
     for fmt in ("parquet", "orc", "csv")
 }
 BATCH_ROWS = register(ConfEntry(
-    "spark.rapids.sql.reader.batchRows", 1 << 16,
+    "spark.rapids.sql.reader.batchRows", 1 << 22,
     "Max rows per decoded batch (reference "
-    "spark.rapids.sql.reader.batchSizeRows, RapidsConf.scala:370).",
+    "spark.rapids.sql.reader.batchSizeRows, RapidsConf.scala:370). The "
+    "default is large on purpose: every device program launch pays "
+    "host->device dispatch latency (severe over a tunneled PJRT link), "
+    "so the TPU wants FEW LARGE batches — the reference's ~2GiB "
+    "batchSizeBytes target (RapidsConf.scala:364) serves the same goal.",
     conv=int))
 
 
