@@ -65,17 +65,25 @@ def _norm(rows):
 
 def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
                   verify: bool = False, session_conf: dict | None = None,
-                  generate: bool = True) -> list[dict]:
+                  generate: bool = True, suite: str = "tpcds") -> list[dict]:
     """Run each query ``iterations`` times on the device engine; report
     per-query wall times (median), row counts, and optional host-oracle
-    verification. Returns a list of per-query report dicts."""
-    from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds
-    from spark_rapids_tpu.bench.tpcds_queries import build_query
+    verification. Returns a list of per-query report dicts.
+    ``suite`` selects the workload: "tpcds" (default) or "tpch"
+    (reference BenchmarkRunner supports tpcds/tpch/tpcxbb the same way,
+    BenchmarkRunner.scala)."""
     from spark_rapids_tpu.session import TpuSession
+    if suite == "tpch":
+        from spark_rapids_tpu.bench.tpch_gen import generate_tpch as gen
+        from spark_rapids_tpu.bench.tpch_queries import (
+            build_tpch_query as build_query)
+    else:
+        from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds as gen
+        from spark_rapids_tpu.bench.tpcds_queries import build_query
 
     if generate:
         t0 = time.perf_counter()
-        generate_tpcds(data_dir, sf=sf)
+        gen(data_dir, sf=sf)
         gen_s = time.perf_counter() - t0
     else:
         gen_s = 0.0
@@ -131,6 +139,7 @@ def main() -> None:
     ap.add_argument("--queries", default="q3,q6,q42,q52,q55")
     ap.add_argument("--iterations", type=int, default=1)
     ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch"))
     ap.add_argument("--report", default=None,
                     help="write the JSON report to this path")
     args = ap.parse_args()
@@ -138,7 +147,8 @@ def main() -> None:
     data_dir = os.path.join(args.data_dir, f"sf{args.sf:g}")
     reports = run_benchmark(data_dir, args.sf,
                             [q.strip() for q in args.queries.split(",")],
-                            iterations=args.iterations, verify=args.verify)
+                            iterations=args.iterations, verify=args.verify,
+                            suite=args.suite)
     out = json.dumps(reports, indent=2)
     print(out)
     if args.report:

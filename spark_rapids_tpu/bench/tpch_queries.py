@@ -1,0 +1,555 @@
+"""TPC-H queries as DataFrame code (the TpchLikeSpark.scala pattern).
+
+All 22 queries, ported with the same house rules as the TPC-DS suite
+(reference: integration_tests/.../tpch/TpchLikeSpark.scala:293-1140):
+scalar subqueries fold eagerly, EXISTS/IN become semi/anti joins,
+correlated aggregates become group-by + join.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import os
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum)
+from spark_rapids_tpu.expr.conditional import CaseWhen, Coalesce, If
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.datetime_ops import Year
+from spark_rapids_tpu.expr.predicates import In, Or
+from spark_rapids_tpu.expr.strings import Substring
+
+__all__ = ["TPCH_QUERIES", "build_tpch_query"]
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _d(y, m, d):
+    return lit(_dt.date(y, m, d))
+
+
+def _disc_price():
+    return col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+
+
+def q1(session, data_dir: str):
+    """TPC-H q1: pricing summary report."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_returnflag", "l_linestatus", "l_quantity",
+             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"])
+    return li.where(col("l_shipdate") <= _d(1998, 9, 2)) \
+        .group_by("l_returnflag", "l_linestatus") \
+        .agg(Sum(col("l_quantity")).alias("sum_qty"),
+             Sum(col("l_extendedprice")).alias("sum_base_price"),
+             Sum(_disc_price()).alias("sum_disc_price"),
+             Sum(_disc_price() * (lit(1.0) + col("l_tax")))
+             .alias("sum_charge"),
+             Average(col("l_quantity")).alias("avg_qty"),
+             Average(col("l_extendedprice")).alias("avg_price"),
+             Average(col("l_discount")).alias("avg_disc"),
+             CountStar().alias("count_order")) \
+        .order_by(("l_returnflag", True), ("l_linestatus", True))
+
+
+def q2(session, data_dir: str):
+    """TPC-H q2: minimum-cost European supplier per brass part."""
+    pt = _t(session, data_dir, "part",
+            ["p_partkey", "p_mfgr", "p_size", "p_type"]) \
+        .where((col("p_size") == lit(15))
+               & col("p_type").like("%BRASS"))
+    sup = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_acctbal", "s_name", "s_address", "s_phone",
+              "s_comment", "s_nationkey"])
+    ps = _t(session, data_dir, "partsupp",
+            ["ps_partkey", "ps_suppkey", "ps_supplycost"])
+    na = _t(session, data_dir, "nation",
+            ["n_nationkey", "n_name", "n_regionkey"])
+    re = _t(session, data_dir, "region",
+            ["r_regionkey", "r_name"]) \
+        .where(col("r_name") == lit("EUROPE")).select(col("r_regionkey"))
+    europe = ps.join(sup, on=[("ps_suppkey", "s_suppkey")]) \
+        .join(na, on=[("s_nationkey", "n_nationkey")]) \
+        .join(re, on=[("n_regionkey", "r_regionkey")], how="semi")
+    min_cost = europe.group_by("ps_partkey") \
+        .agg(Min(col("ps_supplycost")).alias("min_cost")) \
+        .select(col("ps_partkey").alias("mc_partkey"), col("min_cost"))
+    return europe.join(min_cost, on=[("ps_partkey", "mc_partkey")]) \
+        .where(col("ps_supplycost") == col("min_cost")) \
+        .join(pt, on=[("ps_partkey", "p_partkey")]) \
+        .select(col("s_acctbal"), col("s_name"), col("n_name"),
+                col("ps_partkey").alias("p_partkey"), col("p_mfgr"),
+                col("s_address"), col("s_phone"), col("s_comment")) \
+        .order_by(("s_acctbal", False), ("n_name", True),
+                  ("s_name", True), ("p_partkey", True)) \
+        .limit(100)
+
+
+def q3(session, data_dir: str):
+    """TPC-H q3: unshipped orders revenue."""
+    cu = _t(session, data_dir, "customer",
+            ["c_custkey", "c_mktsegment"]) \
+        .where(col("c_mktsegment") == lit("BUILDING")) \
+        .select(col("c_custkey"))
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]) \
+        .where(col("o_orderdate") < _d(1995, 3, 15))
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"]) \
+        .where(col("l_shipdate") > _d(1995, 3, 15))
+    return li.join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")], how="semi") \
+        .group_by("l_orderkey", "o_orderdate", "o_shippriority") \
+        .agg(Sum(_disc_price()).alias("revenue")) \
+        .select(col("l_orderkey"), col("revenue"), col("o_orderdate"),
+                col("o_shippriority")) \
+        .order_by(("revenue", False), ("o_orderdate", True)).limit(10)
+
+
+def q4(session, data_dir: str):
+    """TPC-H q4: order priority checking."""
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_orderdate", "o_orderpriority"]) \
+        .where((col("o_orderdate") >= _d(1993, 7, 1))
+               & (col("o_orderdate") < _d(1993, 10, 1)))
+    late = _t(session, data_dir, "lineitem",
+              ["l_orderkey", "l_commitdate", "l_receiptdate"]) \
+        .where(col("l_commitdate") < col("l_receiptdate")) \
+        .select(col("l_orderkey"))
+    return od.join(late, on=[("o_orderkey", "l_orderkey")], how="semi") \
+        .group_by("o_orderpriority") \
+        .agg(CountStar().alias("order_count")) \
+        .order_by(("o_orderpriority", True))
+
+
+def q5(session, data_dir: str):
+    """TPC-H q5: local supplier volume in ASIA, 1994."""
+    cu = _t(session, data_dir, "customer", ["c_custkey", "c_nationkey"])
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_orderdate"]) \
+        .where((col("o_orderdate") >= _d(1994, 1, 1))
+               & (col("o_orderdate") < _d(1995, 1, 1)))
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_suppkey", "l_extendedprice", "l_discount"])
+    sup = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_nationkey"])
+    na = _t(session, data_dir, "nation",
+            ["n_nationkey", "n_name", "n_regionkey"])
+    re = _t(session, data_dir, "region",
+            ["r_regionkey", "r_name"]) \
+        .where(col("r_name") == lit("ASIA")).select(col("r_regionkey"))
+    return li.join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")]) \
+        .join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .where(col("c_nationkey") == col("s_nationkey")) \
+        .join(na, on=[("s_nationkey", "n_nationkey")]) \
+        .join(re, on=[("n_regionkey", "r_regionkey")], how="semi") \
+        .group_by("n_name") \
+        .agg(Sum(_disc_price()).alias("revenue")) \
+        .order_by(("revenue", False))
+
+
+def q6(session, data_dir: str):
+    """TPC-H q6: forecasting revenue change."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_extendedprice", "l_discount", "l_shipdate", "l_quantity"])
+    return li.where((col("l_shipdate") >= _d(1994, 1, 1))
+                    & (col("l_shipdate") < _d(1995, 1, 1))
+                    & (col("l_discount") >= lit(0.05))
+                    & (col("l_discount") <= lit(0.07))
+                    & (col("l_quantity") < lit(24.0))) \
+        .agg(Sum(col("l_extendedprice") * col("l_discount"))
+             .alias("revenue"))
+
+
+def q7(session, data_dir: str):
+    """TPC-H q7: volume shipping FRANCE<->GERMANY."""
+    sup = _t(session, data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_suppkey", "l_shipdate", "l_extendedprice",
+             "l_discount"]) \
+        .where((col("l_shipdate") >= _d(1995, 1, 1))
+               & (col("l_shipdate") <= _d(1996, 12, 31)))
+    od = _t(session, data_dir, "orders", ["o_orderkey", "o_custkey"])
+    cu = _t(session, data_dir, "customer", ["c_custkey", "c_nationkey"])
+    n1 = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .where(In(col("n_name"), [lit("FRANCE"), lit("GERMANY")])) \
+        .select(col("n_nationkey").alias("n1_key"),
+                col("n_name").alias("supp_nation"))
+    n2 = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .where(In(col("n_name"), [lit("FRANCE"), lit("GERMANY")])) \
+        .select(col("n_nationkey").alias("n2_key"),
+                col("n_name").alias("cust_nation"))
+    j = li.join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")]) \
+        .join(n1, on=[("s_nationkey", "n1_key")]) \
+        .join(n2, on=[("c_nationkey", "n2_key")]) \
+        .where(~(col("supp_nation") == col("cust_nation")))
+    return j.with_column("l_year", Year(col("l_shipdate"))) \
+        .group_by("supp_nation", "cust_nation", "l_year") \
+        .agg(Sum(_disc_price()).alias("revenue")) \
+        .order_by(("supp_nation", True), ("cust_nation", True),
+                  ("l_year", True))
+
+
+def q8(session, data_dir: str):
+    """TPC-H q8: national market share of BRAZIL in AMERICA."""
+    pt = _t(session, data_dir, "part", ["p_partkey", "p_type"]) \
+        .where(col("p_type") == lit("ECONOMY ANODIZED STEEL")) \
+        .select(col("p_partkey"))
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_suppkey", "l_orderkey", "l_extendedprice",
+             "l_discount"])
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_orderdate"]) \
+        .where((col("o_orderdate") >= _d(1995, 1, 1))
+               & (col("o_orderdate") <= _d(1996, 12, 31)))
+    cu = _t(session, data_dir, "customer", ["c_custkey", "c_nationkey"])
+    sup = _t(session, data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    n1 = _t(session, data_dir, "nation",
+            ["n_nationkey", "n_regionkey"]) \
+        .select(col("n_nationkey").alias("n1_key"),
+                col("n_regionkey"))
+    re = _t(session, data_dir, "region",
+            ["r_regionkey", "r_name"]) \
+        .where(col("r_name") == lit("AMERICA")).select(col("r_regionkey"))
+    n2 = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .select(col("n_nationkey").alias("n2_key"),
+                col("n_name").alias("nation"))
+    j = li.join(pt, on=[("l_partkey", "p_partkey")], how="semi") \
+        .join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")]) \
+        .join(n1, on=[("c_nationkey", "n1_key")]) \
+        .join(re, on=[("n_regionkey", "r_regionkey")], how="semi") \
+        .join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .join(n2, on=[("s_nationkey", "n2_key")]) \
+        .with_column("o_year", Year(col("o_orderdate"))) \
+        .with_column("volume", _disc_price())
+    return j.group_by("o_year").agg(
+        (Sum(If(col("nation") == lit("BRAZIL"), col("volume"),
+                lit(0.0))) / Sum(col("volume"))).alias("mkt_share")) \
+        .order_by(("o_year", True))
+
+
+def q9(session, data_dir: str):
+    """TPC-H q9: product-type profit by nation and year."""
+    pt = _t(session, data_dir, "part", ["p_partkey", "p_name"]) \
+        .where(col("p_name").contains("green")).select(col("p_partkey"))
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_suppkey", "l_orderkey", "l_quantity",
+             "l_extendedprice", "l_discount"])
+    ps = _t(session, data_dir, "partsupp",
+            ["ps_partkey", "ps_suppkey", "ps_supplycost"])
+    od = _t(session, data_dir, "orders", ["o_orderkey", "o_orderdate"])
+    sup = _t(session, data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    na = _t(session, data_dir, "nation", ["n_nationkey", "n_name"])
+    j = li.join(pt, on=[("l_partkey", "p_partkey")], how="semi") \
+        .join(ps, on=[("l_partkey", "ps_partkey"),
+                      ("l_suppkey", "ps_suppkey")]) \
+        .join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .join(na, on=[("s_nationkey", "n_nationkey")]) \
+        .with_column("o_year", Year(col("o_orderdate"))) \
+        .with_column("amount", _disc_price()
+                     - col("ps_supplycost") * col("l_quantity"))
+    return j.group_by("n_name", "o_year") \
+        .agg(Sum(col("amount")).alias("sum_profit")) \
+        .select(col("n_name").alias("nation"), col("o_year"),
+                col("sum_profit")) \
+        .order_by(("nation", True), ("o_year", False))
+
+
+def q10(session, data_dir: str):
+    """TPC-H q10: returned item reporting."""
+    cu = _t(session, data_dir, "customer",
+            ["c_custkey", "c_name", "c_acctbal", "c_address", "c_phone",
+             "c_comment", "c_nationkey"])
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_orderdate"]) \
+        .where((col("o_orderdate") >= _d(1993, 10, 1))
+               & (col("o_orderdate") < _d(1994, 1, 1)))
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_returnflag", "l_extendedprice",
+             "l_discount"]) \
+        .where(col("l_returnflag") == lit("R"))
+    na = _t(session, data_dir, "nation", ["n_nationkey", "n_name"])
+    return li.join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")]) \
+        .join(na, on=[("c_nationkey", "n_nationkey")]) \
+        .group_by("c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                  "c_address", "c_comment") \
+        .agg(Sum(_disc_price()).alias("revenue")) \
+        .select(col("c_custkey"), col("c_name"), col("revenue"),
+                col("c_acctbal"), col("n_name"), col("c_address"),
+                col("c_phone"), col("c_comment")) \
+        .order_by(("revenue", False)).limit(20)
+
+
+def q11(session, data_dir: str):
+    """TPC-H q11: important stock identification (GERMANY)."""
+    sup = _t(session, data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    na = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .where(col("n_name") == lit("GERMANY")) \
+        .select(col("n_nationkey"))
+    ps = _t(session, data_dir, "partsupp",
+            ["ps_partkey", "ps_suppkey", "ps_supplycost", "ps_availqty"])
+    german = ps.join(sup, on=[("ps_suppkey", "s_suppkey")]) \
+        .join(na, on=[("s_nationkey", "n_nationkey")], how="semi")
+    total_rows = german.agg(
+        Sum(col("ps_supplycost") * col("ps_availqty")).alias("t")) \
+        .collect()
+    threshold = float(total_rows[0][0] or 0.0) * 0.0001
+    return german.group_by("ps_partkey") \
+        .agg(Sum(col("ps_supplycost") * col("ps_availqty"))
+             .alias("value")) \
+        .where(col("value") > lit(threshold)) \
+        .order_by(("value", False))
+
+
+def q12(session, data_dir: str):
+    """TPC-H q12: shipping modes and order priority."""
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_orderpriority"])
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_shipmode", "l_commitdate", "l_receiptdate",
+             "l_shipdate"]) \
+        .where(In(col("l_shipmode"), [lit("MAIL"), lit("SHIP")])
+               & (col("l_commitdate") < col("l_receiptdate"))
+               & (col("l_shipdate") < col("l_commitdate"))
+               & (col("l_receiptdate") >= _d(1994, 1, 1))
+               & (col("l_receiptdate") < _d(1995, 1, 1)))
+    high = In(col("o_orderpriority"), [lit("1-URGENT"), lit("2-HIGH")])
+    return li.join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .group_by("l_shipmode") \
+        .agg(Sum(If(high, lit(1), lit(0))).alias("high_line_count"),
+             Sum(If(high, lit(0), lit(1))).alias("low_line_count")) \
+        .order_by(("l_shipmode", True))
+
+
+def q13(session, data_dir: str):
+    """TPC-H q13: customer distribution by order count."""
+    cu = _t(session, data_dir, "customer", ["c_custkey"])
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_comment"]) \
+        .where(~col("o_comment").like("%special%requests%")) \
+        .select(col("o_custkey"), col("o_orderkey"))
+    counts = cu.join(od, on=[("c_custkey", "o_custkey")], how="left") \
+        .group_by("c_custkey") \
+        .agg(Count(col("o_orderkey")).alias("c_count"))
+    return counts.group_by("c_count") \
+        .agg(CountStar().alias("custdist")) \
+        .order_by(("custdist", False), ("c_count", False))
+
+
+def q14(session, data_dir: str):
+    """TPC-H q14: promotion effect."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_shipdate", "l_extendedprice", "l_discount"]) \
+        .where((col("l_shipdate") >= _d(1995, 9, 1))
+               & (col("l_shipdate") < _d(1995, 10, 1)))
+    pt = _t(session, data_dir, "part", ["p_partkey", "p_type"])
+    j = li.join(pt, on=[("l_partkey", "p_partkey")])
+    return j.agg(
+        (lit(100.0)
+         * Sum(If(col("p_type").like("PROMO%"), _disc_price(), lit(0.0)))
+         / Sum(_disc_price())).alias("promo_revenue"))
+
+
+def q15(session, data_dir: str):
+    """TPC-H q15: top supplier by quarterly revenue."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_suppkey", "l_shipdate", "l_extendedprice", "l_discount"]) \
+        .where((col("l_shipdate") >= _d(1996, 1, 1))
+               & (col("l_shipdate") < _d(1996, 4, 1)))
+    revenue = li.group_by("l_suppkey") \
+        .agg(Sum(_disc_price()).alias("total_revenue"))
+    max_rows = revenue.agg(Max(col("total_revenue")).alias("m")).collect()
+    max_rev = float(max_rows[0][0] or 0.0)
+    sup = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_name", "s_address", "s_phone"])
+    return revenue.where(col("total_revenue") == lit(max_rev)) \
+        .join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .select(col("s_suppkey"), col("s_name"), col("s_address"),
+                col("s_phone"), col("total_revenue")) \
+        .order_by(("s_suppkey", True))
+
+
+def q16(session, data_dir: str):
+    """TPC-H q16: parts/supplier relationship (excl. complaints)."""
+    pt = _t(session, data_dir, "part",
+            ["p_partkey", "p_brand", "p_type", "p_size"]) \
+        .where(~(col("p_brand") == lit("Brand#45"))
+               & ~col("p_type").like("MEDIUM POLISHED%")
+               & In(col("p_size"), [lit(v) for v in
+                                    (49, 14, 23, 45, 19, 3, 36, 9)]))
+    bad = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_comment"]) \
+        .where(col("s_comment").like("%Customer%Complaints%")) \
+        .select(col("s_suppkey"))
+    ps = _t(session, data_dir, "partsupp", ["ps_partkey", "ps_suppkey"])
+    return ps.join(pt, on=[("ps_partkey", "p_partkey")]) \
+        .join(bad, on=[("ps_suppkey", "s_suppkey")], how="anti") \
+        .group_by("p_brand", "p_type", "p_size") \
+        .agg(CountDistinct(col("ps_suppkey")).alias("supplier_cnt")) \
+        .order_by(("supplier_cnt", False), ("p_brand", True),
+                  ("p_type", True), ("p_size", True))
+
+
+def q17(session, data_dir: str):
+    """TPC-H q17: small-quantity-order revenue."""
+    pt = _t(session, data_dir, "part",
+            ["p_partkey", "p_brand", "p_container"]) \
+        .where((col("p_brand") == lit("Brand#23"))
+               & (col("p_container") == lit("MED BOX"))) \
+        .select(col("p_partkey"))
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_quantity", "l_extendedprice"])
+    avg_qty = li.group_by("l_partkey") \
+        .agg((Average(col("l_quantity")) * lit(0.2)).alias("qty_thresh")) \
+        .select(col("l_partkey").alias("aq_partkey"), col("qty_thresh"))
+    return li.join(pt, on=[("l_partkey", "p_partkey")], how="semi") \
+        .join(avg_qty, on=[("l_partkey", "aq_partkey")]) \
+        .where(col("l_quantity") < col("qty_thresh")) \
+        .agg((Sum(col("l_extendedprice")) / lit(7.0)).alias("avg_yearly"))
+
+
+def q18(session, data_dir: str):
+    """TPC-H q18: large-volume customers."""
+    li = _t(session, data_dir, "lineitem", ["l_orderkey", "l_quantity"])
+    big = li.group_by("l_orderkey") \
+        .agg(Sum(col("l_quantity")).alias("q")) \
+        .where(col("q") > lit(300.0)) \
+        .select(col("l_orderkey").alias("big_orderkey"))
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_custkey", "o_orderdate", "o_totalprice"])
+    cu = _t(session, data_dir, "customer", ["c_custkey", "c_name"])
+    return li.join(big, on=[("l_orderkey", "big_orderkey")], how="semi") \
+        .join(od, on=[("l_orderkey", "o_orderkey")]) \
+        .join(cu, on=[("o_custkey", "c_custkey")]) \
+        .group_by("c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                  "o_totalprice") \
+        .agg(Sum(col("l_quantity")).alias("sum_qty")) \
+        .order_by(("o_totalprice", False), ("o_orderdate", True)) \
+        .limit(100)
+
+
+def q19(session, data_dir: str):
+    """TPC-H q19: discounted revenue (three brand/container bands)."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_quantity", "l_extendedprice", "l_discount",
+             "l_shipmode", "l_shipinstruct"]) \
+        .where(In(col("l_shipmode"), [lit("AIR"), lit("AIR REG")])
+               & (col("l_shipinstruct") == lit("DELIVER IN PERSON")))
+    pt = _t(session, data_dir, "part",
+            ["p_partkey", "p_brand", "p_container", "p_size"])
+    j = li.join(pt, on=[("l_partkey", "p_partkey")])
+
+    def band(brand, containers, qlo, slo, shi):
+        return ((col("p_brand") == lit(brand))
+                & In(col("p_container"), [lit(c) for c in containers])
+                & (col("l_quantity") >= lit(float(qlo)))
+                & (col("l_quantity") <= lit(float(qlo + 10)))
+                & (col("p_size") >= lit(slo)) & (col("p_size") <= lit(shi)))
+
+    cond = Or(Or(
+        band("Brand#12", ("SM CASE", "SM BOX", "SM PACK", "SM PKG"),
+             1, 1, 5),
+        band("Brand#23", ("MED BAG", "MED BOX", "MED PKG", "MED PACK"),
+             10, 1, 10)),
+        band("Brand#34", ("LG CASE", "LG BOX", "LG PACK", "LG PKG"),
+             20, 1, 15))
+    return j.where(cond).agg(Sum(_disc_price()).alias("revenue"))
+
+
+def q20(session, data_dir: str):
+    """TPC-H q20: potential part promotion (CANADA forest parts)."""
+    pt = _t(session, data_dir, "part", ["p_partkey", "p_name"]) \
+        .where(col("p_name").like("forest%")).select(col("p_partkey"))
+    li = _t(session, data_dir, "lineitem",
+            ["l_partkey", "l_suppkey", "l_quantity", "l_shipdate"]) \
+        .where((col("l_shipdate") >= _d(1994, 1, 1))
+               & (col("l_shipdate") < _d(1995, 1, 1)))
+    half_qty = li.group_by("l_partkey", "l_suppkey") \
+        .agg((Sum(col("l_quantity")) * lit(0.5)).alias("half_qty")) \
+        .select(col("l_partkey").alias("hq_partkey"),
+                col("l_suppkey").alias("hq_suppkey"), col("half_qty"))
+    ps = _t(session, data_dir, "partsupp",
+            ["ps_partkey", "ps_suppkey", "ps_availqty"])
+    good_supp = ps.join(pt, on=[("ps_partkey", "p_partkey")], how="semi") \
+        .join(half_qty, on=[("ps_partkey", "hq_partkey"),
+                            ("ps_suppkey", "hq_suppkey")]) \
+        .where(col("ps_availqty").cast(T.DoubleType()) > col("half_qty")) \
+        .select(col("ps_suppkey"))
+    sup = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_name", "s_address", "s_nationkey"])
+    na = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .where(col("n_name") == lit("CANADA")).select(col("n_nationkey"))
+    return sup.join(good_supp, on=[("s_suppkey", "ps_suppkey")],
+                    how="semi") \
+        .join(na, on=[("s_nationkey", "n_nationkey")], how="semi") \
+        .select(col("s_name"), col("s_address")) \
+        .order_by(("s_name", True))
+
+
+def q21(session, data_dir: str):
+    """TPC-H q21: suppliers who kept orders waiting."""
+    li = _t(session, data_dir, "lineitem",
+            ["l_orderkey", "l_suppkey", "l_receiptdate", "l_commitdate"])
+    late = li.where(col("l_receiptdate") > col("l_commitdate"))
+    multi = li.group_by("l_orderkey") \
+        .agg(CountDistinct(col("l_suppkey")).alias("nsupp")) \
+        .where(col("nsupp") >= lit(2)) \
+        .select(col("l_orderkey").alias("multi_ok"))
+    single_late = late.group_by("l_orderkey") \
+        .agg(CountDistinct(col("l_suppkey")).alias("nlate")) \
+        .where(col("nlate") == lit(1)) \
+        .select(col("l_orderkey").alias("single_late_ok"))
+    od = _t(session, data_dir, "orders",
+            ["o_orderkey", "o_orderstatus"]) \
+        .where(col("o_orderstatus") == lit("F")).select(col("o_orderkey"))
+    sup = _t(session, data_dir, "supplier",
+             ["s_suppkey", "s_name", "s_nationkey"])
+    na = _t(session, data_dir, "nation", ["n_nationkey", "n_name"]) \
+        .where(col("n_name") == lit("SAUDI ARABIA")) \
+        .select(col("n_nationkey"))
+    return late.join(od, on=[("l_orderkey", "o_orderkey")], how="semi") \
+        .join(multi, on=[("l_orderkey", "multi_ok")], how="semi") \
+        .join(single_late, on=[("l_orderkey", "single_late_ok")],
+              how="semi") \
+        .join(sup, on=[("l_suppkey", "s_suppkey")]) \
+        .join(na, on=[("s_nationkey", "n_nationkey")], how="semi") \
+        .group_by("s_name").agg(CountStar().alias("numwait")) \
+        .order_by(("numwait", False), ("s_name", True)).limit(100)
+
+
+def q22(session, data_dir: str):
+    """TPC-H q22: global sales opportunity."""
+    codes = ("13", "31", "23", "29", "30", "18", "17")
+    cu = _t(session, data_dir, "customer",
+            ["c_custkey", "c_phone", "c_acctbal"]) \
+        .with_column("cntrycode", Substring(col("c_phone"), lit(1),
+                                            lit(2))) \
+        .where(In(col("cntrycode"), [lit(c) for c in codes]))
+    avg_rows = cu.where(col("c_acctbal") > lit(0.0)) \
+        .agg(Average(col("c_acctbal")).alias("a")).collect()
+    avg_bal = float(avg_rows[0][0] or 0.0)
+    od = _t(session, data_dir, "orders", ["o_custkey"]) \
+        .select(col("o_custkey"))
+    return cu.where(col("c_acctbal") > lit(avg_bal)) \
+        .join(od, on=[("c_custkey", "o_custkey")], how="anti") \
+        .group_by("cntrycode") \
+        .agg(CountStar().alias("numcust"),
+             Sum(col("c_acctbal")).alias("totacctbal")) \
+        .order_by(("cntrycode", True))
+
+
+TPCH_QUERIES = {f"q{i}": fn for i, fn in enumerate(
+    (q1, q2, q3, q4, q5, q6, q7, q8, q9, q10, q11, q12, q13, q14, q15,
+     q16, q17, q18, q19, q20, q21, q22), start=1)}
+
+
+def build_tpch_query(name: str, session, data_dir: str):
+    return TPCH_QUERIES[name](session, data_dir)
