@@ -34,7 +34,7 @@ MIN_JAX = (0, 4, 26)
 MIN_PYARROW = (10, 0, 0)
 
 INIT_TIMEOUT = register(ConfEntry(
-    "spark.rapids.tpu.initTimeoutSeconds", 300,
+    "spark.rapids.tpu.initTimeoutSeconds", 90,
     "Deadline for accelerator backend initialization. A tunneled/remote "
     "PJRT client can hang forever inside device acquisition; the "
     "reference treats executor init failure as fail-fast-and-relaunch "
@@ -102,8 +102,26 @@ def _check_versions(allow_incompatible: bool) -> None:
 
 def _probe_devices():
     """Run in a worker thread: returns jax.devices() (may hang in a
-    wedged PJRT client — the caller enforces the deadline)."""
+    wedged PJRT client — the caller enforces the deadline).
+
+    When the process explicitly requests the CPU platform
+    (JAX_PLATFORMS=cpu or jax_platforms config), probe ONLY the cpu
+    backend: the bare ``jax.devices()`` default-backend resolution goes
+    through the accelerator plugin's client init, so a wedged tunnel
+    would block even pure-CPU sessions (observed round 4: every
+    JAX_PLATFORMS=cpu verification process hung in make_c_api_client
+    when the axon relay went down mid-run)."""
+    import os
     import jax
+    env = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0].strip()
+    if env == "cpu":
+        # the accelerator plugin's site hook rewrites jax_platforms to
+        # "axon,cpu" AFTER the env var is read, so the env intent must
+        # be re-asserted through the config (the authoritative path) or
+        # backends() initializes the tunnel client first anyway
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        return jax.devices("cpu")
     return jax.devices()
 
 
