@@ -1038,6 +1038,17 @@ QUERIES = {"q3": q3, "q6": q6, "q7": q7, "q12": q12, "q13": q13,
            "q63": q63, "q65": q65, "q68": q68, "q69": q69, "q73": q73,
            "q79": q79, "q88": q88, "q89": q89, "q96": q96, "q98": q98}
 
+# full-suite tranches live in sibling modules to keep files reviewable
+from spark_rapids_tpu.bench.tpcds_queries2 import QUERIES2  # noqa: E402
+from spark_rapids_tpu.bench.tpcds_queries3 import QUERIES3  # noqa: E402
+from spark_rapids_tpu.bench.tpcds_queries4 import QUERIES4  # noqa: E402
+from spark_rapids_tpu.bench.tpcds_queries5 import QUERIES5  # noqa: E402
+
+QUERIES.update(QUERIES2)
+QUERIES.update(QUERIES3)
+QUERIES.update(QUERIES4)
+QUERIES.update(QUERIES5)
+
 
 def build_query(name: str, session, data_dir: str):
     return QUERIES[name](session, data_dir)

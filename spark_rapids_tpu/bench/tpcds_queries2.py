@@ -1,0 +1,594 @@
+"""TPC-DS queries, full-suite tranche 2 (q1-q99 gap fill, part 1 of 3).
+
+DataFrame ports of the TPC-DS query definitions the reference ships as
+SQL text (integration_tests/.../tpcds/TpcdsLikeSpark.scala:720-4700).
+House rules (same as tpcds_queries.py):
+  - scalar subqueries are evaluated eagerly and folded as literals (the
+    plan shape Spark produces after subquery execution);
+  - EXISTS / IN-subquery become semi joins, NOT EXISTS becomes anti;
+  - correlated aggregate subqueries become group-by + join (Spark's
+    RewriteCorrelatedScalarSubquery does the same);
+  - SQL UNION (distinct) is union() + distinct(); UNION ALL is union().
+"""
+from __future__ import annotations
+
+import os
+
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum,
+                                              stddev_samp)
+from spark_rapids_tpu.expr.arithmetic import Abs
+from spark_rapids_tpu.expr.conditional import CaseWhen, Coalesce, If
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.math_ops import Round
+from spark_rapids_tpu.expr.predicates import In, Or
+from spark_rapids_tpu.expr.strings import Concat, Substring, Upper
+
+__all__ = ["QUERIES2"]
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _date_sk(y: int, m: int, d: int) -> int:
+    import datetime as _dt
+    return 2415022 + (_dt.date(y, m, d) - _dt.date(1900, 1, 1)).days
+
+
+# ---------------------------------------------------------------------------
+# customer-total-return family: q1 / q30 / q81
+# ---------------------------------------------------------------------------
+
+def _total_return_outliers(session, data_dir, ctr, key_col, state_filter):
+    """Shared q1/q30/q81 spine: rows whose total return exceeds 1.2x the
+    per-group average (correlated subquery -> group-by + join)."""
+    avg_by_grp = ctr.group_by("ctr_grp") \
+        .agg((Average(col("ctr_total_return")) * lit(1.2)).alias("ctr_avg")) \
+        .select(col("ctr_grp").alias("avg_grp"), col("ctr_avg"))
+    return ctr.join(avg_by_grp, on=[("ctr_grp", "avg_grp")]) \
+        .where(col("ctr_total_return") > col("ctr_avg"))
+
+
+def q1(session, data_dir: str):
+    """TPC-DS q1: customers returning > 1.2x the store average, TN
+    stores, year 2000."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2000)).select(col("d_date_sk"))
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_returned_date_sk", "sr_customer_sk", "sr_store_sk",
+             "sr_return_amt"])
+    ctr = sr.join(dd, on=[("sr_returned_date_sk", "d_date_sk")]) \
+        .group_by("sr_customer_sk", "sr_store_sk") \
+        .agg(Sum(col("sr_return_amt")).alias("ctr_total_return")) \
+        .select(col("sr_customer_sk").alias("ctr_customer_sk"),
+                col("sr_store_sk").alias("ctr_grp"),
+                col("ctr_total_return"))
+    out = _total_return_outliers(session, data_dir, ctr, "ctr_grp", None)
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_state"]) \
+        .where(col("s_state") == lit("TN")).select(col("s_store_sk"))
+    cu = _t(session, data_dir, "customer", ["c_customer_sk", "c_customer_id"])
+    return out.join(st, on=[("ctr_grp", "s_store_sk")]) \
+        .join(cu, on=[("ctr_customer_sk", "c_customer_sk")]) \
+        .select(col("c_customer_id")) \
+        .order_by(("c_customer_id", True)).limit(100)
+
+
+def q30(session, data_dir: str):
+    """TPC-DS q30: web-return outliers by state, GA customers."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2002)).select(col("d_date_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"])
+    wr = _t(session, data_dir, "web_returns",
+            ["wr_returned_date_sk", "wr_returning_customer_sk",
+             "wr_returning_addr_sk", "wr_return_amt"])
+    ctr = wr.join(dd, on=[("wr_returned_date_sk", "d_date_sk")]) \
+        .join(ca, on=[("wr_returning_addr_sk", "ca_address_sk")]) \
+        .group_by("wr_returning_customer_sk", "ca_state") \
+        .agg(Sum(col("wr_return_amt")).alias("ctr_total_return")) \
+        .select(col("wr_returning_customer_sk").alias("ctr_customer_sk"),
+                col("ca_state").alias("ctr_grp"), col("ctr_total_return"))
+    out = _total_return_outliers(session, data_dir, ctr, "ctr_grp", None)
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_customer_id", "c_salutation",
+             "c_first_name", "c_last_name", "c_preferred_cust_flag",
+             "c_birth_day", "c_birth_month", "c_birth_year",
+             "c_birth_country", "c_login", "c_email_address",
+             "c_last_review_date", "c_current_addr_sk"])
+    ca2 = _t(session, data_dir, "customer_address",
+             ["ca_address_sk", "ca_state"]) \
+        .where(col("ca_state") == lit("GA")) \
+        .select(col("ca_address_sk").alias("ca2_address_sk"))
+    cols = [col(c) for c in
+            ("c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+             "c_preferred_cust_flag", "c_birth_day", "c_birth_month",
+             "c_birth_year", "c_birth_country", "c_login",
+             "c_email_address", "c_last_review_date")]
+    return out.join(cu, on=[("ctr_customer_sk", "c_customer_sk")]) \
+        .join(ca2, on=[("c_current_addr_sk", "ca2_address_sk")]) \
+        .select(*cols, col("ctr_total_return")) \
+        .order_by(*[(c.name, True) for c in cols],
+                  ("ctr_total_return", True)) \
+        .limit(100)
+
+
+def q81(session, data_dir: str):
+    """TPC-DS q81: catalog-return outliers by state, GA customers, with
+    full address."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2000)).select(col("d_date_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"])
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_returned_date_sk", "cr_returning_customer_sk",
+             "cr_returning_addr_sk", "cr_return_amt_inc_tax"])
+    ctr = cr.join(dd, on=[("cr_returned_date_sk", "d_date_sk")]) \
+        .join(ca, on=[("cr_returning_addr_sk", "ca_address_sk")]) \
+        .group_by("cr_returning_customer_sk", "ca_state") \
+        .agg(Sum(col("cr_return_amt_inc_tax")).alias("ctr_total_return")) \
+        .select(col("cr_returning_customer_sk").alias("ctr_customer_sk"),
+                col("ca_state").alias("ctr_grp"), col("ctr_total_return"))
+    out = _total_return_outliers(session, data_dir, ctr, "ctr_grp", None)
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_customer_id", "c_salutation",
+             "c_first_name", "c_last_name", "c_current_addr_sk"])
+    ca2 = _t(session, data_dir, "customer_address")
+    ca2 = ca2.where(col("ca_state") == lit("GA")).select(
+        col("ca_address_sk").alias("ca2_address_sk"),
+        col("ca_street_number"), col("ca_street_name"),
+        col("ca_street_type"), col("ca_suite_number"), col("ca_city"),
+        col("ca_county"), col("ca_state"), col("ca_zip"), col("ca_country"),
+        col("ca_gmt_offset"), col("ca_location_type"))
+    names = ["c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+             "ca_street_number", "ca_street_name", "ca_street_type",
+             "ca_suite_number", "ca_city", "ca_county", "ca_state",
+             "ca_zip", "ca_country", "ca_gmt_offset", "ca_location_type"]
+    return out.join(cu, on=[("ctr_customer_sk", "c_customer_sk")]) \
+        .join(ca2, on=[("c_current_addr_sk", "ca2_address_sk")]) \
+        .select(*[col(n) for n in names], col("ctr_total_return")) \
+        .order_by(*[(n, True) for n in names], ("ctr_total_return", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# year-over-year customer families: q4 / q11 / q74
+# ---------------------------------------------------------------------------
+
+def _year_total(session, data_dir, sales, cust_col, date_col, total_expr,
+                year, tag, extra_cols=()):
+    """One year_total branch: per-customer yearly total for one channel,
+    pinned to one year (the reference builds one CTE and filters it six
+    ways; filter pushdown yields exactly these per-year branches)."""
+    cu_cols = ["c_customer_sk", "c_customer_id", "c_first_name",
+               "c_last_name"] + list(extra_cols)
+    cu = _t(session, data_dir, "customer", cu_cols)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(year)).select(col("d_date_sk"))
+    keys = ["c_customer_id", "c_first_name", "c_last_name"] + \
+        list(extra_cols)
+    g = sales.join(dd, on=[(date_col, "d_date_sk")]) \
+        .join(cu, on=[(cust_col, "c_customer_sk")]) \
+        .group_by(*keys) \
+        .agg(total_expr.alias("year_total"))
+    ren = [col(k).alias(f"{tag}_{k}") for k in keys] + \
+        [col("year_total").alias(f"{tag}_total")]
+    return g.select(*ren)
+
+
+def _yoy_query(session, data_dir, channels, year, select_flag):
+    """Shared spine of q4 (3 channels) / q11 (2 channels, flag col) /
+    q74 (2 channels, net_paid): first/second-year totals per channel,
+    joined on customer id; growth-ratio comparisons filter the rows."""
+    frames = {}
+    for tag, (sales_fn, cust_col, date_col, total_fn) in channels.items():
+        for yr, suffix in ((year, "1"), (year + 1, "2")):
+            extra = ("c_preferred_cust_flag",) if (
+                select_flag and tag == "s" and suffix == "2") else ()
+            frames[tag + suffix] = _year_total(
+                session, data_dir, sales_fn(), cust_col, date_col,
+                total_fn(), yr, tag + suffix, extra_cols=extra)
+    first_tags = [t + "1" for t in channels]
+    base = frames["s1"].where(col("s1_total") > lit(0.0))
+    for t in channels:
+        if t == "s":
+            continue
+        base = base.join(
+            frames[t + "1"].where(col(f"{t}1_total") > lit(0.0)),
+            on=[("s1_c_customer_id", f"{t}1_c_customer_id")])
+    for t in channels:
+        base = base.join(frames[t + "2"],
+                         on=[("s1_c_customer_id", f"{t}2_c_customer_id")])
+    other = [t for t in channels if t != "s"]
+    cond = None
+    for t in other:
+        c = (col(f"{t}2_total") / col(f"{t}1_total")) > \
+            (col("s2_total") / col("s1_total"))
+        cond = c if cond is None else cond & c
+    out_cols = [col("s2_c_customer_id").alias("customer_id"),
+                col("s2_c_first_name").alias("customer_first_name"),
+                col("s2_c_last_name").alias("customer_last_name")]
+    if select_flag:
+        out_cols.append(col("s2_c_preferred_cust_flag")
+                        .alias("customer_preferred_cust_flag"))
+    res = base.where(cond).select(*out_cols)
+    orders = [(c.name, True) for c in out_cols]
+    return res.order_by(*orders).limit(100)
+
+
+def q4(session, data_dir: str):
+    """TPC-DS q4: customers growing faster in catalog than store AND web
+    (three-channel year-over-year)."""
+    def ss():
+        return _t(session, data_dir, "store_sales",
+                  ["ss_sold_date_sk", "ss_customer_sk", "ss_ext_list_price",
+                   "ss_ext_wholesale_cost", "ss_ext_discount_amt",
+                   "ss_ext_sales_price"])
+
+    def cs():
+        return _t(session, data_dir, "catalog_sales",
+                  ["cs_sold_date_sk", "cs_bill_customer_sk",
+                   "cs_ext_list_price", "cs_ext_wholesale_cost",
+                   "cs_ext_discount_amt", "cs_ext_sales_price"])
+
+    def ws():
+        return _t(session, data_dir, "web_sales",
+                  ["ws_sold_date_sk", "ws_bill_customer_sk",
+                   "ws_ext_list_price", "ws_ext_wholesale_cost",
+                   "ws_ext_discount_amt", "ws_ext_sales_price"])
+
+    def tot(p):
+        return lambda: Sum(((col(f"{p}_ext_list_price")
+                             - col(f"{p}_ext_wholesale_cost")
+                             - col(f"{p}_ext_discount_amt"))
+                            + col(f"{p}_ext_sales_price")) / lit(2.0))
+
+    channels = {
+        "s": (ss, "ss_customer_sk", "ss_sold_date_sk", tot("ss")),
+        "c": (cs, "cs_bill_customer_sk", "cs_sold_date_sk", tot("cs")),
+        "w": (ws, "ws_bill_customer_sk", "ws_sold_date_sk", tot("ws")),
+    }
+    # q4 compares c-growth > s-growth and c-growth > w-growth
+    frames = {}
+    for tag, (sales_fn, cust_col, date_col, total_fn) in channels.items():
+        for yr, suffix in ((2001, "1"), (2002, "2")):
+            extra = ("c_preferred_cust_flag",) if (
+                tag == "s" and suffix == "2") else ()
+            frames[tag + suffix] = _year_total(
+                session, data_dir, sales_fn(), cust_col, date_col,
+                total_fn(), yr, tag + suffix, extra_cols=extra)
+    base = frames["s1"].where(col("s1_total") > lit(0.0)) \
+        .join(frames["c1"].where(col("c1_total") > lit(0.0)),
+              on=[("s1_c_customer_id", "c1_c_customer_id")]) \
+        .join(frames["w1"].where(col("w1_total") > lit(0.0)),
+              on=[("s1_c_customer_id", "w1_c_customer_id")]) \
+        .join(frames["s2"], on=[("s1_c_customer_id", "s2_c_customer_id")]) \
+        .join(frames["c2"], on=[("s1_c_customer_id", "c2_c_customer_id")]) \
+        .join(frames["w2"], on=[("s1_c_customer_id", "w2_c_customer_id")])
+    cond = ((col("c2_total") / col("c1_total"))
+            > (col("s2_total") / col("s1_total"))) \
+        & ((col("c2_total") / col("c1_total"))
+           > (col("w2_total") / col("w1_total")))
+    out_cols = [col("s2_c_customer_id").alias("customer_id"),
+                col("s2_c_first_name").alias("customer_first_name"),
+                col("s2_c_last_name").alias("customer_last_name"),
+                col("s2_c_preferred_cust_flag")
+                .alias("customer_preferred_cust_flag")]
+    return base.where(cond).select(*out_cols) \
+        .order_by(*[(c.name, True) for c in out_cols]).limit(100)
+
+
+def q11(session, data_dir: str):
+    """TPC-DS q11: customers whose web growth beats store growth."""
+    def ss():
+        return _t(session, data_dir, "store_sales",
+                  ["ss_sold_date_sk", "ss_customer_sk",
+                   "ss_ext_list_price", "ss_ext_discount_amt"])
+
+    def ws():
+        return _t(session, data_dir, "web_sales",
+                  ["ws_sold_date_sk", "ws_bill_customer_sk",
+                   "ws_ext_list_price", "ws_ext_discount_amt"])
+
+    channels = {
+        "s": (ss, "ss_customer_sk", "ss_sold_date_sk",
+              lambda: Sum(col("ss_ext_list_price")
+                          - col("ss_ext_discount_amt"))),
+        "w": (ws, "ws_bill_customer_sk", "ws_sold_date_sk",
+              lambda: Sum(col("ws_ext_list_price")
+                          - col("ws_ext_discount_amt"))),
+    }
+    return _yoy_query(session, data_dir, channels, 2001, select_flag=True)
+
+
+def q74(session, data_dir: str):
+    """TPC-DS q74: net-paid year-over-year, web growth beats store."""
+    def ss():
+        return _t(session, data_dir, "store_sales",
+                  ["ss_sold_date_sk", "ss_customer_sk", "ss_net_paid"])
+
+    def ws():
+        return _t(session, data_dir, "web_sales",
+                  ["ws_sold_date_sk", "ws_bill_customer_sk", "ws_net_paid"])
+
+    channels = {
+        "s": (ss, "ss_customer_sk", "ss_sold_date_sk",
+              lambda: Sum(col("ss_net_paid"))),
+        "w": (ws, "ws_bill_customer_sk", "ws_sold_date_sk",
+              lambda: Sum(col("ws_net_paid"))),
+    }
+    return _yoy_query(session, data_dir, channels, 2001, select_flag=False)
+
+
+# ---------------------------------------------------------------------------
+# weekly pivots: q2 / q59
+# ---------------------------------------------------------------------------
+
+def _dow_pivot(joined, price_col):
+    """sum(case d_day_name = X then price end) for the seven days."""
+    def day(n):
+        return Sum(CaseWhen([(col("d_day_name") == lit(n),
+                              col(price_col))], lit(None)))
+    return [day("Sunday").alias("sun_sales"), day("Monday").alias("mon_sales"),
+            day("Tuesday").alias("tue_sales"),
+            day("Wednesday").alias("wed_sales"),
+            day("Thursday").alias("thu_sales"),
+            day("Friday").alias("fri_sales"),
+            day("Saturday").alias("sat_sales")]
+
+
+def q2(session, data_dir: str):
+    """TPC-DS q2: week-over-year day-of-week sales ratios (web+catalog)."""
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_ext_sales_price"]) \
+        .select(col("ws_sold_date_sk").alias("sold_date_sk"),
+                col("ws_ext_sales_price").alias("sales_price"))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_ext_sales_price"]) \
+        .select(col("cs_sold_date_sk").alias("sold_date_sk"),
+                col("cs_ext_sales_price").alias("sales_price"))
+    wscs = ws.union(cs)
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_week_seq", "d_day_name"])
+    wswscs = wscs.join(dd, on=[("sold_date_sk", "d_date_sk")]) \
+        .group_by("d_week_seq").agg(*_dow_pivot(None, "sales_price"))
+    dy = _t(session, data_dir, "date_dim", ["d_week_seq", "d_year"])
+    names = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+    y = wswscs.join(dy.where(col("d_year") == lit(2001))
+                    .select(col("d_week_seq").alias("y_week")),
+                    on=[("d_week_seq", "y_week")]) \
+        .select(col("d_week_seq").alias("d_week_seq1"),
+                *[col(f"{n}_sales").alias(f"{n}_sales1") for n in names])
+    z = wswscs.join(dy.where(col("d_year") == lit(2002))
+                    .select(col("d_week_seq").alias("z_week")),
+                    on=[("d_week_seq", "z_week")]) \
+        .select((col("d_week_seq") - lit(53)).cast(T.IntegerType())
+                .alias("d_week_seq2m"),
+                *[col(f"{n}_sales").alias(f"{n}_sales2") for n in names])
+    return y.join(z, on=[("d_week_seq1", "d_week_seq2m")]) \
+        .select(col("d_week_seq1"),
+                *[Round(col(f"{n}_sales1") / col(f"{n}_sales2"), 2)
+                  .alias(f"r_{n}") for n in names]) \
+        .order_by(("d_week_seq1", True))
+
+
+def q59(session, data_dir: str):
+    """TPC-DS q59: store week-over-year day-of-week ratios."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_sales_price"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_week_seq", "d_day_name"])
+    wss = ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .group_by("d_week_seq", "ss_store_sk") \
+        .agg(*_dow_pivot(None, "ss_sales_price"))
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_id", "s_store_name"])
+    dm = _t(session, data_dir, "date_dim", ["d_week_seq", "d_month_seq"])
+    names = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+    y = wss.join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(dm.where((col("d_month_seq") >= lit(1212))
+                       & (col("d_month_seq") <= lit(1223)))
+              .select(col("d_week_seq").alias("y_week")),
+              on=[("d_week_seq", "y_week")]) \
+        .select(col("s_store_name").alias("s_store_name1"),
+                col("d_week_seq").alias("d_week_seq1"),
+                col("s_store_id").alias("s_store_id1"),
+                *[col(f"{n}_sales").alias(f"{n}_sales1") for n in names])
+    x = wss.join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(dm.where((col("d_month_seq") >= lit(1224))
+                       & (col("d_month_seq") <= lit(1235)))
+              .select(col("d_week_seq").alias("x_week")),
+              on=[("d_week_seq", "x_week")]) \
+        .select(col("s_store_id").alias("s_store_id2"),
+                (col("d_week_seq") - lit(52)).cast(T.IntegerType())
+                .alias("d_week_seq2m"),
+                *[col(f"{n}_sales").alias(f"{n}_sales2") for n in names])
+    return y.join(x, on=[("s_store_id1", "s_store_id2"),
+                         ("d_week_seq1", "d_week_seq2m")]) \
+        .select(col("s_store_name1"), col("s_store_id1"),
+                col("d_week_seq1"),
+                *[(col(f"{n}_sales1") / col(f"{n}_sales2"))
+                  .alias(f"r_{n}") for n in names]) \
+        .order_by(("s_store_name1", True), ("s_store_id1", True),
+                  ("d_week_seq1", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# distinct-customer set ops: q38 / q87 / q97
+# ---------------------------------------------------------------------------
+
+def _cust_dates(session, data_dir, sales, cust_col, date_col):
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_last_name", "c_first_name"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_date", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211)))
+    return sales.join(dd, on=[(date_col, "d_date_sk")]) \
+        .join(cu, on=[(cust_col, "c_customer_sk")]) \
+        .select(col("c_last_name"), col("c_first_name"), col("d_date")) \
+        .distinct()
+
+
+def _three_channel_cust_dates(session, data_dir):
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_customer_sk"])
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_bill_customer_sk"])
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_bill_customer_sk"])
+    a = _cust_dates(session, data_dir, ss, "ss_customer_sk",
+                    "ss_sold_date_sk")
+    b = _cust_dates(session, data_dir, cs, "cs_bill_customer_sk",
+                    "cs_sold_date_sk")
+    c = _cust_dates(session, data_dir, ws, "ws_bill_customer_sk",
+                    "ws_sold_date_sk")
+    return a, b, c
+
+
+def q38(session, data_dir: str):
+    """TPC-DS q38: count of customers active in all three channels
+    (INTERSECT)."""
+    a, b, c = _three_channel_cust_dates(session, data_dir)
+    return a.intersect(b).intersect(c).agg(CountStar().alias("cnt"))
+
+
+def q87(session, data_dir: str):
+    """TPC-DS q87: store-only shoppers (EXCEPT chain) count."""
+    a, b, c = _three_channel_cust_dates(session, data_dir)
+    return a.subtract(b).subtract(c).agg(CountStar().alias("cnt"))
+
+
+def q97(session, data_dir: str):
+    """TPC-DS q97: store/catalog shopper overlap via FULL OUTER JOIN."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    ssci = _t(session, data_dir, "store_sales",
+              ["ss_sold_date_sk", "ss_customer_sk", "ss_item_sk"]) \
+        .join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .group_by("ss_customer_sk", "ss_item_sk").agg() \
+        .select(col("ss_customer_sk").alias("s_customer_sk"),
+                col("ss_item_sk").alias("s_item_sk"))
+    csci = _t(session, data_dir, "catalog_sales",
+              ["cs_sold_date_sk", "cs_bill_customer_sk", "cs_item_sk"]) \
+        .join(dd, on=[("cs_sold_date_sk", "d_date_sk")]) \
+        .group_by("cs_bill_customer_sk", "cs_item_sk").agg() \
+        .select(col("cs_bill_customer_sk").alias("c_customer_sk"),
+                col("cs_item_sk").alias("c_item_sk"))
+    j = ssci.join(csci, on=[("s_customer_sk", "c_customer_sk"),
+                            ("s_item_sk", "c_item_sk")], how="full")
+    return j.agg(
+        Sum(If(col("s_customer_sk").is_not_null()
+               & col("c_customer_sk").is_null(), lit(1), lit(0)))
+        .alias("store_only"),
+        Sum(If(col("s_customer_sk").is_null()
+               & col("c_customer_sk").is_not_null(), lit(1), lit(0)))
+        .alias("catalog_only"),
+        Sum(If(col("s_customer_sk").is_not_null()
+               & col("c_customer_sk").is_not_null(), lit(1), lit(0)))
+        .alias("store_and_catalog"))
+
+
+# ---------------------------------------------------------------------------
+# quarterly county growth: q31
+# ---------------------------------------------------------------------------
+
+def q31(session, data_dir: str):
+    """TPC-DS q31: counties where web growth outpaces store growth across
+    2000 Q1->Q2->Q3."""
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_county"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_qoy", "d_year"]) \
+        .where(col("d_year") == lit(2000))
+
+    def chan(sales, date_col, addr_col, price_col, name):
+        return sales.join(dd, on=[(date_col, "d_date_sk")]) \
+            .join(ca, on=[(addr_col, "ca_address_sk")]) \
+            .group_by("ca_county", "d_qoy") \
+            .agg(Sum(col(price_col)).alias(name))
+
+    ss = chan(_t(session, data_dir, "store_sales",
+                 ["ss_sold_date_sk", "ss_addr_sk", "ss_ext_sales_price"]),
+              "ss_sold_date_sk", "ss_addr_sk", "ss_ext_sales_price",
+              "store_sales")
+    ws = chan(_t(session, data_dir, "web_sales",
+                 ["ws_sold_date_sk", "ws_bill_addr_sk",
+                  "ws_ext_sales_price"]),
+              "ws_sold_date_sk", "ws_bill_addr_sk", "ws_ext_sales_price",
+              "web_sales")
+
+    def leg(frame, q, name, val):
+        return frame.where(col("d_qoy") == lit(q)) \
+            .select(col("ca_county").alias(f"{name}_county"),
+                    col(val).alias(name))
+
+    j = leg(ss, 1, "ss1", "store_sales") \
+        .join(leg(ss, 2, "ss2", "store_sales"),
+              on=[("ss1_county", "ss2_county")]) \
+        .join(leg(ss, 3, "ss3", "store_sales"),
+              on=[("ss1_county", "ss3_county")]) \
+        .join(leg(ws, 1, "ws1", "web_sales"),
+              on=[("ss1_county", "ws1_county")]) \
+        .join(leg(ws, 2, "ws2", "web_sales"),
+              on=[("ss1_county", "ws2_county")]) \
+        .join(leg(ws, 3, "ws3", "web_sales"),
+              on=[("ss1_county", "ws3_county")])
+    return j.where(((col("ws2") / col("ws1")) > (col("ss2") / col("ss1")))
+                   & ((col("ws3") / col("ws2"))
+                      > (col("ss3") / col("ss2")))) \
+        .select(col("ss1_county").alias("ca_county"), lit(2000).alias("d_year"),
+                (col("ws2") / col("ws1")).alias("web_q1_q2_increase"),
+                (col("ss2") / col("ss1")).alias("store_q1_q2_increase"),
+                (col("ws3") / col("ws2")).alias("web_q2_q3_increase"),
+                (col("ss3") / col("ss2")).alias("store_q2_q3_increase")) \
+        .order_by(("ca_county", True))
+
+
+# ---------------------------------------------------------------------------
+# excess-discount: q32 / q92
+# ---------------------------------------------------------------------------
+
+def _excess_discount(session, data_dir, sales_tbl, item_col, date_col,
+                     disc_col, manufact_id, start):
+    lo = _date_sk(*start)
+    hi = lo + 90
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo)) & (col("d_date_sk") <= lit(hi)))
+    sales = _t(session, data_dir, sales_tbl, [date_col, item_col, disc_col])
+    windowed = sales.join(dd, on=[(date_col, "d_date_sk")])
+    avg_disc = windowed.group_by(item_col) \
+        .agg((Average(col(disc_col)) * lit(1.3)).alias("disc_thresh")) \
+        .select(col(item_col).alias("avg_item_sk"), col("disc_thresh"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_manufact_id"]) \
+        .where(col("i_manufact_id") == lit(manufact_id)) \
+        .select(col("i_item_sk"))
+    return windowed.join(it, on=[(item_col, "i_item_sk")]) \
+        .join(avg_disc, on=[(item_col, "avg_item_sk")]) \
+        .where(col(disc_col) > col("disc_thresh")) \
+        .agg(Sum(col(disc_col)).alias("excess_discount_amount"))
+
+
+def q32(session, data_dir: str):
+    """TPC-DS q32: catalog excess discount amount."""
+    return _excess_discount(session, data_dir, "catalog_sales",
+                            "cs_item_sk", "cs_sold_date_sk",
+                            "cs_ext_discount_amt", 977, (2000, 1, 27))
+
+
+def q92(session, data_dir: str):
+    """TPC-DS q92: web excess discount amount."""
+    return _excess_discount(session, data_dir, "web_sales",
+                            "ws_item_sk", "ws_sold_date_sk",
+                            "ws_ext_discount_amt", 350, (2000, 1, 27))
+
+
+QUERIES2 = {"q1": q1, "q2": q2, "q4": q4, "q11": q11, "q30": q30,
+            "q31": q31, "q32": q32, "q38": q38, "q59": q59, "q74": q74,
+            "q81": q81, "q87": q87, "q92": q92, "q97": q97}

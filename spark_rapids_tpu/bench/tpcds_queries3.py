@@ -1,0 +1,694 @@
+"""TPC-DS queries, full-suite tranche 3 (q1-q99 gap fill, part 2 of 3).
+
+Inventory, sales+returns three-way joins, shipping-lag pivots, and the
+exists/not-exists shipping queries.  Same house rules as
+tpcds_queries2.py (reference: TpcdsLikeSpark.scala:1561-4700).
+"""
+from __future__ import annotations
+
+import os
+
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum,
+                                              stddev_samp)
+from spark_rapids_tpu.expr.conditional import CaseWhen, Coalesce, If
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.predicates import In, Or
+from spark_rapids_tpu.expr.strings import Substring
+
+__all__ = ["QUERIES3"]
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _date_sk(y: int, m: int, d: int) -> int:
+    import datetime as _dt
+    return 2415022 + (_dt.date(y, m, d) - _dt.date(1900, 1, 1)).days
+
+
+# ---------------------------------------------------------------------------
+# sales -> store_returns -> catalog re-purchase chains: q17 / q25 / q29
+# ---------------------------------------------------------------------------
+
+def _sales_returns_catalog(session, data_dir, d1_where, d2_where, d3_where,
+                           aggs):
+    """Shared q17/q25/q29 spine: store sale -> its return -> follow-up
+    catalog purchase by the same customer for the same item."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+             "ss_store_sk", "ss_ticket_number", "ss_quantity",
+             "ss_net_profit"])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+             "sr_ticket_number", "sr_return_quantity", "sr_net_loss"])
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+             "cs_quantity", "cs_net_profit"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_moy", "d_year", "d_quarter_name"])
+    d1 = d1_where(dd).select(col("d_date_sk").alias("d1_sk"))
+    d2 = d2_where(dd).select(col("d_date_sk").alias("d2_sk"))
+    d3 = d3_where(dd).select(col("d_date_sk").alias("d3_sk"))
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_id", "s_store_name", "s_state"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_id", "i_item_desc"])
+    base = ss.join(d1, on=[("ss_sold_date_sk", "d1_sk")]) \
+        .join(sr, on=[("ss_customer_sk", "sr_customer_sk"),
+                      ("ss_item_sk", "sr_item_sk"),
+                      ("ss_ticket_number", "sr_ticket_number")]) \
+        .join(d2, on=[("sr_returned_date_sk", "d2_sk")]) \
+        .join(cs, on=[("sr_customer_sk", "cs_bill_customer_sk"),
+                      ("sr_item_sk", "cs_item_sk")]) \
+        .join(d3, on=[("cs_sold_date_sk", "d3_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")])
+    return base
+
+
+def q17(session, data_dir: str):
+    """TPC-DS q17: quantity stats (count/avg/stddev) across the
+    sale->return->catalog chain, 2001Q1-Q3."""
+    qs = ["2001Q1", "2001Q2", "2001Q3"]
+    base = _sales_returns_catalog(
+        session, data_dir,
+        lambda dd: dd.where(col("d_quarter_name") == lit("2001Q1")),
+        lambda dd: dd.where(In(col("d_quarter_name"),
+                               [lit(q) for q in qs])),
+        lambda dd: dd.where(In(col("d_quarter_name"),
+                               [lit(q) for q in qs])),
+        None)
+    return base.group_by("i_item_id", "i_item_desc", "s_state").agg(
+        Count(col("ss_quantity")).alias("store_sales_quantitycount"),
+        Average(col("ss_quantity")).alias("store_sales_quantityave"),
+        stddev_samp(col("ss_quantity")).alias("store_sales_quantitystdev"),
+        (stddev_samp(col("ss_quantity")) / Average(col("ss_quantity")))
+        .alias("store_sales_quantitycov"),
+        Count(col("sr_return_quantity")).alias("sr_quantitycount"),
+        Average(col("sr_return_quantity")).alias("sr_quantityave"),
+        stddev_samp(col("sr_return_quantity")).alias("sr_quantitystdev"),
+        (stddev_samp(col("sr_return_quantity"))
+         / Average(col("sr_return_quantity"))).alias("sr_quantitycov"),
+        Count(col("cs_quantity")).alias("cs_quantitycount"),
+        Average(col("cs_quantity")).alias("cs_quantityave"),
+        (stddev_samp(col("cs_quantity")) / Average(col("cs_quantity")))
+        .alias("cs_quantitystdev"),
+        (stddev_samp(col("cs_quantity")) / Average(col("cs_quantity")))
+        .alias("cs_quantitycov")) \
+        .order_by(("i_item_id", True), ("i_item_desc", True),
+                  ("s_state", True)) \
+        .limit(100)
+
+
+def q25(session, data_dir: str):
+    """TPC-DS q25: profit/loss totals across the chain, Apr-Oct 2001."""
+    base = _sales_returns_catalog(
+        session, data_dir,
+        lambda dd: dd.where((col("d_moy") == lit(4))
+                            & (col("d_year") == lit(2001))),
+        lambda dd: dd.where((col("d_moy") >= lit(4))
+                            & (col("d_moy") <= lit(10))
+                            & (col("d_year") == lit(2001))),
+        lambda dd: dd.where((col("d_moy") >= lit(4))
+                            & (col("d_moy") <= lit(10))
+                            & (col("d_year") == lit(2001))),
+        None)
+    return base.group_by("i_item_id", "i_item_desc", "s_store_id",
+                         "s_store_name").agg(
+        Sum(col("ss_net_profit")).alias("store_sales_profit"),
+        Sum(col("sr_net_loss")).alias("store_returns_loss"),
+        Sum(col("cs_net_profit")).alias("catalog_sales_profit")) \
+        .order_by(("i_item_id", True), ("i_item_desc", True),
+                  ("s_store_id", True), ("s_store_name", True)) \
+        .limit(100)
+
+
+def q29(session, data_dir: str):
+    """TPC-DS q29: quantity totals across the chain, Sep 1999 + 3yr."""
+    base = _sales_returns_catalog(
+        session, data_dir,
+        lambda dd: dd.where((col("d_moy") == lit(9))
+                            & (col("d_year") == lit(1999))),
+        lambda dd: dd.where((col("d_moy") >= lit(9))
+                            & (col("d_moy") <= lit(12))
+                            & (col("d_year") == lit(1999))),
+        lambda dd: dd.where(In(col("d_year"),
+                               [lit(1999), lit(2000), lit(2001)])),
+        None)
+    return base.group_by("i_item_id", "i_item_desc", "s_store_id",
+                         "s_store_name").agg(
+        Sum(col("ss_quantity")).alias("store_sales_quantity"),
+        Sum(col("sr_return_quantity")).alias("store_returns_quantity"),
+        Sum(col("cs_quantity")).alias("catalog_sales_quantity")) \
+        .order_by(("i_item_id", True), ("i_item_desc", True),
+                  ("s_store_id", True), ("s_store_name", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# inventory: q21 / q22 / q37 / q82 / q39
+# ---------------------------------------------------------------------------
+
+def q21(session, data_dir: str):
+    """TPC-DS q21: warehouse inventory before/after a pivot date."""
+    pivot = _date_sk(2000, 3, 11)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(pivot - 30))
+               & (col("d_date_sk") <= lit(pivot + 30)))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_id", "i_current_price"]) \
+        .where((col("i_current_price") >= lit(0.99))
+               & (col("i_current_price") <= lit(1.49)))
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name"])
+    inv = _t(session, data_dir, "inventory")
+    g = inv.join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .join(it, on=[("inv_item_sk", "i_item_sk")]) \
+        .join(wh, on=[("inv_warehouse_sk", "w_warehouse_sk")]) \
+        .group_by("w_warehouse_name", "i_item_id").agg(
+            Sum(If(col("inv_date_sk") < lit(pivot),
+                   col("inv_quantity_on_hand"), lit(0)))
+            .alias("inv_before"),
+            Sum(If(col("inv_date_sk") >= lit(pivot),
+                   col("inv_quantity_on_hand"), lit(0)))
+            .alias("inv_after"))
+    ratio = If(col("inv_before") > lit(0),
+               col("inv_after").cast(T.DoubleType()) / col("inv_before"),
+               lit(None))
+    return g.where((ratio >= lit(2.0 / 3.0)) & (ratio <= lit(3.0 / 2.0))) \
+        .order_by(("w_warehouse_name", True), ("i_item_id", True)) \
+        .limit(100)
+
+
+def q22(session, data_dir: str):
+    """TPC-DS q22: average quantity-on-hand ROLLUP over the item
+    hierarchy."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_product_name", "i_brand", "i_class",
+             "i_category"])
+    wh = _t(session, data_dir, "warehouse", ["w_warehouse_sk"])
+    inv = _t(session, data_dir, "inventory")
+    return inv.join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .join(it, on=[("inv_item_sk", "i_item_sk")]) \
+        .join(wh, on=[("inv_warehouse_sk", "w_warehouse_sk")]) \
+        .rollup("i_product_name", "i_brand", "i_class", "i_category") \
+        .agg(Average(col("inv_quantity_on_hand")).alias("qoh")) \
+        .order_by(("qoh", True), ("i_product_name", True),
+                  ("i_brand", True), ("i_class", True),
+                  ("i_category", True)) \
+        .limit(100)
+
+
+def _inventory_pricerange(session, data_dir, lo_price, hi_price, start,
+                          manufact_ids, demand_tbl, demand_item):
+    lo = _date_sk(*start)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 60)))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_id", "i_item_desc", "i_current_price",
+             "i_manufact_id"]) \
+        .where((col("i_current_price") >= lit(lo_price))
+               & (col("i_current_price") <= lit(hi_price))
+               & In(col("i_manufact_id"),
+                    [lit(m) for m in manufact_ids]))
+    inv = _t(session, data_dir, "inventory") \
+        .where((col("inv_quantity_on_hand") >= lit(100))
+               & (col("inv_quantity_on_hand") <= lit(500)))
+    demand = _t(session, data_dir, demand_tbl, [demand_item]) \
+        .select(col(demand_item).alias("dem_item_sk"))
+    return it.join(inv, on=[("i_item_sk", "inv_item_sk")]) \
+        .join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .join(demand, on=[("i_item_sk", "dem_item_sk")], how="semi") \
+        .group_by("i_item_id", "i_item_desc", "i_current_price").agg() \
+        .order_by(("i_item_id", True)).limit(100)
+
+
+def q37(session, data_dir: str):
+    """TPC-DS q37: catalog-demanded items in stock (price band)."""
+    return _inventory_pricerange(session, data_dir, 68.0, 98.0,
+                                 (2000, 2, 1), [677, 940, 694, 808],
+                                 "catalog_sales", "cs_item_sk")
+
+
+def q82(session, data_dir: str):
+    """TPC-DS q82: store-demanded items in stock (price band)."""
+    return _inventory_pricerange(session, data_dir, 62.0, 92.0,
+                                 (2000, 5, 25), [129, 270, 821, 423],
+                                 "store_sales", "ss_item_sk")
+
+
+def _q39_inv(session, data_dir):
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where(col("d_year") == lit(2001))
+    it = _t(session, data_dir, "item", ["i_item_sk"])
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name"])
+    inv = _t(session, data_dir, "inventory")
+    g = inv.join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .join(it, on=[("inv_item_sk", "i_item_sk")]) \
+        .join(wh, on=[("inv_warehouse_sk", "w_warehouse_sk")]) \
+        .group_by("w_warehouse_name", "w_warehouse_sk", "i_item_sk",
+                  "d_moy") \
+        .agg(stddev_samp(col("inv_quantity_on_hand")).alias("stdev"),
+             Average(col("inv_quantity_on_hand")).alias("mean"))
+    g = g.where(If(col("mean") == lit(0.0), lit(0.0),
+                   col("stdev") / col("mean")) > lit(1.0))
+    cov = If(col("mean") == lit(0.0), lit(None),
+             col("stdev") / col("mean"))
+    return g.select(col("w_warehouse_sk"), col("i_item_sk"), col("d_moy"),
+                    col("mean"), cov.alias("cov"))
+
+
+def q39(session, data_dir: str):
+    """TPC-DS q39a: warehouse/item months with high inventory variance,
+    month 1 self-joined to month 2."""
+    inv = _q39_inv(session, data_dir)
+    inv1 = inv.where(col("d_moy") == lit(1)) \
+        .select(col("w_warehouse_sk").alias("w1"),
+                col("i_item_sk").alias("i1"),
+                col("d_moy").alias("moy1"),
+                col("mean").alias("mean1"), col("cov").alias("cov1"))
+    inv2 = inv.where(col("d_moy") == lit(2)) \
+        .select(col("w_warehouse_sk").alias("w2"),
+                col("i_item_sk").alias("i2"),
+                col("d_moy").alias("moy2"),
+                col("mean").alias("mean2"), col("cov").alias("cov2"))
+    return inv1.join(inv2, on=[("i1", "i2"), ("w1", "w2")]) \
+        .select(col("w1"), col("i1"), col("moy1"), col("mean1"),
+                col("cov1"), col("w2"), col("i2"), col("moy2"),
+                col("mean2"), col("cov2")) \
+        .order_by(("w1", True), ("i1", True), ("moy1", True),
+                  ("mean1", True), ("cov1", True), ("moy2", True),
+                  ("mean2", True), ("cov2", True))
+
+
+# ---------------------------------------------------------------------------
+# q40: catalog sales +/- returns around a pivot date
+# ---------------------------------------------------------------------------
+
+def q40(session, data_dir: str):
+    """TPC-DS q40: catalog sales net of refunds, before/after pivot."""
+    pivot = _date_sk(2000, 3, 11)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(pivot - 30))
+               & (col("d_date_sk") <= lit(pivot + 30)))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_item_sk", "cs_order_number",
+             "cs_warehouse_sk", "cs_sales_price"])
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_order_number", "cr_item_sk", "cr_refunded_cash"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_id", "i_current_price"]) \
+        .where((col("i_current_price") >= lit(0.99))
+               & (col("i_current_price") <= lit(1.49)))
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_state"])
+    net = col("cs_sales_price") - Coalesce(col("cr_refunded_cash"),
+                                           lit(0.0))
+    return cs.join(cr, on=[("cs_order_number", "cr_order_number"),
+                           ("cs_item_sk", "cr_item_sk")], how="left") \
+        .join(wh, on=[("cs_warehouse_sk", "w_warehouse_sk")]) \
+        .join(it, on=[("cs_item_sk", "i_item_sk")]) \
+        .join(dd, on=[("cs_sold_date_sk", "d_date_sk")]) \
+        .group_by("w_state", "i_item_id").agg(
+            Sum(If(col("cs_sold_date_sk") < lit(pivot), net, lit(0.0)))
+            .alias("sales_before"),
+            Sum(If(col("cs_sold_date_sk") >= lit(pivot), net, lit(0.0)))
+            .alias("sales_after")) \
+        .order_by(("w_state", True), ("i_item_id", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# shipping-lag pivots: q62 / q99 / q50
+# ---------------------------------------------------------------------------
+
+def _lag_buckets(ship_col, sold_col):
+    lag = col(ship_col) - col(sold_col)
+    return [
+        Sum(If(lag <= lit(30), lit(1), lit(0))).alias("d30"),
+        Sum(If((lag > lit(30)) & (lag <= lit(60)), lit(1), lit(0)))
+        .alias("d60"),
+        Sum(If((lag > lit(60)) & (lag <= lit(90)), lit(1), lit(0)))
+        .alias("d90"),
+        Sum(If((lag > lit(90)) & (lag <= lit(120)), lit(1), lit(0)))
+        .alias("d120"),
+        Sum(If(lag > lit(120), lit(1), lit(0))).alias("dmore"),
+    ]
+
+
+def q62(session, data_dir: str):
+    """TPC-DS q62: web shipping-lag buckets by warehouse/mode/site."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_ship_date_sk", "ws_sold_date_sk", "ws_warehouse_sk",
+             "ws_ship_mode_sk", "ws_web_site_sk"])
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name"])
+    sm = _t(session, data_dir, "ship_mode", ["sm_ship_mode_sk", "sm_type"])
+    web = _t(session, data_dir, "web_site", ["web_site_sk", "web_name"])
+    return ws.join(dd, on=[("ws_ship_date_sk", "d_date_sk")]) \
+        .join(wh, on=[("ws_warehouse_sk", "w_warehouse_sk")]) \
+        .join(sm, on=[("ws_ship_mode_sk", "sm_ship_mode_sk")]) \
+        .join(web, on=[("ws_web_site_sk", "web_site_sk")]) \
+        .with_column("wname", Substring(col("w_warehouse_name"),
+                                        lit(1), lit(20))) \
+        .group_by("wname", "sm_type", "web_name") \
+        .agg(*_lag_buckets("ws_ship_date_sk", "ws_sold_date_sk")) \
+        .order_by(("wname", True), ("sm_type", True), ("web_name", True)) \
+        .limit(100)
+
+
+def q99(session, data_dir: str):
+    """TPC-DS q99: catalog shipping-lag buckets by warehouse/mode/call
+    center."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_ship_date_sk", "cs_sold_date_sk", "cs_warehouse_sk",
+             "cs_ship_mode_sk", "cs_call_center_sk"])
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name"])
+    sm = _t(session, data_dir, "ship_mode", ["sm_ship_mode_sk", "sm_type"])
+    cc = _t(session, data_dir, "call_center",
+            ["cc_call_center_sk", "cc_name"])
+    return cs.join(dd, on=[("cs_ship_date_sk", "d_date_sk")]) \
+        .join(wh, on=[("cs_warehouse_sk", "w_warehouse_sk")]) \
+        .join(sm, on=[("cs_ship_mode_sk", "sm_ship_mode_sk")]) \
+        .join(cc, on=[("cs_call_center_sk", "cc_call_center_sk")]) \
+        .with_column("wname", Substring(col("w_warehouse_name"),
+                                        lit(1), lit(20))) \
+        .group_by("wname", "sm_type", "cc_name") \
+        .agg(*_lag_buckets("cs_ship_date_sk", "cs_sold_date_sk")) \
+        .order_by(("wname", True), ("sm_type", True), ("cc_name", True)) \
+        .limit(100)
+
+
+def q50(session, data_dir: str):
+    """TPC-DS q50: return-lag buckets per store, returns in Aug 2001."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+             "ss_ticket_number", "ss_store_sk"])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+             "sr_ticket_number"])
+    d2 = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") == lit(8))) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_name", "s_company_id",
+             "s_street_number", "s_street_name", "s_street_type",
+             "s_suite_number", "s_city", "s_county", "s_state", "s_zip"])
+    keys = ["s_store_name", "s_company_id", "s_street_number",
+            "s_street_name", "s_street_type", "s_suite_number", "s_city",
+            "s_county", "s_state", "s_zip"]
+    return ss.join(sr, on=[("ss_ticket_number", "sr_ticket_number"),
+                           ("ss_item_sk", "sr_item_sk"),
+                           ("ss_customer_sk", "sr_customer_sk")]) \
+        .join(d2, on=[("sr_returned_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .group_by(*keys) \
+        .agg(*_lag_buckets("sr_returned_date_sk", "ss_sold_date_sk")) \
+        .order_by(*[(k, True) for k in keys]).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# exists / not-exists shipping: q16 / q94 / q95
+# ---------------------------------------------------------------------------
+
+def _multi_warehouse_orders(sales, order_col, wh_col):
+    """Orders shipped from more than one warehouse (the EXISTS
+    same-order-different-warehouse subquery)."""
+    return sales.group_by(order_col) \
+        .agg(CountDistinct(col(wh_col)).alias("wh_cnt")) \
+        .where(col("wh_cnt") >= lit(2)) \
+        .select(col(order_col).alias("mw_order"))
+
+
+def q16(session, data_dir: str):
+    """TPC-DS q16: catalog orders shipped from multiple warehouses with
+    no returns, GA, 60-day window."""
+    lo = _date_sk(2002, 2, 1)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 60)))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_ship_date_sk", "cs_ship_addr_sk", "cs_call_center_sk",
+             "cs_order_number", "cs_warehouse_sk", "cs_ext_ship_cost",
+             "cs_net_profit"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"]) \
+        .where(col("ca_state") == lit("GA")).select(col("ca_address_sk"))
+    cc = _t(session, data_dir, "call_center",
+            ["cc_call_center_sk", "cc_county"]) \
+        .where(col("cc_county") == lit("Williamson County")) \
+        .select(col("cc_call_center_sk"))
+    mw = _multi_warehouse_orders(
+        _t(session, data_dir, "catalog_sales",
+           ["cs_order_number", "cs_warehouse_sk"]),
+        "cs_order_number", "cs_warehouse_sk")
+    cr = _t(session, data_dir, "catalog_returns", ["cr_order_number"]) \
+        .select(col("cr_order_number"))
+    return cs.join(dd, on=[("cs_ship_date_sk", "d_date_sk")]) \
+        .join(ca, on=[("cs_ship_addr_sk", "ca_address_sk")]) \
+        .join(cc, on=[("cs_call_center_sk", "cc_call_center_sk")]) \
+        .join(mw, on=[("cs_order_number", "mw_order")], how="semi") \
+        .join(cr, on=[("cs_order_number", "cr_order_number")],
+              how="anti") \
+        .agg(CountDistinct(col("cs_order_number")).alias("order_count"),
+             Sum(col("cs_ext_ship_cost")).alias("total_shipping_cost"),
+             Sum(col("cs_net_profit")).alias("total_net_profit"))
+
+
+def _web_ship_report(session, data_dir, returns_semi: bool):
+    """q94 (anti returns) / q95 (semi returned multi-warehouse)."""
+    lo = _date_sk(1999, 2, 1)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 60)))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_ship_date_sk", "ws_ship_addr_sk", "ws_web_site_sk",
+             "ws_order_number", "ws_warehouse_sk", "ws_ext_ship_cost",
+             "ws_net_profit"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"]) \
+        .where(col("ca_state") == lit("IL")).select(col("ca_address_sk"))
+    web = _t(session, data_dir, "web_site",
+             ["web_site_sk", "web_company_name"]) \
+        .where(col("web_company_name") == lit("pri")) \
+        .select(col("web_site_sk"))
+    mw = _multi_warehouse_orders(
+        _t(session, data_dir, "web_sales",
+           ["ws_order_number", "ws_warehouse_sk"]),
+        "ws_order_number", "ws_warehouse_sk")
+    wr = _t(session, data_dir, "web_returns", ["wr_order_number"]) \
+        .select(col("wr_order_number"))
+    base = ws.join(dd, on=[("ws_ship_date_sk", "d_date_sk")]) \
+        .join(ca, on=[("ws_ship_addr_sk", "ca_address_sk")]) \
+        .join(web, on=[("ws_web_site_sk", "web_site_sk")]) \
+        .join(mw, on=[("ws_order_number", "mw_order")], how="semi")
+    if returns_semi:
+        # q95: order must ALSO appear among returned multi-warehouse
+        # orders
+        returned_mw = wr.join(mw, on=[("wr_order_number", "mw_order")],
+                              how="semi")
+        base = base.join(returned_mw,
+                         on=[("ws_order_number", "wr_order_number")],
+                         how="semi")
+    else:
+        base = base.join(wr, on=[("ws_order_number", "wr_order_number")],
+                         how="anti")
+    return base.agg(
+        CountDistinct(col("ws_order_number")).alias("order_count"),
+        Sum(col("ws_ext_ship_cost")).alias("total_shipping_cost"),
+        Sum(col("ws_net_profit")).alias("total_net_profit"))
+
+
+def q94(session, data_dir: str):
+    """TPC-DS q94: multi-warehouse web orders with no returns."""
+    return _web_ship_report(session, data_dir, returns_semi=False)
+
+
+def q95(session, data_dir: str):
+    """TPC-DS q95: multi-warehouse web orders that were returned."""
+    return _web_ship_report(session, data_dir, returns_semi=True)
+
+
+# ---------------------------------------------------------------------------
+# q90 / q91 / q93
+# ---------------------------------------------------------------------------
+
+def q90(session, data_dir: str):
+    """TPC-DS q90: web AM/PM sales-count ratio."""
+    def count_hours(alias, h_lo, h_hi):
+        ws = _t(session, data_dir, "web_sales",
+                ["ws_sold_time_sk", "ws_ship_hdemo_sk", "ws_web_page_sk"])
+        hd = _t(session, data_dir, "household_demographics",
+                ["hd_demo_sk", "hd_dep_count"]) \
+            .where(col("hd_dep_count") == lit(6)).select(col("hd_demo_sk"))
+        td = _t(session, data_dir, "time_dim", ["t_time_sk", "t_hour"]) \
+            .where((col("t_hour") >= lit(h_lo))
+                   & (col("t_hour") <= lit(h_hi))) \
+            .select(col("t_time_sk"))
+        wp = _t(session, data_dir, "web_page",
+                ["wp_web_page_sk", "wp_char_count"]) \
+            .where((col("wp_char_count") >= lit(5000))
+                   & (col("wp_char_count") <= lit(5200))) \
+            .select(col("wp_web_page_sk"))
+        return ws.join(hd, on=[("ws_ship_hdemo_sk", "hd_demo_sk")]) \
+            .join(td, on=[("ws_sold_time_sk", "t_time_sk")]) \
+            .join(wp, on=[("ws_web_page_sk", "wp_web_page_sk")]) \
+            .agg(CountStar().alias(alias))
+
+    am = count_hours("amc", 8, 9)
+    pm = count_hours("pmc", 19, 20)
+    return am.join(pm, how="cross") \
+        .select((col("amc").cast(T.DoubleType())
+                 / col("pmc").cast(T.DoubleType())).alias("am_pm_ratio")) \
+        .order_by(("am_pm_ratio", True)).limit(100)
+
+
+def q91(session, data_dir: str):
+    """TPC-DS q91: call-center losses from returns by demographic."""
+    cc = _t(session, data_dir, "call_center",
+            ["cc_call_center_sk", "cc_call_center_id", "cc_name",
+             "cc_manager"])
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_call_center_sk", "cr_returned_date_sk",
+             "cr_returning_customer_sk", "cr_net_loss"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(1998)) & (col("d_moy") == lit(11))) \
+        .select(col("d_date_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_cdemo_sk", "c_current_hdemo_sk",
+             "c_current_addr_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_gmt_offset"]) \
+        .where(col("ca_gmt_offset") == lit(-7.0)) \
+        .select(col("ca_address_sk"))
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"]) \
+        .where(Or((col("cd_marital_status") == lit("M"))
+                  & (col("cd_education_status") == lit("Unknown")),
+                  (col("cd_marital_status") == lit("W"))
+                  & (col("cd_education_status")
+                     == lit("Advanced Degree"))))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_buy_potential"]) \
+        .where(col("hd_buy_potential").like("Unknown%")) \
+        .select(col("hd_demo_sk"))
+    return cr.join(cc, on=[("cr_call_center_sk", "cc_call_center_sk")]) \
+        .join(dd, on=[("cr_returned_date_sk", "d_date_sk")]) \
+        .join(cu, on=[("cr_returning_customer_sk", "c_customer_sk")]) \
+        .join(cd, on=[("c_current_cdemo_sk", "cd_demo_sk")]) \
+        .join(hd, on=[("c_current_hdemo_sk", "hd_demo_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .group_by("cc_call_center_id", "cc_name", "cc_manager",
+                  "cd_marital_status", "cd_education_status") \
+        .agg(Sum(col("cr_net_loss")).alias("returns_loss")) \
+        .select(col("cc_call_center_id").alias("call_center"),
+                col("cc_name").alias("call_center_name"),
+                col("cc_manager").alias("manager"),
+                col("returns_loss")) \
+        .order_by(("returns_loss", False))
+
+
+def q93(session, data_dir: str):
+    """TPC-DS q93: actual sales after 'reason 28' returns."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_item_sk", "ss_ticket_number", "ss_customer_sk",
+             "ss_quantity", "ss_sales_price"])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_item_sk", "sr_ticket_number", "sr_reason_sk",
+             "sr_return_quantity"])
+    re = _t(session, data_dir, "reason",
+            ["r_reason_sk", "r_reason_desc"]) \
+        .where(col("r_reason_desc") == lit("reason 28")) \
+        .select(col("r_reason_sk"))
+    act = If(col("sr_return_quantity").is_not_null(),
+             (col("ss_quantity") - col("sr_return_quantity"))
+             * col("ss_sales_price"),
+             col("ss_quantity") * col("ss_sales_price"))
+    return ss.join(sr, on=[("ss_item_sk", "sr_item_sk"),
+                           ("ss_ticket_number", "sr_ticket_number")],
+                   how="left") \
+        .join(re, on=[("sr_reason_sk", "r_reason_sk")], how="semi") \
+        .group_by("ss_customer_sk") \
+        .agg(Sum(act).alias("sumsales")) \
+        .order_by(("sumsales", True), ("ss_customer_sk", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q18: catalog demographics rollup
+# ---------------------------------------------------------------------------
+
+def q18(session, data_dir: str):
+    """TPC-DS q18: catalog averages ROLLUP(item, country, state,
+    county)."""
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk",
+             "cs_bill_customer_sk", "cs_quantity", "cs_list_price",
+             "cs_coupon_amt", "cs_sales_price", "cs_net_profit"])
+    cd1 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk", "cd_gender", "cd_education_status",
+              "cd_dep_count"]) \
+        .where((col("cd_gender") == lit("F"))
+               & (col("cd_education_status") == lit("Unknown"))) \
+        .select(col("cd_demo_sk"), col("cd_dep_count"))
+    cd2 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk"]) \
+        .select(col("cd_demo_sk").alias("cd2_demo_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_cdemo_sk", "c_current_addr_sk",
+             "c_birth_month", "c_birth_year"]) \
+        .where(In(col("c_birth_month"),
+                  [lit(m) for m in (1, 6, 8, 9, 12, 2)]))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_country", "ca_state", "ca_county"]) \
+        .where(In(col("ca_state"),
+                  [lit(s) for s in ("MS", "IN", "ND", "OK", "NM", "VA")]))
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(1998)).select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"])
+    base = cs.join(dd, on=[("cs_sold_date_sk", "d_date_sk")]) \
+        .join(it, on=[("cs_item_sk", "i_item_sk")]) \
+        .join(cd1, on=[("cs_bill_cdemo_sk", "cd_demo_sk")]) \
+        .join(cu, on=[("cs_bill_customer_sk", "c_customer_sk")]) \
+        .join(cd2, on=[("c_current_cdemo_sk", "cd2_demo_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")])
+    return base.rollup("i_item_id", "ca_country", "ca_state", "ca_county") \
+        .agg(Average(col("cs_quantity").cast(T.DoubleType())).alias("agg1"),
+             Average(col("cs_list_price")).alias("agg2"),
+             Average(col("cs_coupon_amt")).alias("agg3"),
+             Average(col("cs_sales_price")).alias("agg4"),
+             Average(col("cs_net_profit")).alias("agg5"),
+             Average(col("c_birth_year").cast(T.DoubleType())).alias("agg6"),
+             Average(col("cd_dep_count").cast(T.DoubleType())).alias("agg7")) \
+        .order_by(("ca_country", True), ("ca_state", True),
+                  ("ca_county", True), ("i_item_id", True)) \
+        .limit(100)
+
+
+QUERIES3 = {"q16": q16, "q17": q17, "q18": q18, "q21": q21, "q22": q22,
+            "q25": q25, "q29": q29, "q37": q37, "q39": q39, "q40": q40,
+            "q50": q50, "q62": q62, "q82": q82, "q90": q90, "q91": q91,
+            "q93": q93, "q94": q94, "q95": q95, "q99": q99}

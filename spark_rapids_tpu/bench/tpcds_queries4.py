@@ -1,0 +1,1088 @@
+"""TPC-DS queries, full-suite tranche 4 (q1-q99 gap fill, part 3 of 4).
+
+Channel-union profit reports, EXISTS-family demographics, bucket
+cross-joins, and correlated-count item queries.  Same house rules as
+tpcds_queries2.py (reference: TpcdsLikeSpark.scala:911-4330).
+"""
+from __future__ import annotations
+
+import os
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum)
+from spark_rapids_tpu.expr.conditional import CaseWhen, Coalesce, If
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.predicates import In, Or
+from spark_rapids_tpu.expr.strings import Concat, Substring, Upper
+
+__all__ = ["QUERIES4"]
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _date_sk(y: int, m: int, d: int) -> int:
+    import datetime as _dt
+    return 2415022 + (_dt.date(y, m, d) - _dt.date(1900, 1, 1)).days
+
+
+# ---------------------------------------------------------------------------
+# q5: channel sales/returns rollup
+# ---------------------------------------------------------------------------
+
+def q5(session, data_dir: str):
+    """TPC-DS q5: 14-day sales/returns/profit per channel, ROLLUP."""
+    lo = _date_sk(2000, 8, 23)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 14)))
+
+    def leg(frame, sk, date, sales, profit, ret, loss):
+        """Normalize a sales or returns frame to the salesreturns
+        shape."""
+        return frame.select(
+            col(sk).alias("unit_sk"), col(date).alias("date_sk"),
+            (col(sales) if sales else lit(0.0)).alias("sales_price"),
+            (col(profit) if profit else lit(0.0)).alias("profit"),
+            (col(ret) if ret else lit(0.0)).alias("return_amt"),
+            (col(loss) if loss else lit(0.0)).alias("net_loss"))
+
+    ss = leg(_t(session, data_dir, "store_sales",
+                ["ss_store_sk", "ss_sold_date_sk", "ss_ext_sales_price",
+                 "ss_net_profit"]),
+             "ss_store_sk", "ss_sold_date_sk", "ss_ext_sales_price",
+             "ss_net_profit", None, None)
+    sr = leg(_t(session, data_dir, "store_returns",
+                ["sr_store_sk", "sr_returned_date_sk", "sr_return_amt",
+                 "sr_net_loss"]),
+             "sr_store_sk", "sr_returned_date_sk", None, None,
+             "sr_return_amt", "sr_net_loss")
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_store_id"])
+    ssr = ss.union(sr).join(dd, on=[("date_sk", "d_date_sk")]) \
+        .join(st, on=[("unit_sk", "s_store_sk")]) \
+        .group_by("s_store_id").agg(
+            Sum(col("sales_price")).alias("sales"),
+            Sum(col("profit")).alias("profit"),
+            Sum(col("return_amt")).alias("returns"),
+            Sum(col("net_loss")).alias("profit_loss"))
+
+    cs = leg(_t(session, data_dir, "catalog_sales",
+                ["cs_catalog_page_sk", "cs_sold_date_sk",
+                 "cs_ext_sales_price", "cs_net_profit"]),
+             "cs_catalog_page_sk", "cs_sold_date_sk",
+             "cs_ext_sales_price", "cs_net_profit", None, None)
+    cr = leg(_t(session, data_dir, "catalog_returns",
+                ["cr_catalog_page_sk", "cr_returned_date_sk",
+                 "cr_return_amount", "cr_net_loss"]),
+             "cr_catalog_page_sk", "cr_returned_date_sk", None, None,
+             "cr_return_amount", "cr_net_loss")
+    cp = _t(session, data_dir, "catalog_page",
+            ["cp_catalog_page_sk", "cp_catalog_page_id"])
+    csr = cs.union(cr).join(dd, on=[("date_sk", "d_date_sk")]) \
+        .join(cp, on=[("unit_sk", "cp_catalog_page_sk")]) \
+        .group_by("cp_catalog_page_id").agg(
+            Sum(col("sales_price")).alias("sales"),
+            Sum(col("profit")).alias("profit"),
+            Sum(col("return_amt")).alias("returns"),
+            Sum(col("net_loss")).alias("profit_loss"))
+
+    ws_s = leg(_t(session, data_dir, "web_sales",
+                  ["ws_web_site_sk", "ws_sold_date_sk",
+                   "ws_ext_sales_price", "ws_net_profit"]),
+               "ws_web_site_sk", "ws_sold_date_sk", "ws_ext_sales_price",
+               "ws_net_profit", None, None)
+    # web returns ride the originating sale's web site (LEFT OUTER to
+    # web_sales in the reference)
+    wr_raw = _t(session, data_dir, "web_returns",
+                ["wr_returned_date_sk", "wr_item_sk", "wr_order_number",
+                 "wr_return_amt", "wr_net_loss"])
+    ws_map = _t(session, data_dir, "web_sales",
+                ["ws_item_sk", "ws_order_number", "ws_web_site_sk"]) \
+        .select(col("ws_item_sk").alias("m_item_sk"),
+                col("ws_order_number").alias("m_order_number"),
+                col("ws_web_site_sk").alias("m_web_site_sk"))
+    wr = wr_raw.join(ws_map, on=[("wr_item_sk", "m_item_sk"),
+                                 ("wr_order_number", "m_order_number")],
+                     how="left") \
+        .select(col("m_web_site_sk").alias("unit_sk"),
+                col("wr_returned_date_sk").alias("date_sk"),
+                lit(0.0).alias("sales_price"), lit(0.0).alias("profit"),
+                col("wr_return_amt").alias("return_amt"),
+                col("wr_net_loss").alias("net_loss"))
+    web = _t(session, data_dir, "web_site", ["web_site_sk", "web_site_id"])
+    wsr = ws_s.union(wr).join(dd, on=[("date_sk", "d_date_sk")]) \
+        .join(web, on=[("unit_sk", "web_site_sk")]) \
+        .group_by("web_site_id").agg(
+            Sum(col("sales_price")).alias("sales"),
+            Sum(col("profit")).alias("profit"),
+            Sum(col("return_amt")).alias("returns"),
+            Sum(col("net_loss")).alias("profit_loss"))
+
+    def channel(frame, label, id_prefix, id_col):
+        return frame.select(
+            lit(label).alias("channel"),
+            Concat(lit(id_prefix), col(id_col)).alias("id"),
+            col("sales"), col("returns"),
+            (col("profit") - col("profit_loss")).alias("profit"))
+
+    u = channel(ssr, "store channel", "store", "s_store_id") \
+        .union(channel(csr, "catalog channel", "catalog_page",
+                       "cp_catalog_page_id")) \
+        .union(channel(wsr, "web channel", "web_site", "web_site_id"))
+    return u.rollup("channel", "id").agg(
+        Sum(col("sales")).alias("sales"),
+        Sum(col("returns")).alias("returns"),
+        Sum(col("profit")).alias("profit")) \
+        .order_by(("channel", True), ("id", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q8: preferred-customer zips
+# ---------------------------------------------------------------------------
+
+_Q8_ZIPS = [
+    "24128", "76232", "65084", "87816", "83926", "77556", "20548", "26231",
+    "43848", "15126", "91137", "61265", "98294", "25782", "17920", "18426",
+    "98235", "40081", "84093", "28577", "55565", "17183", "54601", "67897",
+    "22752", "86284", "18376", "38607", "45200", "21756", "29741", "96765",
+    "23932", "89360", "29839", "25989", "28898", "91068", "72550", "10390",
+    "18845", "47770", "82636", "41367", "76638", "86198", "81312", "37126",
+    "39192", "88424", "72175", "81426", "53672", "10445", "42666", "66864",
+    "66708", "41248", "48583", "82276", "18842", "78890", "49448", "14089",
+    "38122", "34425", "79077", "19849", "43285", "39861", "66162", "77610",
+    "13695", "99543", "83444", "83041", "12305", "57665", "68341", "25003",
+    "57834", "62878", "49130", "81096", "18840", "27700", "23470", "50412",
+    "21195", "16021", "76107", "71954", "68309", "18119", "98359", "64544",
+    "10336", "86379", "27068", "39736", "98569", "28915", "24206", "56529",
+    "57647", "54917", "42961", "91110", "63981", "14922", "36420", "23006",
+    "67467", "32754", "30903", "20260", "31671", "51798", "72325", "85816",
+    "68621", "13955", "36446", "41766", "68806", "16725", "15146", "22744",
+    "35850", "88086", "51649", "18270", "52867", "39972", "96976", "63792",
+    "11376", "94898", "13595", "10516", "90225", "58943", "39371", "94945",
+    "28587", "96576", "57855", "28488", "26105", "83933", "25858", "34322",
+    "44438", "73171", "30122", "34102", "22685", "71256", "78451", "54364",
+    "13354", "45375", "40558", "56458", "28286", "45266", "47305", "69399",
+    "83921", "26233", "11101", "15371", "69913", "35942", "15882", "25631",
+    "24610", "44165", "99076", "33786", "70738", "26653", "14328", "72305",
+    "62496", "22152", "10144", "64147", "48425", "14663", "21076", "18799",
+    "30450", "63089", "81019", "68893", "24996", "51200", "51211", "45692",
+    "92712", "70466", "79994", "22437", "25280", "38935", "71791", "73134",
+    "56571", "14060", "19505", "72425", "56575", "74351", "68786", "51650",
+    "20004", "18383", "76614", "11634", "18906", "15765", "41368", "73241",
+    "76698", "78567", "97189", "28545", "76231", "75691", "22246", "51061",
+    "90578", "56691", "68014", "51103", "94167", "57047", "14867", "73520",
+    "15734", "63435", "25733", "35474", "24676", "94627", "53535", "17879",
+    "15559", "53268", "59166", "11928", "59402", "33282", "45721", "43933",
+    "68101", "33515", "36634", "71286", "19736", "58058", "55253", "67473",
+    "41918", "19515", "36495", "19430", "22351", "77191", "91393", "49156",
+    "50298", "87501", "18652", "53179", "18767", "63193", "23968", "65164",
+    "68880", "21286", "72823", "58470", "67301", "13394", "31016", "70372",
+    "67030", "40604", "24317", "45748", "39127", "26065", "77721", "31029",
+    "31880", "60576", "24671", "45549", "13376", "50016", "33123", "19769",
+    "22927", "97789", "46081", "72151", "15723", "46136", "51949", "68100",
+    "96888", "64528", "14171", "79777", "28709", "11489", "25103", "32213",
+    "78668", "22245", "15798", "27156", "37930", "62971", "21337", "51622",
+    "67853", "10567", "38415", "15455", "58263", "42029", "60279", "37125",
+    "56240", "88190", "50308", "26859", "64457", "89091", "82136", "62377",
+    "36233", "63837", "58078", "17043", "30010", "60099", "28810", "98025",
+    "29178", "87343", "73273", "30469", "64034", "39516", "86057", "21309",
+    "90257", "67875", "40162", "11356", "73650", "61810", "72013", "30431",
+    "22461", "19512", "13375", "55307", "30625", "83849", "68908", "26689",
+    "96451", "38193", "46820", "88885", "84935", "69035", "83144", "47537",
+    "56616", "94983", "48033", "69952", "25486", "61547", "27385", "61860",
+    "58048", "56910", "16807", "17871", "35258", "31387", "35458", "35576"]
+
+
+def q8(session, data_dir: str):
+    """TPC-DS q8: store profit for stores whose zip-2 prefix matches
+    qualifying customer zips (INTERSECT of list and preferred-heavy
+    zips)."""
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_zip"])
+    z1 = ca.select(Substring(col("ca_zip"), lit(1), lit(5)).alias("zip")) \
+        .where(In(col("zip"), [lit(z) for z in _Q8_ZIPS]))
+    cu = _t(session, data_dir, "customer",
+            ["c_current_addr_sk", "c_preferred_cust_flag"]) \
+        .where(col("c_preferred_cust_flag") == lit("Y")) \
+        .select(col("c_current_addr_sk"))
+    z2 = ca.join(cu, on=[("ca_address_sk", "c_current_addr_sk")]) \
+        .with_column("zip", Substring(col("ca_zip"), lit(1), lit(5))) \
+        .group_by("zip") \
+        .agg(CountStar().alias("cnt")) \
+        .where(col("cnt") > lit(10)).select(col("zip"))
+    zips = z1.intersect(z2) \
+        .select(Substring(col("zip"), lit(1), lit(2)).alias("zip2")) \
+        .distinct()
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_qoy", "d_year"]) \
+        .where((col("d_qoy") == lit(2)) & (col("d_year") == lit(1998))) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_name", "s_zip"]) \
+        .with_column("s_zip2", Substring(col("s_zip"), lit(1), lit(2)))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_store_sk", "ss_sold_date_sk", "ss_net_profit"])
+    return ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(zips, on=[("s_zip2", "zip2")], how="semi") \
+        .group_by("s_store_name") \
+        .agg(Sum(col("ss_net_profit")).alias("profit")) \
+        .order_by(("s_store_name", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q9: quantity-bucket report (scalar subqueries, eagerly folded)
+# ---------------------------------------------------------------------------
+
+def q9(session, data_dir: str):
+    """TPC-DS q9: avg discount or net-paid per quantity bucket, chosen
+    by bucket count (five folded scalar subqueries)."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_quantity", "ss_ext_discount_amt", "ss_net_paid"])
+    bounds = [(1, 20, 74129), (21, 40, 122840), (41, 60, 56580),
+              (61, 80, 10097), (81, 100, 165306)]
+    vals = []
+    for lo, hi, thresh in bounds:
+        rows = ss.where((col("ss_quantity") >= lit(lo))
+                        & (col("ss_quantity") <= lit(hi))) \
+            .agg(CountStar().alias("cnt"),
+                 Average(col("ss_ext_discount_amt")).alias("avg_disc"),
+                 Average(col("ss_net_paid")).alias("avg_paid")).collect()
+        cnt, avg_disc, avg_paid = rows[0]
+        vals.append(avg_disc if (cnt or 0) > thresh else avg_paid)
+    re = _t(session, data_dir, "reason", ["r_reason_sk"]) \
+        .where(col("r_reason_sk") == lit(1))
+    return re.select(*[lit(v).alias(f"bucket{i+1}")
+                       for i, v in enumerate(vals)])
+
+
+# ---------------------------------------------------------------------------
+# exists-family demographics: q10 / q35
+# ---------------------------------------------------------------------------
+
+def _active_customers(session, data_dir, d_filter):
+    """Union of customer keys active in store + (web or catalog) within
+    the window: EXISTS ss AND (EXISTS ws OR EXISTS cs)."""
+    dd = d_filter(_t(session, data_dir, "date_dim",
+                     ["d_date_sk", "d_year", "d_moy", "d_qoy"])) \
+        .select(col("d_date_sk"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_customer_sk", "ss_sold_date_sk"]) \
+        .join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .select(col("ss_customer_sk").alias("k"))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_bill_customer_sk", "ws_sold_date_sk"]) \
+        .join(dd, on=[("ws_sold_date_sk", "d_date_sk")]) \
+        .select(col("ws_bill_customer_sk").alias("k"))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_ship_customer_sk", "cs_sold_date_sk"]) \
+        .join(dd, on=[("cs_sold_date_sk", "d_date_sk")]) \
+        .select(col("cs_ship_customer_sk").alias("k"))
+    return ss, ws.union(cs)
+
+
+def q10(session, data_dir: str):
+    """TPC-DS q10: demographics counts for county customers active in
+    store and web-or-catalog, 2002 H1."""
+    ss_keys, other_keys = _active_customers(
+        session, data_dir,
+        lambda dd: dd.where((col("d_year") == lit(2002))
+                            & (col("d_moy") >= lit(1))
+                            & (col("d_moy") <= lit(4))))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk", "c_current_cdemo_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_county"]) \
+        .where(In(col("ca_county"),
+                  [lit(c) for c in
+                   ("Rush County", "Toole County", "Jefferson County",
+                    "Dona Ana County", "La Porte County")])) \
+        .select(col("ca_address_sk"))
+    cd = _t(session, data_dir, "customer_demographics")
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    base = cu.join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .join(ss_keys, on=[("c_customer_sk", "k")], how="semi") \
+        .join(other_keys, on=[("c_customer_sk", "k")], how="semi") \
+        .join(cd, on=[("c_current_cdemo_sk", "cd_demo_sk")])
+    aggs = [CountStar().alias(f"cnt{i}") for i in range(1, 7)]
+    return base.group_by(*keys).agg(*aggs) \
+        .order_by(*[(k, True) for k in keys]).limit(100)
+
+
+def q35(session, data_dir: str):
+    """TPC-DS q35: demographics stats for customers active in store and
+    web-or-catalog, 2002 Q1-Q3."""
+    ss_keys, other_keys = _active_customers(
+        session, data_dir,
+        lambda dd: dd.where((col("d_year") == lit(2002))
+                            & (col("d_qoy") < lit(4))))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk", "c_current_cdemo_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"])
+    cd = _t(session, data_dir, "customer_demographics")
+    base = cu.join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .join(ss_keys, on=[("c_customer_sk", "k")], how="semi") \
+        .join(other_keys, on=[("c_customer_sk", "k")], how="semi") \
+        .join(cd, on=[("c_current_cdemo_sk", "cd_demo_sk")])
+    keys = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    return base.group_by(*keys).agg(
+        CountStar().alias("cnt1"),
+        Min(col("cd_dep_count")).alias("min1"),
+        Max(col("cd_dep_count")).alias("max1"),
+        Average(col("cd_dep_count")).alias("avg1"),
+        CountStar().alias("cnt2"),
+        Min(col("cd_dep_employed_count")).alias("min2"),
+        Max(col("cd_dep_employed_count")).alias("max2"),
+        Average(col("cd_dep_employed_count")).alias("avg2"),
+        CountStar().alias("cnt3"),
+        Min(col("cd_dep_college_count")).alias("min3"),
+        Max(col("cd_dep_college_count")).alias("max3"),
+        Average(col("cd_dep_college_count")).alias("avg3")) \
+        .order_by(*[(k, True) for k in keys]).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q28: list-price buckets cross-join
+# ---------------------------------------------------------------------------
+
+def q28(session, data_dir: str):
+    """TPC-DS q28: six price-bucket stats cross-joined into one row."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_quantity", "ss_list_price", "ss_coupon_amt",
+             "ss_wholesale_cost"])
+    buckets = [
+        (0, 5, 8, 459, 57), (6, 10, 90, 2323, 31), (11, 15, 142, 12214, 79),
+        (16, 20, 135, 6071, 38), (21, 25, 122, 836, 17),
+        (26, 30, 154, 7326, 7)]
+    out = None
+    for i, (qlo, qhi, lp, ca_, wc) in enumerate(buckets, 1):
+        b = ss.where(
+            (col("ss_quantity") >= lit(qlo)) & (col("ss_quantity") <= lit(qhi))
+            & (((col("ss_list_price") >= lit(float(lp)))
+                & (col("ss_list_price") <= lit(float(lp + 10))))
+               | ((col("ss_coupon_amt") >= lit(float(ca_)))
+                  & (col("ss_coupon_amt") <= lit(float(ca_ + 1000))))
+               | ((col("ss_wholesale_cost") >= lit(float(wc)))
+                  & (col("ss_wholesale_cost") <= lit(float(wc + 20)))))) \
+            .agg(Average(col("ss_list_price")).alias(f"b{i}_lp"),
+                 Count(col("ss_list_price")).alias(f"b{i}_cnt"),
+                 CountDistinct(col("ss_list_price")).alias(f"b{i}_cntd"))
+        out = b if out is None else out.join(b, how="cross")
+    return out.limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q34 / q45 / q46
+# ---------------------------------------------------------------------------
+
+def q34(session, data_dir: str):
+    """TPC-DS q34: 15-20 item tickets for high-dependency households."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+             "ss_customer_sk", "ss_ticket_number"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_dom", "d_year"]) \
+        .where((((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(3)))
+                | ((col("d_dom") >= lit(25)) & (col("d_dom") <= lit(28))))
+               & In(col("d_year"), [lit(1999), lit(2000), lit(2001)])) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_county"]) \
+        .where(col("s_county") == lit("Williamson County")) \
+        .select(col("s_store_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_buy_potential", "hd_vehicle_count",
+             "hd_dep_count"]) \
+        .where(Or(col("hd_buy_potential") == lit(">10000"),
+                  col("hd_buy_potential") == lit("unknown"))
+               & (col("hd_vehicle_count") > lit(0))
+               & (If(col("hd_vehicle_count") > lit(0),
+                     col("hd_dep_count").cast(T.DoubleType())
+                     / col("hd_vehicle_count"), lit(None)) > lit(1.2))) \
+        .select(col("hd_demo_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_last_name", "c_first_name",
+             "c_salutation", "c_preferred_cust_flag"])
+    grouped = ss.join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .group_by("ss_ticket_number", "ss_customer_sk") \
+        .agg(CountStar().alias("cnt")) \
+        .where((col("cnt") >= lit(15)) & (col("cnt") <= lit(20)))
+    return grouped.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .select(col("c_last_name"), col("c_first_name"),
+                col("c_salutation"), col("c_preferred_cust_flag"),
+                col("ss_ticket_number"), col("cnt")) \
+        .order_by(("c_last_name", True), ("c_first_name", True),
+                  ("c_salutation", True), ("c_preferred_cust_flag", False),
+                  ("ss_ticket_number", True))
+
+
+def q45(session, data_dir: str):
+    """TPC-DS q45: web sales by customer zip/city, zip list OR item
+    subquery."""
+    ids_rows = _t(session, data_dir, "item",
+                  ["i_item_sk", "i_item_id"]) \
+        .where(In(col("i_item_sk"),
+                  [lit(k) for k in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)])) \
+        .select(col("i_item_id")).collect()
+    ids = sorted({r[0] for r in ids_rows}) or ["<none>"]
+    zips = ["85669", "86197", "88274", "83405", "86475", "85392", "85460",
+            "80348", "81792"]
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_bill_customer_sk", "ws_item_sk", "ws_sold_date_sk",
+             "ws_sales_price"])
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_zip", "ca_city"])
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_qoy", "d_year"]) \
+        .where((col("d_qoy") == lit(2)) & (col("d_year") == lit(2001))) \
+        .select(col("d_date_sk"))
+    return ws.join(cu, on=[("ws_bill_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .join(it, on=[("ws_item_sk", "i_item_sk")]) \
+        .join(dt, on=[("ws_sold_date_sk", "d_date_sk")]) \
+        .where(Or(In(Substring(col("ca_zip"), lit(1), lit(5)),
+                     [lit(z) for z in zips]),
+                  In(col("i_item_id"), [lit(i) for i in ids]))) \
+        .group_by("ca_zip", "ca_city") \
+        .agg(Sum(col("ws_sales_price")).alias("sum_price")) \
+        .order_by(("ca_zip", True), ("ca_city", True)).limit(100)
+
+
+def q46(session, data_dir: str):
+    """TPC-DS q46: weekend ticket totals where bought city differs from
+    current city."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk", "ss_addr_sk",
+             "ss_customer_sk", "ss_ticket_number", "ss_coupon_amt",
+             "ss_net_profit"])
+    dt = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_dow", "d_year"]) \
+        .where(In(col("d_dow"), [lit(6), lit(0)])
+               & In(col("d_year"), [lit(1999), lit(2000), lit(2001)])) \
+        .select(col("d_date_sk"))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_city"]) \
+        .where(In(col("s_city"), [lit("Fairview"), lit("Midway")])) \
+        .select(col("s_store_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count"]) \
+        .where(Or(col("hd_dep_count") == lit(4),
+                  col("hd_vehicle_count") == lit(3))) \
+        .select(col("hd_demo_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_city"])
+    grouped = ss.join(dt, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(hd, on=[("ss_hdemo_sk", "hd_demo_sk")]) \
+        .join(ca, on=[("ss_addr_sk", "ca_address_sk")]) \
+        .group_by("ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                  "ca_city") \
+        .agg(Sum(col("ss_coupon_amt")).alias("amt"),
+             Sum(col("ss_net_profit")).alias("profit")) \
+        .select(col("ss_ticket_number"), col("ss_customer_sk"),
+                col("ca_city").alias("bought_city"), col("amt"),
+                col("profit"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk", "c_first_name",
+             "c_last_name"])
+    ca2 = _t(session, data_dir, "customer_address",
+             ["ca_address_sk", "ca_city"]) \
+        .select(col("ca_address_sk").alias("cur_addr_sk"),
+                col("ca_city").alias("ca_city"))
+    return grouped.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca2, on=[("c_current_addr_sk", "cur_addr_sk")]) \
+        .where(~(col("ca_city") == col("bought_city"))) \
+        .select(col("c_last_name"), col("c_first_name"), col("ca_city"),
+                col("bought_city"), col("ss_ticket_number"), col("amt"),
+                col("profit")) \
+        .order_by(("c_last_name", True), ("c_first_name", True),
+                  ("ca_city", True), ("bought_city", True),
+                  ("ss_ticket_number", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q41: item-variant correlated count
+# ---------------------------------------------------------------------------
+
+def q41(session, data_dir: str):
+    """TPC-DS q41: product names of manufacturers with matching item
+    variants (correlated count > 0 -> semi join on manufacturer)."""
+    it = _t(session, data_dir, "item")
+
+    def band(cat, colors, units, sizes):
+        return ((col("i_category") == lit(cat))
+                & In(col("i_color"), [lit(c) for c in colors])
+                & In(col("i_units"), [lit(u) for u in units])
+                & In(col("i_size"), [lit(s) for s in sizes]))
+
+    variants = Or(
+        Or(Or(band("Women", ("powder", "khaki"), ("Ounce", "Oz"),
+                   ("medium", "extra large")),
+              band("Women", ("brown", "honeydew"), ("Bunch", "Ton"),
+                   ("N/A", "small"))),
+           Or(band("Men", ("floral", "deep"), ("N/A", "Dozen"),
+                   ("petite", "large")),
+              band("Men", ("light", "cornflower"), ("Box", "Pound"),
+                   ("medium", "extra large")))),
+        Or(Or(band("Women", ("midnight", "snow"), ("Pallet", "Gross"),
+                   ("medium", "extra large")),
+              band("Women", ("cyan", "papaya"), ("Cup", "Dram"),
+                   ("N/A", "small"))),
+           Or(band("Men", ("orange", "frosted"), ("Each", "Tbl"),
+                   ("petite", "large")),
+              band("Men", ("forest", "ghost"), ("Lb", "Bundle"),
+                   ("medium", "extra large")))))
+    manufs = it.where(variants).select(col("i_manufact").alias("vm")) \
+        .distinct()
+    return it.where((col("i_manufact_id") >= lit(738))
+                    & (col("i_manufact_id") <= lit(778))) \
+        .join(manufs, on=[("i_manufact", "vm")], how="semi") \
+        .select(col("i_product_name")).distinct() \
+        .order_by(("i_product_name", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q44: best/worst items by store profit rank
+# ---------------------------------------------------------------------------
+
+def q44(session, data_dir: str):
+    """TPC-DS q44: rank items by avg net profit in store 4, pair best
+    with worst."""
+    from spark_rapids_tpu.expr.window import (Rank, WindowExpression,
+                                              WindowSpec)
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_item_sk", "ss_store_sk", "ss_addr_sk", "ss_net_profit"])
+    store4 = ss.where(col("ss_store_sk") == lit(4))
+    # baseline: avg profit of null-address rows (eagerly folded scalar)
+    base_rows = store4.where(col("ss_addr_sk").is_null()) \
+        .group_by("ss_store_sk") \
+        .agg(Average(col("ss_net_profit")).alias("rank_col")).collect()
+    baseline = (base_rows[0][1] if base_rows else 0.0) or 0.0
+    v1 = store4.group_by("ss_item_sk") \
+        .agg(Average(col("ss_net_profit")).alias("rank_col")) \
+        .where(col("rank_col") > lit(0.9 * baseline))
+    asc = WindowExpression(Rank(), WindowSpec(
+        order_by=((col("rank_col"), True),)))
+    desc = WindowExpression(Rank(), WindowSpec(
+        order_by=((col("rank_col"), False),)))
+    up = v1.select(col("ss_item_sk").alias("item_sk_a"),
+                   asc.alias("rnk")).where(col("rnk") < lit(11))
+    dn = v1.select(col("ss_item_sk").alias("item_sk_d"),
+                   desc.alias("rnk_d")).where(col("rnk_d") < lit(11))
+    i1 = _t(session, data_dir, "item",
+            ["i_item_sk", "i_product_name"]) \
+        .select(col("i_item_sk").alias("i1_sk"),
+                col("i_product_name").alias("best_performing"))
+    i2 = _t(session, data_dir, "item",
+            ["i_item_sk", "i_product_name"]) \
+        .select(col("i_item_sk").alias("i2_sk"),
+                col("i_product_name").alias("worst_performing"))
+    return up.join(dn, on=[("rnk", "rnk_d")]) \
+        .join(i1, on=[("item_sk_a", "i1_sk")]) \
+        .join(i2, on=[("item_sk_d", "i2_sk")]) \
+        .select(col("rnk"), col("best_performing"),
+                col("worst_performing")) \
+        .order_by(("rnk", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q49: worst return ratios per channel
+# ---------------------------------------------------------------------------
+
+def _return_ratios(session, data_dir, channel, sales_tbl, returns_tbl,
+                   cols):
+    from spark_rapids_tpu.expr.window import (Rank, WindowExpression,
+                                              WindowSpec)
+    (s_item, s_order, s_qty, s_paid, s_profit, s_date,
+     r_item, r_order, r_qty, r_amt) = cols
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") == lit(12))) \
+        .select(col("d_date_sk"))
+    sales = _t(session, data_dir, sales_tbl,
+               [s_item, s_order, s_qty, s_paid, s_profit, s_date]) \
+        .where((col(s_profit) > lit(1.0)) & (col(s_paid) > lit(0.0))
+               & (col(s_qty) > lit(0)))
+    rets = _t(session, data_dir, returns_tbl,
+              [r_item, r_order, r_qty, r_amt]) \
+        .where(col(r_amt) > lit(10000.0))
+    j = sales.join(rets, on=[(s_order, r_order), (s_item, r_item)]) \
+        .join(dd, on=[(s_date, "d_date_sk")]) \
+        .group_by(s_item).agg(
+            (Sum(Coalesce(col(r_qty), lit(0))).cast(T.DoubleType())
+             / Sum(Coalesce(col(s_qty), lit(0))).cast(T.DoubleType()))
+            .alias("return_ratio"),
+            (Sum(Coalesce(col(r_amt), lit(0.0)))
+             / Sum(Coalesce(col(s_paid), lit(0.0))))
+            .alias("currency_ratio"))
+    rr = WindowExpression(Rank(), WindowSpec(
+        order_by=((col("return_ratio"), True),)))
+    cr = WindowExpression(Rank(), WindowSpec(
+        order_by=((col("currency_ratio"), True),)))
+    ranked = j.select(lit(channel).alias("channel"),
+                      col(s_item).alias("item"), col("return_ratio"),
+                      rr.alias("return_rank"), cr.alias("currency_rank"))
+    return ranked.where(Or(col("return_rank") <= lit(10),
+                           col("currency_rank") <= lit(10)))
+
+
+def q49(session, data_dir: str):
+    """TPC-DS q49: worst return ratios across the three channels."""
+    web = _return_ratios(
+        session, data_dir, "web", "web_sales", "web_returns",
+        ("ws_item_sk", "ws_order_number", "ws_quantity", "ws_net_paid",
+         "ws_net_profit", "ws_sold_date_sk",
+         "wr_item_sk", "wr_order_number", "wr_return_quantity",
+         "wr_return_amt"))
+    cat = _return_ratios(
+        session, data_dir, "catalog", "catalog_sales", "catalog_returns",
+        ("cs_item_sk", "cs_order_number", "cs_quantity", "cs_net_paid",
+         "cs_net_profit", "cs_sold_date_sk",
+         "cr_item_sk", "cr_order_number", "cr_return_quantity",
+         "cr_return_amount"))
+    sto = _return_ratios(
+        session, data_dir, "store", "store_sales", "store_returns",
+        ("ss_item_sk", "ss_ticket_number", "ss_quantity", "ss_net_paid",
+         "ss_net_profit", "ss_sold_date_sk",
+         "sr_item_sk", "sr_ticket_number", "sr_return_quantity",
+         "sr_return_amt"))
+    return web.union(cat).union(sto).distinct() \
+        .order_by(("channel", True), ("return_rank", True),
+                  ("currency_rank", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q54: maternity follow-up revenue segments
+# ---------------------------------------------------------------------------
+
+def q54(session, data_dir: str):
+    """TPC-DS q54: revenue segments of customers who bought Women/
+    maternity items in Dec 1998."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_moy", "d_year", "d_month_seq"])
+    target = dd.where((col("d_moy") == lit(12))
+                      & (col("d_year") == lit(1998))) \
+        .select(col("d_date_sk"))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_bill_customer_sk", "cs_item_sk"]) \
+        .select(col("cs_sold_date_sk").alias("sold_date_sk"),
+                col("cs_bill_customer_sk").alias("customer_sk"),
+                col("cs_item_sk").alias("item_sk"))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_bill_customer_sk", "ws_item_sk"]) \
+        .select(col("ws_sold_date_sk").alias("sold_date_sk"),
+                col("ws_bill_customer_sk").alias("customer_sk"),
+                col("ws_item_sk").alias("item_sk"))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_class"]) \
+        .where((col("i_category") == lit("Women"))
+               & (col("i_class") == lit("maternity"))) \
+        .select(col("i_item_sk"))
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_addr_sk"])
+    my_customers = cs.union(ws) \
+        .join(target, on=[("sold_date_sk", "d_date_sk")], how="semi") \
+        .join(it, on=[("item_sk", "i_item_sk")], how="semi") \
+        .join(cu, on=[("customer_sk", "c_customer_sk")]) \
+        .select(col("c_customer_sk"), col("c_current_addr_sk")) \
+        .distinct()
+    seq_rows = dd.where((col("d_year") == lit(1998))
+                        & (col("d_moy") == lit(12))) \
+        .select(col("d_month_seq")).limit(1).collect()
+    base_seq = seq_rows[0][0]
+    window = dd.where((col("d_month_seq") >= lit(base_seq + 1))
+                      & (col("d_month_seq") <= lit(base_seq + 3))) \
+        .select(col("d_date_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_county", "ca_state"])
+    st = _t(session, data_dir, "store", ["s_county", "s_state"]) \
+        .select(col("s_county"), col("s_state")).distinct()
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_customer_sk", "ss_ext_sales_price"])
+    revenue = my_customers \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .join(st, on=[("ca_county", "s_county"),
+                      ("ca_state", "s_state")], how="semi") \
+        .join(ss, on=[("c_customer_sk", "ss_customer_sk")]) \
+        .join(window, on=[("ss_sold_date_sk", "d_date_sk")], how="semi") \
+        .group_by("c_customer_sk") \
+        .agg(Sum(col("ss_ext_sales_price")).alias("revenue"))
+    segments = revenue.select(
+        (col("revenue") / lit(50.0)).cast(T.IntegerType())
+        .alias("segment"))
+    return segments.group_by("segment") \
+        .agg(CountStar().alias("num_customers")) \
+        .with_column("segment_base", col("segment") * lit(50)) \
+        .order_by(("segment", True), ("num_customers", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q56: color-item tri-channel totals
+# ---------------------------------------------------------------------------
+
+def q56(session, data_dir: str):
+    """TPC-DS q56: slate/blanched/burnished item revenue across
+    channels, gmt -5, Feb 2001."""
+    ids_rows = _t(session, data_dir, "item",
+                  ["i_item_id", "i_color"]) \
+        .where(In(col("i_color"),
+                  [lit(c) for c in ("slate", "blanched", "burnished")])) \
+        .select(col("i_item_id")).distinct().collect()
+    ids = sorted(r[0] for r in ids_rows) or ["<none>"]
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") == lit(2))) \
+        .select(col("d_date_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_gmt_offset"]) \
+        .where(col("ca_gmt_offset") == lit(-5.0)) \
+        .select(col("ca_address_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"]) \
+        .where(In(col("i_item_id"), [lit(i) for i in ids]))
+
+    def chan(sales, date_c, item_c, addr_c, price_c):
+        return sales.join(dd, on=[(date_c, "d_date_sk")]) \
+            .join(it, on=[(item_c, "i_item_sk")]) \
+            .join(ca, on=[(addr_c, "ca_address_sk")]) \
+            .group_by("i_item_id") \
+            .agg(Sum(col(price_c)).alias("total_sales"))
+
+    ss = chan(_t(session, data_dir, "store_sales",
+                 ["ss_sold_date_sk", "ss_item_sk", "ss_addr_sk",
+                  "ss_ext_sales_price"]),
+              "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk",
+              "ss_ext_sales_price")
+    cs = chan(_t(session, data_dir, "catalog_sales",
+                 ["cs_sold_date_sk", "cs_item_sk", "cs_bill_addr_sk",
+                  "cs_ext_sales_price"]),
+              "cs_sold_date_sk", "cs_item_sk", "cs_bill_addr_sk",
+              "cs_ext_sales_price")
+    ws = chan(_t(session, data_dir, "web_sales",
+                 ["ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk",
+                  "ws_ext_sales_price"]),
+              "ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk",
+              "ws_ext_sales_price")
+    return ss.union(cs).union(ws).group_by("i_item_id") \
+        .agg(Sum(col("total_sales")).alias("total_sales")) \
+        .order_by(("total_sales", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q58: items selling evenly across channels in one week
+# ---------------------------------------------------------------------------
+
+def q58(session, data_dir: str):
+    """TPC-DS q58: items with balanced revenue across the three channels
+    for the week of 2000-01-03."""
+    target_sk = _date_sk(2000, 1, 3)
+    dd_all = _t(session, data_dir, "date_dim",
+                ["d_date_sk", "d_date", "d_week_seq"])
+    wk_rows = dd_all.where(col("d_date_sk") == lit(target_sk)) \
+        .select(col("d_week_seq")).limit(1).collect()
+    wk = wk_rows[0][0]
+    week_dates = dd_all.where(col("d_week_seq") == lit(wk)) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"])
+
+    def rev(sales, item_c, date_c, price_c, name):
+        return sales.join(week_dates, on=[(date_c, "d_date_sk")],
+                          how="semi") \
+            .join(it, on=[(item_c, "i_item_sk")]) \
+            .group_by("i_item_id") \
+            .agg(Sum(col(price_c)).alias(name)) \
+            .select(col("i_item_id").alias(f"{name}_id"), col(name))
+
+    ss = rev(_t(session, data_dir, "store_sales",
+                ["ss_item_sk", "ss_sold_date_sk", "ss_ext_sales_price"]),
+             "ss_item_sk", "ss_sold_date_sk", "ss_ext_sales_price",
+             "ss_item_rev")
+    cs = rev(_t(session, data_dir, "catalog_sales",
+                ["cs_item_sk", "cs_sold_date_sk", "cs_ext_sales_price"]),
+             "cs_item_sk", "cs_sold_date_sk", "cs_ext_sales_price",
+             "cs_item_rev")
+    ws = rev(_t(session, data_dir, "web_sales",
+                ["ws_item_sk", "ws_sold_date_sk", "ws_ext_sales_price"]),
+             "ws_item_sk", "ws_sold_date_sk", "ws_ext_sales_price",
+             "ws_item_rev")
+    j = ss.join(cs, on=[("ss_item_rev_id", "cs_item_rev_id")]) \
+        .join(ws, on=[("ss_item_rev_id", "ws_item_rev_id")])
+    between = lambda a, b: ((col(a) >= lit(0.9) * col(b))
+                            & (col(a) <= lit(1.1) * col(b)))
+    avg3 = ((col("ss_item_rev") + col("cs_item_rev") + col("ws_item_rev"))
+            / lit(3.0))
+    return j.where(between("ss_item_rev", "cs_item_rev")
+                   & between("ss_item_rev", "ws_item_rev")
+                   & between("cs_item_rev", "ss_item_rev")
+                   & between("cs_item_rev", "ws_item_rev")
+                   & between("ws_item_rev", "ss_item_rev")
+                   & between("ws_item_rev", "cs_item_rev")) \
+        .select(col("ss_item_rev_id").alias("item_id"),
+                col("ss_item_rev"),
+                (col("ss_item_rev") / (col("ss_item_rev")
+                                       + col("cs_item_rev")
+                                       + col("ws_item_rev")) / lit(3.0)
+                 * lit(100.0)).alias("ss_dev"),
+                col("cs_item_rev"),
+                (col("cs_item_rev") / (col("ss_item_rev")
+                                       + col("cs_item_rev")
+                                       + col("ws_item_rev")) / lit(3.0)
+                 * lit(100.0)).alias("cs_dev"),
+                col("ws_item_rev"),
+                (col("ws_item_rev") / (col("ss_item_rev")
+                                       + col("cs_item_rev")
+                                       + col("ws_item_rev")) / lit(3.0)
+                 * lit(100.0)).alias("ws_dev"),
+                avg3.alias("average")) \
+        .order_by(("item_id", True), ("ss_item_rev", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q76: null-leg channel counts
+# ---------------------------------------------------------------------------
+
+def q76(session, data_dir: str):
+    """TPC-DS q76: sales recorded with NULL keys per channel."""
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_category"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_qoy"])
+
+    def leg(sales, null_c, date_c, item_c, price_c, label):
+        return sales.where(col(null_c).is_null()) \
+            .join(dd, on=[(date_c, "d_date_sk")]) \
+            .join(it, on=[(item_c, "i_item_sk")]) \
+            .select(lit(label).alias("channel"),
+                    col("d_year"), col("d_qoy"), col("i_category"),
+                    col(price_c).alias("ext_sales_price"))
+
+    ss = leg(_t(session, data_dir, "store_sales",
+                ["ss_store_sk", "ss_sold_date_sk", "ss_item_sk",
+                 "ss_ext_sales_price"]),
+             "ss_store_sk", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "store")
+    ws = leg(_t(session, data_dir, "web_sales",
+                ["ws_ship_customer_sk", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_ext_sales_price"]),
+             "ws_ship_customer_sk", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price", "web")
+    cs = leg(_t(session, data_dir, "catalog_sales",
+                ["cs_ship_addr_sk", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_ext_sales_price"]),
+             "cs_ship_addr_sk", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price", "catalog")
+    return ss.union(ws).union(cs) \
+        .group_by("channel", "d_year", "d_qoy", "i_category") \
+        .agg(CountStar().alias("sales_cnt"),
+             Sum(col("ext_sales_price")).alias("sales_amt")) \
+        .order_by(("channel", True), ("d_year", True), ("d_qoy", True),
+                  ("i_category", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q83: returned-quantity three-way comparison
+# ---------------------------------------------------------------------------
+
+def q83(session, data_dir: str):
+    """TPC-DS q83: return quantities per item across channels for three
+    specific weeks."""
+    dates = [_date_sk(2000, 6, 30), _date_sk(2000, 9, 27),
+             _date_sk(2000, 11, 17)]
+    dd_all = _t(session, data_dir, "date_dim",
+                ["d_date_sk", "d_week_seq"])
+    wk_rows = dd_all.where(In(col("d_date_sk"),
+                              [lit(d) for d in dates])) \
+        .select(col("d_week_seq")).distinct().collect()
+    weeks = sorted(r[0] for r in wk_rows)
+    week_dates = dd_all.where(In(col("d_week_seq"),
+                                 [lit(w) for w in weeks])) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"])
+
+    def rets(tbl, item_c, date_c, qty_c, name):
+        return _t(session, data_dir, tbl, [item_c, date_c, qty_c]) \
+            .join(week_dates, on=[(date_c, "d_date_sk")], how="semi") \
+            .join(it, on=[(item_c, "i_item_sk")]) \
+            .group_by("i_item_id") \
+            .agg(Sum(col(qty_c)).alias(name)) \
+            .select(col("i_item_id").alias(f"{name}_id"), col(name))
+
+    sr = rets("store_returns", "sr_item_sk", "sr_returned_date_sk",
+              "sr_return_quantity", "sr_item_qty")
+    cr = rets("catalog_returns", "cr_item_sk", "cr_returned_date_sk",
+              "cr_return_quantity", "cr_item_qty")
+    wr = rets("web_returns", "wr_item_sk", "wr_returned_date_sk",
+              "wr_return_quantity", "wr_item_qty")
+    j = sr.join(cr, on=[("sr_item_qty_id", "cr_item_qty_id")]) \
+        .join(wr, on=[("sr_item_qty_id", "wr_item_qty_id")])
+    total = (col("sr_item_qty") + col("cr_item_qty")
+             + col("wr_item_qty")).cast(T.DoubleType())
+    return j.select(
+        col("sr_item_qty_id").alias("item_id"), col("sr_item_qty"),
+        (col("sr_item_qty") / total / lit(3.0) * lit(100.0))
+        .alias("sr_dev"),
+        col("cr_item_qty"),
+        (col("cr_item_qty") / total / lit(3.0) * lit(100.0))
+        .alias("cr_dev"),
+        col("wr_item_qty"),
+        (col("wr_item_qty") / total / lit(3.0) * lit(100.0))
+        .alias("wr_dev"),
+        (total / lit(3.0)).alias("average")) \
+        .order_by(("item_id", True), ("sr_item_qty", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q84 / q85 / q86
+# ---------------------------------------------------------------------------
+
+def q84(session, data_dir: str):
+    """TPC-DS q84: Edgewood customers in an income band with store
+    returns."""
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_customer_id", "c_first_name",
+             "c_last_name", "c_current_addr_sk", "c_current_cdemo_sk",
+             "c_current_hdemo_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_city"]) \
+        .where(col("ca_city") == lit("Edgewood")) \
+        .select(col("ca_address_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_income_band_sk"])
+    ib = _t(session, data_dir, "income_band") \
+        .where((col("ib_lower_bound") >= lit(38128))
+               & (col("ib_upper_bound") <= lit(38128 + 50000))) \
+        .select(col("ib_income_band_sk"))
+    sr = _t(session, data_dir, "store_returns", ["sr_cdemo_sk"]) \
+        .select(col("sr_cdemo_sk"))
+    cd = _t(session, data_dir, "customer_demographics", ["cd_demo_sk"])
+    name = Concat(Coalesce(col("c_last_name"), lit("")), lit(", "),
+                  Coalesce(col("c_first_name"), lit("")))
+    return cu.join(ca, on=[("c_current_addr_sk", "ca_address_sk")],
+                   how="semi") \
+        .join(hd, on=[("c_current_hdemo_sk", "hd_demo_sk")]) \
+        .join(ib, on=[("hd_income_band_sk", "ib_income_band_sk")],
+              how="semi") \
+        .join(cd, on=[("c_current_cdemo_sk", "cd_demo_sk")]) \
+        .join(sr, on=[("cd_demo_sk", "sr_cdemo_sk")], how="semi") \
+        .select(col("c_customer_id").alias("customer_id"),
+                name.alias("customername")) \
+        .order_by(("customer_id", True)).limit(100)
+
+
+def q85(session, data_dir: str):
+    """TPC-DS q85: web-return reasons under demographic/state/profit
+    bands."""
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_item_sk", "ws_order_number", "ws_web_page_sk",
+             "ws_sold_date_sk", "ws_quantity", "ws_sales_price",
+             "ws_net_profit"])
+    wr = _t(session, data_dir, "web_returns",
+            ["wr_item_sk", "wr_order_number", "wr_refunded_cdemo_sk",
+             "wr_returning_cdemo_sk", "wr_refunded_addr_sk",
+             "wr_reason_sk", "wr_fee", "wr_refunded_cash"])
+    wp = _t(session, data_dir, "web_page", ["wp_web_page_sk"])
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2000)).select(col("d_date_sk"))
+    cd1 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk", "cd_marital_status", "cd_education_status"]) \
+        .select(col("cd_demo_sk").alias("cd1_sk"),
+                col("cd_marital_status").alias("cd1_ms"),
+                col("cd_education_status").alias("cd1_es"))
+    cd2 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk", "cd_marital_status", "cd_education_status"]) \
+        .select(col("cd_demo_sk").alias("cd2_sk"),
+                col("cd_marital_status").alias("cd2_ms"),
+                col("cd_education_status").alias("cd2_es"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_country", "ca_state"]) \
+        .where(col("ca_country") == lit("United States"))
+    re = _t(session, data_dir, "reason", ["r_reason_sk", "r_reason_desc"])
+    demo = Or(Or(
+        (col("cd1_ms") == lit("M")) & (col("cd1_es") == lit("Advanced Degree"))
+        & (col("ws_sales_price") >= lit(100.0))
+        & (col("ws_sales_price") <= lit(150.0)),
+        (col("cd1_ms") == lit("S")) & (col("cd1_es") == lit("College"))
+        & (col("ws_sales_price") >= lit(50.0))
+        & (col("ws_sales_price") <= lit(100.0))),
+        (col("cd1_ms") == lit("W")) & (col("cd1_es") == lit("2 yr Degree"))
+        & (col("ws_sales_price") >= lit(150.0))
+        & (col("ws_sales_price") <= lit(200.0)))
+    addr = Or(Or(
+        In(col("ca_state"), [lit(s) for s in ("IN", "OH", "NJ")])
+        & (col("ws_net_profit") >= lit(100.0))
+        & (col("ws_net_profit") <= lit(200.0)),
+        In(col("ca_state"), [lit(s) for s in ("WI", "CT", "KY")])
+        & (col("ws_net_profit") >= lit(150.0))
+        & (col("ws_net_profit") <= lit(300.0))),
+        In(col("ca_state"), [lit(s) for s in ("LA", "IA", "AR")])
+        & (col("ws_net_profit") >= lit(50.0))
+        & (col("ws_net_profit") <= lit(250.0)))
+    base = ws.join(wr, on=[("ws_item_sk", "wr_item_sk"),
+                           ("ws_order_number", "wr_order_number")]) \
+        .join(wp, on=[("ws_web_page_sk", "wp_web_page_sk")], how="semi") \
+        .join(dd, on=[("ws_sold_date_sk", "d_date_sk")]) \
+        .join(cd1, on=[("wr_refunded_cdemo_sk", "cd1_sk")]) \
+        .join(cd2, on=[("wr_returning_cdemo_sk", "cd2_sk")]) \
+        .join(ca, on=[("wr_refunded_addr_sk", "ca_address_sk")]) \
+        .where((col("cd1_ms") == col("cd2_ms"))
+               & (col("cd1_es") == col("cd2_es")) & demo & addr) \
+        .join(re, on=[("wr_reason_sk", "r_reason_sk")])
+    return base.group_by("r_reason_desc").agg(
+        Average(col("ws_quantity").cast(T.DoubleType())).alias("avg_qty"),
+        Average(col("wr_refunded_cash")).alias("avg_cash"),
+        Average(col("wr_fee")).alias("avg_fee")) \
+        .with_column("reason", Substring(col("r_reason_desc"), lit(1),
+                                         lit(20))) \
+        .select(col("reason"), col("avg_qty"), col("avg_cash"),
+                col("avg_fee")) \
+        .order_by(("reason", True), ("avg_qty", True), ("avg_cash", True),
+                  ("avg_fee", True)) \
+        .limit(100)
+
+
+def q86(session, data_dir: str):
+    """TPC-DS q86: web net-paid ROLLUP(category, class) with rank."""
+    from spark_rapids_tpu.expr.core import grouping_id
+    from spark_rapids_tpu.expr.window import (Rank, WindowExpression,
+                                              WindowSpec)
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_item_sk", "ws_net_paid"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_class"])
+    base = ws.join(dd, on=[("ws_sold_date_sk", "d_date_sk")]) \
+        .join(it, on=[("ws_item_sk", "i_item_sk")]) \
+        .rollup("i_category", "i_class") \
+        .agg(Sum(col("ws_net_paid")).alias("total_sum"),
+             grouping_id().alias("lochierarchy"))
+    rank = WindowExpression(
+        Rank(), WindowSpec(
+            partition_by=(col("lochierarchy"), col("i_category")),
+            order_by=((col("total_sum"), False),)))
+    return base.select(col("total_sum"), col("i_category"), col("i_class"),
+                       col("lochierarchy"),
+                       rank.alias("rank_within_parent")) \
+        .order_by(("lochierarchy", False), ("i_category", True),
+                  ("rank_within_parent", True)) \
+        .limit(100)
+
+
+QUERIES4 = {"q5": q5, "q8": q8, "q9": q9, "q10": q10, "q28": q28,
+            "q34": q34, "q35": q35, "q41": q41, "q44": q44, "q45": q45,
+            "q46": q46, "q49": q49, "q54": q54, "q56": q56, "q58": q58,
+            "q76": q76, "q83": q83, "q84": q84, "q85": q85, "q86": q86}

@@ -1,0 +1,1133 @@
+"""TPC-DS queries, full-suite tranche 5 (q1-q99 gap fill, part 4 of 4).
+
+The heavyweight plans: lag/lead self-joins (q47/q57), cumulative
+windows (q51), the 17-table q64, wide pivots (q66/q67), channel
+profit unions (q75/q77/q78/q80), and the multi-CTE q14/q23/q24.
+Same house rules as tpcds_queries2.py (reference:
+TpcdsLikeSpark.scala:1385-4101).  q14/q23/q24/q39 implement the 'a'
+variant of the reference's two-part queries.
+"""
+from __future__ import annotations
+
+import os
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum)
+from spark_rapids_tpu.expr.conditional import CaseWhen, Coalesce, If
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.math_ops import Round
+from spark_rapids_tpu.expr.predicates import In, Or
+from spark_rapids_tpu.expr.strings import Concat, Substring, Upper
+from spark_rapids_tpu.expr.window import (Rank, WindowExpression,
+                                          WindowFrame, WindowSpec,
+                                          UNBOUNDED, CURRENT_ROW)
+
+__all__ = ["QUERIES5"]
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _date_sk(y: int, m: int, d: int) -> int:
+    import datetime as _dt
+    return 2415022 + (_dt.date(y, m, d) - _dt.date(1900, 1, 1)).days
+
+
+# ---------------------------------------------------------------------------
+# q47 / q57: monthly sales vs yearly average with lag/lead self-joins
+# ---------------------------------------------------------------------------
+
+def _monthly_rank_frame(session, data_dir, use_store: bool):
+    """v1 CTE: monthly sales + yearly-average window + rank-in-time."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where(Or(Or(col("d_year") == lit(1999),
+                     (col("d_year") == lit(1998)) & (col("d_moy") == lit(12))),
+                  (col("d_year") == lit(2000)) & (col("d_moy") == lit(1))))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_brand"])
+    if use_store:
+        sales = _t(session, data_dir, "store_sales",
+                   ["ss_item_sk", "ss_sold_date_sk", "ss_store_sk",
+                    "ss_sales_price"])
+        ent = _t(session, data_dir, "store",
+                 ["s_store_sk", "s_store_name", "s_company_name"])
+        base = sales.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+            .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+            .join(ent, on=[("ss_store_sk", "s_store_sk")])
+        ent_cols = ["s_store_name", "s_company_name"]
+        price = "ss_sales_price"
+    else:
+        sales = _t(session, data_dir, "catalog_sales",
+                   ["cs_item_sk", "cs_sold_date_sk", "cs_call_center_sk",
+                    "cs_sales_price"])
+        ent = _t(session, data_dir, "call_center",
+                 ["cc_call_center_sk", "cc_name"])
+        base = sales.join(dd, on=[("cs_sold_date_sk", "d_date_sk")]) \
+            .join(it, on=[("cs_item_sk", "i_item_sk")]) \
+            .join(ent, on=[("cs_call_center_sk", "cc_call_center_sk")])
+        ent_cols = ["cc_name"]
+        price = "cs_sales_price"
+    keys = ["i_category", "i_brand"] + ent_cols
+    g = base.group_by(*keys, "d_year", "d_moy") \
+        .agg(Sum(col(price)).alias("sum_sales"))
+    part = tuple(col(k) for k in keys)
+    avg_w = WindowExpression(
+        Average(col("sum_sales")),
+        WindowSpec(partition_by=part + (col("d_year"),)))
+    rn = WindowExpression(
+        Rank(), WindowSpec(partition_by=part,
+                           order_by=((col("d_year"), True),
+                                     (col("d_moy"), True))))
+    return g.select(*[col(k) for k in keys], col("d_year"), col("d_moy"),
+                    col("sum_sales"), avg_w.alias("avg_monthly_sales"),
+                    rn.alias("rn")), keys
+
+
+def _lag_lead_query(session, data_dir, use_store: bool):
+    from spark_rapids_tpu.expr.arithmetic import Abs
+    v1, keys = _monthly_rank_frame(session, data_dir, use_store)
+    lag = v1.select(*[col(k).alias(f"lag_{k}") for k in keys],
+                    (col("rn") + lit(1)).alias("lag_rn"),
+                    col("sum_sales").alias("psum"))
+    lead = v1.select(*[col(k).alias(f"lead_{k}") for k in keys],
+                     (col("rn") - lit(1)).alias("lead_rn"),
+                     col("sum_sales").alias("nsum"))
+    on_lag = [(k, f"lag_{k}") for k in keys] + [("rn", "lag_rn")]
+    on_lead = [(k, f"lead_{k}") for k in keys] + [("rn", "lead_rn")]
+    v2 = v1.join(lag, on=on_lag).join(lead, on=on_lead)
+    out = v2.where((col("d_year") == lit(1999))
+                   & (col("avg_monthly_sales") > lit(0.0))
+                   & (Abs(col("sum_sales") - col("avg_monthly_sales"))
+                      / col("avg_monthly_sales") > lit(0.1)))
+    sel = [col(k) for k in keys] + [col("d_year"), col("d_moy"),
+                                    col("avg_monthly_sales"),
+                                    col("sum_sales"), col("psum"),
+                                    col("nsum")]
+    return out.select(*sel) \
+        .with_column("delta", col("sum_sales") - col("avg_monthly_sales")) \
+        .order_by(("delta", True), (keys[2], True), ("d_year", True),
+                  ("d_moy", True)) \
+        .select(*[c.name for c in sel]) \
+        .limit(100)
+
+
+def q47(session, data_dir: str):
+    """TPC-DS q47: store monthly outliers with prev/next month sales."""
+    return _lag_lead_query(session, data_dir, use_store=True)
+
+
+def q57(session, data_dir: str):
+    """TPC-DS q57: catalog call-center monthly outliers with prev/next."""
+    return _lag_lead_query(session, data_dir, use_store=False)
+
+
+# ---------------------------------------------------------------------------
+# q51: cumulative web-vs-store revenue
+# ---------------------------------------------------------------------------
+
+def q51(session, data_dir: str):
+    """TPC-DS q51: first dates where cumulative web sales exceed
+    cumulative store sales per item."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_date", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211)))
+    cum = WindowFrame("rows", UNBOUNDED, CURRENT_ROW)
+
+    def v1(sales, item_c, date_c, price_c, name):
+        g = sales.where(col(item_c).is_not_null()) \
+            .join(dd, on=[(date_c, "d_date_sk")]) \
+            .group_by(item_c, "d_date") \
+            .agg(Sum(col(price_c)).alias("day_sales"))
+        cume = WindowExpression(
+            Sum(col("day_sales")),
+            WindowSpec(partition_by=(col(item_c),),
+                       order_by=((col("d_date"), True),), frame=cum))
+        return g.select(col(item_c).alias(f"{name}_item_sk"),
+                        col("d_date").alias(f"{name}_date"),
+                        cume.alias(f"{name}_cume"))
+
+    web = v1(_t(session, data_dir, "web_sales",
+                ["ws_item_sk", "ws_sold_date_sk", "ws_sales_price"]),
+             "ws_item_sk", "ws_sold_date_sk", "ws_sales_price", "web")
+    sto = v1(_t(session, data_dir, "store_sales",
+                ["ss_item_sk", "ss_sold_date_sk", "ss_sales_price"]),
+             "ss_item_sk", "ss_sold_date_sk", "ss_sales_price", "store")
+    j = web.join(sto, on=[("web_item_sk", "store_item_sk"),
+                          ("web_date", "store_date")], how="full")
+    merged = j.select(
+        Coalesce(col("web_item_sk"), col("store_item_sk"))
+        .alias("item_sk"),
+        Coalesce(col("web_date"), col("store_date")).alias("d_date"),
+        col("web_cume").alias("web_sales"),
+        col("store_cume").alias("store_sales"))
+    web_c = WindowExpression(
+        Max(col("web_sales")),
+        WindowSpec(partition_by=(col("item_sk"),),
+                   order_by=((col("d_date"), True),), frame=cum))
+    sto_c = WindowExpression(
+        Max(col("store_sales")),
+        WindowSpec(partition_by=(col("item_sk"),),
+                   order_by=((col("d_date"), True),), frame=cum))
+    y = merged.select(col("item_sk"), col("d_date"), col("web_sales"),
+                      col("store_sales"), web_c.alias("web_cumulative"),
+                      sto_c.alias("store_cumulative"))
+    return y.where(col("web_cumulative") > col("store_cumulative")) \
+        .order_by(("item_sk", True), ("d_date", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q64: cross-store repeat purchases (the 17-table join)
+# ---------------------------------------------------------------------------
+
+def q64(session, data_dir: str):
+    """TPC-DS q64: item repurchase stats joined across two years."""
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_item_sk", "cs_order_number", "cs_ext_list_price"])
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_item_sk", "cr_order_number", "cr_refunded_cash",
+             "cr_reversed_charge", "cr_store_credit"])
+    cs_ui = cs.join(cr, on=[("cs_item_sk", "cr_item_sk"),
+                            ("cs_order_number", "cr_order_number")]) \
+        .group_by("cs_item_sk") \
+        .agg(Sum(col("cs_ext_list_price")).alias("sale"),
+             Sum(col("cr_refunded_cash") + col("cr_reversed_charge")
+                 + col("cr_store_credit")).alias("refund")) \
+        .where(col("sale") > lit(2.0) * col("refund")) \
+        .select(col("cs_item_sk").alias("ui_item_sk"))
+
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_item_sk", "ss_ticket_number", "ss_store_sk",
+             "ss_sold_date_sk", "ss_customer_sk", "ss_cdemo_sk",
+             "ss_hdemo_sk", "ss_addr_sk", "ss_promo_sk",
+             "ss_wholesale_cost", "ss_list_price", "ss_coupon_amt"])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_item_sk", "sr_ticket_number"])
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_name", "s_zip"])
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_cdemo_sk", "c_current_hdemo_sk",
+             "c_current_addr_sk", "c_first_sales_date_sk",
+             "c_first_shipto_date_sk"])
+    cd1 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk", "cd_marital_status"]) \
+        .select(col("cd_demo_sk").alias("cd1_sk"),
+                col("cd_marital_status").alias("cd1_ms"))
+    cd2 = _t(session, data_dir, "customer_demographics",
+             ["cd_demo_sk", "cd_marital_status"]) \
+        .select(col("cd_demo_sk").alias("cd2_sk"),
+                col("cd_marital_status").alias("cd2_ms"))
+    hd1 = _t(session, data_dir, "household_demographics",
+             ["hd_demo_sk", "hd_income_band_sk"]) \
+        .select(col("hd_demo_sk").alias("hd1_sk"),
+                col("hd_income_band_sk").alias("hd1_ib"))
+    hd2 = _t(session, data_dir, "household_demographics",
+             ["hd_demo_sk", "hd_income_band_sk"]) \
+        .select(col("hd_demo_sk").alias("hd2_sk"),
+                col("hd_income_band_sk").alias("hd2_ib"))
+    ad1 = _t(session, data_dir, "customer_address",
+             ["ca_address_sk", "ca_street_number", "ca_street_name",
+              "ca_city", "ca_zip"]) \
+        .select(col("ca_address_sk").alias("ad1_sk"),
+                col("ca_street_number").alias("b_street_number"),
+                col("ca_street_name").alias("b_street_name"),
+                col("ca_city").alias("b_city"),
+                col("ca_zip").alias("b_zip"))
+    ad2 = _t(session, data_dir, "customer_address",
+             ["ca_address_sk", "ca_street_number", "ca_street_name",
+              "ca_city", "ca_zip"]) \
+        .select(col("ca_address_sk").alias("ad2_sk"),
+                col("ca_street_number").alias("c_street_number"),
+                col("ca_street_name").alias("c_street_name"),
+                col("ca_city").alias("c_city"),
+                col("ca_zip").alias("c_zip"))
+    ib1 = _t(session, data_dir, "income_band", ["ib_income_band_sk"]) \
+        .select(col("ib_income_band_sk").alias("ib1_sk"))
+    ib2 = _t(session, data_dir, "income_band", ["ib_income_band_sk"]) \
+        .select(col("ib_income_band_sk").alias("ib2_sk"))
+    pr = _t(session, data_dir, "promotion", ["p_promo_sk"])
+    d1 = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .select(col("d_date_sk").alias("d1_sk"),
+                col("d_year").alias("syear"))
+    d2 = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .select(col("d_date_sk").alias("d2_sk"),
+                col("d_year").alias("fsyear"))
+    d3 = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .select(col("d_date_sk").alias("d3_sk"),
+                col("d_year").alias("s2year"))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_product_name", "i_color",
+             "i_current_price"]) \
+        .where(In(col("i_color"),
+                  [lit(c) for c in ("purple", "burlywood", "indian",
+                                    "spring", "floral", "medium")])
+               & (col("i_current_price") >= lit(64.0))
+               & (col("i_current_price") <= lit(74.0))
+               & (col("i_current_price") >= lit(65.0))
+               & (col("i_current_price") <= lit(79.0)))
+    base = ss.join(sr, on=[("ss_item_sk", "sr_item_sk"),
+                           ("ss_ticket_number", "sr_ticket_number")]) \
+        .join(cs_ui, on=[("ss_item_sk", "ui_item_sk")], how="semi") \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(d1, on=[("ss_sold_date_sk", "d1_sk")]) \
+        .join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(cd1, on=[("ss_cdemo_sk", "cd1_sk")]) \
+        .join(hd1, on=[("ss_hdemo_sk", "hd1_sk")]) \
+        .join(ad1, on=[("ss_addr_sk", "ad1_sk")]) \
+        .join(cd2, on=[("c_current_cdemo_sk", "cd2_sk")]) \
+        .join(hd2, on=[("c_current_hdemo_sk", "hd2_sk")]) \
+        .join(ad2, on=[("c_current_addr_sk", "ad2_sk")]) \
+        .join(d2, on=[("c_first_sales_date_sk", "d2_sk")]) \
+        .join(d3, on=[("c_first_shipto_date_sk", "d3_sk")]) \
+        .join(pr, on=[("ss_promo_sk", "p_promo_sk")], how="semi") \
+        .join(ib1, on=[("hd1_ib", "ib1_sk")], how="semi") \
+        .join(ib2, on=[("hd2_ib", "ib2_sk")], how="semi") \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .where(~(col("cd1_ms") == col("cd2_ms")))
+    keys = ["i_product_name", "i_item_sk", "s_store_name", "s_zip",
+            "b_street_number", "b_street_name", "b_city", "b_zip",
+            "c_street_number", "c_street_name", "c_city", "c_zip",
+            "syear", "fsyear", "s2year"]
+    cross_sales = base.group_by(*keys).agg(
+        CountStar().alias("cnt"),
+        Sum(col("ss_wholesale_cost")).alias("s1"),
+        Sum(col("ss_list_price")).alias("s2"),
+        Sum(col("ss_coupon_amt")).alias("s3"))
+    cs1 = cross_sales.where(col("syear") == lit(1999))
+    cs2 = cross_sales.where(col("syear") == lit(2000)).select(
+        col("i_item_sk").alias("cs2_item_sk"),
+        col("s_store_name").alias("cs2_store_name"),
+        col("s_zip").alias("cs2_zip"),
+        col("syear").alias("cs2_syear"), col("cnt").alias("cs2_cnt"),
+        col("s1").alias("cs2_s1"), col("s2").alias("cs2_s2"),
+        col("s3").alias("cs2_s3"))
+    return cs1.join(cs2, on=[("i_item_sk", "cs2_item_sk"),
+                             ("s_store_name", "cs2_store_name"),
+                             ("s_zip", "cs2_zip")]) \
+        .where(col("cs2_cnt") <= col("cnt")) \
+        .select(col("i_product_name"), col("s_store_name"), col("s_zip"),
+                col("b_street_number"), col("b_street_name"),
+                col("b_city"), col("b_zip"), col("c_street_number"),
+                col("c_street_name"), col("c_city"), col("c_zip"),
+                col("syear"), col("cnt"), col("s1"), col("s2"),
+                col("s3"), col("cs2_s1"), col("cs2_s2"), col("cs2_s3"),
+                col("cs2_syear"), col("cs2_cnt")) \
+        .order_by(("i_product_name", True), ("s_store_name", True),
+                  ("cs2_cnt", True))
+
+
+# ---------------------------------------------------------------------------
+# q66: warehouse monthly shipping pivot
+# ---------------------------------------------------------------------------
+
+def q66(session, data_dir: str):
+    """TPC-DS q66: per-warehouse monthly sales/net pivot for DHL+BARIAN
+    shipments in a time band, web + catalog."""
+    months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+              "sep", "oct", "nov", "dec"]
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where(col("d_year") == lit(2001))
+    td = _t(session, data_dir, "time_dim", ["t_time_sk", "t_time"]) \
+        .where((col("t_time") >= lit(30838))
+               & (col("t_time") <= lit(30838 + 28800))) \
+        .select(col("t_time_sk"))
+    sm = _t(session, data_dir, "ship_mode",
+            ["sm_ship_mode_sk", "sm_carrier"]) \
+        .where(In(col("sm_carrier"), [lit("DHL"), lit("BARIAN")])) \
+        .select(col("sm_ship_mode_sk"))
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name", "w_warehouse_sq_ft",
+             "w_city", "w_county", "w_state", "w_country"])
+    wkeys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+             "w_state", "w_country"]
+
+    def leg(sales, wh_c, date_c, time_c, mode_c, sales_expr, net_expr):
+        base = sales.join(dd, on=[(date_c, "d_date_sk")]) \
+            .join(td, on=[(time_c, "t_time_sk")], how="semi") \
+            .join(sm, on=[(mode_c, "sm_ship_mode_sk")], how="semi") \
+            .join(wh, on=[(wh_c, "w_warehouse_sk")])
+        aggs = []
+        for i, m in enumerate(months, 1):
+            aggs.append(Sum(If(col("d_moy") == lit(i), sales_expr,
+                               lit(0.0))).alias(f"{m}_sales"))
+        for i, m in enumerate(months, 1):
+            aggs.append(Sum(If(col("d_moy") == lit(i), net_expr,
+                               lit(0.0))).alias(f"{m}_net"))
+        return base.group_by(*wkeys, "d_year").agg(*aggs)
+
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_warehouse_sk", "ws_sold_date_sk", "ws_sold_time_sk",
+             "ws_ship_mode_sk", "ws_ext_sales_price", "ws_quantity",
+             "ws_net_paid"])
+    web = leg(ws, "ws_warehouse_sk", "ws_sold_date_sk", "ws_sold_time_sk",
+              "ws_ship_mode_sk",
+              col("ws_ext_sales_price") * col("ws_quantity"),
+              col("ws_net_paid") * col("ws_quantity"))
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_warehouse_sk", "cs_sold_date_sk", "cs_sold_time_sk",
+             "cs_ship_mode_sk", "cs_sales_price", "cs_quantity",
+             "cs_net_paid_inc_tax"])
+    cat = leg(cs, "cs_warehouse_sk", "cs_sold_date_sk", "cs_sold_time_sk",
+              "cs_ship_mode_sk",
+              col("cs_sales_price") * col("cs_quantity"),
+              col("cs_net_paid_inc_tax") * col("cs_quantity"))
+    u = web.union(cat)
+    aggs = [Sum(col(f"{m}_sales")).alias(f"{m}_sales") for m in months]
+    aggs += [Sum(col(f"{m}_sales") / col("w_warehouse_sq_ft"))
+             .alias(f"{m}_sales_per_sq_foot") for m in months]
+    aggs += [Sum(col(f"{m}_net")).alias(f"{m}_net") for m in months]
+    return u.group_by(*wkeys, "d_year").agg(*aggs) \
+        .with_column("ship_carriers", lit("DHL,BARIAN")) \
+        .order_by(("w_warehouse_name", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q67: top items per category over a full rollup
+# ---------------------------------------------------------------------------
+
+def q67(session, data_dir: str):
+    """TPC-DS q67: rank stores/items inside category over an 8-level
+    ROLLUP."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq", "d_year", "d_qoy", "d_moy"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211)))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+             "ss_sales_price", "ss_quantity"])
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_store_id"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_class", "i_brand",
+             "i_product_name"])
+    base = ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .rollup("i_category", "i_class", "i_brand", "i_product_name",
+                "d_year", "d_qoy", "d_moy", "s_store_id") \
+        .agg(Sum(Coalesce(col("ss_sales_price") * col("ss_quantity"),
+                          lit(0.0))).alias("sumsales"))
+    rk = WindowExpression(
+        Rank(), WindowSpec(partition_by=(col("i_category"),),
+                           order_by=((col("sumsales"), False),)))
+    ranked = base.select(col("i_category"), col("i_class"), col("i_brand"),
+                         col("i_product_name"), col("d_year"),
+                         col("d_qoy"), col("d_moy"), col("s_store_id"),
+                         col("sumsales"), rk.alias("rk"))
+    return ranked.where(col("rk") <= lit(100)) \
+        .order_by(("i_category", True), ("i_class", True),
+                  ("i_brand", True), ("i_product_name", True),
+                  ("d_year", True), ("d_qoy", True), ("d_moy", True),
+                  ("s_store_id", True), ("sumsales", True), ("rk", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q70: profitable states rollup
+# ---------------------------------------------------------------------------
+
+def q70(session, data_dir: str):
+    """TPC-DS q70: net profit ROLLUP(state, county) limited to top-5
+    ranked states."""
+    from spark_rapids_tpu.expr.core import grouping_id
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_month_seq"]) \
+        .where((col("d_month_seq") >= lit(1200))
+               & (col("d_month_seq") <= lit(1211))) \
+        .select(col("d_date_sk"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_net_profit"])
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_state", "s_county"])
+    joined = ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")])
+    by_state = joined.group_by("s_state") \
+        .agg(Sum(col("ss_net_profit")).alias("sp"))
+    rank_w = WindowExpression(
+        Rank(), WindowSpec(partition_by=(),
+                           order_by=((col("sp"), False),)))
+    top5 = by_state.select(col("s_state").alias("top_state"),
+                           rank_w.alias("ranking")) \
+        .where(col("ranking") <= lit(5)).select(col("top_state"))
+    base = joined.join(top5, on=[("s_state", "top_state")], how="semi") \
+        .rollup("s_state", "s_county") \
+        .agg(Sum(col("ss_net_profit")).alias("total_sum"),
+             grouping_id().alias("lochierarchy"))
+    rk = WindowExpression(
+        Rank(), WindowSpec(partition_by=(col("lochierarchy"),
+                                         col("s_state")),
+                           order_by=((col("total_sum"), False),)))
+    return base.select(col("total_sum"), col("s_state"), col("s_county"),
+                       col("lochierarchy"),
+                       rk.alias("rank_within_parent")) \
+        .order_by(("lochierarchy", False), ("s_state", True),
+                  ("rank_within_parent", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q71: brand revenue by meal-time minute
+# ---------------------------------------------------------------------------
+
+def q71(session, data_dir: str):
+    """TPC-DS q71: manager-1 brand revenue at breakfast/dinner minutes
+    across the three channels, Nov 1999."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_moy", "d_year"]) \
+        .where((col("d_moy") == lit(11)) & (col("d_year") == lit(1999))) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_brand_id", "i_brand", "i_manager_id"]) \
+        .where(col("i_manager_id") == lit(1)) \
+        .select(col("i_item_sk"), col("i_brand_id"), col("i_brand"))
+    td = _t(session, data_dir, "time_dim",
+            ["t_time_sk", "t_hour", "t_minute", "t_meal_time"]) \
+        .where(Or(col("t_meal_time") == lit("breakfast"),
+                  col("t_meal_time") == lit("dinner")))
+
+    def leg(sales, price_c, date_c, item_c, time_c):
+        return sales.join(dd, on=[(date_c, "d_date_sk")]) \
+            .select(col(price_c).alias("ext_price"),
+                    col(item_c).alias("sold_item_sk"),
+                    col(time_c).alias("time_sk"))
+
+    ws = leg(_t(session, data_dir, "web_sales",
+                ["ws_ext_sales_price", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_sold_time_sk"]),
+             "ws_ext_sales_price", "ws_sold_date_sk", "ws_item_sk",
+             "ws_sold_time_sk")
+    cs = leg(_t(session, data_dir, "catalog_sales",
+                ["cs_ext_sales_price", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_sold_time_sk"]),
+             "cs_ext_sales_price", "cs_sold_date_sk", "cs_item_sk",
+             "cs_sold_time_sk")
+    ss = leg(_t(session, data_dir, "store_sales",
+                ["ss_ext_sales_price", "ss_sold_date_sk", "ss_item_sk",
+                 "ss_sold_time_sk"]),
+             "ss_ext_sales_price", "ss_sold_date_sk", "ss_item_sk",
+             "ss_sold_time_sk")
+    return ws.union(cs).union(ss) \
+        .join(it, on=[("sold_item_sk", "i_item_sk")]) \
+        .join(td, on=[("time_sk", "t_time_sk")]) \
+        .group_by("i_brand", "i_brand_id", "t_hour", "t_minute") \
+        .agg(Sum(col("ext_price")).alias("ext_price")) \
+        .select(col("i_brand_id").alias("brand_id"),
+                col("i_brand").alias("brand"), col("t_hour"),
+                col("t_minute"), col("ext_price")) \
+        .order_by(("ext_price", False), ("brand_id", True),
+                  ("t_hour", True), ("t_minute", True), ("brand", True))
+
+
+# ---------------------------------------------------------------------------
+# q72: inventory shortfalls on promoted catalog sales
+# ---------------------------------------------------------------------------
+
+def q72(session, data_dir: str):
+    """TPC-DS q72: catalog demand exceeding inventory, by week, with
+    promo split."""
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_item_sk", "cs_order_number", "cs_bill_cdemo_sk",
+             "cs_bill_hdemo_sk", "cs_sold_date_sk", "cs_ship_date_sk",
+             "cs_promo_sk", "cs_quantity"])
+    inv = _t(session, data_dir, "inventory")
+    wh = _t(session, data_dir, "warehouse",
+            ["w_warehouse_sk", "w_warehouse_name"])
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_desc"])
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_marital_status"]) \
+        .where(col("cd_marital_status") == lit("D")) \
+        .select(col("cd_demo_sk"))
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_buy_potential"]) \
+        .where(col("hd_buy_potential") == lit(">10000")) \
+        .select(col("hd_demo_sk"))
+    d1 = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_week_seq", "d_year"]) \
+        .where(col("d_year") == lit(1999)) \
+        .select(col("d_date_sk").alias("d1_sk"),
+                col("d_week_seq").alias("d1_week_seq"))
+    d2 = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_week_seq"]) \
+        .select(col("d_date_sk").alias("d2_sk"),
+                col("d_week_seq").alias("d2_week_seq"))
+    d3 = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .select(col("d_date_sk").alias("d3_sk"))
+    pr = _t(session, data_dir, "promotion", ["p_promo_sk"]) \
+        .select(col("p_promo_sk"))
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_item_sk", "cr_order_number"]) \
+        .select(col("cr_item_sk").alias("crj_item_sk"),
+                col("cr_order_number").alias("crj_order_number"),
+                lit(1).alias("cr_hit"))
+    base = cs.join(inv, on=[("cs_item_sk", "inv_item_sk")]) \
+        .join(wh, on=[("inv_warehouse_sk", "w_warehouse_sk")]) \
+        .join(it, on=[("cs_item_sk", "i_item_sk")]) \
+        .join(cd, on=[("cs_bill_cdemo_sk", "cd_demo_sk")], how="semi") \
+        .join(hd, on=[("cs_bill_hdemo_sk", "hd_demo_sk")], how="semi") \
+        .join(d1, on=[("cs_sold_date_sk", "d1_sk")]) \
+        .join(d2, on=[("inv_date_sk", "d2_sk")]) \
+        .join(d3, on=[("cs_ship_date_sk", "d3_sk")]) \
+        .where((col("d1_week_seq") == col("d2_week_seq"))
+               & (col("inv_quantity_on_hand") < col("cs_quantity"))
+               & (col("d3_sk").cast(T.LongType())
+                  > col("d1_sk").cast(T.LongType()) + lit(5))) \
+        .join(pr, on=[("cs_promo_sk", "p_promo_sk")], how="left") \
+        .join(cr, on=[("cs_item_sk", "crj_item_sk"),
+                      ("cs_order_number", "crj_order_number")],
+              how="left")
+    return base.group_by("i_item_desc", "w_warehouse_name", "d1_week_seq") \
+        .agg(Sum(If(col("p_promo_sk").is_null(), lit(1), lit(0)))
+             .alias("no_promo"),
+             Sum(If(col("p_promo_sk").is_not_null(), lit(1), lit(0)))
+             .alias("promo"),
+             CountStar().alias("total_cnt")) \
+        .order_by(("total_cnt", False), ("i_item_desc", True),
+                  ("w_warehouse_name", True), ("d1_week_seq", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q75: year-over-year sales counts net of returns
+# ---------------------------------------------------------------------------
+
+def q75(session, data_dir: str):
+    """TPC-DS q75: Books items whose sales count shrank >10% year over
+    year, net of returns."""
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_brand_id", "i_class_id", "i_category_id",
+             "i_category", "i_manufact_id"]) \
+        .where(col("i_category") == lit("Books"))
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"])
+
+    def leg(sales_tbl, s_cols, item_c, date_c, order_c, qty_c, price_c,
+            ret_tbl, r_item, r_order, r_qty, r_amt):
+        sales = _t(session, data_dir, sales_tbl, s_cols)
+        rets = _t(session, data_dir, ret_tbl,
+                  [r_item, r_order, r_qty, r_amt]) \
+            .select(col(r_item).alias("rj_item"),
+                    col(r_order).alias("rj_order"),
+                    col(r_qty).alias("r_qty"),
+                    col(r_amt).alias("r_amt"))
+        return sales.join(it, on=[(item_c, "i_item_sk")]) \
+            .join(dd, on=[(date_c, "d_date_sk")]) \
+            .join(rets, on=[(order_c, "rj_order"), (item_c, "rj_item")],
+                  how="left") \
+            .select(col("d_year"), col("i_brand_id"), col("i_class_id"),
+                    col("i_category_id"), col("i_manufact_id"),
+                    (col(qty_c) - Coalesce(col("r_qty"), lit(0)))
+                    .alias("sales_cnt"),
+                    (col(price_c) - Coalesce(col("r_amt"), lit(0.0)))
+                    .alias("sales_amt"))
+
+    cs = leg("catalog_sales",
+             ["cs_item_sk", "cs_order_number", "cs_sold_date_sk",
+              "cs_quantity", "cs_ext_sales_price"],
+             "cs_item_sk", "cs_sold_date_sk", "cs_order_number",
+             "cs_quantity", "cs_ext_sales_price",
+             "catalog_returns", "cr_item_sk", "cr_order_number",
+             "cr_return_quantity", "cr_return_amount")
+    ss = leg("store_sales",
+             ["ss_item_sk", "ss_ticket_number", "ss_sold_date_sk",
+              "ss_quantity", "ss_ext_sales_price"],
+             "ss_item_sk", "ss_sold_date_sk", "ss_ticket_number",
+             "ss_quantity", "ss_ext_sales_price",
+             "store_returns", "sr_item_sk", "sr_ticket_number",
+             "sr_return_quantity", "sr_return_amt")
+    ws = leg("web_sales",
+             ["ws_item_sk", "ws_order_number", "ws_sold_date_sk",
+              "ws_quantity", "ws_ext_sales_price"],
+             "ws_item_sk", "ws_sold_date_sk", "ws_order_number",
+             "ws_quantity", "ws_ext_sales_price",
+             "web_returns", "wr_item_sk", "wr_order_number",
+             "wr_return_quantity", "wr_return_amt")
+    all_sales = cs.union(ss).union(ws).distinct() \
+        .group_by("d_year", "i_brand_id", "i_class_id", "i_category_id",
+                  "i_manufact_id") \
+        .agg(Sum(col("sales_cnt")).alias("sales_cnt"),
+             Sum(col("sales_amt")).alias("sales_amt"))
+    curr = all_sales.where(col("d_year") == lit(2002))
+    prev = all_sales.where(col("d_year") == lit(2001)).select(
+        col("i_brand_id").alias("p_brand_id"),
+        col("i_class_id").alias("p_class_id"),
+        col("i_category_id").alias("p_category_id"),
+        col("i_manufact_id").alias("p_manufact_id"),
+        col("d_year").alias("prev_year"),
+        col("sales_cnt").alias("prev_cnt"),
+        col("sales_amt").alias("prev_amt"))
+    j = curr.join(prev, on=[("i_brand_id", "p_brand_id"),
+                            ("i_class_id", "p_class_id"),
+                            ("i_category_id", "p_category_id"),
+                            ("i_manufact_id", "p_manufact_id")])
+    return j.where(col("sales_cnt").cast(T.DoubleType())
+                   / col("prev_cnt").cast(T.DoubleType()) < lit(0.9)) \
+        .select(col("prev_year"), col("d_year").alias("year"),
+                col("i_brand_id"), col("i_class_id"),
+                col("i_category_id"), col("i_manufact_id"),
+                col("prev_cnt").alias("prev_yr_cnt"),
+                col("sales_cnt").alias("curr_yr_cnt"),
+                (col("sales_cnt") - col("prev_cnt"))
+                .alias("sales_cnt_diff"),
+                (col("sales_amt") - col("prev_amt"))
+                .alias("sales_amt_diff")) \
+        .order_by(("sales_cnt_diff", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q77: channel profit and loss
+# ---------------------------------------------------------------------------
+
+def q77(session, data_dir: str):
+    """TPC-DS q77: 30-day profit and returns per channel, ROLLUP."""
+    lo = _date_sk(2000, 8, 23)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 30)))
+
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_store_sk", "ss_ext_sales_price",
+             "ss_net_profit"]) \
+        .join(dd, on=[("ss_sold_date_sk", "d_date_sk")], how="semi") \
+        .group_by("ss_store_sk") \
+        .agg(Sum(col("ss_ext_sales_price")).alias("sales"),
+             Sum(col("ss_net_profit")).alias("profit"))
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_returned_date_sk", "sr_store_sk", "sr_return_amt",
+             "sr_net_loss"]) \
+        .join(dd, on=[("sr_returned_date_sk", "d_date_sk")], how="semi") \
+        .group_by("sr_store_sk") \
+        .agg(Sum(col("sr_return_amt")).alias("s_returns"),
+             Sum(col("sr_net_loss")).alias("profit_loss"))
+    store = ss.join(sr, on=[("ss_store_sk", "sr_store_sk")], how="left") \
+        .select(lit("store channel").alias("channel"),
+                col("ss_store_sk").alias("id"), col("sales"),
+                Coalesce(col("s_returns"), lit(0.0)).alias("returns"),
+                (col("profit") - Coalesce(col("profit_loss"), lit(0.0)))
+                .alias("profit"))
+
+    cs = _t(session, data_dir, "catalog_sales",
+            ["cs_sold_date_sk", "cs_call_center_sk", "cs_ext_sales_price",
+             "cs_net_profit"]) \
+        .join(dd, on=[("cs_sold_date_sk", "d_date_sk")], how="semi") \
+        .group_by("cs_call_center_sk") \
+        .agg(Sum(col("cs_ext_sales_price")).alias("sales"),
+             Sum(col("cs_net_profit")).alias("profit"))
+    cr = _t(session, data_dir, "catalog_returns",
+            ["cr_returned_date_sk", "cr_return_amount", "cr_net_loss"]) \
+        .join(dd, on=[("cr_returned_date_sk", "d_date_sk")], how="semi") \
+        .agg(Sum(col("cr_return_amount")).alias("c_returns"),
+             Sum(col("cr_net_loss")).alias("c_profit_loss"))
+    catalog = cs.join(cr, how="cross") \
+        .select(lit("catalog channel").alias("channel"),
+                col("cs_call_center_sk").alias("id"), col("sales"),
+                col("c_returns").alias("returns"),
+                (col("profit") - col("c_profit_loss")).alias("profit"))
+
+    wsf = _t(session, data_dir, "web_sales",
+             ["ws_sold_date_sk", "ws_web_page_sk", "ws_ext_sales_price",
+              "ws_net_profit"]) \
+        .join(dd, on=[("ws_sold_date_sk", "d_date_sk")], how="semi") \
+        .group_by("ws_web_page_sk") \
+        .agg(Sum(col("ws_ext_sales_price")).alias("sales"),
+             Sum(col("ws_net_profit")).alias("profit"))
+    wrf = _t(session, data_dir, "web_returns",
+             ["wr_returned_date_sk", "wr_web_page_sk", "wr_return_amt",
+              "wr_net_loss"]) \
+        .join(dd, on=[("wr_returned_date_sk", "d_date_sk")], how="semi") \
+        .group_by("wr_web_page_sk") \
+        .agg(Sum(col("wr_return_amt")).alias("w_returns"),
+             Sum(col("wr_net_loss")).alias("w_profit_loss"))
+    web = wsf.join(wrf, on=[("ws_web_page_sk", "wr_web_page_sk")],
+                   how="left") \
+        .select(lit("web channel").alias("channel"),
+                col("ws_web_page_sk").alias("id"), col("sales"),
+                Coalesce(col("w_returns"), lit(0.0)).alias("returns"),
+                (col("profit") - Coalesce(col("w_profit_loss"), lit(0.0)))
+                .alias("profit"))
+
+    return store.union(catalog).union(web).rollup("channel", "id").agg(
+        Sum(col("sales")).alias("sales"),
+        Sum(col("returns")).alias("returns"),
+        Sum(col("profit")).alias("profit")) \
+        .order_by(("channel", True), ("id", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q78: store loyalty vs other channels
+# ---------------------------------------------------------------------------
+
+def q78(session, data_dir: str):
+    """TPC-DS q78: unreturned per-customer-item sales, store vs other
+    channels, year 2000."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"])
+
+    def leg(sales_tbl, cols, item_c, cust_c, date_c, order_c, qty_c, wc_c,
+            sp_c, ret_tbl, r_item, r_order, tag):
+        sales = _t(session, data_dir, sales_tbl, cols)
+        rets = _t(session, data_dir, ret_tbl, [r_item, r_order]) \
+            .select(col(r_item).alias("rj_item"),
+                    col(r_order).alias("rj_order"))
+        return sales.join(rets, on=[(order_c, "rj_order"),
+                                    (item_c, "rj_item")], how="anti") \
+            .join(dd, on=[(date_c, "d_date_sk")]) \
+            .group_by("d_year", item_c, cust_c) \
+            .agg(Sum(col(qty_c)).alias(f"{tag}_qty"),
+                 Sum(col(wc_c)).alias(f"{tag}_wc"),
+                 Sum(col(sp_c)).alias(f"{tag}_sp")) \
+            .select(col("d_year").alias(f"{tag}_sold_year"),
+                    col(item_c).alias(f"{tag}_item_sk"),
+                    col(cust_c).alias(f"{tag}_customer_sk"),
+                    col(f"{tag}_qty"), col(f"{tag}_wc"),
+                    col(f"{tag}_sp"))
+
+    ws = leg("web_sales",
+             ["ws_item_sk", "ws_bill_customer_sk", "ws_sold_date_sk",
+              "ws_order_number", "ws_quantity", "ws_wholesale_cost",
+              "ws_sales_price"],
+             "ws_item_sk", "ws_bill_customer_sk", "ws_sold_date_sk",
+             "ws_order_number", "ws_quantity", "ws_wholesale_cost",
+             "ws_sales_price", "web_returns", "wr_item_sk",
+             "wr_order_number", "ws")
+    cs = leg("catalog_sales",
+             ["cs_item_sk", "cs_bill_customer_sk", "cs_sold_date_sk",
+              "cs_order_number", "cs_quantity", "cs_wholesale_cost",
+              "cs_sales_price"],
+             "cs_item_sk", "cs_bill_customer_sk", "cs_sold_date_sk",
+             "cs_order_number", "cs_quantity", "cs_wholesale_cost",
+             "cs_sales_price", "catalog_returns", "cr_item_sk",
+             "cr_order_number", "cs")
+    ss = leg("store_sales",
+             ["ss_item_sk", "ss_customer_sk", "ss_sold_date_sk",
+              "ss_ticket_number", "ss_quantity", "ss_wholesale_cost",
+              "ss_sales_price"],
+             "ss_item_sk", "ss_customer_sk", "ss_sold_date_sk",
+             "ss_ticket_number", "ss_quantity", "ss_wholesale_cost",
+             "ss_sales_price", "store_returns", "sr_item_sk",
+             "sr_ticket_number", "ss")
+    j = ss.join(ws, on=[("ss_sold_year", "ws_sold_year"),
+                        ("ss_item_sk", "ws_item_sk"),
+                        ("ss_customer_sk", "ws_customer_sk")],
+                how="left") \
+        .join(cs, on=[("ss_sold_year", "cs_sold_year"),
+                      ("ss_item_sk", "cs_item_sk"),
+                      ("ss_customer_sk", "cs_customer_sk")],
+              how="left")
+    other_qty = Coalesce(col("ws_qty"), lit(0)) + Coalesce(col("cs_qty"),
+                                                           lit(0))
+    return j.where((col("ss_sold_year") == lit(2000))
+                   & (other_qty > lit(0))) \
+        .select(col("ss_sold_year"), col("ss_item_sk"),
+                col("ss_customer_sk"),
+                Round(col("ss_qty").cast(T.DoubleType())
+                      / If(other_qty == lit(0), lit(1),
+                           other_qty).cast(T.DoubleType()), 2)
+                .alias("ratio"),
+                col("ss_qty").alias("store_qty"),
+                col("ss_wc").alias("store_wholesale_cost"),
+                col("ss_sp").alias("store_sales_price"),
+                other_qty.alias("other_chan_qty"),
+                (Coalesce(col("ws_wc"), lit(0.0))
+                 + Coalesce(col("cs_wc"), lit(0.0)))
+                .alias("other_chan_wholesale_cost"),
+                (Coalesce(col("ws_sp"), lit(0.0))
+                 + Coalesce(col("cs_sp"), lit(0.0)))
+                .alias("other_chan_sales_price")) \
+        .order_by(("ss_sold_year", True), ("ss_item_sk", True),
+                  ("ss_customer_sk", True), ("store_qty", False),
+                  ("store_wholesale_cost", False),
+                  ("store_sales_price", False)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q80: channel profit report with promo filter
+# ---------------------------------------------------------------------------
+
+def q80(session, data_dir: str):
+    """TPC-DS q80: 30-day sales/returns/profit per channel entity for
+    non-TV-promoted expensive items."""
+    lo = _date_sk(2000, 8, 23)
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk"]) \
+        .where((col("d_date_sk") >= lit(lo))
+               & (col("d_date_sk") <= lit(lo + 30)))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_current_price"]) \
+        .where(col("i_current_price") > lit(50.0)).select(col("i_item_sk"))
+    pr = _t(session, data_dir, "promotion",
+            ["p_promo_sk", "p_channel_tv"]) \
+        .where(col("p_channel_tv") == lit("N")).select(col("p_promo_sk"))
+
+    def leg(sales_tbl, s_cols, date_c, item_c, promo_c, ent_c, price_c,
+            profit_c, ret_tbl, r_cols, r_item, r_order, s_order, r_amt,
+            r_loss, ent_tbl, ent_sk, ent_id):
+        sales = _t(session, data_dir, sales_tbl, s_cols)
+        rets = _t(session, data_dir, ret_tbl, r_cols) \
+            .select(col(r_item).alias("rj_item"),
+                    col(r_order).alias("rj_order"),
+                    col(r_amt).alias("r_amt"), col(r_loss).alias("r_loss"))
+        ent = _t(session, data_dir, ent_tbl, [ent_sk, ent_id])
+        return sales \
+            .join(rets, on=[(item_c, "rj_item"), (s_order, "rj_order")],
+                  how="left") \
+            .join(dd, on=[(date_c, "d_date_sk")], how="semi") \
+            .join(it, on=[(item_c, "i_item_sk")], how="semi") \
+            .join(pr, on=[(promo_c, "p_promo_sk")], how="semi") \
+            .join(ent, on=[(ent_c, ent_sk)]) \
+            .group_by(ent_id) \
+            .agg(Sum(col(price_c)).alias("sales"),
+                 Sum(Coalesce(col("r_amt"), lit(0.0))).alias("returns"),
+                 Sum(col(profit_c) - Coalesce(col("r_loss"), lit(0.0)))
+                 .alias("profit"))
+
+    ssr = leg("store_sales",
+              ["ss_sold_date_sk", "ss_store_sk", "ss_item_sk",
+               "ss_promo_sk", "ss_ticket_number", "ss_ext_sales_price",
+               "ss_net_profit"],
+              "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+              "ss_store_sk", "ss_ext_sales_price", "ss_net_profit",
+              "store_returns",
+              ["sr_item_sk", "sr_ticket_number", "sr_return_amt",
+               "sr_net_loss"],
+              "sr_item_sk", "sr_ticket_number", "ss_ticket_number",
+              "sr_return_amt", "sr_net_loss",
+              "store", "s_store_sk", "s_store_id")
+    csr = leg("catalog_sales",
+              ["cs_sold_date_sk", "cs_catalog_page_sk", "cs_item_sk",
+               "cs_promo_sk", "cs_order_number", "cs_ext_sales_price",
+               "cs_net_profit"],
+              "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+              "cs_catalog_page_sk", "cs_ext_sales_price", "cs_net_profit",
+              "catalog_returns",
+              ["cr_item_sk", "cr_order_number", "cr_return_amount",
+               "cr_net_loss"],
+              "cr_item_sk", "cr_order_number", "cs_order_number",
+              "cr_return_amount", "cr_net_loss",
+              "catalog_page", "cp_catalog_page_sk", "cp_catalog_page_id")
+    wsr = leg("web_sales",
+              ["ws_sold_date_sk", "ws_web_site_sk", "ws_item_sk",
+               "ws_promo_sk", "ws_order_number", "ws_ext_sales_price",
+               "ws_net_profit"],
+              "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+              "ws_web_site_sk", "ws_ext_sales_price", "ws_net_profit",
+              "web_returns",
+              ["wr_item_sk", "wr_order_number", "wr_return_amt",
+               "wr_net_loss"],
+              "wr_item_sk", "wr_order_number", "ws_order_number",
+              "wr_return_amt", "wr_net_loss",
+              "web_site", "web_site_sk", "web_site_id")
+
+    def channel(frame, label, prefix, id_col):
+        return frame.select(
+            lit(label).alias("channel"),
+            Concat(lit(prefix), col(id_col)).alias("id"),
+            col("sales"), col("returns"), col("profit"))
+
+    u = channel(ssr, "store channel", "store", "s_store_id") \
+        .union(channel(csr, "catalog channel", "catalog_page",
+                       "cp_catalog_page_id")) \
+        .union(channel(wsr, "web channel", "web_site", "web_site_id"))
+    return u.rollup("channel", "id").agg(
+        Sum(col("sales")).alias("sales"),
+        Sum(col("returns")).alias("returns"),
+        Sum(col("profit")).alias("profit")) \
+        .order_by(("channel", True), ("id", True)).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q14 (variant a): cross-channel item comparison
+# ---------------------------------------------------------------------------
+
+def q14(session, data_dir: str):
+    """TPC-DS q14a: channel sales of items sold in ALL three channels,
+    vs the overall average."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"])
+    years = dd.where((col("d_year") >= lit(1999))
+                     & (col("d_year") <= lit(2001))) \
+        .select(col("d_date_sk"))
+    it_full = _t(session, data_dir, "item",
+                 ["i_item_sk", "i_brand_id", "i_class_id",
+                  "i_category_id"])
+
+    def sold_triples(sales_tbl, item_c, date_c):
+        return _t(session, data_dir, sales_tbl, [item_c, date_c]) \
+            .join(years, on=[(date_c, "d_date_sk")], how="semi") \
+            .join(it_full, on=[(item_c, "i_item_sk")]) \
+            .select(col("i_brand_id"), col("i_class_id"),
+                    col("i_category_id")).distinct()
+
+    triples = sold_triples("store_sales", "ss_item_sk", "ss_sold_date_sk") \
+        .intersect(sold_triples("catalog_sales", "cs_item_sk",
+                                "cs_sold_date_sk")) \
+        .intersect(sold_triples("web_sales", "ws_item_sk",
+                                "ws_sold_date_sk")) \
+        .select(col("i_brand_id").alias("t_brand"),
+                col("i_class_id").alias("t_class"),
+                col("i_category_id").alias("t_cat"))
+    cross_items = it_full.join(
+        triples, on=[("i_brand_id", "t_brand"), ("i_class_id", "t_class"),
+                     ("i_category_id", "t_cat")], how="semi") \
+        .select(col("i_item_sk").alias("ci_item_sk"))
+
+    def qlp(sales_tbl, qty_c, price_c, date_c):
+        return _t(session, data_dir, sales_tbl,
+                  [qty_c, price_c, date_c]) \
+            .join(years, on=[(date_c, "d_date_sk")], how="semi") \
+            .select((col(qty_c) * col(price_c)).alias("qlp"))
+
+    avg_rows = qlp("store_sales", "ss_quantity", "ss_list_price",
+                   "ss_sold_date_sk") \
+        .union(qlp("catalog_sales", "cs_quantity", "cs_list_price",
+                   "cs_sold_date_sk")) \
+        .union(qlp("web_sales", "ws_quantity", "ws_list_price",
+                   "ws_sold_date_sk")) \
+        .agg(Average(col("qlp")).alias("average_sales")).collect()
+    average_sales = avg_rows[0][0] or 0.0
+
+    target = dd.where((col("d_year") == lit(2001))
+                      & (col("d_moy") == lit(11))) \
+        .select(col("d_date_sk"))
+
+    def channel(sales_tbl, item_c, qty_c, price_c, date_c, label):
+        sales = _t(session, data_dir, sales_tbl,
+                   [item_c, qty_c, price_c, date_c])
+        return sales.join(target, on=[(date_c, "d_date_sk")], how="semi") \
+            .join(cross_items, on=[(item_c, "ci_item_sk")], how="semi") \
+            .join(it_full, on=[(item_c, "i_item_sk")]) \
+            .group_by("i_brand_id", "i_class_id", "i_category_id") \
+            .agg(Sum(col(qty_c) * col(price_c)).alias("sales"),
+                 CountStar().alias("number_sales")) \
+            .where(col("sales") > lit(average_sales)) \
+            .select(lit(label).alias("channel"), col("i_brand_id"),
+                    col("i_class_id"), col("i_category_id"),
+                    col("sales"), col("number_sales"))
+
+    u = channel("store_sales", "ss_item_sk", "ss_quantity",
+                "ss_list_price", "ss_sold_date_sk", "store") \
+        .union(channel("catalog_sales", "cs_item_sk", "cs_quantity",
+                       "cs_list_price", "cs_sold_date_sk", "catalog")) \
+        .union(channel("web_sales", "ws_item_sk", "ws_quantity",
+                       "ws_list_price", "ws_sold_date_sk", "web"))
+    return u.rollup("channel", "i_brand_id", "i_class_id",
+                    "i_category_id") \
+        .agg(Sum(col("sales")).alias("sum_sales"),
+             Sum(col("number_sales")).alias("sum_number_sales")) \
+        .order_by(("channel", True), ("i_brand_id", True),
+                  ("i_class_id", True), ("i_category_id", True)) \
+        .limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q23 (variant a): frequent items bought by best customers
+# ---------------------------------------------------------------------------
+
+def q23(session, data_dir: str):
+    """TPC-DS q23a: catalog+web revenue in Feb 2000 from frequently
+    store-sold items bought by the biggest store customers."""
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_date", "d_year", "d_moy"])
+    years = dd.where(In(col("d_year"),
+                        [lit(y) for y in (2000, 2001, 2002, 2003)]))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+             "ss_quantity", "ss_sales_price"])
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_desc"])
+    frequent = ss.join(years.select(col("d_date_sk"), col("d_date")),
+                       on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .with_column("itemdesc", Substring(col("i_item_desc"), lit(1),
+                                           lit(30))) \
+        .group_by("itemdesc", "ss_item_sk", "d_date") \
+        .agg(CountStar().alias("cnt")) \
+        .where(col("cnt") > lit(4)) \
+        .select(col("ss_item_sk").alias("freq_item_sk")).distinct()
+
+    cu = _t(session, data_dir, "customer", ["c_customer_sk"])
+    sales_by_cust = ss.join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .group_by("c_customer_sk") \
+        .agg(Sum(col("ss_quantity") * col("ss_sales_price"))
+             .alias("csales"))
+    in_window = ss.join(years.select(col("d_date_sk")),
+                        on=[("ss_sold_date_sk", "d_date_sk")], how="semi") \
+        .join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .group_by("c_customer_sk") \
+        .agg(Sum(col("ss_quantity") * col("ss_sales_price"))
+             .alias("csales"))
+    max_rows = in_window.agg(Max(col("csales")).alias("m")).collect()
+    tpcds_cmax = max_rows[0][0] or 0.0
+    best = sales_by_cust \
+        .where(col("csales") > lit(0.95 * float(tpcds_cmax))) \
+        .select(col("c_customer_sk").alias("best_cust_sk"))
+
+    feb = dd.where((col("d_year") == lit(2000))
+                   & (col("d_moy") == lit(2))).select(col("d_date_sk"))
+
+    def channel(sales_tbl, item_c, cust_c, qty_c, price_c, date_c):
+        return _t(session, data_dir, sales_tbl,
+                  [item_c, cust_c, qty_c, price_c, date_c]) \
+            .join(feb, on=[(date_c, "d_date_sk")], how="semi") \
+            .join(frequent, on=[(item_c, "freq_item_sk")], how="semi") \
+            .join(best, on=[(cust_c, "best_cust_sk")], how="semi") \
+            .select((col(qty_c) * col(price_c)).alias("sales"))
+
+    u = channel("catalog_sales", "cs_item_sk", "cs_bill_customer_sk",
+                "cs_quantity", "cs_list_price", "cs_sold_date_sk") \
+        .union(channel("web_sales", "ws_item_sk", "ws_bill_customer_sk",
+                       "ws_quantity", "ws_list_price", "ws_sold_date_sk"))
+    return u.agg(Sum(col("sales")).alias("total")).limit(100)
+
+
+# ---------------------------------------------------------------------------
+# q24 (variant a): customer net-paid by color
+# ---------------------------------------------------------------------------
+
+def q24(session, data_dir: str):
+    """TPC-DS q24a: pale-item net paid per customer/store, above 5% of
+    the average."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_ticket_number", "ss_item_sk", "ss_customer_sk",
+             "ss_store_sk", "ss_net_paid"])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_ticket_number", "sr_item_sk"])
+    st = _t(session, data_dir, "store",
+            ["s_store_sk", "s_store_name", "s_market_id", "s_state",
+             "s_zip"]) \
+        .where(col("s_market_id") == lit(8))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_color", "i_current_price", "i_manager_id",
+             "i_units", "i_size"])
+    cu = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_first_name", "c_last_name",
+             "c_birth_country"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state", "ca_country", "ca_zip"]) \
+        .with_column("ca_country_up", Upper(col("ca_country")))
+    base = ss.join(sr, on=[("ss_ticket_number", "sr_ticket_number"),
+                           ("ss_item_sk", "sr_item_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .join(cu, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_birth_country", "ca_country_up"),
+                      ("s_zip", "ca_zip")])
+    ssales = base.group_by("c_last_name", "c_first_name", "s_store_name",
+                           "ca_state", "s_state", "i_color",
+                           "i_current_price", "i_manager_id", "i_units",
+                           "i_size") \
+        .agg(Sum(col("ss_net_paid")).alias("netpaid"))
+    avg_rows = ssales.agg(Average(col("netpaid")).alias("a")).collect()
+    threshold = 0.05 * float(avg_rows[0][0] or 0.0)
+    return ssales.where(col("i_color") == lit("pale")) \
+        .group_by("c_last_name", "c_first_name", "s_store_name") \
+        .agg(Sum(col("netpaid")).alias("paid")) \
+        .where(col("paid") > lit(threshold)) \
+        .order_by(("c_last_name", True), ("c_first_name", True),
+                  ("s_store_name", True), ("paid", True))
+
+
+QUERIES5 = {"q14": q14, "q23": q23, "q24": q24, "q47": q47, "q51": q51,
+            "q57": q57, "q64": q64, "q66": q66, "q67": q67, "q70": q70,
+            "q71": q71, "q72": q72, "q75": q75, "q77": q77, "q78": q78,
+            "q80": q80}

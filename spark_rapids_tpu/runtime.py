@@ -72,8 +72,11 @@ def pin_arrow_threads() -> None:
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Idempotently turn on the persistent compilation cache.
 
-    Safe to call before or after backend initialization; returns the cache
-    directory in use (None if disabled via conf/env).
+    Call AFTER device initialization (ensure_runtime does): the cache
+    dir is fingerprinted on jax.config.jax_platforms, which device init
+    pins to the user's requested platform — fingerprinting before that
+    can mix local-CPU and tunnel-compiled AOT entries in one dir.
+    Returns the cache directory in use (None if disabled via conf/env).
     """
     global _enabled_dir
     cache_dir = cache_dir or COMPILATION_CACHE_DIR.default
@@ -85,7 +88,19 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     import hashlib
     fp = hashlib.md5()
     fp.update(os.environ.get("XLA_FLAGS", "").encode())
-    fp.update(os.environ.get("JAX_PLATFORMS", "").encode())
+    # the CONFIG value, not the env var: the accelerator site hook
+    # rewrites jax_platforms after env processing, so the env string can
+    # say "cpu" while programs actually compile for (and on) the tunnel
+    # terminal — those AOT entries must not share a dir with true local
+    # CPU compiles (observed "+prefer-no-scatter not supported … SIGILL"
+    # loads in round 4)
+    try:
+        import jax
+        platforms = jax.config.jax_platforms or os.environ.get(
+            "JAX_PLATFORMS", "")
+    except Exception:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+    fp.update(str(platforms).encode())
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
@@ -124,7 +139,9 @@ def ensure_runtime(conf=None) -> None:
     semaphore wiring lives in memory/catalog.py."""
     pin_arrow_threads()
     settings = getattr(conf, "settings", None) or {}
-    if COMPILATION_CACHE_ENABLED.get(settings):
-        enable_compilation_cache(COMPILATION_CACHE_DIR.get(settings))
+    # device init FIRST: it pins jax_platforms to the user's requested
+    # platform, which the cache fingerprint below depends on
     from spark_rapids_tpu.device import initialize_device
     initialize_device(conf)
+    if COMPILATION_CACHE_ENABLED.get(settings):
+        enable_compilation_cache(COMPILATION_CACHE_DIR.get(settings))

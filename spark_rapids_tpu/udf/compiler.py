@@ -3,12 +3,18 @@
 The compile strategy mirrors the reference's Instruction/State design
 (udf-compiler Instruction.scala symbolic stack machine, State.scala):
 walk the instruction stream with a symbolic operand stack whose entries
-are Expression nodes; a RETURN yields the compiled tree.  v0 scope:
-straight-line code (no jumps/loops/short-circuit), arithmetic
-(+ - * / // % **), unary minus, comparisons, and calls to a small
-builtin allowlist (abs).  Unsupported constructs raise internally and
-the caller falls back to the row-at-a-time host UDF — the reference's
-silent-fallback contract (LogicalPlanRules.apply :79-94).
+are Expression nodes.  Conditional jumps FORK the symbolic state (the
+CPython analog of CFG.scala's basic blocks + State.scala's per-block
+condition): one successor per branch edge, each carrying the
+accumulated path condition, and every RETURN contributes a
+(condition, value) pair merged into a nested If tree the way
+CatalystExpressionBuilder.compile folds blocks into CaseWhen.  Scope:
+branches (if/else, ternary, short-circuit and/or), arithmetic
+(+ - * / // % **), unary minus/not, comparisons, and calls to a small
+builtin allowlist (abs).  Backward jumps (loops) and unknown opcodes
+raise internally and the caller falls back to the row-at-a-time host
+UDF — the reference's silent-fallback contract
+(LogicalPlanRules.apply :79-94).
 """
 from __future__ import annotations
 
@@ -74,76 +80,210 @@ def compile_udf(fn: Callable, args: Sequence[Expression]) -> Expression | None:
         return None
 
 
+#: path-explosion bound for branchy lambdas (the reference's CFG fold is
+#: linear in blocks; path enumeration is exponential in nesting, so cap)
+_MAX_PATHS = 64
+
+
+def _as_bool(e: Expression) -> Expression:
+    """Coerce a popped jump operand to a boolean condition."""
+    from spark_rapids_tpu.expr import predicates as P
+    if isinstance(e, Literal) and not isinstance(e.value, bool):
+        return lit(bool(e.value))
+    try:
+        is_bool = isinstance(e.dtype, T.BooleanType)
+    except Exception:
+        # unbound attribute: dtype unknown at compile time — assume
+        # numeric truthiness (comparisons/logic produce Boolean nodes
+        # whose dtype IS known, so they take the branch above)
+        is_bool = False
+    if is_bool:
+        return e
+    # python truthiness of a numeric: x != 0
+    return P.Not(P.EqualTo(e, lit(0)))
+
+
 def _compile(fn: Callable, args: list[Expression]) -> Expression:
     code = fn.__code__
     if code.co_argcount != len(args):
         raise _Unsupported("arity")
-    locals_map: dict[str, Expression] = {
-        name: args[i] for i, name in
-        enumerate(code.co_varnames[:code.co_argcount])}
     binops = _binary_builders()
     cmps = _compare_builders()
+    from spark_rapids_tpu.expr import predicates as P
     from spark_rapids_tpu.expr.arithmetic import Abs, UnaryMinus
+    from spark_rapids_tpu.expr.conditional import If as IfExpr
     allowed_globals = {"abs": lambda a: Abs(a)}
 
-    stack: list = []
-    for ins in dis.get_instructions(fn):
-        op = ins.opname
-        if op in ("RESUME", "NOP", "PRECALL", "CACHE", "PUSH_NULL",
-                  "COPY_FREE_VARS"):
-            continue
-        if op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_BORROW"):
-            if ins.argval not in locals_map:
-                raise _Unsupported(f"unbound local {ins.argval}")
-            stack.append(locals_map[ins.argval])
-        elif op in ("LOAD_FAST_LOAD_FAST", "LOAD_FAST_BORROW_LOAD_FAST_BORROW"):
-            for name in ins.argval:
-                if name not in locals_map:
-                    raise _Unsupported(f"unbound local {name}")
-                stack.append(locals_map[name])
-        elif op == "LOAD_CONST":
-            stack.append(lit(ins.argval))
-        elif op in ("LOAD_GLOBAL",):
-            name = ins.argval
-            if name not in allowed_globals:
-                raise _Unsupported(f"global {name}")
-            stack.append(allowed_globals[name])
-        elif op == "BINARY_OP":
-            sym = ins.argrepr.rstrip("=")
-            if "=" in ins.argrepr and not ins.argrepr.endswith("="):
-                raise _Unsupported(ins.argrepr)
-            if sym not in binops:
-                raise _Unsupported(f"binary {ins.argrepr}")
-            b, a = stack.pop(), stack.pop()
-            stack.append(binops[sym](a, b))
-        elif op == "UNARY_NEGATIVE":
-            stack.append(UnaryMinus(stack.pop()))
-        elif op == "COMPARE_OP":
-            sym = ins.argrepr.split()[0]
-            if sym not in cmps:
-                raise _Unsupported(f"compare {ins.argrepr}")
-            b, a = stack.pop(), stack.pop()
-            stack.append(cmps[sym](a, b))
-        elif op == "CALL":
-            argc = ins.arg
-            call_args = [stack.pop() for _ in range(argc)][::-1]
-            target = stack.pop()
-            if stack and stack[-1] is None:
+    instructions = list(dis.get_instructions(fn))
+    by_offset = {ins.offset: i for i, ins in enumerate(instructions)}
+
+    init_locals: dict[str, Expression] = {
+        name: args[i] for i, name in
+        enumerate(code.co_varnames[:code.co_argcount])}
+
+    # worklist of symbolic paths: (instr index, stack, locals, pathcond)
+    # — the CPython analog of the reference's per-basic-block State with
+    # a condition (State.scala); conditional jumps fork the path
+    paths: list[tuple[int, list, dict, Expression | None]] = [
+        (0, [], init_locals, None)]
+    returns: list[tuple[Expression | None, Expression]] = []
+    steps = 0
+
+    while paths:
+        if len(paths) + len(returns) > _MAX_PATHS:
+            raise _Unsupported("too many paths")
+        i, stack, locals_map, cond = paths.pop()
+        while True:
+            steps += 1
+            if steps > 100_000 or i >= len(instructions):
+                raise _Unsupported("no return / runaway")
+            ins = instructions[i]
+            op = ins.opname
+
+            def jump_index() -> int:
+                tgt = ins.argval  # byte offset of the jump target
+                if tgt not in by_offset:
+                    raise _Unsupported("jump target")
+                j = by_offset[tgt]
+                if j <= i:
+                    raise _Unsupported("backward jump (loop)")
+                return j
+
+            if op in ("RESUME", "NOP", "PRECALL", "CACHE", "PUSH_NULL",
+                      "COPY_FREE_VARS", "NOT_TAKEN"):
+                i += 1
+            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK",
+                        "LOAD_FAST_BORROW"):
+                if ins.argval not in locals_map:
+                    raise _Unsupported(f"unbound local {ins.argval}")
+                stack.append(locals_map[ins.argval])
+                i += 1
+            elif op in ("LOAD_FAST_LOAD_FAST",
+                        "LOAD_FAST_BORROW_LOAD_FAST_BORROW"):
+                for name in ins.argval:
+                    if name not in locals_map:
+                        raise _Unsupported(f"unbound local {name}")
+                    stack.append(locals_map[name])
+                i += 1
+            elif op == "LOAD_CONST":
+                stack.append(lit(ins.argval))
+                i += 1
+            elif op == "LOAD_GLOBAL":
+                name = ins.argval
+                if name not in allowed_globals:
+                    raise _Unsupported(f"global {name}")
+                stack.append(allowed_globals[name])
+                i += 1
+            elif op == "BINARY_OP":
+                sym = ins.argrepr.rstrip("=")
+                if "=" in ins.argrepr and not ins.argrepr.endswith("="):
+                    raise _Unsupported(ins.argrepr)
+                if sym not in binops:
+                    raise _Unsupported(f"binary {ins.argrepr}")
+                b, a = stack.pop(), stack.pop()
+                stack.append(binops[sym](a, b))
+                i += 1
+            elif op == "UNARY_NEGATIVE":
+                stack.append(UnaryMinus(stack.pop()))
+                i += 1
+            elif op == "UNARY_NOT":
+                stack.append(P.Not(_as_bool(stack.pop())))
+                i += 1
+            elif op == "TO_BOOL":
+                stack.append(_as_bool(stack.pop()))
+                i += 1
+            elif op == "COMPARE_OP":
+                # 3.13+ sets a bool-coercion bit rendered as
+                # "bool(>)"; the coercion is the TO_BOOL this machine
+                # already models, so strip the wrapper
+                sym = ins.argrepr.split()[0]
+                if sym.startswith("bool(") and sym.endswith(")"):
+                    sym = sym[5:-1]
+                if sym not in cmps:
+                    raise _Unsupported(f"compare {ins.argrepr}")
+                b, a = stack.pop(), stack.pop()
+                stack.append(cmps[sym](a, b))
+                i += 1
+            elif op == "CALL":
+                argc = ins.arg
+                call_args = [stack.pop() for _ in range(argc)][::-1]
+                target = stack.pop()
+                if stack and stack[-1] is None:
+                    stack.pop()
+                if not callable(target):
+                    raise _Unsupported("call target")
+                stack.append(target(*call_args))
+                i += 1
+            elif op == "STORE_FAST":
+                locals_map = dict(locals_map)
+                locals_map[ins.argval] = stack.pop()
+                i += 1
+            elif op == "POP_TOP":
                 stack.pop()
-            if not callable(target):
-                raise _Unsupported("call target")
-            stack.append(target(*call_args))
-        elif op in ("RETURN_VALUE",):
-            if len(stack) != 1:
-                raise _Unsupported("stack depth at return")
-            return stack[0]
-        elif op == "RETURN_CONST":
-            return lit(ins.argval)
-        elif op == "STORE_FAST":
-            locals_map[ins.argval] = stack.pop()
-        else:
-            raise _Unsupported(op)
-    raise _Unsupported("no return")
+                i += 1
+            elif op == "COPY":
+                stack.append(stack[-ins.arg])
+                i += 1
+            elif op == "SWAP":
+                stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                i += 1
+            elif op in ("JUMP_FORWARD",):
+                i = jump_index()
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                c = _as_bool(stack.pop())
+                taken = c if op.endswith("TRUE") else P.Not(c)
+                fall = P.Not(c) if op.endswith("TRUE") else c
+                j = jump_index()
+                paths.append((j, list(stack), locals_map,
+                              taken if cond is None else P.And(cond,
+                                                               taken)))
+                cond = fall if cond is None else P.And(cond, fall)
+                i += 1
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = stack.pop()
+                c = P.IsNull(v)
+                taken = c if op.endswith("IF_NONE") else P.Not(c)
+                fall = P.Not(c) if op.endswith("IF_NONE") else c
+                j = jump_index()
+                paths.append((j, list(stack), locals_map,
+                              taken if cond is None else P.And(cond,
+                                                               taken)))
+                cond = fall if cond is None else P.And(cond, fall)
+                i += 1
+            elif op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+                # short-circuit and/or (<=3.11): on the jump edge the
+                # operand STAYS on the stack as the expression value
+                v = stack[-1]
+                c = _as_bool(v)
+                is_true = op.startswith("JUMP_IF_TRUE")
+                taken = c if is_true else P.Not(c)
+                fall = P.Not(c) if is_true else c
+                j = jump_index()
+                paths.append((j, list(stack), locals_map,
+                              taken if cond is None else P.And(cond,
+                                                               taken)))
+                stack.pop()
+                cond = fall if cond is None else P.And(cond, fall)
+                i += 1
+            elif op == "RETURN_VALUE":
+                if len(stack) != 1:
+                    raise _Unsupported("stack depth at return")
+                returns.append((cond, stack[0]))
+                break
+            elif op == "RETURN_CONST":
+                returns.append((cond, lit(ins.argval)))
+                break
+            else:
+                raise _Unsupported(op)
+
+    if not returns:
+        raise _Unsupported("no return")
+    # merge return paths into a nested If (CatalystExpressionBuilder's
+    # block fold); the LAST explored path (first pushed) is the default
+    out = returns[0][1]
+    for c, v in returns[1:]:
+        out = IfExpr(c, v, out) if c is not None else v
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -227,7 +367,27 @@ def maybe_compile_udfs(exprs: Sequence[Expression], conf) -> list[Expression]:
                 # honor the declared return type either way, so the output
                 # schema is identical whether or not compilation succeeds
                 from spark_rapids_tpu.expr.cast import Cast
-                return Cast(compiled, node.return_type)
+                from spark_rapids_tpu.expr.conditional import If
+                from spark_rapids_tpu.expr.predicates import IsNull, Or
+                # null-in -> null-out guard: a branch taken on a NULL
+                # condition can yield a literal, but the interpreter
+                # fallback never calls the python fn on null inputs —
+                # results must not depend on whether compilation
+                # succeeded
+                null_any = None
+                for child in node.children:
+                    # the rewrite runs on UNBOUND expressions, where
+                    # nullable is not yet known — guard everything that
+                    # is not a provably non-null literal
+                    if isinstance(child, Literal) and child.value is not None:
+                        continue
+                    t = IsNull(child)
+                    null_any = t if null_any is None else Or(null_any, t)
+                out = Cast(compiled, node.return_type)
+                if null_any is not None:
+                    out = If(null_any,
+                             Cast(lit(None), node.return_type), out)
+                return out
         return node
 
     return [e.transform_up(rewrite) if isinstance(e, Expression) else e

@@ -39,7 +39,48 @@ def test_compile_two_args_and_abs():
 def test_unsupported_returns_none():
     assert compile_udf(lambda x: len(str(x)), [col("a")]) is None
     assert compile_udf(lambda x: [x], [col("a")]) is None
-    assert compile_udf(lambda x: x if x > 0 else -x, [col("a")]) is None
+    # loops stay unsupported (backward jump)
+    def looping(x):
+        t = 0.0
+        for _ in range(3):
+            t = t + x
+        return t
+    assert compile_udf(looping, [col("a")]) is None
+
+
+def test_compile_branches():
+    """Round-4 verdict item 6: CFG branches compile to If trees
+    (reference CFG.scala + Instruction.scala conditional handling)."""
+    assert compile_udf(lambda x: x if x > 0 else -x, [col("a")]) \
+        is not None
+    assert compile_udf(lambda x, y: x + 1 if x > y else y - 1,
+                       [col("a"), col("b")]) is not None
+    assert compile_udf(lambda x, y: 1.0 if (x > 0 and y > 0) else 0.0,
+                       [col("a"), col("b")]) is not None
+    assert compile_udf(lambda x, y: 1.0 if (x > 0 or y > 0) else 0.0,
+                       [col("a"), col("b")]) is not None
+    assert compile_udf(
+        lambda x: 0.0 if x < 0 else (1.0 if x < 10 else 2.0),
+        [col("a")]) is not None
+
+
+def test_branch_udf_matches_interpreter():
+    """Compiled branchy UDF runs on device and matches the row-at-a-time
+    interpreter, including null inputs (null in -> null out guard)."""
+    fns = [
+        (lambda x: x if x > 0 else -x, 1),
+        (lambda x, y: x + 1 if x > y else y - 1, 2),
+        (lambda x, y: 1.0 if (x > 0 and y > 0) else 0.0, 2),
+        (lambda x: 0.0 if x < 0 else (1.0 if x < 3 else 2.0), 1),
+    ]
+    for fn, nargs in fns:
+        args = [col("a"), col("b")][:nargs]
+        on = _df(_session(compiler=True)).select(
+            udf(fn, T.DoubleType())(*args).alias("u"))
+        off = _df(_session(compiler=False)).select(
+            udf(fn, T.DoubleType())(*args).alias("u"))
+        assert "PythonUDF" not in on.explain()
+        assert on.collect() == off.collect(), fn
 
 
 def test_compiled_udf_runs_on_device():
