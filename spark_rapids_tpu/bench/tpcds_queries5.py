@@ -91,10 +91,10 @@ def _lag_lead_query(session, data_dir, use_store: bool):
     from spark_rapids_tpu.expr.arithmetic import Abs
     v1, keys = _monthly_rank_frame(session, data_dir, use_store)
     lag = v1.select(*[col(k).alias(f"lag_{k}") for k in keys],
-                    (col("rn") + lit(1)).alias("lag_rn"),
+                    (col("rn") + lit(1)).cast(T.IntegerType()).alias("lag_rn"),
                     col("sum_sales").alias("psum"))
     lead = v1.select(*[col(k).alias(f"lead_{k}") for k in keys],
-                     (col("rn") - lit(1)).alias("lead_rn"),
+                     (col("rn") - lit(1)).cast(T.IntegerType()).alias("lead_rn"),
                      col("sum_sales").alias("nsum"))
     on_lag = [(k, f"lag_{k}") for k in keys] + [("rn", "lag_rn")]
     on_lead = [(k, f"lead_{k}") for k in keys] + [("rn", "lead_rn")]

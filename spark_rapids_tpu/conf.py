@@ -195,6 +195,15 @@ MESH_DEVICE_COUNT = int_conf(
     "SURVEY.md §5.8) instead of the in-process exchange. 0 disables. "
     "(ref: the UCX transport enable, RapidsConf.scala:652)")
 
+MESH_JOIN_BUILD_THRESHOLD = bytes_conf(
+    "spark.rapids.tpu.mesh.join.buildThresholdBytes", 128 << 20,
+    "Mesh joins replicate the build side to every device while it fits "
+    "under this many bytes (broadcast-style, GpuBroadcastHashJoinExec); "
+    "above it BOTH sides hash-exchange on the join keys over the mesh "
+    "and each device joins its co-partitioned shards locally "
+    "(GpuShuffledHashJoinExec.scala:162). 0 forces the partitioned "
+    "path.")
+
 UDF_COMPILER_ENABLED = bool_conf(
     "spark.rapids.sql.udfCompiler.enabled", False,
     "Compile Python UDF bytecode to native expressions when possible. "

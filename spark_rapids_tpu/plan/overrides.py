@@ -82,10 +82,13 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
                 and not _schema_has_arrays(lc.exec_node, rc.exec_node):
             # mesh mode: replicated-build join, one probe shard per
             # device (the GpuBroadcastHashJoinExec analog over ICI)
+            from spark_rapids_tpu.conf import MESH_JOIN_BUILD_THRESHOLD
             from spark_rapids_tpu.exec.mesh_exec import MeshJoinExec
             ex = MeshJoinExec(lc.exec_node, rc.exec_node, node.left_on,
                               node.right_on, node.how,
-                              conf.mesh_device_count, node.condition)
+                              conf.mesh_device_count, node.condition,
+                              build_threshold_bytes=conf.get(
+                                  MESH_JOIN_BUILD_THRESHOLD))
         else:
             ex = JoinExec(lc.exec_node, rc.exec_node, node.left_on,
                           node.right_on, node.how, node.condition)

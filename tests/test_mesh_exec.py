@@ -299,3 +299,68 @@ def test_mesh_join_feeding_non_mesh_consumer(rng):
     ov, meta = out._overridden(quiet=True)
     host = _sorted_rows(collect_host(meta.exec_node, sm.conf))
     assert dev == host and len(dev) > 0
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_mesh_join_partitioned_matches_oracle(rng, how):
+    """Partitioned mesh join (VERDICT r3 item 5): threshold 0 forces the
+    all-to-all-both-sides path (GpuShuffledHashJoinExec.scala:162
+    analog); result must equal the host oracle for every join type."""
+    from spark_rapids_tpu.exec.core import collect_host
+    sm = TpuSession({**MESH_CONF,
+                     "spark.rapids.tpu.mesh.join.buildThresholdBytes": 0})
+    fact = sm.from_pydict(_data(rng), SCHEMA, partitions=4,
+                          rows_per_batch=64)
+    out = fact.join(_dim_df(sm), on="k", how=how)
+    assert "MeshJoinExec" in out.explain()
+    dev = _sorted_rows(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    host = _sorted_rows(collect_host(meta.exec_node, sm.conf))
+    assert dev == host and len(dev) > 0
+
+
+def test_mesh_join_partitioned_large_build(rng):
+    """Build side larger than one device's fair shard still joins
+    correctly: every build row is present exactly once across the mesh
+    after the all-to-all (no replication)."""
+    from spark_rapids_tpu.exec.core import collect_host
+    sm = TpuSession({**MESH_CONF,
+                     "spark.rapids.tpu.mesh.join.buildThresholdBytes": 0})
+    n = 3000   # build side BIGGER than the stream side
+    build_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                             T.StructField("w", T.LongType(), True)])
+    build = sm.from_pydict(
+        {"k": rng.integers(0, 97, n).astype(np.int32),
+         "w": rng.integers(0, 10**6, n).astype(np.int64)},
+        build_schema, partitions=4, rows_per_batch=256)
+    probe = sm.from_pydict(_data(rng, n=300, nkeys=97), SCHEMA,
+                           partitions=2, rows_per_batch=64)
+    out = probe.join(build, on="k", how="inner") \
+        .group_by("k").agg(Sum(col("w")).alias("sw"),
+                           CountStar().alias("cnt"))
+    assert "MeshJoinExec" in out.explain()
+    dev = _sorted_rows(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    host = _sorted_rows(collect_host(meta.exec_node, sm.conf))
+    assert dev == host and len(dev) > 0
+
+
+def test_mesh_join_threshold_keeps_replicated(rng):
+    """A tiny build under the default threshold stays on the replicated
+    path (no exchange nodes execute for the build side)."""
+    from spark_rapids_tpu.exec.core import ExecCtx
+    sm, _ = _sessions()
+    fact = sm.from_pydict(_data(rng), SCHEMA, partitions=2,
+                          rows_per_batch=64)
+    out = fact.join(_dim_df(sm), on="k", how="inner")
+    ov, meta = out._overridden(quiet=True)
+    node = meta.exec_node
+    from spark_rapids_tpu.exec.mesh_exec import MeshJoinExec
+    while not isinstance(node, MeshJoinExec):
+        node = node.children[0]
+    with ExecCtx(backend="device", conf=sm.conf) as ctx:
+        list(node.partition_iter(ctx, 0))
+        assert node._use_partitioned(ctx) is False
+        # neither exchange computed its outputs (replicated path only)
+        for ex in node._exchanges:
+            assert ("meshex", id(ex), ctx.backend) not in ctx.cache
