@@ -88,10 +88,14 @@ def _jit_slice_part(sorted_batch: ColumnBatch, start, count, out_cap: int):
 class ShuffleExchangeExec(PlanNode):
     """Repartition child output by a Partitioning strategy."""
 
-    def __init__(self, partitioning: Partitioning, child: PlanNode):
+    def __init__(self, partitioning: Partitioning, child: PlanNode,
+                 shuffle_id: int | None = None):
         super().__init__([child])
         self.partitioning = partitioning
         partitioning.bind(child.output_schema)
+        # stable id for cross-process serving (two processes cannot
+        # agree on id(self)); defaults to the in-process identity
+        self.shuffle_id = shuffle_id if shuffle_id is not None else id(self)
 
     @property
     def output_schema(self) -> T.Schema:
@@ -131,7 +135,7 @@ class ShuffleExchangeExec(PlanNode):
                         _jit_slice_part, sb, jnp.asarray(starts[p], jnp.int32),
                         jnp.asarray(counts[p], jnp.int32),
                         round_capacity(int(counts[p])))
-                    transport.write_partition(id(self), bi, p, piece)
+                    transport.write_partition(self.shuffle_id, bi, p, piece)
             return transport
         out: list[list] = [[] for _ in range(n)]
         for bi, b in enumerate(batches):
@@ -154,7 +158,7 @@ class ShuffleExchangeExec(PlanNode):
         own range."""
         shuffled = self._shuffled(ctx)
         if ctx.is_device:
-            yield from shuffled.fetch_partition(id(self), pid, lo, hi)
+            yield from shuffled.fetch_partition(self.shuffle_id, pid, lo, hi)
         else:
             yield from shuffled[pid][lo:hi]
 
@@ -218,7 +222,7 @@ class AdaptiveShuffleReaderExec(PlanNode):
         shuffled = child._shuffled(ctx)  # stage barrier: materialize maps
         target = ctx.conf.get(ADVISORY_PARTITION_BYTES)
         skew_at = ctx.conf.get(SKEWED_PARTITION_THRESHOLD)
-        sizes = shuffled.partition_sizes(id(child)) \
+        sizes = shuffled.partition_sizes(child.shuffle_id) \
             if hasattr(shuffled, "partition_sizes") else None
         if not sizes:
             return identity
@@ -234,7 +238,7 @@ class AdaptiveShuffleReaderExec(PlanNode):
 
         for pid in range(n):
             sz = sizes.get(pid, 0)
-            per_batch = shuffled.batch_sizes(id(child), pid) \
+            per_batch = shuffled.batch_sizes(child.shuffle_id, pid) \
                 if (self.allow_skew_split and sz > skew_at
                     and hasattr(shuffled, "batch_sizes")) else None
             if per_batch and len(per_batch) > 1:
@@ -311,3 +315,40 @@ class BroadcastExchangeExec(PlanNode):
 
     def node_desc(self) -> str:
         return "BroadcastExchangeExec"
+
+
+class RemoteShuffleReaderExec(PlanNode):
+    """Reduce-side scan of a REMOTE peer's map output over the TCP
+    transport: the cross-process half of the accelerated shuffle
+    (reference read path: RapidsCachingReader -> RapidsShuffleIterator
+    -> transport client fetch, RapidsShuffleInternalManager.scala:307-345
+    + RapidsShuffleClient.scala).  The map side runs in another process
+    serving its partitions through TcpShuffleServer; this exec streams
+    them into the local pipeline, so a full plan executes with map tasks
+    in one process and reduce tasks in another.
+    """
+
+    def __init__(self, address, shuffle_id: int, num_parts: int,
+                 schema: T.Schema):
+        super().__init__([])
+        self.address = tuple(address)
+        self.shuffle_id = shuffle_id
+        self._num_parts = num_parts
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self._num_parts
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        from spark_rapids_tpu.shuffle.tcp import fetch_remote
+        yield from fetch_remote(self.address, self.shuffle_id, pid,
+                                device=ctx.is_device)
+
+    def node_desc(self) -> str:
+        return (f"RemoteShuffleReaderExec[{self.address[0]}:"
+                f"{self.address[1]}, shuffle={self.shuffle_id}, "
+                f"parts={self._num_parts}]")

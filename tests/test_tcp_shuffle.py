@@ -223,3 +223,105 @@ def test_tcp_fetch_timeout_on_stalled_peer():
         assert time.monotonic() - t0 < 30
     finally:
         srv.close()
+
+
+MAP_SIDE_SCRIPT = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.core import ExecCtx
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.exec.partitioning import HashPartitioning
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.session import TpuSession
+
+    # the MAP SIDE of a real plan: scan -> filter -> hash exchange,
+    # executed here and SERVED to the remote reduce process
+    s = TpuSession({"spark.rapids.shuffle.transport.class":
+                    "spark_rapids_tpu.shuffle.tcp.TcpShuffleTransport"})
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.LongType(), True)])
+    rng = np.random.default_rng(3)
+    df = s.from_pydict({"k": rng.integers(0, 13, 500).astype(np.int32),
+                        "v": rng.integers(0, 1000, 500).astype(np.int64)},
+                       schema, partitions=3) \
+        .where(col("v") >= 100)
+    ov, meta = df._overridden(quiet=True)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                             meta.exec_node, shuffle_id=777)
+    ctx = ExecCtx(backend="device", conf=s.conf)
+    transport = ex._shuffled(ctx)          # runs the map side
+    print(json.dumps({"port": transport.address[1]}), flush=True)
+    sys.stdin.readline()
+    transport.close()
+""")
+
+
+def test_distributed_query_two_processes():
+    """VERDICT r3 item 7: a full query executes distributed — map tasks
+    (scan -> filter -> hash partition) in process A served over TCP,
+    reduce tasks (group-by aggregate) in process B, equal to the
+    single-process run of the same plan (reference
+    RapidsShuffleInternalManager.scala:285-345 write/read split)."""
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    from spark_rapids_tpu.exec.exchange import RemoteShuffleReaderExec
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.session import TpuSession
+
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.LongType(), True)])
+
+    import tempfile
+    # stderr goes to a FILE, not a pipe: XLA floods stderr with
+    # multi-KB warnings (e.g. AOT-cache machine-feature mismatches)
+    # and an unread 64KB stderr pipe blocks the child BEFORE it prints
+    # the port line — deadlocking the whole test
+    err = tempfile.TemporaryFile(mode="w+")
+    p = subprocess.Popen([_sys.executable, "-c", MAP_SIDE_SCRIPT],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         stderr=err, text=True)
+    try:
+        line = p.stdout.readline()
+        err.seek(0)
+        assert line, err.read()
+        port = json.loads(line)["port"]
+
+        # reduce side: remote scan of the peer's map output -> final agg
+        s = TpuSession({})
+        reader = RemoteShuffleReaderExec(("127.0.0.1", port), 777, 4,
+                                         schema)
+        agg = HashAggregateExec(
+            [col("k")], [col("k"), Sum(col("v")).alias("sv"),
+                         CountStar().alias("cnt")], reader)
+        with ExecCtx(backend="device", conf=s.conf) as ctx:
+            rows = []
+            from spark_rapids_tpu.exec.core import device_to_host, \
+                _rows_from_host
+            for pid in range(agg.num_partitions(ctx)):
+                for b in agg.partition_iter(ctx, pid):
+                    rows.extend(_rows_from_host(device_to_host(b)))
+
+        # oracle: same data + plan in ONE process
+        import numpy as np
+        rng = np.random.default_rng(3)
+        want = TpuSession({}).from_pydict(
+            {"k": rng.integers(0, 13, 500).astype(np.int32),
+             "v": rng.integers(0, 1000, 500).astype(np.int64)},
+            schema, partitions=3) \
+            .where(col("v") >= 100).group_by("k") \
+            .agg(Sum(col("v")).alias("sv"), CountStar().alias("cnt")) \
+            .collect()
+        assert sorted(rows) == sorted(want) and len(rows) == 13
+    finally:
+        try:
+            p.stdin.close()
+        except OSError:
+            pass
+        p.wait(timeout=30)

@@ -1,0 +1,46 @@
+"""TPC-H suite: generator sanity + queries verify vs host oracle.
+
+Reference test pattern: tpch_test.py wraps TpchLikeSpark queries as
+assertions (integration_tests/src/main/python/tpch_test.py).  Default
+runs a smoke subset; TPCH_FULL=1 sweeps all 22 (committed full pass:
+artifacts/tpch_22_sf001_verify.txt).
+"""
+import os
+
+import pytest
+
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch, table_row_counts
+from spark_rapids_tpu.bench.tpch_queries import TPCH_QUERIES
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch") / "sf001")
+    generate_tpch(d, sf=0.01)
+    return d
+
+
+def test_row_counts_scale():
+    c1 = table_row_counts(1.0)
+    assert c1["lineitem"] == 6_000_000
+    assert c1["nation"] == 25 and c1["region"] == 5
+    assert table_row_counts(0.1)["orders"] == 150_000
+
+
+def test_all_22_queries_registered():
+    assert len(TPCH_QUERIES) == 22
+    assert all(f"q{i}" in TPCH_QUERIES for i in range(1, 23))
+
+
+_SMOKE = ["q1", "q3", "q6", "q13", "q16", "q18", "q21"]
+_SUITE = sorted(TPCH_QUERIES) if os.environ.get("TPCH_FULL") == "1" \
+    else _SMOKE
+
+
+@pytest.mark.parametrize("query", _SUITE)
+def test_query_device_matches_oracle(data_dir, query):
+    r = run_benchmark(data_dir, 0.01, [query], verify=True,
+                      generate=False, suite="tpch")[0]
+    assert "error" not in r, r
+    assert r["ok"], r
