@@ -17,7 +17,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.core import (ExecCtx, PlanNode, host_to_device)
-from spark_rapids_tpu.expr.core import (Expression, bind, eval_device,
+from spark_rapids_tpu.expr.core import (Alias, Expression, bind, eval_device,
                                         eval_host, output_name)
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
 from spark_rapids_tpu.ops import host_kernels as hk
@@ -201,6 +201,37 @@ class ProjectExec(PlanNode):
                                         node.dtype, False))
         return HostBatch(cols, T.Schema(fields))
 
+    @property
+    def output_batching(self):
+        # 1:1 batch mapping: whatever batching contract the child
+        # satisfies, the projection's output satisfies too (keeps the
+        # planner from inserting a coalesce that would destroy the
+        # child's ordering between an aggregate pair)
+        return self.children[0].output_batching
+
+    @property
+    def output_ordering(self):
+        """Elementwise projection preserves row order; the child's
+        clustering survives through columns projected as plain
+        references (possibly renamed)."""
+        from spark_rapids_tpu.expr.core import BoundReference
+        child_ord = self.children[0].output_ordering
+        if not child_ord:
+            return None
+        child_names = self.children[0].output_schema.names
+        renames: dict[str, str] = {}
+        for b, out in zip(self._bound, self._schema.names):
+            inner = b.children[0] if isinstance(b, Alias) else b
+            if isinstance(inner, BoundReference) \
+                    and inner.index < len(child_names):
+                renames.setdefault(child_names[inner.index], out)
+        names = []
+        for n in child_ord:
+            if n not in renames:
+                break
+            names.append(renames[n])
+        return names or None
+
     def node_desc(self) -> str:
         return f"ProjectExec[{self._schema.names}]"
 
@@ -226,6 +257,18 @@ class FilterExec(PlanNode):
     @property
     def output_schema(self) -> T.Schema:
         return self.children[0].output_schema
+
+    @property
+    def output_ordering(self):
+        # front-pack compaction is a stable permutation: surviving rows
+        # keep their relative order, so the child's clustering holds
+        return self.children[0].output_ordering
+
+    @property
+    def output_batching(self):
+        # 1:1 batch mapping (fewer rows per batch never violates a goal
+        # the child's batching already satisfied)
+        return self.children[0].output_batching
 
     def _jit_fn(self):
         if not hasattr(self, "_filter_jit"):
@@ -356,6 +399,10 @@ class LocalLimitExec(PlanNode):
     @property
     def output_schema(self) -> T.Schema:
         return self.children[0].output_schema
+
+    @property
+    def output_ordering(self):
+        return self.children[0].output_ordering  # prefix slice
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         yield from _limited(ctx, self.children[0].partition_iter(ctx, pid),

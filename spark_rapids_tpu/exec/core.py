@@ -317,6 +317,18 @@ class PlanNode:
     def bound_exprs(self) -> list:
         return []
 
+    @property
+    def output_ordering(self) -> list | None:
+        """Column names such that, WITHIN each emitted batch, rows equal
+        on any prefix of them are contiguous (a lexicographic sort by
+        these columns guarantees it).  None = no guarantee.  Downstream
+        sort-based group-bys use this to skip their re-sort when the
+        child already clusters the grouping keys — the reference keeps
+        the analogous sort-order metadata on SparkPlan.outputOrdering
+        and GpuSortAggregate picks merge-aggregation off it
+        (aggregate.scala:348-560)."""
+        return None
+
     #: True when this operator JITs multiple input batches together
     #: (concat, merge, build-side materialization) — such programs need
     #: same-device inputs, so the planner aligns mesh-committed batches

@@ -68,6 +68,13 @@ class SortExec(PlanNode):
     def children_coalesce_goal(self) -> list[CoalesceGoal | None]:
         return [RequireSingleBatch if self._global else None]
 
+    @property
+    def output_ordering(self):
+        """Each emitted batch is lexicographically sorted by the sort
+        keys — equal keys are contiguous regardless of direction."""
+        return [self.output_schema.names[o.child_index]
+                for o in self._orders]
+
     def num_partitions(self, ctx: ExecCtx) -> int:
         # a global sort is a TOTAL order: the output is one partition.
         # Sorting each input partition independently and letting a limit

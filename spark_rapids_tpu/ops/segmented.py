@@ -65,18 +65,27 @@ def _cols_differ(col: DeviceColumn) -> jax.Array:
 
 
 def sorted_group_by(batch: ColumnBatch, key_indices: list[int],
-                    aggs: list[AggSpec]) -> ColumnBatch:
+                    aggs: list[AggSpec],
+                    presorted: bool = False) -> ColumnBatch:
     """Group ``batch`` by key columns, computing ``aggs``.
 
     Output schema: key columns (original names/types) then one column per
     agg. Output capacity == input capacity; num_rows == number of groups.
     Grand aggregates (no keys) produce exactly one row, even on empty input
     (reference "reduction default-values path", aggregate.scala:514+).
+
+    ``presorted``: the caller guarantees rows equal on the key columns
+    are already contiguous (PlanNode.output_ordering) — segment
+    detection only needs contiguity, so the O(n log n) sort is skipped
+    (the reference's sort-aggregate-over-sorted-input fast path).
     """
     cap = batch.capacity
     if key_indices:
-        orders = [SortOrder(i, True, True) for i in key_indices]
-        sb = sort_batch(batch, orders)
+        if presorted:
+            sb = batch
+        else:
+            orders = [SortOrder(i, True, True) for i in key_indices]
+            sb = sort_batch(batch, orders)
         real = sb.row_mask()
         idx = jnp.arange(cap, dtype=jnp.int32)
         differ = jnp.zeros(cap, jnp.bool_)
