@@ -13,6 +13,7 @@ drain worker threads.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Callable, Iterator, Sequence
 
 import numpy as np
@@ -24,7 +25,10 @@ from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.expr.core import Expression, bind
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
 
-__all__ = ["PandasUDF", "pandas_udf", "ArrowEvalPythonExec"]
+__all__ = ["PandasUDF", "pandas_udf", "ArrowEvalPythonExec",
+           "PandasAggUDF", "pandas_agg_udf", "MapInPandasExec",
+           "FlatMapGroupsInPandasExec", "AggregateInPandasExec",
+           "FlatMapCoGroupsInPandasExec"]
 
 CONCURRENT_PYTHON = register(ConfEntry(
     "spark.rapids.python.concurrentPythonWorkers", 2,
@@ -40,6 +44,30 @@ def _py_semaphore(n: int) -> threading.BoundedSemaphore:
         if n not in _sems:
             _sems[n] = threading.BoundedSemaphore(n)
         return _sems[n]
+
+
+_slot_tls = threading.local()
+
+
+@contextmanager
+def _udf_slot(sem: threading.BoundedSemaphore):
+    """Per-thread REENTRANT semaphore hold: a chain of streaming pandas
+    execs in one thread (map_in_pandas over map_in_pandas) pulls child
+    batches while the downstream UDF slot is held — counting each level
+    against the semaphore would self-deadlock once the chain is longer
+    than the permit count, so the whole chain consumes ONE worker slot
+    (the reference's semaphore also counts python WORKERS, not plan
+    depth — PythonWorkerSemaphore.scala:42-100)."""
+    depth = getattr(_slot_tls, "depth", 0)
+    if depth == 0:
+        sem.acquire()
+    _slot_tls.depth = depth + 1
+    try:
+        yield
+    finally:
+        _slot_tls.depth = depth
+        if depth == 0:
+            sem.release()
 
 
 class PandasUDF(Expression):
@@ -73,6 +101,20 @@ class PandasUDF(Expression):
     def __repr__(self):
         name = getattr(self.fn, "__name__", "<lambda>")
         return f"PandasUDF({name}, {', '.join(map(repr, self.children))})"
+
+
+def _host_col_to_series(v):
+    """HostColumn -> pandas Series with nulls surfaced as None/NaN
+    (numeric columns upcast to float64 only when nulls are present)."""
+    import pandas as pd
+    if isinstance(v.dtype, T.StringType):
+        return pd.Series(v.data)
+    data = v.data.astype("float64") if not np.all(v.validity) \
+        and v.dtype.numeric else v.data
+    s = pd.Series(data)
+    if not np.all(v.validity):
+        s[~np.asarray(v.validity)] = None
+    return s
 
 
 def pandas_udf(fn: Callable, return_type: T.DataType | None = None):
@@ -115,28 +157,15 @@ class ArrowEvalPythonExec(PlanNode):
         return [c for _, u in self._udfs for c in u.children]
 
     def _series_inputs(self, hb: HostBatch, u: PandasUDF):
-        import pandas as pd
         from spark_rapids_tpu.expr.core import eval_host
-        out = []
-        for c in u.children:
-            v = eval_host(c, hb)
-            if isinstance(v.dtype, T.StringType):
-                out.append(pd.Series(v.data))
-            else:
-                data = v.data.astype("float64") if not np.all(v.validity) \
-                    and v.dtype.numeric else v.data
-                s = pd.Series(data)
-                if not np.all(v.validity):
-                    s[~v.validity] = None
-                out.append(s)
-        return out
+        return [_host_col_to_series(eval_host(c, hb)) for c in u.children]
 
     def _apply_udfs(self, hb: HostBatch, ctx: ExecCtx) -> HostBatch:
         import pandas as pd
         sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
         cols = list(hb.columns)
         for name, u in self._udfs:
-            with sem:
+            with _udf_slot(sem):
                 result = u.fn(*self._series_inputs(hb, u))
             r = pd.Series(result)
             if len(r) != hb.num_rows:
@@ -164,3 +193,342 @@ class ArrowEvalPythonExec(PlanNode):
 
     def node_desc(self) -> str:
         return (f"ArrowEvalPythonExec[{[n for n, _ in self._udfs]}]")
+
+
+# ---------------------------------------------------------------------------
+# pandas exec family: iterator / grouped / cogrouped / aggregating variants
+# (reference sql-plugin .../execution/python/: GpuMapInPandasExec.scala:141,
+# GpuFlatMapGroupsInPandasExec.scala:180, GpuAggregateInPandasExec.scala:198,
+# GpuFlatMapCoGroupsInPandasExec.scala:167 — all stream device batches over
+# the Arrow boundary to pandas workers; here the worker is in-process and
+# the semaphore bounds concurrent UDF evaluation the same way)
+# ---------------------------------------------------------------------------
+
+def _to_pandas(hb: HostBatch):
+    return hb.to_arrow().to_pandas()
+
+
+def _from_pandas(pdf, schema: T.Schema, what: str) -> HostBatch:
+    """Validate + convert a UDF's output DataFrame against the declared
+    schema: labeled columns match by NAME, unlabeled (RangeIndex) by
+    position — Spark's assignment rules for mapInPandas/applyInPandas."""
+    import pandas as pd
+    import pyarrow as pa
+    if not isinstance(pdf, pd.DataFrame):
+        raise TypeError(f"{what} must produce pandas DataFrames, got "
+                        f"{type(pdf).__name__}")
+    names = list(schema.names)
+    if all(isinstance(c, int) for c in pdf.columns):
+        if len(pdf.columns) != len(names):
+            raise ValueError(
+                f"{what} returned {len(pdf.columns)} unlabeled columns "
+                f"for schema {names}")
+        pdf = pdf.set_axis(names, axis=1)
+    else:
+        missing = [n for n in names if n not in pdf.columns]
+        if missing:
+            raise ValueError(f"{what} output is missing columns {missing} "
+                             f"(has {list(pdf.columns)})")
+        pdf = pdf[names]
+    arrays = [pa.array(pdf[n], type=T.to_arrow(f.data_type),
+                       from_pandas=True) for n, f in zip(names, schema)]
+    rb = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
+    return HostBatch.from_arrow(rb)
+
+
+def _host_batches(node: PlanNode, ctx: ExecCtx, pid: int):
+    from spark_rapids_tpu.exec.core import device_to_host
+    for b in node.partition_iter(ctx, pid):
+        yield device_to_host(b) if ctx.is_device else b
+
+
+def _emit(hb: HostBatch, ctx: ExecCtx):
+    from spark_rapids_tpu.exec.core import host_to_device
+    return host_to_device(hb) if ctx.is_device else hb
+
+
+class MapInPandasExec(PlanNode):
+    """df.map_in_pandas(fn, schema): ``fn`` receives an ITERATOR of
+    pandas DataFrames (one partition's batches) and yields DataFrames
+    conforming to ``schema`` — output row count is unconstrained
+    (reference GpuMapInPandasExec.scala:60-141)."""
+
+    def __init__(self, fn: Callable, out_schema: T.Schema, child: PlanNode):
+        super().__init__([child])
+        self._fn = fn
+        self._schema = out_schema
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        # materialize the partition's inputs BEFORE taking a worker
+        # slot: next(it) runs arbitrary UDF code, and if it also pulled
+        # un-executed upstream stages (a shuffle drain on other worker
+        # threads, themselves competing for permits) a held permit
+        # could deadlock the pool — with a plain list the pull is pure
+        # python (review finding; FlatMapGroups/Aggregate already
+        # materialize their partition the same way)
+        pdfs = [_to_pandas(hb) for hb in
+                _host_batches(self.children[0], ctx, pid)]
+        it = self._fn(iter(pdfs))
+        while True:
+            # slot held only around the UDF body (runs inside next()
+            # for generator UDFs); reentrant so chained pandas execs in
+            # one thread consume a single worker slot
+            with _udf_slot(sem):
+                try:
+                    out = next(it)
+                except StopIteration:
+                    return
+            hb = _from_pandas(out, self._schema, "map_in_pandas")
+            if hb.num_rows:
+                yield _emit(hb, ctx)
+
+    def node_desc(self) -> str:
+        name = getattr(self._fn, "__name__", "<lambda>")
+        return f"MapInPandasExec[{name}]"
+
+
+def _group_frames(pdf, key_names: list):
+    """Per-group sub-frames, null keys kept as their own groups and
+    group order deterministic (sorted, nulls last — pandas sort=True)."""
+    return pdf.groupby(list(key_names), dropna=False, sort=True)
+
+
+class FlatMapGroupsInPandasExec(PlanNode):
+    """group_by(keys).apply_in_pandas(fn, schema): ``fn`` receives each
+    group as one pandas DataFrame (ALL child columns, keys included) and
+    returns a DataFrame conforming to ``schema``.  The planner inserts a
+    hash exchange on the keys so each group lands wholly in one
+    partition (reference GpuFlatMapGroupsInPandasExec.scala:75
+    requiredChildDistribution = ClusteredDistribution)."""
+
+    def __init__(self, key_names: Sequence[str], fn: Callable,
+                 out_schema: T.Schema, child: PlanNode):
+        super().__init__([child])
+        self._keys = list(key_names)
+        self._fn = fn
+        self._schema = out_schema
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        batches = list(_host_batches(self.children[0], ctx, pid))
+        if not batches:
+            return
+        pdf = _to_pandas(HostBatch.concat(batches))
+        if not len(pdf):
+            return
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        for _, g in _group_frames(pdf, self._keys):
+            with _udf_slot(sem):
+                out = self._fn(g.reset_index(drop=True))
+            hb = _from_pandas(out, self._schema, "apply_in_pandas")
+            if hb.num_rows:
+                yield _emit(hb, ctx)
+
+    def node_desc(self) -> str:
+        return f"FlatMapGroupsInPandasExec[keys={self._keys}]"
+
+
+class PandasAggUDF(Expression):
+    """Grouped-aggregate pandas UDF: Series in, ONE scalar out per
+    group — planned into AggregateInPandasExec, never evaluated inline
+    (reference GpuAggregateInPandasExec's PythonUDAF plan)."""
+
+    sql_name = "PandasAggUDF"
+
+    def __init__(self, fn: Callable, children: Sequence[Expression],
+                 return_type: T.DataType):
+        self.fn = fn
+        self.children = tuple(children)
+        self.return_type = return_type
+
+    def with_new_children(self, children):
+        return PandasAggUDF(self.fn, children, self.return_type)
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _eval(self, vals, ctx):
+        raise ValueError("PandasAggUDF must be planned by "
+                         "AggregateInPandasExec (use it in group_by("
+                         ").agg())")
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "<lambda>")
+        return f"PandasAggUDF({name}, {', '.join(map(repr, self.children))})"
+
+
+def pandas_agg_udf(fn: Callable, return_type: T.DataType | None = None):
+    """``df.group_by("k").agg(pandas_agg_udf(lambda s: s.mean())(col("v"))
+    .alias("m"))`` — ``fn`` receives pandas Series and returns one
+    scalar per group."""
+
+    def apply(*cols):
+        return PandasAggUDF(fn, list(cols), return_type or T.DoubleType())
+
+    return apply
+
+
+class AggregateInPandasExec(PlanNode):
+    """One output row per group: key columns + one column per pandas
+    aggregate UDF (Series -> scalar).  A black-box aggregate cannot be
+    split partial/final, so the planner clusters rows by key first
+    (reference GpuAggregateInPandasExec.scala:63-198)."""
+
+    def __init__(self, key_names: Sequence[str], udfs: Sequence,
+                 child: PlanNode):
+        super().__init__([child])
+        self._keys = list(key_names)
+        cs = child.output_schema
+        self._udfs = []  # (name, PandasAggUDF bound to child schema)
+        fields = [cs.field(k) for k in self._keys]
+        for name, u in udfs:
+            bound = [bind(c, cs) for c in u.children]
+            self._udfs.append((name, PandasAggUDF(u.fn, bound,
+                                                  u.return_type)))
+            fields.append(T.StructField(name, u.return_type, True))
+        self._schema = T.Schema(fields)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def bound_exprs(self):
+        return [c for _, u in self._udfs for c in u.children]
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        import pandas as pd
+        from spark_rapids_tpu.expr.core import eval_host
+        batches = list(_host_batches(self.children[0], ctx, pid))
+        if not batches:
+            if self._keys:
+                return
+            # keyless grand aggregate over empty input still produces
+            # ONE row — the UDF sees empty Series (Spark global
+            # aggregation semantics; this engine's HashAggregateExec
+            # default-values row does the same)
+            batches = [HostBatch.empty(self.children[0].output_schema)]
+        hb = HostBatch.concat(batches)
+        if not hb.num_rows and self._keys:
+            return
+        # key columns + each UDF's evaluated input series, side by side
+        # (keys convert individually — a whole-batch _to_pandas would
+        # pay for every non-key column just to read the keys)
+        frame = {}
+        for k in self._keys:
+            frame[k] = _host_col_to_series(
+                hb.columns[hb.schema.index_of(k)])
+        in_names: list[list[str]] = []
+        for ui, (name, u) in enumerate(self._udfs):
+            cols = []
+            for ci, c in enumerate(u.children):
+                s = _host_col_to_series(eval_host(c, hb))
+                cn = f"_in_{ui}_{ci}"
+                frame[cn] = s
+                cols.append(cn)
+            in_names.append(cols)
+        pdf = pd.DataFrame(frame, index=range(hb.num_rows))
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        rows: dict[str, list] = {n: [] for n in self._schema.names}
+        if self._keys:
+            groups = _group_frames(pdf, self._keys)
+        else:
+            groups = [((), pdf)]
+        for key, g in groups:
+            if not isinstance(key, tuple):
+                key = (key,)
+            for k, kv in zip(self._keys, key):
+                rows[k].append(None if pd.isna(kv) else kv)
+            for (name, u), cols in zip(self._udfs, in_names):
+                with _udf_slot(sem):
+                    r = u.fn(*[g[c] for c in cols])
+                rows[name].append(None if r is None or
+                                  (np.isscalar(r) and pd.isna(r)) else r)
+        out = pd.DataFrame({n: pd.Series(rows[n]) for n in
+                            self._schema.names})
+        hb_out = _from_pandas(out, self._schema, "pandas agg")
+        if hb_out.num_rows:
+            yield _emit(hb_out, ctx)
+
+    def node_desc(self) -> str:
+        return (f"AggregateInPandasExec[keys={self._keys}, "
+                f"aggs={[n for n, _ in self._udfs]}]")
+
+
+def _null_safe_key(key) -> tuple:
+    """Normalize a group-key tuple so null keys compare equal across the
+    two cogrouped sides (NaN != NaN would otherwise split them)."""
+    import pandas as pd
+    if not isinstance(key, tuple):
+        key = (key,)
+    return tuple("\x00<null>" if pd.isna(k) else k for k in key)
+
+
+class FlatMapCoGroupsInPandasExec(PlanNode):
+    """df1.group_by(k).cogroup(df2.group_by(k)).apply_in_pandas(fn,
+    schema): ``fn(left_pdf, right_pdf)`` once per key present on EITHER
+    side; the absent side arrives as an empty DataFrame with its full
+    column set (reference GpuFlatMapCoGroupsInPandasExec.scala:70-167,
+    requiredChildDistribution clusters both children on their keys)."""
+
+    def __init__(self, left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn: Callable, out_schema: T.Schema, left: PlanNode,
+                 right: PlanNode):
+        super().__init__([left, right])
+        self._lkeys = list(left_keys)
+        self._rkeys = list(right_keys)
+        self._fn = fn
+        self._schema = out_schema
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.children[0].num_partitions(ctx)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        def side_groups(node, keys):
+            batches = list(_host_batches(node, ctx, pid))
+            empty = _to_pandas(HostBatch.empty(node.output_schema))
+            if not batches:
+                return {}, empty
+            pdf = _to_pandas(HostBatch.concat(batches))
+            if not len(pdf):
+                return {}, empty
+            return {_null_safe_key(k): g.reset_index(drop=True)
+                    for k, g in _group_frames(pdf, keys)}, empty
+
+        lgroups, lempty = side_groups(self.children[0], self._lkeys)
+        rgroups, rempty = side_groups(self.children[1], self._rkeys)
+        keys = sorted(set(lgroups) | set(rgroups), key=repr)
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        for k in keys:
+            # absent side gets a fresh copy: UDFs commonly mutate their
+            # input in place, and a shared empty frame would leak those
+            # mutations into later calls (review finding)
+            lg = lgroups.get(k)
+            rg = rgroups.get(k)
+            with _udf_slot(sem):
+                out = self._fn(lg if lg is not None else lempty.copy(),
+                               rg if rg is not None else rempty.copy())
+            hb = _from_pandas(out, self._schema, "cogroup apply_in_pandas")
+            if hb.num_rows:
+                yield _emit(hb, ctx)
+
+    def node_desc(self) -> str:
+        return (f"FlatMapCoGroupsInPandasExec[{self._lkeys} x "
+                f"{self._rkeys}]")

@@ -16,7 +16,8 @@ from spark_rapids_tpu.expr.core import Expression
 
 __all__ = ["LogicalPlan", "Scan", "Project", "Filter", "Aggregate", "Join",
            "Sort", "Limit", "Union", "Window", "Repartition", "Expand",
-           "Generate"]
+           "Generate", "MapInPandas", "FlatMapGroupsInPandas",
+           "AggregateInPandas", "FlatMapCoGroupsInPandas"]
 
 
 class LogicalPlan:
@@ -166,6 +167,74 @@ class Generate(LogicalPlan):
     @property
     def children(self):
         return (self.child,)
+
+
+@dataclass
+class MapInPandas(LogicalPlan):
+    """fn(iterator of pandas DataFrames) -> iterator of DataFrames
+    (reference GpuMapInPandasExec)."""
+    fn: object
+    out_schema: T.Schema
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.out_schema
+
+
+@dataclass
+class FlatMapGroupsInPandas(LogicalPlan):
+    """group_by(keys).apply_in_pandas(fn, schema) (reference
+    GpuFlatMapGroupsInPandasExec)."""
+    keys: list
+    fn: object
+    out_schema: T.Schema
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.out_schema
+
+
+@dataclass
+class AggregateInPandas(LogicalPlan):
+    """group_by(keys).agg(pandas_agg_udf...) (reference
+    GpuAggregateInPandasExec)."""
+    keys: list
+    udfs: list  # (output name, PandasAggUDF)
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclass
+class FlatMapCoGroupsInPandas(LogicalPlan):
+    """cogroup(...).apply_in_pandas(fn, schema) (reference
+    GpuFlatMapCoGroupsInPandasExec)."""
+    left_keys: list
+    right_keys: list
+    fn: object
+    out_schema: T.Schema
+    left: LogicalPlan
+    right: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def schema(self):
+        return self.out_schema
 
 
 @dataclass

@@ -1,0 +1,315 @@
+"""Pandas exec family: mapInPandas / applyInPandas / grouped agg /
+cogroup (reference sql-plugin .../execution/python/:
+GpuMapInPandasExec.scala, GpuFlatMapGroupsInPandasExec.scala,
+GpuAggregateInPandasExec.scala, GpuFlatMapCoGroupsInPandasExec.scala;
+test model: udf_test.py + udf_cudf_test.py differential asserts)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.exec.python_exec import pandas_agg_udf
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.session import TpuSession
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.DoubleType(), True)])
+
+
+def _df(s, n=60, parts=3, null_keys=False):
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, 6, n).astype(np.int32)
+    data = {"k": k, "v": rng.normal(size=n)}
+    df = s.from_pydict(data, SCHEMA, partitions=parts)
+    if null_keys:
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.core import Literal, lit
+        df = df.select(
+            If(col("k") >= lit(np.int32(5)),
+               Literal(None, T.IntegerType()),
+               col("k")).alias("k"), col("v"))
+    return df
+
+
+def _pandas_oracle(df):
+    rows = df.collect()
+    return pd.DataFrame({"k": pd.array([r[0] for r in rows],
+                                       dtype="Int64"),
+                         "v": [r[1] for r in rows]})
+
+
+# -- map_in_pandas -----------------------------------------------------------
+
+def test_map_in_pandas_device_matches_host():
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                           T.StructField("v2", T.DoubleType(), True)])
+
+    def fn(it):
+        for pdf in it:
+            sub = pdf[pdf["v"] > 0]          # row count may change
+            yield pd.DataFrame({"k": sub["k"], "v2": sub["v"] * 2})
+
+    out = _df(s).map_in_pandas(fn, out_schema)
+    assert "MapInPandasExec" in out.explain()
+    dev = sorted(out.collect())
+    ov, meta = out._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf))
+    assert dev == host
+    base = _pandas_oracle(_df(s))
+    assert len(dev) == int((base["v"] > 0).sum())
+
+
+def test_map_in_pandas_positional_columns():
+    """Unlabeled (RangeIndex) output columns match the schema by
+    position — Spark's assignment rule."""
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("a", T.IntegerType(), True),
+                           T.StructField("b", T.DoubleType(), True)])
+
+    def fn(it):
+        for pdf in it:
+            out = pd.concat([pdf["k"], pdf["v"]], axis=1)
+            out.columns = range(2)
+            yield out
+
+    rows = _df(s).map_in_pandas(fn, out_schema).collect()
+    assert len(rows) == 60
+
+
+def test_map_in_pandas_missing_column_fails():
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("nope", T.DoubleType(), True)])
+
+    def fn(it):
+        for pdf in it:
+            yield pd.DataFrame({"other": pdf["v"]})
+
+    with pytest.raises(Exception, match="missing columns"):
+        _df(s).map_in_pandas(fn, out_schema).collect()
+
+
+def test_map_in_pandas_fallback_when_disabled():
+    s = TpuSession({"spark.rapids.sql.exec.MapInPandasExec": "false"})
+    out_schema = T.Schema([T.StructField("v2", T.DoubleType(), True)])
+
+    def fn(it):
+        for pdf in it:
+            yield pd.DataFrame({"v2": pdf["v"] + 1})
+
+    out = _df(s).map_in_pandas(fn, out_schema)
+    text = out.explain()
+    assert "! MapInPandasExec" in text
+    assert "spark.rapids.sql.exec.MapInPandasExec is disabled" in text
+    assert len(out.collect()) == 60
+
+
+# -- apply_in_pandas ---------------------------------------------------------
+
+def test_apply_in_pandas_matches_pandas_groupby():
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                           T.StructField("demeaned", T.DoubleType(), True),
+                           T.StructField("n", T.LongType(), True)])
+
+    def fn(pdf):
+        return pd.DataFrame({"k": pdf["k"],
+                             "demeaned": pdf["v"] - pdf["v"].mean(),
+                             "n": len(pdf)})
+
+    df = _df(s)
+    out = df.group_by("k").apply_in_pandas(fn, out_schema)
+    ex = out.explain()
+    assert "FlatMapGroupsInPandasExec" in ex
+    # groups must be clustered: the planner inserts a hash exchange
+    assert "ShuffleExchangeExec" in ex
+    got = sorted(out.collect())
+    base = _pandas_oracle(df)
+    want = []
+    for k, g in base.groupby("k"):
+        for v in g["v"]:
+            want.append((int(k), v - g["v"].mean(), len(g)))
+    assert len(got) == len(want)
+    for a, b in zip(got, sorted(want)):
+        assert a[0] == b[0] and abs(a[1] - b[1]) < 1e-9 and a[2] == b[2]
+
+
+def test_apply_in_pandas_null_keys_form_a_group():
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("n", T.LongType(), True)])
+
+    def fn(pdf):
+        return pd.DataFrame({"n": [len(pdf)]})
+
+    df = _df(s, null_keys=True)
+    got = sorted(r[0] for r in
+                 df.group_by("k").apply_in_pandas(fn, out_schema).collect())
+    base = _pandas_oracle(df)
+    want = sorted(base.groupby("k", dropna=False).size().tolist())
+    assert got == want
+    # 6 groups: keys 0..4 plus the null group
+    assert len(got) == 6
+
+
+def test_apply_in_pandas_expression_key_rejected():
+    s = TpuSession({})
+    out_schema = T.Schema([T.StructField("n", T.LongType(), True)])
+    with pytest.raises(NotImplementedError, match="plain column"):
+        _df(s).group_by(col("k") + col("k")).apply_in_pandas(
+            lambda p: pd.DataFrame({"n": [len(p)]}), out_schema)
+
+
+# -- grouped aggregate pandas UDFs ------------------------------------------
+
+def test_pandas_agg_udf_matches_oracle():
+    s = TpuSession({})
+    med = pandas_agg_udf(lambda v: v.median(), T.DoubleType())
+    iqr = pandas_agg_udf(lambda v: v.quantile(0.75) - v.quantile(0.25),
+                         T.DoubleType())
+    df = _df(s)
+    out = df.group_by("k").agg(med(col("v")).alias("med"),
+                               iqr(col("v")).alias("iqr"))
+    assert "AggregateInPandasExec" in out.explain()
+    got = {r[0]: (r[1], r[2]) for r in out.collect()}
+    base = _pandas_oracle(df)
+    for k, g in base.groupby("k"):
+        m, q = got[int(k)]
+        assert abs(m - g["v"].median()) < 1e-9
+        assert abs(q - (g["v"].quantile(0.75) -
+                        g["v"].quantile(0.25))) < 1e-9
+
+
+def test_pandas_agg_udf_grand_aggregate_single_row():
+    s = TpuSession({})
+    total = pandas_agg_udf(lambda v: float(v.sum()), T.DoubleType())
+    df = _df(s)
+    rows = df.agg(total(col("v")).alias("t")).collect()
+    assert len(rows) == 1
+    base = _pandas_oracle(df)
+    assert abs(rows[0][0] - base["v"].sum()) < 1e-9
+
+
+def test_pandas_agg_udf_mixed_with_builtin_rejected():
+    from spark_rapids_tpu.expr.aggregates import Sum
+    s = TpuSession({})
+    m = pandas_agg_udf(lambda v: v.mean(), T.DoubleType())
+    with pytest.raises(NotImplementedError, match="mixing"):
+        _df(s).group_by("k").agg(m(col("v")).alias("a"),
+                                 Sum(col("v")).alias("b"))
+
+
+# -- cogroup -----------------------------------------------------------------
+
+def test_cogroup_apply_in_pandas():
+    s = TpuSession({})
+    right_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                             T.StructField("w", T.DoubleType(), True)])
+    # right side has keys 4..9: keys 0..3 left-only, 6..9 right-only
+    right = s.from_pydict(
+        {"k": np.arange(4, 10, dtype=np.int32),
+         "w": np.arange(6, dtype=np.float64)}, right_schema, partitions=2)
+    out_schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                           T.StructField("nl", T.LongType(), True),
+                           T.StructField("nr", T.LongType(), True)])
+
+    def fn(l, r):
+        assert list(l.columns) == ["k", "v"]      # full column sets,
+        assert list(r.columns) == ["k", "w"]      # even when empty
+        k = l["k"].iloc[0] if len(l) else r["k"].iloc[0]
+        return pd.DataFrame({"k": [k], "nl": [len(l)], "nr": [len(r)]})
+
+    df = _df(s)
+    out = df.group_by("k").cogroup(right.group_by("k")).apply_in_pandas(
+        fn, out_schema)
+    assert "FlatMapCoGroupsInPandasExec" in out.explain()
+    got = {r[0]: (r[1], r[2]) for r in out.collect()}
+    base = _pandas_oracle(df)
+    counts = base.groupby("k").size()
+    assert set(got) == set(range(10))
+    for k in range(10):
+        nl = int(counts.get(k, 0))
+        nr = 1 if 4 <= k <= 9 else 0
+        assert got[k] == (nl, nr), k
+
+
+def test_cogroup_key_arity_mismatch_rejected():
+    s = TpuSession({})
+    with pytest.raises(ValueError, match="same number of keys"):
+        _df(s).group_by("k").cogroup(_df(s).group_by("k", "v"))
+
+
+# -- review-finding regressions ---------------------------------------------
+
+def test_chained_map_in_pandas_no_deadlock():
+    """Three chained map_in_pandas with concurrentPythonWorkers=2: the
+    streaming chain must consume ONE worker slot (reentrant hold), not
+    one per level — holding a permit per level self-deadlocks."""
+    s = TpuSession({"spark.rapids.python.concurrentPythonWorkers": "2"})
+    sch = T.Schema([T.StructField("v", T.DoubleType(), True)])
+
+    def step(delta):
+        def fn(it):
+            for pdf in it:
+                yield pd.DataFrame({"v": pdf["v"] + delta})
+        return fn
+
+    out = _df(s).select(col("v")) \
+        .map_in_pandas(step(1.0), sch) \
+        .map_in_pandas(step(10.0), sch) \
+        .map_in_pandas(step(100.0), sch)
+    rows = out.collect()
+    assert len(rows) == 60
+    base = sorted(r[1] for r in _df(s).collect())
+    assert sorted(r[0] for r in rows) == pytest.approx(
+        [v + 111.0 for v in base])
+
+
+def test_pandas_agg_udf_empty_input_grand_aggregate():
+    """Keyless grouped-agg over empty input yields ONE row (the UDF sees
+    empty Series) — Spark global-aggregation semantics."""
+    from spark_rapids_tpu.expr.core import lit
+    s = TpuSession({})
+    total = pandas_agg_udf(lambda v: float(v.sum()), T.DoubleType())
+    rows = _df(s).where(col("v") > lit(1e18)) \
+        .agg(total(col("v")).alias("t")).collect()
+    assert rows == [(0.0,)]
+
+
+def test_cogroup_key_dtype_mismatch_rejected():
+    """Hash routing is dtype-width-sensitive (murmur3): mismatched key
+    types would silently split matching groups across partitions."""
+    s = TpuSession({})
+    other = s.from_pydict(
+        {"k": np.arange(3, dtype=np.int64),
+         "w": np.arange(3, dtype=np.float64)},
+        T.Schema([T.StructField("k", T.LongType(), True),
+                  T.StructField("w", T.DoubleType(), True)]))
+    sch = T.Schema([T.StructField("n", T.LongType(), True)])
+    with pytest.raises(TypeError, match="key types must match"):
+        _df(s).group_by("k").cogroup(other.group_by("k")) \
+            .apply_in_pandas(lambda l, r: pd.DataFrame({"n": [1]}), sch)
+
+
+def test_cogroup_udf_mutating_empty_side_isolated():
+    """A UDF that mutates its (absent-side) input must not corrupt
+    later calls — each absent side receives a fresh copy."""
+    s = TpuSession({})
+    right = s.from_pydict(
+        {"k": np.array([0], dtype=np.int32),
+         "w": np.array([1.0])},
+        T.Schema([T.StructField("k", T.IntegerType(), True),
+                  T.StructField("w", T.DoubleType(), True)]), partitions=1)
+    sch = T.Schema([T.StructField("k", T.IntegerType(), True),
+                    T.StructField("ncols", T.LongType(), True)])
+
+    def fn(l, r):
+        k = l["k"].iloc[0] if len(l) else r["k"].iloc[0]
+        n = len(r.columns)
+        r["extra"] = 1          # mutate in place
+        return pd.DataFrame({"k": [k], "ncols": [n]})
+
+    out = _df(s).group_by("k").cogroup(right.group_by("k")) \
+        .apply_in_pandas(fn, sch).collect()
+    # every call saw the pristine 2-column right frame
+    assert all(n == 2 for _, n in out)
