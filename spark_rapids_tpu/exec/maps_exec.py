@@ -1,0 +1,126 @@
+"""Map decomposition: split map columns into array-column pairs.
+
+Reference: the plugin runs GetMapValue/map_keys/map_values on device as
+cuDF LIST-of-struct kernels (complexTypeExtractors.scala,
+collectionOperations.scala).  This engine's device layout has no
+two-buffer column, so the planner rewrites eligible plans to split each
+map column at the scan boundary into two ordinary ARRAY columns — the
+row's sorted keys and the aligned values — after which every existing
+device kernel (filter/join/agg over extracted values) applies untouched
+and the physical plan carries no MapType at all (plan/maps.py holds the
+rewrite; explain shows the split exec instead of a GetMapValue
+fallback).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+
+__all__ = ["MapDecomposeExec", "keys_name", "vals_name",
+           "size_name", "decomposable"]
+
+
+def keys_name(map_col: str) -> str:
+    return f"{map_col}__map_keys"
+
+
+def vals_name(map_col: str) -> str:
+    return f"{map_col}__map_vals"
+
+
+def size_name(map_col: str) -> str:
+    return f"{map_col}__map_size"
+
+
+def decomposable(mt: T.DataType) -> bool:
+    """Key AND value must be numeric/boolean: the split copies raw dict
+    entries into typed array buffers, and only those python values ARE
+    their storage encoding (dates/timestamps need the days/micros
+    encodings, strings/nested have no device array element layout) —
+    everything else keeps the raw host path."""
+    if not isinstance(mt, T.MapType):
+        return False
+    return all((t.np_dtype is not None
+                and not isinstance(t, (T.ArrayType, T.DateType,
+                                       T.TimestampType)))
+               for t in (mt.key_type, mt.value_type))
+
+
+class MapDecomposeExec(PlanNode):
+    """Replace each named map column with (sorted keys array, aligned
+    values array).  Runs on the host right above the scan — the input
+    still carries maps, so the tagger keeps THIS node host-side, and
+    everything above it is map-free and device-eligible."""
+
+    combines_batches = False
+
+    def __init__(self, child: PlanNode, map_cols: Sequence[str]):
+        super().__init__([child])
+        self._maps = list(map_cols)
+        fields = []
+        for f in child.output_schema:
+            if f.name in self._maps:
+                mt = f.data_type
+                assert decomposable(mt), mt
+                fields.append(T.StructField(keys_name(f.name),
+                                            T.ArrayType(mt.key_type), True))
+                fields.append(T.StructField(vals_name(f.name),
+                                            T.ArrayType(mt.value_type), True))
+                # entries whose VALUE is null are dropped from the
+                # arrays (device arrays have no element nulls; m[k] of
+                # a null-valued entry and of a missing key are both
+                # null, so lookups stay exact) — size(m) must still
+                # count them, so the true entry count rides its own
+                # column (-1 for null maps, the legacy sizeOfNull
+                # convention Size() itself emits)
+                fields.append(T.StructField(size_name(f.name),
+                                            T.IntegerType(), False))
+            else:
+                fields.append(f)
+        self._schema = T.Schema(fields)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        assert not ctx.is_device, \
+            "MapDecomposeExec reads raw maps: host-side only"
+        for hb in self.children[0].partition_iter(ctx, pid):
+            cols = []
+            for f, c in zip(hb.schema, hb.columns):
+                if f.name not in self._maps:
+                    cols.append(c)
+                    continue
+                n = len(c.data)
+                keys = np.empty(n, dtype=object)
+                vals = np.empty(n, dtype=object)
+                sizes = np.full(n, -1, dtype=np.int32)
+                for i in range(n):
+                    if c.validity[i]:
+                        d = c.data[i]
+                        items = sorted((k, v) for k, v in d.items()
+                                       if v is not None)
+                        keys[i] = [k for k, _ in items]
+                        vals[i] = [v for _, v in items]
+                        sizes[i] = len(d)
+                    else:
+                        keys[i] = None
+                        vals[i] = None
+                validity = np.asarray(c.validity, np.bool_)
+                mt = f.data_type
+                cols.append(HostColumn(keys, validity.copy(),
+                                       T.ArrayType(mt.key_type)))
+                cols.append(HostColumn(vals, validity.copy(),
+                                       T.ArrayType(mt.value_type)))
+                cols.append(HostColumn(sizes, np.ones(n, np.bool_),
+                                       T.IntegerType()))
+            yield HostBatch(cols, self._schema)
+
+    def node_desc(self) -> str:
+        return f"MapDecomposeExec[{self._maps}]"

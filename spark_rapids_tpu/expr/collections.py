@@ -15,7 +15,8 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr.core import Expression, Val
 
-__all__ = ["GetArrayItem", "Size", "ArrayContains", "GetMapValue"]
+__all__ = ["GetArrayItem", "Size", "ArrayContains", "GetMapValue",
+           "MapKeys", "MapValues", "MapLookup"]
 
 
 class GetMapValue(Expression):
@@ -154,3 +155,129 @@ class ArrayContains(Expression):
         validity = arr.validity & val.validity
         data = xp.where(validity, hit, False)
         return ctx.canonical(data, validity, T.BooleanType())
+
+
+def _encode_elems(values, dtype: T.DataType) -> list:
+    """Raw python map keys/values -> the engine's storage encodings for
+    an array column (date -> days, timestamp -> micros) — the same
+    conversion GetMapValue gets from HostColumn.from_values, applied
+    per element."""
+    from spark_rapids_tpu.host.batch import HostColumn
+    return HostColumn.from_values(list(values), dtype).data.tolist()
+
+
+class MapKeys(Expression):
+    """map_keys(m): the map's keys as an array, deterministic sorted
+    order (reference collectionOperations GpuMapKeys; Spark leaves the
+    order unspecified — sorted matches this engine's canonical map
+    layout).  On raw (host-only) maps this is a host expression; the
+    planner's map-decomposition rewrite replaces it with a direct
+    reference to the keys array column for the device path."""
+
+    sql_name = "MapKeys"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        mt = self.children[0].dtype
+        assert isinstance(mt, T.MapType), mt
+        return T.ArrayType(mt.key_type)
+
+    @property
+    def device_supported(self) -> bool:
+        return False
+
+    def _eval(self, vals, ctx):
+        assert not ctx.is_device, "MapKeys on raw maps is host-only"
+        m = vals[0]
+        kt = self.dtype.element_type
+        data = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            data[i] = _encode_elems(sorted(m.data[i]), kt) \
+                if m.validity[i] else None
+        from spark_rapids_tpu.expr.core import Val
+        return Val(data, np.asarray(m.validity, bool), None, self.dtype)
+
+
+class MapValues(Expression):
+    """map_values(m): the map's values as an array, aligned with
+    map_keys' sorted key order (reference GpuMapValues)."""
+
+    sql_name = "MapValues"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        mt = self.children[0].dtype
+        assert isinstance(mt, T.MapType), mt
+        return T.ArrayType(mt.value_type)
+
+    @property
+    def device_supported(self) -> bool:
+        return False
+
+    def _eval(self, vals, ctx):
+        assert not ctx.is_device, "MapValues on raw maps is host-only"
+        m = vals[0]
+        vt = self.dtype.element_type
+        data = np.empty(ctx.capacity, dtype=object)
+        for i in range(ctx.capacity):
+            data[i] = _encode_elems(
+                [v for _, v in sorted(m.data[i].items())], vt) \
+                if m.validity[i] else None
+        from spark_rapids_tpu.expr.core import Val
+        return Val(data, np.asarray(m.validity, bool), None, self.dtype)
+
+
+class MapLookup(Expression):
+    """Decomposed-map ``m[key]``: find the key's slot in the aligned
+    sorted-keys/values ARRAY column pair and gather the value — the
+    device form of GetMapValue after the planner's map-decomposition
+    rewrite (reference complexTypeExtractors.scala GetMapValue, which
+    the plugin runs as a cuDF LIST binary search; here a masked
+    equality + argmax over the static [capacity, max_len] key matrix)."""
+
+    sql_name = "MapLookup"
+
+    def __init__(self, keys_arr: Expression, vals_arr: Expression,
+                 key: Expression):
+        self.children = (keys_arr, vals_arr, key)
+
+    @property
+    def dtype(self):
+        at = self.children[1].dtype
+        assert isinstance(at, T.ArrayType), at
+        return at.element_type
+
+    def _eval(self, vals, ctx):
+        keys, vs, k = vals
+        elem = self.dtype
+        if not ctx.is_device:
+            n = ctx.capacity
+            out = np.zeros(n, dtype=elem.np_dtype)
+            validity = np.zeros(n, dtype=np.bool_)
+            for i in range(n):
+                if not (keys.validity[i] and k.validity[i]):
+                    continue
+                row = keys.data[i]
+                want = k.data[i]
+                for j, kv in enumerate(row):
+                    if kv == want:
+                        out[i] = vs.data[i][j]
+                        validity[i] = True
+                        break
+            return ctx.canonical(out, validity, elem)
+        xp = ctx.xp
+        w = keys.data.shape[1]
+        in_len = xp.arange(w, dtype=np.int32)[None, :] < keys.lengths[:, None]
+        eq = (keys.data == k.data[:, None]) & in_len
+        found = xp.any(eq, axis=1)
+        idx = xp.argmax(eq, axis=1)
+        picked = xp.take_along_axis(vs.data, idx[:, None], axis=1)[:, 0]
+        validity = keys.validity & k.validity & found
+        data = xp.where(validity, picked, xp.zeros((), vs.data.dtype))
+        return ctx.canonical(data, validity, elem)

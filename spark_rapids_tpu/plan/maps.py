@@ -1,0 +1,218 @@
+"""Logical rewrite: decompose map columns into array pairs for device
+execution.
+
+Reference: the plugin executes GetMapValue / map_keys / map_values on
+the GPU over cuDF LIST columns (complexTypeExtractors.scala,
+collectionOperations.scala).  Here MapType has no device layout, so a
+plan whose EVERY use of a map column is an extraction is rewritten:
+
+* the scan is wrapped in :class:`MapDecomposeExec` (host-side split
+  into sorted-keys / aligned-values ARRAY columns), and
+* ``GetMapValue(m, k)`` becomes a device ``MapLookup`` over the pair,
+  ``map_keys/map_values`` become direct column references, ``size``
+  reads the keys array —
+
+after which the physical plan carries no MapType and the tagger keeps
+it on the device (the raw host path remains for bare-map uses, string
+keys, or any ambiguity; same degradation model as the reference's
+willNotWorkOnGpu tagging).
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.conf import ConfEntry, TpuConf, _bool, register
+from spark_rapids_tpu.exec.maps_exec import (MapDecomposeExec, decomposable,
+                                             keys_name, size_name,
+                                             vals_name)
+from spark_rapids_tpu.expr.collections import (GetMapValue, MapKeys,
+                                               MapLookup, MapValues, Size)
+from spark_rapids_tpu.expr.core import Expression, UnresolvedAttribute, col
+from spark_rapids_tpu.plan import logical as L
+
+__all__ = ["decompose_maps", "DECOMPOSE_MAPS"]
+
+DECOMPOSE_MAPS = register(ConfEntry(
+    "spark.rapids.sql.decomposeMaps", True,
+    "Rewrite plans whose map columns are only ever extracted "
+    "(m[key]/map_keys/map_values/size) to split each map into "
+    "sorted-keys/values array columns at the scan, running the "
+    "extractions on the device.", conv=_bool))
+
+# an occurrence of the map attribute is allowed only as the FIRST child
+# of one of these.  MapKeys/MapValues are NOT here: the decomposed
+# arrays drop null-VALUED entries (no element nulls on device), which
+# lookups and the size column absorb exactly but whole-array views
+# would observe — those uses keep the raw host path.
+_EXTRACTORS = (GetMapValue, Size)
+
+# nodes that pass their child's columns through to their own output
+# (a map column surviving to the plan root through these is a bare use)
+_PASS_THROUGH = (L.Filter, L.Sort, L.Limit, L.Repartition, L.Union,
+                 L.Window, L.Generate)
+
+# nodes whose presence forces the raw host path: their row-level view
+# of the child schema (pandas frames) or positional column contracts
+# would observably change under decomposition
+_DISQUALIFYING = (L.MapInPandas, L.FlatMapGroupsInPandas,
+                  L.AggregateInPandas, L.FlatMapCoGroupsInPandas, L.Union)
+
+
+def _node_exprs(n: L.LogicalPlan) -> list:
+    out: list = []
+    if isinstance(n, L.Project):
+        out += n.exprs
+    elif isinstance(n, L.Filter):
+        out.append(n.condition)
+    elif isinstance(n, L.Aggregate):
+        out += list(n.group_exprs) + list(n.agg_exprs)
+    elif isinstance(n, L.Join):
+        out += list(n.left_on) + list(n.right_on)
+        if n.condition is not None:
+            out.append(n.condition)
+    elif isinstance(n, L.Sort):
+        for o in n.orders:
+            e = o[0] if isinstance(o, tuple) else o
+            if isinstance(e, Expression):
+                out.append(e)
+    elif isinstance(n, L.Window):
+        out += n.window_exprs
+    elif isinstance(n, L.Expand):
+        out += [e for proj in n.projections for e in proj]
+    elif isinstance(n, L.Generate):
+        out.append(n.generator)
+    elif isinstance(n, L.Repartition):
+        out += n.keys
+    return out
+
+
+def _walk(n: L.LogicalPlan):
+    yield n
+    for c in n.children:
+        yield from _walk(c)
+
+
+def _bare_uses(e: Expression, names: set, bad: set) -> None:
+    if isinstance(e, UnresolvedAttribute):
+        if e.name in names:
+            bad.add(e.name)
+        return
+    for i, ch in enumerate(getattr(e, "children", ())):
+        if isinstance(ch, UnresolvedAttribute) and ch.name in names:
+            if not (isinstance(e, _EXTRACTORS) and i == 0):
+                bad.add(ch.name)
+        else:
+            _bare_uses(ch, names, bad)
+
+
+def _escaping(n: L.LogicalPlan, names: set, bad: set) -> None:
+    """Map columns reaching the plan OUTPUT through schema-pass-through
+    nodes are bare uses (the user would observe split columns)."""
+    if isinstance(n, L.Scan):
+        for f in n.schema:
+            if f.name in names:
+                bad.add(f.name)
+        return
+    if isinstance(n, _PASS_THROUGH) or not isinstance(
+            n, (L.Project, L.Aggregate, L.Expand)):
+        for c in n.children:
+            _escaping(c, names, bad)
+
+
+def _rewrite_expr(e: Expression, names: set) -> Expression:
+    def rw(node):
+        kids = getattr(node, "children", ())
+        m = kids[0] if kids else None
+        if not (isinstance(m, UnresolvedAttribute) and m.name in names):
+            return node
+        if isinstance(node, GetMapValue):
+            return MapLookup(col(keys_name(m.name)), col(vals_name(m.name)),
+                             node.children[1])
+        if isinstance(node, Size):
+            # the split's size column counts null-valued entries the
+            # keys array dropped, and already encodes legacy
+            # size(null)=-1 as a valid -1
+            return col(size_name(m.name))
+        return node
+
+    return e.transform_up(rw)
+
+
+def _rebuild(n: L.LogicalPlan, names: set) -> L.LogicalPlan:
+    from dataclasses import fields as dfields, replace
+
+    if isinstance(n, L.Scan):
+        split = [f.name for f in n.schema if f.name in names]
+        if split:
+            return L.Scan(MapDecomposeExec(n.exec_node, split))
+        return n
+    kw = {}
+    for f in dfields(n):
+        v = getattr(n, f.name)
+        if isinstance(v, L.LogicalPlan):
+            kw[f.name] = _rebuild(v, names)
+        elif isinstance(v, Expression):
+            kw[f.name] = _rewrite_expr(v, names)
+        elif isinstance(v, list) and v and isinstance(v[0], list):
+            kw[f.name] = [[_rewrite_expr(e, names) if
+                           isinstance(e, Expression) else e for e in inner]
+                          for inner in v]
+        elif isinstance(v, list):
+            kw[f.name] = [
+                _rebuild(x, names) if isinstance(x, L.LogicalPlan) else
+                _rewrite_expr(x, names) if isinstance(x, Expression) else x
+                for x in v]
+    return replace(n, **kw) if kw else n
+
+
+def decompose_maps(plan: L.LogicalPlan, conf: TpuConf) -> L.LogicalPlan:
+    if not conf.get(DECOMPOSE_MAPS):
+        return plan
+    nodes = list(_walk(plan))
+    # candidate map columns: decomposable dtype, unique across scans, no
+    # name collision with the reserved split names
+    seen: dict[str, int] = {}
+    for n in nodes:
+        if isinstance(n, L.Scan):
+            for f in n.schema:
+                if decomposable(f.data_type):
+                    seen[f.name] = seen.get(f.name, 0) + 1
+    all_names = {f.name for n in nodes if isinstance(n, L.Scan)
+                 for f in n.schema}
+    names = {m for m, cnt in seen.items()
+             if cnt == 1 and keys_name(m) not in all_names
+             and vals_name(m) not in all_names
+             and size_name(m) not in all_names}
+    if not names:
+        return plan
+    if any(isinstance(n, _DISQUALIFYING) for n in nodes):
+        return plan
+    bad: set = set()
+    # alias shadowing: a projection/aggregate output REUSING a map's
+    # name (e.g. col("arr").alias("m")) re-scopes that name above it —
+    # this pass matches by name with no scoping, so shadowed names keep
+    # the raw path (review finding)
+    from spark_rapids_tpu.expr.core import output_name as _oname
+    for n in nodes:
+        if isinstance(n, (L.Project, L.Aggregate, L.Expand)):
+            for e in _node_exprs(n):
+                try:
+                    nm = _oname(e)
+                except Exception:
+                    continue
+                if nm in names and not (
+                        isinstance(e, UnresolvedAttribute)):
+                    bad.add(nm)
+        if isinstance(n, L.Generate):
+            bad |= set(n.output_names) & names
+    for n in nodes:
+        for e in _node_exprs(n):
+            if isinstance(n, L.Sort):
+                # sort-order tuples are not rewritten: ANY reference
+                # (even an extraction) keeps the raw path
+                bad |= e.references() & names
+            else:
+                _bare_uses(e, names, bad)
+    _escaping(plan, names, bad)
+    names -= bad
+    if not names:
+        return plan
+    return _rebuild(plan, names)
