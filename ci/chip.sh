@@ -1,0 +1,41 @@
+#!/bin/bash
+# On-chip tier: the only tier that talks to real TPU hardware.
+#
+# Reference model: the integration Jenkinsfiles run spark-tests.sh on
+# GPU runners; CPU-only CI cannot catch device-lowering failures, and
+# neither can this repo's JAX_PLATFORMS=cpu test suite.  Here:
+#   * probe the accelerator tunnel under a hard timeout FIRST — the
+#     axon client hangs forever when the loopback relay is wedged, and
+#     a wedged tunnel must fail this tier fast instead of eating it
+#     (round-3 failure mode),
+#   * scripts/verify_exprs_tpu.py: the whole expression library on the
+#     chip vs the host oracle,
+#   * bench.py: the TPC-DS q6 ladder on the chip (one JSON line).
+#
+# Usage: ci/chip.sh  (writes artifacts/ci_chip_<utc-date>.txt)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+OUT="artifacts/ci_chip_${STAMP}.txt"
+mkdir -p artifacts
+
+{
+  echo "== chip @ ${STAMP} (commit $(git rev-parse --short HEAD)) =="
+  echo "-- tunnel probe (120s budget) --"
+  if ! timeout 130 python -c "
+import faulthandler
+faulthandler.dump_traceback_later(120, exit=True)
+import jax
+assert jax.default_backend() == 'tpu', jax.default_backend()
+print('tpu up:', jax.devices())
+"; then
+    echo "== chip SKIP: accelerator tunnel is wedged (probe timed out) =="
+    exit 2
+  fi
+  echo "-- expression library on chip vs host oracle --"
+  python scripts/verify_exprs_tpu.py
+  echo "-- bench ladder on chip --"
+  python bench.py
+  echo "== chip PASS =="
+} 2>&1 | tee "$OUT"

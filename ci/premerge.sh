@@ -1,0 +1,31 @@
+#!/bin/bash
+# Premerge tier: every change runs this before merging.
+#
+# Reference model: jenkins/Jenkinsfile-blossom.premerge runs the unit
+# suite + a smoke slice of the integration tests per PR, with the full
+# sweeps deferred to nightly (jenkins/spark-tests.sh).  Here:
+#   * full unit/differential suite on the virtual 8-device CPU mesh
+#     (tests/conftest.py forces JAX_PLATFORMS=cpu) — TPC-DS/TPC-H run
+#     their smoke query subsets,
+#   * API-surface drift gate (tests/test_api_validation.py is part of
+#     the suite),
+#   * multichip dryrun: the full mesh pipeline compiles + executes on
+#     8 virtual devices.
+#
+# Usage: ci/premerge.sh  (writes artifacts/ci_premerge_<utc-date>.txt)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+OUT="artifacts/ci_premerge_${STAMP}.txt"
+mkdir -p artifacts
+
+{
+  echo "== premerge @ ${STAMP} (commit $(git rev-parse --short HEAD)) =="
+  echo "-- unit + differential suite (CPU mesh) --"
+  python -m pytest tests/ -q --durations=10
+  echo "-- multichip dryrun (8 virtual devices) --"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+  echo "== premerge PASS =="
+} 2>&1 | tee "$OUT"

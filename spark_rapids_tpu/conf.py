@@ -134,6 +134,15 @@ ALLOW_FLOAT_AGG = bool_conf(
     "Allow float aggregations whose result may differ in last-bit rounding "
     "due to reduction order. (ref RapidsConf.scala ENABLE_FLOAT_AGG)")
 
+EXACT_DOUBLE_AGG = bool_conf(
+    "spark.rapids.sql.exactDoubleAggregation", False,
+    "Force aggregations over DOUBLE columns to the host engine: TPU f64 "
+    "is a float32-pair emulation (~48 mantissa bits, f32 exponent range "
+    "— docs/compatibility.md) and sums/averages can deviate from exact "
+    "f64; artifacts/f64_pair_error.json quantifies the measured error "
+    "per op. float32 aggregations are exact on TPU and stay on device. "
+    "(ref RapidsConf.scala incompat machinery :461-492)")
+
 REPLACE_SORT_MERGE_JOIN = bool_conf(
     "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
     "Replace sort-merge joins with hash joins on TPU. "

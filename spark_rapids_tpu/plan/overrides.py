@@ -159,7 +159,61 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         # split-only skew reader (_aqe_join_reader), which can raise —
         # never lower — the effective partition count.
         return PlannedNode(ex, list(node.keys), [c])
+    if isinstance(node, L.MapInPandas):
+        from spark_rapids_tpu.exec.python_exec import MapInPandasExec
+        c = lower(node.child, conf)
+        ex = MapInPandasExec(node.fn, node.out_schema, c.exec_node)
+        return PlannedNode(ex, [], [c])
+    if isinstance(node, L.FlatMapGroupsInPandas):
+        from spark_rapids_tpu.exec.python_exec import \
+            FlatMapGroupsInPandasExec
+        c = _cluster_on_keys(lower(node.child, conf), node.keys, conf)
+        ex = FlatMapGroupsInPandasExec(
+            [output_name(k) for k in node.keys], node.fn, node.out_schema,
+            c.exec_node)
+        return PlannedNode(ex, list(node.keys), [c])
+    if isinstance(node, L.AggregateInPandas):
+        from spark_rapids_tpu.exec.python_exec import AggregateInPandasExec
+        c = _cluster_on_keys(lower(node.child, conf), node.keys, conf)
+        ex = AggregateInPandasExec([output_name(k) for k in node.keys],
+                                   node.udfs, c.exec_node)
+        return PlannedNode(ex, list(node.keys), [c])
+    if isinstance(node, L.FlatMapCoGroupsInPandas):
+        from spark_rapids_tpu.exec.python_exec import \
+            FlatMapCoGroupsInPandasExec
+        lc = _cluster_on_keys(lower(node.left, conf), node.left_keys, conf,
+                              force=True)
+        rc = _cluster_on_keys(lower(node.right, conf), node.right_keys,
+                              conf, force=True)
+        ex = FlatMapCoGroupsInPandasExec(
+            [output_name(k) for k in node.left_keys],
+            [output_name(k) for k in node.right_keys],
+            node.fn, node.out_schema, lc.exec_node, rc.exec_node)
+        return PlannedNode(ex, list(node.left_keys) + list(node.right_keys),
+                           [lc, rc])
     raise TypeError(f"cannot lower {node!r}")
+
+
+def _cluster_on_keys(c: PlannedNode, keys: list, conf: TpuConf,
+                     force: bool = False) -> PlannedNode:
+    """Hash-exchange on the grouping keys so every group lands wholly in
+    one partition (Spark's ClusteredDistribution requirement for the
+    grouped pandas execs); keyless grouped-agg collapses to a single
+    partition.  ``force`` exchanges even single-partition children —
+    cogrouped sides must agree on partition COUNT and router, not just
+    co-locate groups."""
+    from spark_rapids_tpu.exec.partitioning import SinglePartitioning
+    nparts = c.exec_node.num_partitions(ExecCtx(backend="host"))
+    if not keys:
+        if nparts <= 1:
+            return c
+        exch = ShuffleExchangeExec(SinglePartitioning(), c.exec_node)
+        return PlannedNode(exch, [], [c])
+    if nparts <= 1 and not force:
+        return c
+    part = HashPartitioning(list(keys), conf.shuffle_partitions)
+    exch = ShuffleExchangeExec(part, c.exec_node)
+    return PlannedNode(exch, list(keys), [c])
 
 
 def _schema_has_arrays(*nodes: PlanNode) -> bool:
@@ -478,6 +532,33 @@ class TpuOverrides:
                         dt, T.StringType):
                     meta.will_not_work(
                         "windowed min/max over strings has no device kernel")
+        from spark_rapids_tpu.exec.mesh_exec import MeshAggregateExec
+        agg_ex = ex._layout if isinstance(ex, MeshAggregateExec) else \
+            ex if isinstance(ex, HashAggregateExec) else None
+        if agg_ex is not None and agg_ex._aggs:
+            # float-aggregation gates (reference ENABLE_FLOAT_AGG +
+            # the incompat machinery, RapidsConf.scala:461-492):
+            # variableFloatAgg=false refuses ANY float aggregation
+            # (reduction order varies); exactDoubleAggregation=true
+            # refuses DOUBLE ones specifically — TPU f64 is a
+            # float32-pair emulation and sums can deviate from exact
+            # f64 (quantified in artifacts/f64_pair_error.json).
+            # Mesh lowering (MeshAggregateExec) shares the layout, so
+            # the gates cover both single-chip and mesh aggregates.
+            from spark_rapids_tpu.conf import (ALLOW_FLOAT_AGG,
+                                               EXACT_DOUBLE_AGG)
+            in_types = [a.input.dtype for a in agg_ex._aggs
+                        if a.input is not None]
+            if not self.conf.get(ALLOW_FLOAT_AGG) and any(
+                    t.fractional for t in in_types):
+                meta.will_not_work(
+                    "float aggregation disabled "
+                    "(spark.rapids.sql.variableFloatAgg.enabled)")
+            if self.conf.get(EXACT_DOUBLE_AGG) and any(
+                    isinstance(t, T.DoubleType) for t in in_types):
+                meta.will_not_work(
+                    "double aggregation forced to host for exact f64 "
+                    "(spark.rapids.sql.exactDoubleAggregation)")
 
     # -- mesh output alignment ------------------------------------------
     def _align_mesh_outputs(self, meta: PlannedNode) -> None:

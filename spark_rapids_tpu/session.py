@@ -196,6 +196,13 @@ class DataFrame:
         return DataFrame(self._s, L.Generate(gen, self._plan, outer=outer,
                                              pos=pos, output_names=names))
 
+    def map_in_pandas(self, fn, schema: T.Schema) -> "DataFrame":
+        """``fn`` receives an iterator of pandas DataFrames (one
+        partition's batches) and yields DataFrames conforming to
+        ``schema``; output row count is unconstrained (Spark
+        mapInPandas; reference GpuMapInPandasExec)."""
+        return DataFrame(self._s, L.MapInPandas(fn, schema, self._plan))
+
     def order_by(self, *orders) -> "DataFrame":
         return DataFrame(self._s, L.Sort(list(orders), self._plan))
 
@@ -364,7 +371,8 @@ class DataFrame:
         return col(e) if isinstance(e, str) else e
 
     def _planned(self) -> PlannedNode:
-        return lower(self._plan, self._s.conf)
+        from spark_rapids_tpu.plan.maps import decompose_maps
+        return lower(decompose_maps(self._plan, self._s.conf), self._s.conf)
 
     def _overridden(self, quiet: bool = False):
         meta = self._planned()
@@ -379,10 +387,52 @@ class GroupedData:
         self._keys = keys
         self._sets = grouping_sets  # list[set[int]] of ACTIVE key indices
 
+    def _key_columns(self, what: str) -> list:
+        """The grouped pandas ops hand ``fn`` the CHILD's columns, so
+        their keys must be plain column references (Spark's
+        applyInPandas has the same restriction in practice)."""
+        from spark_rapids_tpu.expr.core import UnresolvedAttribute
+        for k in self._keys:
+            if not isinstance(k, UnresolvedAttribute):
+                raise NotImplementedError(
+                    f"{what} requires plain column keys, got {k!r}")
+        return list(self._keys)
+
+    def apply_in_pandas(self, fn, schema: T.Schema) -> DataFrame:
+        """``fn`` receives each group as one pandas DataFrame (all child
+        columns) and returns a DataFrame conforming to ``schema`` (Spark
+        groupBy().applyInPandas; reference
+        GpuFlatMapGroupsInPandasExec)."""
+        if self._sets is not None:
+            raise NotImplementedError(
+                "apply_in_pandas with grouping sets is not supported")
+        return DataFrame(self._df._s, L.FlatMapGroupsInPandas(
+            self._key_columns("apply_in_pandas"), fn, schema,
+            self._df._plan))
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair two grouped frames by key for a joint pandas apply
+        (Spark cogroup; reference GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
     def agg(self, *aggs) -> DataFrame:
         from spark_rapids_tpu.expr.aggregates import CountDistinct
-        if any(isinstance(a.children[0] if isinstance(a, Alias) else a,
-                          CountDistinct) for a in aggs):
+        from spark_rapids_tpu.exec.python_exec import PandasAggUDF
+        inners = [(a.children[0] if isinstance(a, Alias) else a)
+                  for a in aggs]
+        if any(isinstance(i, PandasAggUDF) for i in inners):
+            if not all(isinstance(i, PandasAggUDF) for i in inners):
+                raise NotImplementedError(
+                    "mixing pandas_agg_udf with built-in aggregates in "
+                    "one agg() is not supported")
+            if self._sets is not None:
+                raise NotImplementedError(
+                    "pandas_agg_udf with grouping sets is not supported")
+            udfs = [(output_name(a), i) for a, i in zip(aggs, inners)]
+            return DataFrame(self._df._s, L.AggregateInPandas(
+                self._key_columns("agg(pandas_agg_udf)"), udfs,
+                self._df._plan))
+        if any(isinstance(i, CountDistinct) for i in inners):
             return self._agg_with_distinct(list(aggs))
         if self._sets is None:
             exprs = list(self._keys) + list(aggs)
@@ -462,7 +512,15 @@ class GroupedData:
         """Rollup/cube/grouping-sets: Expand with nulled-out key columns +
         a spark_grouping_id literal per set, then a plain group-by over
         (keys..., spark_grouping_id) so rollup-nulls never merge with
-        data-nulls (reference GpuExpandExec + Spark's Expand planning)."""
+        data-nulls (reference GpuExpandExec + Spark's Expand planning).
+
+        When every aggregate is re-aggregable (sum/count/min/max/avg),
+        the input is FIRST aggregated at full key granularity and the
+        Expand runs over the (much smaller) group list, re-merging per
+        set — N projections over |groups| rows instead of N x |input|
+        (the classic rollup-as-reaggregation optimization; the
+        reference's expand feeds the same partial-merge machinery,
+        aggregate.scala:348-560)."""
         from spark_rapids_tpu.expr.core import Literal, UnresolvedAttribute
         user_names = [output_name(k) for k in self._keys]
         child_cols = self._df.columns
@@ -480,6 +538,12 @@ class GroupedData:
             pre_exprs.append(inner.alias(resolved))
             key_names.append(resolved)
         pre = self._df.select(*pre_exprs)
+        decomposed = _decompose_reagg(aggs)
+        if decomposed is not None:
+            base_aggs, aggs = decomposed
+            pre = DataFrame(self._df._s, L.Aggregate(
+                [col(n) for n in key_names],
+                [col(n) for n in key_names] + base_aggs, pre._plan))
         pre_schema = pre.schema
         nk = len(self._keys)
         projections = []
@@ -500,3 +564,88 @@ class GroupedData:
                         for n, u in zip(key_names, user_names)] + aggs
         return DataFrame(self._df._s, L.Aggregate(
             group_exprs, result_exprs, expanded._plan))
+
+
+def _decompose_reagg(aggs: list):
+    """Split aggregate expressions for grouping-sets re-aggregation:
+    base-level partial aggregates at full key granularity plus final
+    expressions over the re-merged columns.  sum->sum-of-sums,
+    count->sum-of-counts, min/max->min/max, avg->sum(sum)/sum(count).
+    Returns (base_aggs, rewritten_aggs), or None when any aggregate is
+    not re-aggregable (first/last/count-distinct) — the caller then
+    expands the raw input instead."""
+    from spark_rapids_tpu.expr.aggregates import (AggregateFunction,
+                                                  Average, Count,
+                                                  CountDistinct, CountStar,
+                                                  Max, Min, Sum)
+    base_aggs: list = []
+    cache: dict[str, str] = {}
+    bad: list = []
+
+    def base_col(fn):
+        key = repr(fn)
+        if key not in cache:
+            name = f"_ra_{len(base_aggs)}"
+            base_aggs.append(Alias(fn, name))
+            cache[key] = name
+        return col(cache[key])
+
+    def rewrite(node):
+        if isinstance(node, CountDistinct):
+            bad.append(node)
+            return node
+        if not isinstance(node, AggregateFunction):
+            return node
+        if isinstance(node, CountStar):
+            return Sum(base_col(CountStar()))
+        if isinstance(node, Count):
+            return Sum(base_col(node))
+        if isinstance(node, (Sum, Min, Max)):
+            return type(node)(base_col(node))
+        if isinstance(node, Average):
+            x = node.children[0]
+            s, c = base_col(Sum(x)), base_col(Count(x))
+            return (Sum(s).cast(T.DoubleType())
+                    / Sum(c).cast(T.DoubleType()))
+        bad.append(node)
+        return node
+
+    rewritten = [a.transform_up(rewrite) for a in aggs]
+    if bad:
+        return None
+    return base_aggs, rewritten
+
+
+class CoGroupedData:
+    """Two grouped frames paired by key; ``apply_in_pandas(fn, schema)``
+    calls ``fn(left_group_pdf, right_group_pdf)`` once per key present
+    on either side (Spark's cogroup; reference
+    GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        if len(left._keys) != len(right._keys):
+            raise ValueError("cogroup requires the same number of keys "
+                             "on both sides")
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema: T.Schema) -> DataFrame:
+        lk = self._left._key_columns("cogroup.apply_in_pandas")
+        rk = self._right._key_columns("cogroup.apply_in_pandas")
+        # both sides are hash-partitioned independently with
+        # dtype-width-sensitive murmur3: mismatched key types would
+        # route equal values to DIFFERENT partitions and silently split
+        # matching groups (review finding) — refuse up front
+        ls, rs = self._left._df.schema, self._right._df.schema
+        for a, b in zip(lk, rk):
+            lt = ls.field(output_name(a)).data_type
+            rt = rs.field(output_name(b)).data_type
+            if lt != rt:
+                raise TypeError(
+                    f"cogroup key types must match: left "
+                    f"{output_name(a)}:{lt!r} vs right "
+                    f"{output_name(b)}:{rt!r} (hash routing is "
+                    f"dtype-sensitive)")
+        return DataFrame(self._left._df._s, L.FlatMapCoGroupsInPandas(
+            lk, rk, fn, schema, self._left._df._plan,
+            self._right._df._plan))
