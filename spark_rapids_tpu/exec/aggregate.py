@@ -263,10 +263,10 @@ class HashAggregateExec(PlanNode):
     # -- device path (reference aggregate.scala:427-485 concat+merge loop) --
     #
     # Compilation discipline (XLA analog of the reference's zero-per-batch-
-    # compilation hot loop, SURVEY §3.3): the whole per-batch update and the
-    # cross-batch merge are each ONE jitted program, and the running buffer
-    # is held at a fixed canonical capacity (shrunk back after each merge)
-    # instead of walking pow2 buckets upward with the input size.
+    # compilation hot loop, SURVEY §3.3): the per-batch update and the
+    # n-way merge are each ONE jitted program; buffers are shrunk to
+    # pow2 group-count buckets, so programs compile once per capacity
+    # bucket, and the merge runs O(total/bound) times, not per batch.
     def _jit_fns(self):
         if not hasattr(self, "_jits"):
             key_idx = list(range(len(self._group_bound)))
@@ -280,9 +280,7 @@ class HashAggregateExec(PlanNode):
                                     presorted=presorted),
                     self._buffer_schema)
 
-            def merge(run, part):
-                cat = _relabel_d(dk.concat_batches([run, part]),
-                                 self._buffer_schema)
+            def merge(cat):
                 return _relabel_d(
                     sorted_group_by(cat, key_idx, self._merge_specs),
                     self._buffer_schema)
@@ -297,31 +295,60 @@ class HashAggregateExec(PlanNode):
             self._jits = (jax.jit(update), jax.jit(merge), jax.jit(final))
         return self._jits
 
+    # pending partial buffers merge once their summed capacity crosses
+    # this bound — peak concat storage stays ~2x the bound while the
+    # n-way merge keeps the sort count at O(total/bound), not O(batches)
+    _MERGE_PENDING_CAP = 1 << 23
+
     def _run_device(self, ctx: ExecCtx, child_it, key_idx) \
             -> Iterator[ColumnBatch]:
+        from spark_rapids_tpu.columnar.batch import round_capacity
         update_jit, merge_jit, final_jit = self._jit_fns()
-        running: ColumnBatch | None = None
-        target_cap = 0
+
+        # Each incoming batch is reduced to its own group buffer and
+        # SHRUNK to its group count; buffers then merge in one n-way
+        # concat + segment-reduce.  The previous pairwise loop re-sorted
+        # the whole running buffer per batch — k full sorts for k
+        # batches — which dominated agg-heavy plans (q65's final
+        # aggregates were ~5s each on SF1).  The reference's
+        # concatenate-then-merge loop amortizes the same way
+        # (aggregate.scala:427-485).
+        parts: list[ColumnBatch] = []
+        total_cap = 0
+
+        def merge_pending() -> None:
+            nonlocal parts, total_cap
+            if len(parts) <= 1:
+                return
+            # the concat is the path's peak allocation: run it under
+            # dispatch so the DeviceSemaphore bounds occupancy and the
+            # OOM-spill-retry hook covers it (review finding)
+            cat = _relabel_d(ctx.dispatch(dk.concat_batches, parts),
+                             self._buffer_schema)
+            merged = ctx.dispatch(merge_jit, cat)
+            ng = merged.host_num_rows()
+            cap = round_capacity(max(int(ng), 1))
+            merged = ctx.dispatch(dk.shrink_capacity, merged, cap)
+            parts = [merged]
+            total_cap = cap
+
         for b in child_it:
             if self.mode == "final":
                 part = _relabel_d(b, self._buffer_schema)
             else:
                 part = ctx.dispatch(update_jit, b)
-            if running is None:
-                running = part
-                target_cap = part.capacity
+            # one host sync per batch (shrink soundness + backpressure)
+            ng = part.host_num_rows()
+            if ng == 0 and key_idx:
                 continue
-            target_cap = max(target_cap, part.capacity)
-            running = ctx.dispatch(dk.pad_capacity, running, target_cap)
-            part = ctx.dispatch(dk.pad_capacity, part, target_cap)
-            merged = ctx.dispatch(merge_jit, running, part)
-            # shrink back to the canonical capacity; num_groups is
-            # materialized host-side to keep the shrink sound (the only
-            # per-batch sync, and it doubles as backpressure)
-            ng = merged.host_num_rows()
-            while target_cap < ng:
-                target_cap <<= 1
-            running = ctx.dispatch(dk.shrink_capacity, merged, target_cap)
+            cap = round_capacity(max(int(ng), 1))
+            part = ctx.dispatch(dk.shrink_capacity, part, cap)
+            parts.append(part)
+            total_cap += cap
+            if total_cap >= self._MERGE_PENDING_CAP:
+                merge_pending()
+        merge_pending()
+        running = parts[0] if parts else None
         if running is None:
             if key_idx or self.mode == "partial":
                 return  # no groups / nothing to emit

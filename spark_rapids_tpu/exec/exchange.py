@@ -85,17 +85,92 @@ def _jit_slice_part(sorted_batch: ColumnBatch, start, count, out_cap: int):
     return dk.take(sorted_batch, idx, count)
 
 
+def _fp_extra(n: PlanNode) -> str | None:
+    """Per-class fingerprint payload for operator parameters that
+    node_desc/bound_exprs do not surface.  Returning None marks the
+    class UNKNOWN: the node then contributes its object identity, so
+    structurally-identical-looking subtrees through it never dedup —
+    a missed optimization, never a wrong reuse."""
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.basic import (FilterExec, GlobalLimitExec,
+                                             LocalLimitExec, ProjectExec,
+                                             UnionExec)
+    from spark_rapids_tpu.exec.expand import ExpandExec
+    from spark_rapids_tpu.exec.generate import GenerateExec
+    from spark_rapids_tpu.exec.joins import CrossJoinExec, JoinExec
+    from spark_rapids_tpu.exec.sortexec import (CoalesceBatchesExec,
+                                                SortExec)
+    from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+
+    if isinstance(n, ShuffleExchangeExec):
+        p = n.partitioning
+        keys = getattr(p, "_keys", None) or getattr(p, "_orders_raw", ())
+        return f"{type(p).__name__}:{p.num_partitions}:{keys!r}"
+    if isinstance(n, AdaptiveShuffleReaderExec):
+        return f"{n.allow_coalesce}:{n.allow_skew_split}"
+    if isinstance(n, (LocalLimitExec, GlobalLimitExec)):
+        return str(n._limit)
+    if isinstance(n, CoalesceBatchesExec):
+        return repr(n._goal)
+    if isinstance(n, (ProjectExec, FilterExec, UnionExec, JoinExec,
+                      CrossJoinExec, HashAggregateExec, SortExec,
+                      ExpandExec, GenerateExec, BackendSwitchExec)):
+        # desc + bound_exprs + schema already carry their parameters
+        return ""
+    return None
+
+
+def plan_fingerprint(node: PlanNode) -> str:
+    """Structural identity of a physical subtree: node descriptions,
+    bound expressions, output schemas, per-class parameter payloads
+    (_fp_extra), and LEAF OBJECT identity (two subtrees match only when
+    they read the very same source execs).  Operators outside the known
+    set contribute object identity too, so unknown semantics can never
+    collide.  Identical fingerprints mean identical map output — the
+    basis for exchange reuse (Spark's ReuseExchange rule, which the
+    reference inherits: a DataFrame referenced twice otherwise executes
+    its whole shuffle pipeline twice — q65's agg-over-agg self-join ran
+    the store_sales scan+join+partial-agg twice)."""
+    import hashlib
+    h = hashlib.sha1()
+
+    def feed(n: PlanNode):
+        h.update(type(n).__name__.encode())
+        h.update(n.node_desc().encode())
+        h.update(repr(n.output_schema).encode())
+        for e in getattr(n, "bound_exprs", []):
+            h.update(repr(e).encode())
+        extra = _fp_extra(n)
+        if extra is None or not n.children:
+            h.update(str(id(n)).encode())
+        else:
+            h.update(extra.encode())
+        for c in n.children:
+            feed(c)
+
+    feed(node)
+    return h.hexdigest()
+
+
 class ShuffleExchangeExec(PlanNode):
     """Repartition child output by a Partitioning strategy."""
 
     def __init__(self, partitioning: Partitioning, child: PlanNode,
-                 shuffle_id: int | None = None):
+                 shuffle_id: "int | str | None" = None):
         super().__init__([child])
         self.partitioning = partitioning
         partitioning.bind(child.output_schema)
-        # stable id for cross-process serving (two processes cannot
-        # agree on id(self)); defaults to the in-process identity
-        self.shuffle_id = shuffle_id if shuffle_id is not None else id(self)
+        # explicit id: cross-process serving (two processes cannot
+        # agree on a local identity); otherwise resolved lazily to the
+        # subtree fingerprint at first execution (children are still
+        # being rewritten by coalesce/transition insertion now)
+        self._shuffle_id = shuffle_id
+
+    @property
+    def shuffle_id(self):
+        if self._shuffle_id is None:
+            self._shuffle_id = plan_fingerprint(self)
+        return self._shuffle_id
 
     @property
     def output_schema(self) -> T.Schema:
@@ -105,7 +180,11 @@ class ShuffleExchangeExec(PlanNode):
         return self.partitioning.num_partitions
 
     def _shuffled(self, ctx: ExecCtx):
-        return ctx.cached(("shuffle", id(self), ctx.backend),
+        # keyed by the structural shuffle_id, NOT object identity:
+        # duplicate exchange subtrees (a DataFrame used twice in one
+        # query) materialize the map side ONCE per execution and both
+        # consumers fetch from it (ReuseExchange)
+        return ctx.cached(("shuffle", self.shuffle_id, ctx.backend),
                           lambda: self._do_shuffle(ctx))
 
     def _do_shuffle(self, ctx: ExecCtx):
@@ -328,7 +407,7 @@ class RemoteShuffleReaderExec(PlanNode):
     in one process and reduce tasks in another.
     """
 
-    def __init__(self, address, shuffle_id: int, num_parts: int,
+    def __init__(self, address, shuffle_id: "int | str", num_parts: int,
                  schema: T.Schema):
         super().__init__([])
         self.address = tuple(address)

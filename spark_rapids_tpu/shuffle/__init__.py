@@ -31,12 +31,12 @@ class ShuffleTransport(Protocol):
     the movement plane.
     """
 
-    def write_partition(self, shuffle_id: int, map_id: int, part_id: int,
+    def write_partition(self, shuffle_id: "int | str", map_id: int, part_id: int,
                         batch) -> None:
         """Store one map-output batch for (shuffle, map, partition)."""
         ...
 
-    def fetch_partition(self, shuffle_id: int, part_id: int,
+    def fetch_partition(self, shuffle_id: "int | str", part_id: int,
                         lo: int = 0, hi: int | None = None) -> Iterable:
         """Batches of one reduce partition in a stable map order,
         restricted to the batch slice [lo, hi) (hi=None -> end).  The

@@ -47,7 +47,7 @@ class LocalShuffleTransport:
                         "batches_written": 0}
 
     # -- SPI ------------------------------------------------------------
-    def write_partition(self, shuffle_id: int, map_id: int, part_id: int,
+    def write_partition(self, shuffle_id: "int | str", map_id: int, part_id: int,
                         batch) -> None:
         if self.codec is None and self.ctx is not None:
             from spark_rapids_tpu.memory.catalog import (
@@ -75,20 +75,20 @@ class LocalShuffleTransport:
                                          []).append(size)
         self.metrics["batches_written"] += 1
 
-    def partition_sizes(self, shuffle_id: int) -> dict[int, int]:
+    def partition_sizes(self, shuffle_id: "int | str") -> dict[int, int]:
         """Map-output statistics per reduce partition (reference
         MapStatus sizes feeding AQE's coalescing decisions)."""
         with self._lock:
             return {pid: sz for (sid, pid), sz in self._sizes.items()
                     if sid == shuffle_id}
 
-    def batch_sizes(self, shuffle_id: int, part_id: int) -> list[int]:
+    def batch_sizes(self, shuffle_id: "int | str", part_id: int) -> list[int]:
         """Per-map-batch sizes of one reduce partition, in fetch order —
         the granularity the adaptive reader splits skewed partitions at."""
         with self._lock:
             return list(self._batch_sizes.get((shuffle_id, part_id), ()))
 
-    def fetch_partition(self, shuffle_id: int, part_id: int,
+    def fetch_partition(self, shuffle_id: "int | str", part_id: int,
                         lo: int = 0, hi: int | None = None) -> Iterable:
         """Stream one reduce partition's batches, optionally only the
         map-batch slice [lo, hi) — the adaptive reader's skew-split
@@ -111,7 +111,7 @@ class LocalShuffleTransport:
                     if self.codec is not None else data
                 yield deserialize_batch(raw, device=True)
 
-    def fetch_partition_serialized(self, shuffle_id: int, part_id: int,
+    def fetch_partition_serialized(self, shuffle_id: "int | str", part_id: int,
                                    lo: int = 0,
                                    hi: int | None = None) -> Iterable[bytes]:
         """Wire frames for one reduce partition's map-batch slice: Arrow

@@ -226,7 +226,7 @@ class TcpShuffleTransport(LocalShuffleTransport):
             advertise=conf.get(TCP_ADVERTISE_ADDRESS))
         self.address = self._server.address
 
-    def fetch_from(self, address, shuffle_id: int, part_id: int,
+    def fetch_from(self, address, shuffle_id: "int | str", part_id: int,
                    lo: int = 0, hi: int | None = None,
                    device: bool = True) -> Iterable:
         """Client entry honoring this transport's conf: the fetch window
@@ -250,7 +250,7 @@ def _resolve_timeout(timeout: float | None) -> float | None:
     return t if t > 0 else None
 
 
-def remote_partition_sizes(address, shuffle_id: int,
+def remote_partition_sizes(address, shuffle_id: "int | str",
                            timeout: float | None = None) -> tuple[dict, dict]:
     """Metadata plane: (partition_sizes, batch_sizes) from a peer
     (reference MetadataRequest/Response flatbuffer RPC).  A wedged peer
@@ -271,7 +271,7 @@ def remote_partition_sizes(address, shuffle_id: int,
             {int(k): v for k, v in meta["batch_sizes"].items()})
 
 
-def fetch_remote(address, shuffle_id: int, part_id: int, lo: int = 0,
+def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                  hi: int | None = None, device: bool = True,
                  inflight_limit: int | None = None,
                  max_frame: int = _MAX_FRAME_MIN,
