@@ -1,0 +1,326 @@
+"""Mortgage benchmark: the reference's Fannie-Mae ETL + aggregate jobs.
+
+Reference: integration_tests .../tests/mortgage/MortgageSpark.scala —
+ReadPerformanceCsv/ReadAcquisitionCsv (:34-120, pipe-delimited
+headerless CSVs, quarter from the file name), NameMapping (:120),
+CreatePerformanceDelinquency (:216-298, the 12-month delinquency
+window expansion), CreateAcquisition/CleanAcquisitionPrime (:300-324),
+and the three aggregate jobs SimpleAggregates /
+AggregatesWithPercentiles / AggregatesWithJoin (:350-437).
+
+BASELINE.json config 5 runs this ETL as the feature-engineering stage
+of the mortgage->XGBoost pipeline; the queries here are the
+spark-rapids-runnable SQL part of that pipeline.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.bench.mortgage_gen import (SELLERS, acq_schema,
+                                                 generate_mortgage,
+                                                 perf_schema)
+from spark_rapids_tpu.expr.aggregates import (Average, First, Max, Min,
+                                              Percentile)
+from spark_rapids_tpu.expr.conditional import Coalesce, If
+from spark_rapids_tpu.expr.core import Literal, col, lit
+from spark_rapids_tpu.expr.datetime_ops import Month, ParseDateFixed, Year
+from spark_rapids_tpu.expr.hashing import Murmur3Hash
+from spark_rapids_tpu.expr.math_ops import Floor, Round
+from spark_rapids_tpu.expr.strings import Hex
+
+__all__ = ["generate_mortgage", "MORTGAGE_QUERIES",
+           "build_mortgage_query", "read_performance", "read_acquisition"]
+
+# the reference's seller-name canonicalization (NameMapping) — a small
+# broadcast-joined lookup; subsetted to the sellers the generator emits
+NAME_MAPPING = [
+    ("WELLS FARGO BANK, N.A.", "Wells Fargo"),
+    ("JPMORGAN CHASE BANK, NATIONAL ASSOCIATION", "JP Morgan Chase"),
+    ("BANK OF AMERICA, N.A.", "Bank of America"),
+    ("CITIMORTGAGE, INC.", "Citi"),
+    ("QUICKEN LOANS INC.", "Quicken Loans"),
+    ("USAA FEDERAL SAVINGS BANK", "USAA"),
+    ("FLAGSTAR BANK, FSB", "Flagstar Bank"),
+    ("PNC BANK, N.A.", "PNC"),
+    ("SUNTRUST MORTGAGE INC.", "Suntrust"),
+    ("AMTRUST BANK", "AmTrust"),
+    ("METLIFE BANK, NA", "Metlife"),
+    ("GMAC MORTGAGE, LLC", "GMAC"),
+]
+
+
+def _quarter_of(path: str) -> str:
+    # .../Performance_2003Q4.txt_0 -> 2003Q4 (GetQuarterFromCsvFileName)
+    base = os.path.basename(path).split(".")[0]
+    return base.split("_")[-1]
+
+
+def _read_with_quarter(session, pattern: str, schema: T.Schema):
+    """Per-file scans unioned with a literal quarter column — the
+    engine-level equivalent of the reference's
+    input_file_name()-derived quarter."""
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        raise FileNotFoundError(
+            f"no mortgage data files match {pattern!r} — run "
+            "generate_mortgage(data_dir, sf) first")
+    dfs = []
+    for p in paths:
+        df = session.read_csv(p, schema=schema, header=False,
+                              delimiter="|")
+        dfs.append(df.with_column("quarter", lit(_quarter_of(p))))
+    out = dfs[0]
+    for d in dfs[1:]:
+        out = out.union(d)
+    return out
+
+
+def read_performance(session, data_dir: str):
+    return _read_with_quarter(
+        session, os.path.join(data_dir, "perf", "Performance_*"),
+        perf_schema())
+
+
+def read_acquisition(session, data_dir: str):
+    return _read_with_quarter(
+        session, os.path.join(data_dir, "acq", "Acquisition_*"),
+        acq_schema())
+
+
+def _null(dtype):
+    return Literal(None, dtype)
+
+
+def _when(cond, value, dtype):
+    return If(cond, value, _null(dtype))
+
+
+def _prepare_performance(df):
+    """CreatePerformanceDelinquency.prepare: string dates -> DateType +
+    month/year/day extracts (device ParseDateFixed)."""
+    d = ParseDateFixed(col("monthly_reporting_period"), "MM/dd/yyyy")
+    return df.with_column("monthly_reporting_period", d) \
+        .with_column("monthly_reporting_period_month",
+                     Month(col("monthly_reporting_period"))) \
+        .with_column("monthly_reporting_period_year",
+                     Year(col("monthly_reporting_period")))
+
+
+def _performance_delinquency(session, df):
+    """CreatePerformanceDelinquency.apply: the 12-month delinquency
+    window expansion (MortgageSpark.scala:232-298)."""
+    status = col("current_loan_delinquency_status")
+    agg_df = df.select(
+        col("quarter"), col("loan_id"), status,
+        _when(status >= lit(1), col("monthly_reporting_period"),
+              T.DateType()).alias("delinquency_30"),
+        _when(status >= lit(3), col("monthly_reporting_period"),
+              T.DateType()).alias("delinquency_90"),
+        _when(status >= lit(6), col("monthly_reporting_period"),
+              T.DateType()).alias("delinquency_180")) \
+        .group_by("quarter", "loan_id") \
+        .agg(Max(status).alias("delinquency_12"),
+             Min(col("delinquency_30")).alias("delinquency_30"),
+             Min(col("delinquency_90")).alias("delinquency_90"),
+             Min(col("delinquency_180")).alias("delinquency_180")) \
+        .select(col("quarter"), col("loan_id"),
+                (col("delinquency_12") >= lit(1)).alias("ever_30"),
+                (col("delinquency_12") >= lit(3)).alias("ever_90"),
+                (col("delinquency_12") >= lit(6)).alias("ever_180"),
+                col("delinquency_30"), col("delinquency_90"),
+                col("delinquency_180"))
+
+    joined = df.select(
+        col("quarter"), col("loan_id"),
+        col("monthly_reporting_period").alias("timestamp"),
+        col("current_loan_delinquency_status").alias("delinquency_12"),
+        col("current_actual_upb").alias("upb_12"),
+        col("monthly_reporting_period_month").alias("timestamp_month"),
+        col("monthly_reporting_period_year").alias("timestamp_year")) \
+        .join(agg_df.select(col("loan_id").alias("a_loan_id"),
+                            col("quarter").alias("a_quarter"),
+                            col("ever_30"), col("ever_90"),
+                            col("ever_180"), col("delinquency_30"),
+                            col("delinquency_90"),
+                            col("delinquency_180")),
+              on=[("loan_id", "a_loan_id"), ("quarter", "a_quarter")],
+              how="left") \
+        .select(col("quarter"), col("loan_id"), col("timestamp"),
+                col("delinquency_12"), col("upb_12"),
+                col("timestamp_month"), col("timestamp_year"),
+                col("ever_30"), col("ever_90"), col("ever_180"),
+                col("delinquency_30"), col("delinquency_90"),
+                col("delinquency_180"))
+
+    # explode(0..11): cross join with a 12-row literal month frame (the
+    # reference notes explode-of-a-literal beats a cross join on GPU;
+    # here the cross join IS the engine's explode of a constant)
+    months_df = session.from_pydict(
+        {"month_y": list(range(12))},
+        T.Schema([T.StructField("month_y", T.IntegerType())]))
+    months = lit(12)
+    base = (col("timestamp_year") * lit(12) + col("timestamp_month")
+            - lit(24000))
+    test_df = joined.join(months_df, how="cross") \
+        .select(
+            col("quarter"),
+            Floor((base - col("month_y")).cast(T.DoubleType())
+                  / lit(12.0)).alias("josh_mody_n"),
+            col("ever_30"), col("ever_90"), col("ever_180"),
+            col("delinquency_30"), col("delinquency_90"),
+            col("delinquency_180"),
+            col("loan_id"), col("month_y"), col("delinquency_12"),
+            col("upb_12")) \
+        .group_by("quarter", "loan_id", "josh_mody_n", "ever_30",
+                  "ever_90", "ever_180", "delinquency_30",
+                  "delinquency_90", "delinquency_180", "month_y") \
+        .agg(Max(col("delinquency_12")).alias("delinquency_12"),
+             Min(col("upb_12")).alias("upb_12"))
+    mody_base = (lit(24000.0) + col("josh_mody_n") * months.cast(
+        T.DoubleType()))
+    tmp = (mody_base + col("month_y").cast(T.DoubleType())) % lit(12.0)
+    test_df = test_df \
+        .with_column("timestamp_year",
+                     Floor((mody_base + (col("month_y") - lit(1))
+                            .cast(T.DoubleType())) / lit(12.0))
+                     .cast(T.IntegerType())) \
+        .with_column("timestamp_month",
+                     If(tmp == lit(0.0), Literal(12, T.IntegerType()),
+                        tmp.cast(T.IntegerType()))) \
+        .with_column("delinquency_12",
+                     (col("delinquency_12") > lit(3)).cast(T.IntegerType())
+                     + (col("upb_12") == lit(0.0)).cast(T.IntegerType()))
+    test_df = test_df.select(
+        col("quarter").alias("t_quarter"),
+        col("loan_id").alias("t_loan_id"),
+        col("timestamp_year").alias("t_year"),
+        col("timestamp_month").alias("t_month"),
+        col("ever_30"), col("ever_90"), col("ever_180"),
+        col("delinquency_30"), col("delinquency_90"),
+        col("delinquency_180"), col("delinquency_12"), col("upb_12"))
+
+    return df.select(
+        col("quarter"), col("loan_id"),
+        col("monthly_reporting_period"), col("interest_rate"),
+        col("current_actual_upb"), col("loan_age"),
+        col("monthly_reporting_period_month").alias("timestamp_month"),
+        col("monthly_reporting_period_year").alias("timestamp_year")) \
+        .join(test_df, on=[("quarter", "t_quarter"),
+                           ("loan_id", "t_loan_id"),
+                           ("timestamp_year", "t_year"),
+                           ("timestamp_month", "t_month")], how="left") \
+        .select(col("quarter"), col("loan_id"),
+                col("monthly_reporting_period"), col("interest_rate"),
+                col("current_actual_upb"), col("loan_age"),
+                col("ever_30"), col("ever_90"), col("ever_180"),
+                col("delinquency_12"), col("upb_12"))
+
+
+def _acquisition(session, df):
+    """CreateAcquisition: canonicalize seller names through the
+    NameMapping broadcast lookup + date parsing."""
+    mapping = session.from_pydict(
+        {"from_seller_name": [a for a, _ in NAME_MAPPING],
+         "to_seller_name": [b for _, b in NAME_MAPPING]},
+        T.Schema([T.StructField("from_seller_name", T.StringType()),
+                  T.StructField("to_seller_name", T.StringType())]))
+    return df.join(mapping, on=[("seller_name", "from_seller_name")],
+                   how="left") \
+        .with_column("old_name", col("seller_name")) \
+        .with_column("seller_name", Coalesce(col("to_seller_name"),
+                                             col("seller_name"))) \
+        .with_column("orig_date",
+                     ParseDateFixed(col("orig_date"), "MM/yyyy")) \
+        .with_column("first_pay_date",
+                     ParseDateFixed(col("first_pay_date"), "MM/yyyy"))
+
+
+def run_etl(session, data_dir: str):
+    """Run.csv / CleanAcquisitionPrime: the full feature ETL."""
+    perf = _prepare_performance(read_performance(session, data_dir))
+    acq = _acquisition(session, read_acquisition(session, data_dir))
+    cleaned = _performance_delinquency(session, perf)
+    acq = acq.select(
+        col("loan_id").alias("acq_loan_id"),
+        col("quarter").alias("acq_quarter"),
+        col("seller_name"), col("orig_interest_rate"), col("orig_upb"),
+        col("orig_loan_term"), col("orig_date"), col("first_pay_date"),
+        col("orig_ltv"), col("dti"), col("borrower_credit_score"),
+        col("zip"))
+    return cleaned.join(acq, on=[("loan_id", "acq_loan_id"),
+                                 ("quarter", "acq_quarter")],
+                        how="inner") \
+        .order_by(("loan_id", True), ("monthly_reporting_period", True)) \
+        .limit(10000)
+
+
+def simple_aggregates(session, data_dir: str):
+    """SimpleAggregates (MortgageSpark.scala:350-366)."""
+    dfp = read_performance(session, data_dir)
+    dfa = read_acquisition(session, data_dir)
+    max_rate = dfp.with_column(
+        "monthval",
+        Month(ParseDateFixed(col("monthly_reporting_period"),
+                             "MM/dd/yyyy"))) \
+        .group_by("monthval", "loan_id") \
+        .agg(Max(col("interest_rate")).alias("max_monthly_rate"))
+    joined = max_rate.select(
+        col("loan_id").alias("p_loan_id"), col("monthval"),
+        col("max_monthly_rate")) \
+        .join(dfa, on=[("p_loan_id", "loan_id")])
+    return joined.group_by("zip", "monthval") \
+        .agg(Min(col("max_monthly_rate")).alias("min_max_monthly_rate")) \
+        .order_by(("zip", True), ("monthval", True))
+
+
+def aggregates_with_percentiles(session, data_dir: str):
+    """AggregatesWithPercentiles (:368-393): interest-rate stats +
+    exact percentiles per anonymized loan (hex(hash(loan_id)))."""
+    dfp = read_performance(session, data_dir)
+    anon = dfp.with_column("loan_id_hash",
+                           Hex(Murmur3Hash(col("loan_id")))) \
+        .select(col("loan_id_hash"), col("interest_rate"))
+    r = col("interest_rate")
+    return anon.group_by("loan_id_hash").agg(
+        Round(Min(r), 4).alias("interest_rate_min"),
+        Round(Max(r), 4).alias("interest_rate_max"),
+        Round(Average(r), 4).alias("interest_rate_avg"),
+        Round(Percentile(r, 0.5), 4).alias("interest_rate_50p"),
+        Round(Percentile(r, 0.75), 4).alias("interest_rate_75p"),
+        Round(Percentile(r, 0.90), 4).alias("interest_rate_90p"),
+        Round(Percentile(r, 0.99), 4).alias("interest_rate_99p")) \
+        .order_by(("loan_id_hash", True)).limit(1000)
+
+
+def aggregates_with_join(session, data_dir: str):
+    """AggregatesWithJoin (:395-421)."""
+    dfp = read_performance(session, data_dir)
+    dfa = read_acquisition(session, data_dir)
+    a = dfp.with_column("loan_id_hash",
+                        Hex(Murmur3Hash(col("loan_id")))) \
+        .group_by("loan_id_hash") \
+        .agg(Min(col("interest_rate")).alias("min_int_rate"))
+    b = dfa.with_column("loan_id_hash",
+                        Hex(Murmur3Hash(col("loan_id")))) \
+        .group_by("loan_id_hash") \
+        .agg(First(col("orig_interest_rate"), ignore_nulls=True)
+             .alias("first_int_rate"),
+             Coalesce(Max(col("dti")), lit(0.0)).alias("max_dti")) \
+        .select(col("loan_id_hash").alias("b_hash"),
+                col("first_int_rate"), col("max_dti"))
+    return a.join(b, on=[("loan_id_hash", "b_hash")], how="left") \
+        .order_by(("loan_id_hash", True)).limit(1000)
+
+
+MORTGAGE_QUERIES = {
+    "etl": run_etl,
+    "simple_agg": simple_aggregates,
+    "percentiles": aggregates_with_percentiles,
+    "agg_join": aggregates_with_join,
+}
+
+
+def build_mortgage_query(name: str, session, data_dir: str):
+    return MORTGAGE_QUERIES[name](session, data_dir)

@@ -1,0 +1,179 @@
+"""Synthetic Fannie-Mae-shaped mortgage data generator.
+
+Reference: the mortgage benchmark reads the public Fannie Mae
+single-family loan CSVs — pipe-delimited, headerless, quarter derived
+from the file name ``Performance_2003Q4.txt_0``
+(MortgageSpark.scala ReadPerformanceCsv/ReadAcquisitionCsv +
+GetQuarterFromCsvFileName).  This generator emits the same shapes
+deterministically: one acquisition row per loan and a monthly
+performance history per loan with a delinquency progression, so the
+delinquency-window ETL has real transitions to find.
+
+``sf`` = thousands of loans (sf=1 -> 1000 loans, ~24k performance
+rows).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["generate_mortgage", "SELLERS", "perf_schema", "acq_schema"]
+
+SELLERS = [
+    "WELLS FARGO BANK, N.A.", "JPMORGAN CHASE BANK, NATIONAL ASSOCIATION",
+    "BANK OF AMERICA, N.A.", "CITIMORTGAGE, INC.", "QUICKEN LOANS INC.",
+    "USAA FEDERAL SAVINGS BANK", "FLAGSTAR BANK, FSB", "OTHER",
+    "PNC BANK, N.A.", "SUNTRUST MORTGAGE INC.", "AMTRUST BANK",
+    "METLIFE BANK, NA", "GMAC MORTGAGE, LLC",
+]
+
+_QUARTERS = ["2003Q1", "2003Q2", "2003Q3", "2003Q4"]
+
+
+def perf_schema():
+    from spark_rapids_tpu import types as T
+    f = T.StructField
+    return T.Schema([
+        f("loan_id", T.LongType()),
+        f("monthly_reporting_period", T.StringType()),
+        f("servicer", T.StringType()),
+        f("interest_rate", T.DoubleType()),
+        f("current_actual_upb", T.DoubleType()),
+        f("loan_age", T.DoubleType()),
+        f("remaining_months_to_legal_maturity", T.DoubleType()),
+        f("adj_remaining_months_to_maturity", T.DoubleType()),
+        f("maturity_date", T.StringType()),
+        f("msa", T.DoubleType()),
+        f("current_loan_delinquency_status", T.IntegerType()),
+        f("mod_flag", T.StringType()),
+        f("zero_balance_code", T.StringType()),
+        f("zero_balance_effective_date", T.StringType()),
+        f("last_paid_installment_date", T.StringType()),
+        f("foreclosed_after", T.StringType()),
+        f("disposition_date", T.StringType()),
+        f("foreclosure_costs", T.DoubleType()),
+        f("prop_preservation_and_repair_costs", T.DoubleType()),
+        f("asset_recovery_costs", T.DoubleType()),
+        f("misc_holding_expenses", T.DoubleType()),
+        f("holding_taxes", T.DoubleType()),
+        f("net_sale_proceeds", T.DoubleType()),
+        f("credit_enhancement_proceeds", T.DoubleType()),
+        f("repurchase_make_whole_proceeds", T.StringType()),
+        f("other_foreclosure_proceeds", T.DoubleType()),
+        f("non_interest_bearing_upb", T.DoubleType()),
+        f("principal_forgiveness_upb", T.StringType()),
+        f("repurchase_make_whole_proceeds_flag", T.StringType()),
+        f("servicing_activity_indicator", T.StringType()),
+    ])
+
+
+def acq_schema():
+    from spark_rapids_tpu import types as T
+    f = T.StructField
+    return T.Schema([
+        f("loan_id", T.LongType()),
+        f("orig_channel", T.StringType()),
+        f("seller_name", T.StringType()),
+        f("orig_interest_rate", T.DoubleType()),
+        f("orig_upb", T.IntegerType()),
+        f("orig_loan_term", T.IntegerType()),
+        f("orig_date", T.StringType()),
+        f("first_pay_date", T.StringType()),
+        f("orig_ltv", T.DoubleType()),
+        f("orig_cltv", T.DoubleType()),
+        f("num_borrowers", T.DoubleType()),
+        f("dti", T.DoubleType()),
+        f("borrower_credit_score", T.DoubleType()),
+        f("first_home_buyer", T.StringType()),
+        f("loan_purpose", T.StringType()),
+        f("property_type", T.StringType()),
+        f("num_units", T.IntegerType()),
+        f("occupancy_status", T.StringType()),
+        f("property_state", T.StringType()),
+        f("zip", T.IntegerType()),
+        f("mortgage_insurance_percent", T.DoubleType()),
+        f("product_type", T.StringType()),
+        f("coborrow_credit_score", T.DoubleType()),
+        f("mortgage_insurance_type", T.DoubleType()),
+        f("relocation_mortgage_indicator", T.StringType()),
+    ])
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def generate_mortgage(data_dir: str, sf: float = 1.0,
+                      seed: int = 7) -> None:
+    """Write perf/Performance_<Q>.txt_0 and acq/Acquisition_<Q>.txt_0."""
+    if os.path.exists(os.path.join(data_dir, "_DONE")):
+        return
+    rng = np.random.default_rng(seed)
+    n_loans = max(int(1000 * sf), 40)
+    os.makedirs(os.path.join(data_dir, "perf"), exist_ok=True)
+    os.makedirs(os.path.join(data_dir, "acq"), exist_ok=True)
+    per_q = n_loans // len(_QUARTERS)
+    loan_id = 100000
+    for q in _QUARTERS:
+        year = int(q[:4])
+        qn = int(q[-1])
+        with open(os.path.join(data_dir, "acq",
+                               f"Acquisition_{q}.txt_0"), "w") as fa, \
+             open(os.path.join(data_dir, "perf",
+                               f"Performance_{q}.txt_0"), "w") as fp:
+            for _ in range(per_q):
+                loan_id += 1
+                rate = round(float(rng.uniform(2.5, 8.5)), 3)
+                upb = int(rng.integers(50, 800)) * 1000
+                term = int(rng.choice([180, 240, 360]))
+                orig_month = int(rng.integers(1, 4)) + (qn - 1) * 3
+                acq = [loan_id, rng.choice(["R", "C", "B"]),
+                       rng.choice(SELLERS), rate, upb, term,
+                       f"{orig_month:02d}/{year}",
+                       f"{(orig_month % 12) + 1:02d}/{year}",
+                       round(float(rng.uniform(40, 97)), 1),
+                       round(float(rng.uniform(40, 99)), 1),
+                       float(rng.integers(1, 3)),
+                       round(float(rng.uniform(10, 60)), 1),
+                       float(rng.integers(550, 830)),
+                       rng.choice(["Y", "N"]), rng.choice(["P", "C", "R"]),
+                       rng.choice(["SF", "PU", "CO"]),
+                       int(rng.integers(1, 5)), rng.choice(["P", "S", "I"]),
+                       rng.choice(["CA", "TX", "NY", "FL", "WA", "CO"]),
+                       int(rng.integers(10000, 99999)),
+                       round(float(rng.uniform(0, 35)), 1), "FRM",
+                       float(rng.integers(550, 830)) if rng.random() < .4
+                       else None,
+                       float(rng.integers(1, 3)) if rng.random() < .3
+                       else None,
+                       rng.choice(["Y", "N"])]
+                fa.write("|".join(_fmt(v) for v in acq) + "\n")
+                # monthly history: delinquency ratchets up for some loans
+                months = int(rng.integers(6, 30))
+                delinquent_from = months - int(rng.integers(1, 8)) \
+                    if rng.random() < 0.25 else None
+                upb_left = float(upb)
+                for t in range(months):
+                    m = (orig_month - 1 + t) % 12 + 1
+                    y = year + (orig_month - 1 + t) // 12
+                    status = 0
+                    if delinquent_from is not None and t >= delinquent_from:
+                        status = min(t - delinquent_from + 1, 9)
+                    upb_left = max(upb_left - float(upb) / term, 0.0)
+                    perf = [loan_id, f"{m:02d}/01/{y}",
+                            rng.choice(["A", "B", ""]),
+                            rate, round(upb_left, 2), float(t),
+                            float(term - t), float(term - t),
+                            f"{m:02d}/{y + term // 12}",
+                            float(rng.integers(10000, 50000)),
+                            status, rng.choice(["Y", "N"]), "", "",
+                            "", "", "", None, None, None, None, None,
+                            None, None, "", None, None, "", "", "Y"]
+                    fp.write("|".join(_fmt(v) for v in perf) + "\n")
+    with open(os.path.join(data_dir, "_DONE"), "w") as f:
+        f.write("ok\n")

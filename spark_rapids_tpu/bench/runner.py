@@ -78,6 +78,9 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
         from spark_rapids_tpu.bench.tpch_gen import generate_tpch as gen
         from spark_rapids_tpu.bench.tpch_queries import (
             build_tpch_query as build_query)
+    elif suite == "mortgage":
+        from spark_rapids_tpu.bench.mortgage import (
+            build_mortgage_query as build_query, generate_mortgage as gen)
     else:
         from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds as gen
         from spark_rapids_tpu.bench.tpcds_queries import build_query
@@ -140,7 +143,7 @@ def main() -> None:
     ap.add_argument("--queries", default="q3,q6,q42,q52,q55")
     ap.add_argument("--iterations", type=int, default=1)
     ap.add_argument("--verify", action="store_true")
-    ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch"))
+    ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch", "mortgage"))
     ap.add_argument("--report", default=None,
                     help="write the JSON report to this path")
     args = ap.parse_args()

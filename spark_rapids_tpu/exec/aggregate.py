@@ -78,16 +78,38 @@ class HashAggregateExec(PlanNode):
                     seen[key] = len(self._aggs)
                     self._aggs.append(a)
         self._agg_index = seen
+        # holistic aggregates (percentile) have NO mergeable
+        # intermediate: the whole input must reduce in one pass, so a
+        # partial/final split can never be planned over them
+        self._holistic = any(getattr(a, "requires_complete", False)
+                             for a in self._aggs)
+        if self._holistic and mode == "partial":
+            raise ValueError(
+                "holistic aggregates (percentile) cannot run in partial "
+                "mode; plan a complete aggregation")
+        if self._holistic and any(
+                op.startswith(("first", "last"))
+                for a in self._aggs for op in a.update_ops):
+            raise NotImplementedError(
+                "percentile cannot be combined with first/last in one "
+                "aggregation: the percentile value-sort would change "
+                "which row first/last observe")
 
-        # pre-projection layout: [group keys..., one col per agg input]
+        # pre-projection layout: [group keys..., one col per DISTINCT
+        # agg input] — p50(v) and p90(v) share one projected column
+        # (also what lets multiple percentiles ride one value-sort)
         self._pre_exprs: list[Expression] = list(self._group_bound)
         self._agg_input_col: list[int | None] = []
+        in_seen: dict[str, int] = {}
         for a in self._aggs:
             if a.input is None:
                 self._agg_input_col.append(None)
-            else:
-                self._agg_input_col.append(len(self._pre_exprs))
+                continue
+            key = repr(a.input)
+            if key not in in_seen:
+                in_seen[key] = len(self._pre_exprs)
                 self._pre_exprs.append(a.input)
+            self._agg_input_col.append(in_seen[key])
         if not self._pre_exprs:
             # rows-only aggregation (e.g. bare COUNT(*)): a zero-column
             # batch would lose its row count, so project a dummy literal
@@ -109,7 +131,9 @@ class HashAggregateExec(PlanNode):
             offs = []
             for op, it in zip(a.update_ops, a.intermediate_types()):
                 offs.append(k + len(self._update_specs))
-                self._update_specs.append(AggSpec(op, ci if ci is not None else 0))
+                self._update_specs.append(AggSpec(
+                    op, ci if ci is not None else 0,
+                    param=getattr(a, "q", None)))
                 buf_fields.append(T.StructField(
                     f"_buf_{len(buf_fields) - k}", it, True))
             self._agg_offsets.append(offs)
@@ -140,7 +164,8 @@ class HashAggregateExec(PlanNode):
         PlanNode.__init__(self, [child])
         self.mode = "final"
         for attr in ("_group_bound", "_group_names", "_result_raw",
-                     "_result_bound", "_aggs", "_agg_index", "_pre_exprs",
+                     "_result_bound", "_aggs", "_agg_index", "_holistic",
+                     "_pre_exprs",
                      "_agg_input_col", "_pre_schema", "_update_specs",
                      "_agg_offsets", "_buffer_schema", "_merge_specs",
                      "_final_exprs"):
@@ -270,7 +295,7 @@ class HashAggregateExec(PlanNode):
     def _jit_fns(self):
         if not hasattr(self, "_jits"):
             key_idx = list(range(len(self._group_bound)))
-            presorted = self._child_presorted()
+            presorted = self._child_presorted() and not self._holistic
 
             def update(b):
                 cols = [eval_device(e, b) for e in self._pre_exprs]
@@ -313,6 +338,16 @@ class HashAggregateExec(PlanNode):
         # aggregates were ~5s each on SF1).  The reference's
         # concatenate-then-merge loop amortizes the same way
         # (aggregate.scala:427-485).
+        if self._holistic:
+            # no merge exists for holistic aggregates: concatenate the
+            # raw input ONCE and reduce it in a single group-by pass
+            # (Spark's ObjectHashAggregate similarly buffers per-group
+            # raw values for Percentile)
+            raw = list(child_it)
+            if len(raw) > 1:
+                child_it = [ctx.dispatch(dk.concat_batches, raw)]
+            else:
+                child_it = raw
         parts: list[ColumnBatch] = []
         total_cap = 0
 
@@ -367,6 +402,24 @@ class HashAggregateExec(PlanNode):
 
     # -- host oracle path --------------------------------------------------
     def _run_host(self, child_it, key_idx) -> Iterator[HostBatch]:
+        if self._holistic:
+            # single-pass reduction over the concatenated raw input
+            # (no mergeable intermediate exists)
+            raw = list(child_it)
+            hb = hk.host_concat(raw) if len(raw) > 1 else (
+                raw[0] if raw else None)
+            if hb is None:
+                if key_idx:
+                    return
+                hb = _empty_host(self.children[0].output_schema)
+            cols = [eval_host(e, hb) for e in self._pre_exprs]
+            pre = HostBatch(cols, self._pre_schema)
+            running = _relabel_h(
+                hk.host_group_by(pre, key_idx, self._update_specs),
+                self._buffer_schema)
+            cols = [eval_host(e, running) for e in self._final_exprs]
+            yield HostBatch(cols, self._output_schema)
+            return
         parts: list[HostBatch] = []
         for b in child_it:
             if self.mode == "final":

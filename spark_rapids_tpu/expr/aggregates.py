@@ -17,6 +17,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr.core import Expression, BoundReference, Literal
 
 __all__ = ["AggregateFunction", "Sum", "Count", "CountStar", "Min", "Max",
+           "Percentile",
            "Average", "First", "Last", "CountDistinct", "stddev_samp",
            "is_aggregate", "has_aggregate"]
 
@@ -302,3 +303,47 @@ class Last(AggregateFunction):
 
     def final_expr(self, offsets):
         return BoundReference(offsets[0], self.dtype, True)
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile with linear interpolation at q*(n-1) (Spark
+    Percentile, ObjectHashAggregate-backed in the reference plugin's
+    fallback list).  HOLISTIC: there is no mergeable intermediate — the
+    planner aggregates the whole input in one pass (exec/aggregate.py
+    _holistic), so partial/final split and mesh lowering are refused."""
+
+    sql_name = "Percentile"
+    update_ops = ("percentile",)
+    merge_ops = ()          # no merge exists: holistic
+    requires_complete = True
+
+    def __init__(self, child: Expression, q: float):
+        super().__init__(child)
+        if not (0.0 <= float(q) <= 1.0):
+            raise ValueError(f"percentile fraction must be in [0,1]: {q}")
+        self.q = float(q)
+
+    def with_new_children(self, children):
+        return Percentile(children[0], self.q)
+
+    @property
+    def dtype(self):
+        return T.DoubleType()
+
+    def coerced(self):
+        from spark_rapids_tpu.expr.cast import Cast
+        t = self.input.dtype
+        if not t.numeric:
+            raise TypeError(f"percentile over {t}")
+        if not isinstance(t, T.DoubleType):
+            return Percentile(Cast(self.input, T.DoubleType()), self.q)
+        return self
+
+    def intermediate_types(self):
+        return [T.DoubleType()]
+
+    def final_expr(self, offsets):
+        return BoundReference(offsets[0], T.DoubleType(), True)
+
+    def __repr__(self):
+        return f"Percentile({self.children[0]!r}, {self.q})"

@@ -17,7 +17,8 @@ __all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
            "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
            "DateDiff", "ToDate", "AddMonths", "LastDay", "NextDay",
            "TruncDate", "WeekOfYear", "FromUnixTime", "UnixTimestamp",
-           "DateFormatClass", "MonthsBetween"]
+           "DateFormatClass", "MonthsBetween",
+           "ParseDateFixed"]
 
 _MICROS_PER_DAY = 86_400_000_000
 
@@ -620,3 +621,101 @@ class MonthsBetween(Expression):
         if self.round_off:
             out = xp.round(out * 1e8) / 1e8
         return ctx.canonical(out, validity, T.DoubleType())
+
+
+class ParseDateFixed(Expression):
+    """to_date(str, fmt) for FIXED-WIDTH digit formats ("MM/dd/yyyy",
+    "MM/yyyy", "yyyy-MM-dd", ...): digits parse straight out of the
+    byte matrix at the format's positions in one vectorized device
+    program — the mortgage suite's date parsing (reference
+    GpuGetTimestamp / specialized to_date paths run these fixed
+    formats on device too).  Unparseable rows are null (Spark
+    non-ANSI to_date)."""
+
+    sql_name = "ParseDateFixed"
+
+    def __init__(self, child: Expression, fmt: str):
+        for tok in ("MM",):
+            assert tok in fmt, f"format {fmt!r} needs MM"
+        assert "yyyy" in fmt, f"format {fmt!r} needs yyyy"
+        self.children = (child,)
+        self.fmt = fmt
+
+    def with_new_children(self, children):
+        return ParseDateFixed(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.DateType()
+
+    def __repr__(self):
+        return f"ParseDateFixed({self.children[0]!r}, {self.fmt!r})"
+
+    def _eval(self, vals, ctx):
+        import datetime as _dt
+        a = vals[0]
+        fmt = self.fmt
+        if not ctx.is_device:
+            py_fmt = fmt.replace("yyyy", "%Y").replace("MM", "%m") \
+                        .replace("dd", "%d")
+            n = ctx.capacity
+            out = np.zeros(n, np.int32)
+            validity = np.zeros(n, np.bool_)
+            epoch = _dt.date(1970, 1, 1)
+            for i in range(n):
+                if not a.validity[i]:
+                    continue
+                sv = a.data[i]
+                # fixed-width contract, same as the device branch:
+                # strptime alone accepts "1/02/2003" / 2-digit years
+                if sv is None or len(sv) != len(fmt):
+                    continue
+                try:
+                    d = _dt.datetime.strptime(sv, py_fmt).date()
+                except (ValueError, TypeError):
+                    continue
+                out[i] = (d - epoch).days
+                validity[i] = True
+            return ctx.canonical(out, validity, T.DateType())
+
+        xp = ctx.xp
+        w = a.data.shape[1]
+        flen = len(fmt)
+
+        def at(j):
+            return a.data[:, j] if j < w else xp.zeros(
+                a.data.shape[0], np.uint8)
+
+        def digits(start, ln):
+            val = xp.zeros(a.data.shape[0], np.int32)
+            ok = xp.ones(a.data.shape[0], bool)
+            for j in range(start, start + ln):
+                c = at(j).astype(np.int32)
+                ok = ok & (c >= 48) & (c <= 57)
+                val = val * 10 + (c - 48)
+            return val, ok
+
+        y, ok_y = digits(fmt.index("yyyy"), 4)
+        m, ok_m = digits(fmt.index("MM"), 2)
+        if "dd" in fmt:
+            d, ok_d = digits(fmt.index("dd"), 2)
+        else:
+            d = xp.ones(a.data.shape[0], np.int32)
+            ok_d = xp.ones(a.data.shape[0], bool)
+        seps_ok = xp.ones(a.data.shape[0], bool)
+        for j, ch in enumerate(fmt):
+            if ch not in "yMd":
+                seps_ok = seps_ok & (at(j) == ord(ch))
+        valid = a.validity & (a.lengths == flen) & ok_y & ok_m & ok_d \
+            & seps_ok & (m >= 1) & (m <= 12) & (d >= 1) \
+            & (d <= _last_dom(y, xp.clip(m, 1, 12), xp))
+        # days-from-civil (Hinnant): exact integer arithmetic, no python
+        # date objects on the hot path
+        y2 = y - (m <= 2)
+        era = xp.floor_divide(y2, 400)
+        yoe = y2 - era * 400
+        mp = xp.where(m > 2, m - 3, m + 9)
+        doy = (153 * mp + 2) // 5 + d - 1
+        doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+        days = (era * 146097 + doe - 719468).astype(np.int32)
+        return ctx.canonical(xp.where(valid, days, 0), valid, T.DateType())

@@ -181,6 +181,15 @@ def _agg_reduce(spec: AggSpec, col: HostColumn | None, seg_starts: np.ndarray,
         elif spec.op == "last_non_null":
             out[g] = vals[-1]
             out_valid[g] = True
+        elif spec.op == "percentile":
+            # same algorithm as the device kernel (sort + linear
+            # interpolation at q*(n-1)) so differential tests compare
+            # bit-for-bit, not vs np.percentile's internals
+            v = np.sort(vals.astype(np.float64))
+            pos = (len(v) - 1) * spec.param
+            lo, hi = int(np.floor(pos)), int(np.ceil(pos))
+            out[g] = v[lo] + (v[hi] - v[lo]) * (pos - lo)
+            out_valid[g] = True
         else:
             raise NotImplementedError(spec.op)
     return HostColumn(out, out_valid, res_type)

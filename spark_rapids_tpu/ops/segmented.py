@@ -26,13 +26,14 @@ __all__ = ["AggSpec", "sorted_group_by"]
 
 # supported aggregate ops (reference AggregateFunctions.scala:531 CudfAggregate)
 _AGG_OPS = ("sum", "count", "count_star", "min", "max", "avg", "first", "last",
-            "first_non_null", "last_non_null")
+            "first_non_null", "last_non_null", "percentile")
 
 
 @dataclass(frozen=True)
 class AggSpec:
     op: str          # one of _AGG_OPS
     child_index: int  # input column (ignored for count_star)
+    param: float | None = None  # percentile fraction q in [0, 1]
 
     def result_type(self, input_type: T.DataType) -> T.DataType:
         if self.op in ("count", "count_star"):
@@ -41,7 +42,7 @@ class AggSpec:
             if input_type.integral:
                 return T.LongType()
             return T.DoubleType()
-        if self.op == "avg":
+        if self.op in ("avg", "percentile"):
             return T.DoubleType()
         return input_type
 
@@ -80,12 +81,23 @@ def sorted_group_by(batch: ColumnBatch, key_indices: list[int],
     (the reference's sort-aggregate-over-sorted-input fast path).
     """
     cap = batch.capacity
-    if key_indices:
-        if presorted:
+    # percentile is order-holistic: rows must ALSO sort by the value
+    # column within each key group (nulls last, so each segment's valid
+    # run starts at the segment start) — Spark computes the same via
+    # per-group sorted buffers (ObjectHashAggregate Percentile)
+    pct_cols = sorted({s.child_index for s in aggs if s.op == "percentile"})
+    if len(pct_cols) > 1:
+        raise NotImplementedError(
+            "percentile aggregates over multiple distinct columns in one "
+            "group-by are not supported (one value-sort per group-by)")
+    if key_indices or pct_cols:
+        if presorted and not pct_cols:
             sb = batch
         else:
             orders = [SortOrder(i, True, True) for i in key_indices]
+            orders += [SortOrder(i, True, False) for i in pct_cols]
             sb = sort_batch(batch, orders)
+    if key_indices:
         real = sb.row_mask()
         idx = jnp.arange(cap, dtype=jnp.int32)
         differ = jnp.zeros(cap, jnp.bool_)
@@ -98,7 +110,8 @@ def sorted_group_by(batch: ColumnBatch, key_indices: list[int],
         num_groups = jnp.where(sb.num_rows > 0,
                                seg_id[jnp.maximum(sb.num_rows - 1, 0)] + 1, 0)
     else:
-        sb = batch
+        if not pct_cols:
+            sb = batch  # grand aggregate without percentile: no sort
         real = sb.row_mask()
         seg_id = jnp.zeros(cap, jnp.int32)
         num_groups = jnp.asarray(1, jnp.int32)  # grand aggregate: one row
@@ -245,6 +258,28 @@ def _compute_agg(spec: AggSpec, col: DeviceColumn | None, seg_id, real, cap,
         zero = jnp.zeros((), data.dtype)
         return DeviceColumn(jnp.where(validity, data, zero), validity,
                             col.dtype), col.dtype
+
+    if op == "percentile":
+        # rows arrive sorted (keys, value asc, value-nulls last), so each
+        # segment's valid values occupy [seg_start, seg_start + cnt_valid);
+        # linear interpolation at q*(n-1), Spark Percentile semantics
+        q = spec.param
+        assert q is not None, "percentile AggSpec needs param=q"
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        starts = jax.ops.segment_min(jnp.where(real, idx, cap), seg_id,
+                                     num_segments=cap)
+        pos = (cnt_valid - 1).astype(jnp.float64) * q
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.ceil(pos).astype(jnp.int32)
+        frac = pos - lo
+        base = jnp.clip(starts, 0, cap - 1)
+        x = col.data.astype(jnp.float64)
+        vlo = x[jnp.clip(base + lo, 0, cap - 1)]
+        vhi = x[jnp.clip(base + hi, 0, cap - 1)]
+        data = vlo + (vhi - vlo) * frac
+        validity = (cnt_valid > 0) & out_mask
+        return DeviceColumn(jnp.where(validity, data, 0.0), validity,
+                            T.DoubleType()), T.DoubleType()
 
     if op in ("first", "last", "first_non_null", "last_non_null"):
         # index of first/last row per segment; *_non_null picks among valid

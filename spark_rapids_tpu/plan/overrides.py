@@ -388,7 +388,13 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
 
 def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
     c = lower(node.child, conf)
-    if conf.mesh_device_count > 1 and not _schema_has_arrays(c.exec_node):
+    # holistic aggregates (percentile) have no mergeable intermediate:
+    # neither the partial/final split nor the mesh program can run
+    # them — plan a whole-input complete aggregation
+    holistic = any(getattr(sub, "requires_complete", False)
+                   for e in node.agg_exprs for sub in e.walk())
+    if conf.mesh_device_count > 1 and not holistic \
+            and not _schema_has_arrays(c.exec_node):
         # grouped AND grand aggregates both lower to the mesh program
         # (grand: partials merge on device 0 inside the shard_map) — a
         # grand aggregate over a mesh join's per-device outputs must
@@ -399,7 +405,7 @@ def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:
                                conf.mesh_device_count)
         return PlannedNode(ex, list(node.agg_exprs), [c])
     nparts = c.exec_node.num_partitions(ExecCtx(backend="host"))
-    if node.group_exprs and nparts > 1:
+    if node.group_exprs and nparts > 1 and not holistic:
         partial = HashAggregateExec(node.group_exprs, node.agg_exprs,
                                     c.exec_node, mode="partial")
         pmeta = PlannedNode(partial, list(node.agg_exprs), [c])

@@ -1,0 +1,79 @@
+"""Mortgage benchmark suite: generator + the reference's four jobs
+verify vs the host oracle (reference MortgageSpark.scala Run /
+SimpleAggregates / AggregatesWithPercentiles / AggregatesWithJoin;
+test model: mortgage_test.py's assert_results_equal)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.bench.mortgage import MORTGAGE_QUERIES
+from spark_rapids_tpu.bench.mortgage_gen import generate_mortgage
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.exec.core import collect_host
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("mortgage") / "sf02")
+    generate_mortgage(d, sf=0.2)
+    return d
+
+
+def _norm(rows):
+    return [tuple(round(x, 6) if isinstance(x, float) else x for x in r)
+            for r in rows]
+
+
+@pytest.mark.parametrize("name", sorted(MORTGAGE_QUERIES))
+def test_mortgage_job_device_matches_oracle(data_dir, name):
+    s = TpuSession({})
+    q = MORTGAGE_QUERIES[name](s, data_dir)
+    dev = q.collect()
+    assert len(dev) > 0
+    ov, meta = q._overridden(quiet=True)
+    host = collect_host(meta.exec_node, s.conf)
+    assert _norm(dev) == _norm(host)
+
+
+def test_mortgage_etl_delinquency_windows(data_dir):
+    """The 12-month window expansion must find real delinquency
+    transitions: some loans are ever_90, and their delinquency_12
+    class is > 0 somewhere."""
+    s = TpuSession({})
+    rows = MORTGAGE_QUERIES["etl"](s, data_dir).collect()
+    # run_etl output: ever_30=6, ever_90=7, ever_180=8, delinquency_12=9
+    ever90 = [r[7] for r in rows]
+    ever180 = [r[8] for r in rows]
+    d12 = [r[9] for r in rows if r[9] is not None]
+    assert any(ever90), "generator should produce 90-day delinquents"
+    assert any(ever180), "generator should produce 180-day delinquents"
+    assert any(v and v > 0 for v in d12)
+
+
+def test_mortgage_percentiles_are_exact(data_dir):
+    """Percentile columns must equal numpy's linear interpolation over
+    the same groups (the engine's holistic percentile path)."""
+    s = TpuSession({})
+    from spark_rapids_tpu.bench.mortgage import read_performance
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.expr.hashing import Murmur3Hash
+    from spark_rapids_tpu.expr.strings import Hex
+    base = read_performance(s, data_dir).with_column(
+        "loan_id_hash", Hex(Murmur3Hash(col("loan_id")))) \
+        .select(col("loan_id_hash"), col("interest_rate")).collect()
+    by_k = {}
+    for k, v in base:
+        by_k.setdefault(k, []).append(v)
+    got = MORTGAGE_QUERIES["percentiles"](s, data_dir).collect()
+    for row in got[:50]:
+        k = row[0]
+        want = np.percentile(by_k[k], 50)
+        assert abs(row[4] - round(want, 4)) < 1e-9, (k, row[4], want)
+
+
+def test_mortgage_via_runner(data_dir):
+    r = run_benchmark(data_dir, 0.2, ["simple_agg"], verify=True,
+                      generate=False, suite="mortgage")[0]
+    assert "error" not in r, r
+    assert r["ok"], r

@@ -341,3 +341,60 @@ def test_exact_double_agg_gate_covers_mesh_aggregates():
     assert "MeshAggregateExec" in ex
     assert "double aggregation forced to host" in ex
     assert len(q.collect()) == 4
+
+
+def test_percentile_holistic_plan_and_results():
+    """Percentile has no mergeable intermediate: the planner must use a
+    whole-input complete aggregation (no partial/final split, no mesh
+    program) and match numpy's linear interpolation exactly."""
+    import numpy as np
+    from spark_rapids_tpu.expr.aggregates import Average, Percentile, Sum
+
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 6, 3000).astype(np.int32)
+    v = rng.normal(size=3000) * 7
+    s = TpuSession({})
+    df = s.from_pydict({"k": k, "v": v}, schema, partitions=4)
+    q = df.group_by("k").agg(Percentile(col("v"), 0.5).alias("p50"),
+                             Percentile(col("v"), 0.99).alias("p99"),
+                             Sum(col("v")).alias("sv"),
+                             Average(col("v")).alias("av"))
+    ex = q.explain()
+    assert "HashAggregateExec[complete" in ex
+    assert "partial" not in ex
+    got = {r[0]: r for r in q.collect()}
+    for g in range(6):
+        seg = v[k == g]
+        assert abs(got[g][1] - np.percentile(seg, 50)) < 1e-9
+        assert abs(got[g][2] - np.percentile(seg, 99)) < 1e-9
+        assert abs(got[g][3] - seg.sum()) < 1e-9
+
+    # mesh sessions must fall back off the mesh program too
+    sm = TpuSession({"spark.rapids.tpu.mesh.deviceCount": 8})
+    qm = sm.from_pydict({"k": k, "v": v}, schema, partitions=4) \
+        .group_by("k").agg(Percentile(col("v"), 0.5).alias("p"))
+    exm = qm.explain()
+    assert "MeshAggregateExec" not in exm
+    assert len(qm.collect()) == 6
+
+    # out-of-range fraction refused up front
+    import pytest as _pt
+    with _pt.raises(ValueError, match="fraction"):
+        Percentile(col("v"), 1.5)
+
+
+def test_percentile_with_first_last_rejected():
+    """The percentile value-sort would change which row first/last
+    observe on device (host keeps input order) — refuse the mix."""
+    import pytest as _pt
+    from spark_rapids_tpu.expr.aggregates import First, Percentile
+
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    s = TpuSession({})
+    df = s.from_pydict({"k": [0, 0, 1], "v": [1.0, 2.0, 3.0]}, schema)
+    with _pt.raises(NotImplementedError, match="first/last"):
+        df.group_by("k").agg(Percentile(col("v"), 0.5).alias("p"),
+                             First(col("v")).alias("f")).collect()
