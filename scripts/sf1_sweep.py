@@ -1,0 +1,80 @@
+"""Full TPC-DS SF1 verified sweep with per-query checkpointing.
+
+Writes one JSON line per query to the checkpoint as it goes (a crashed
+or killed run resumes where it left off) and assembles
+bench_results_sf1_cpu.json at the end.  Usage:
+
+    JAX_PLATFORMS=cpu python scripts/sf1_sweep.py [checkpoint.jsonl]
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spark_rapids_tpu.bench.runner import run_benchmark  # noqa: E402
+from spark_rapids_tpu.bench.tpcds_queries import QUERIES  # noqa: E402
+
+CKPT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sf1_sweep_ckpt.jsonl"
+DATA = ".bench_data/sf1"
+OUT = "bench_results_sf1_cpu.json"
+
+
+def main():
+    done = {}
+    if os.path.exists(CKPT):
+        with open(CKPT) as f:
+            for line in f:
+                r = json.loads(line)
+                done[r["query"]] = r
+        print(f"resuming: {len(done)} queries already recorded",
+              flush=True)
+    queries = sorted(QUERIES, key=lambda q: int(q[1:]))
+    t0 = time.time()
+    with open(CKPT, "a") as ck:
+        for name in queries:
+            if name in done:
+                continue
+            r = run_benchmark(DATA, 1.0, [name], iterations=2,
+                              verify=True, generate=False)[0]
+            times = r.get("device_s_all") or [0]
+            rec = {"query": name, "ok": r.get("ok"),
+                   "rows": r.get("rows"),
+                   "device_warm_s": min(times),
+                   "oracle_s": r.get("oracle_s")}
+            if r.get("oracle_s"):
+                rec["speedup"] = round(r["oracle_s"] /
+                                       max(min(times), 1e-9), 2)
+            if "error" in r:
+                rec["error"] = r["error"]
+            ck.write(json.dumps(rec) + "\n")
+            ck.flush()
+            done[name] = rec
+            print(f"{name}: ok={rec['ok']} "
+                  f"speedup={rec.get('speedup')}", flush=True)
+    recs = [done[q] for q in queries]
+    oks = [r for r in recs if r.get("ok")]
+    sp = sorted(r["speedup"] for r in oks if r.get("speedup"))
+    out = {
+        "description": (
+            "TPC-DS FULL 99-query differential sweep, SF1, device engine "
+            "(XLA:CPU backend, warm persistent compile cache, best of 2 "
+            "iterations) vs single-threaded numpy host oracle; 1-core "
+            "build VM. Device==oracle verified per query."),
+        "generated_by": "scripts/sf1_sweep.py (iterations=2, verify)",
+        "host_cpus": os.cpu_count(),
+        "summary": {"verified": len(oks), "total": len(queries),
+                    "median_speedup": sp[len(sp) // 2] if sp else None,
+                    "min_speedup": sp[0] if sp else None,
+                    "max_speedup": sp[-1] if sp else None,
+                    "wall_s": round(time.time() - t0, 1)},
+        "queries": recs,
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["summary"]))
+
+
+if __name__ == "__main__":
+    main()

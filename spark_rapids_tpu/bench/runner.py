@@ -70,9 +70,9 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
     """Run each query ``iterations`` times on the device engine; report
     per-query wall times (median), row counts, and optional host-oracle
     verification. Returns a list of per-query report dicts.
-    ``suite`` selects the workload: "tpcds" (default) or "tpch"
-    (reference BenchmarkRunner supports tpcds/tpch/tpcxbb the same way,
-    BenchmarkRunner.scala)."""
+    ``suite`` selects the workload: "tpcds" (default), "tpch",
+    "tpcxbb", or "mortgage" (reference BenchmarkRunner supports the
+    same suites, BenchmarkRunner.scala)."""
     from spark_rapids_tpu.session import TpuSession
     if suite == "tpch":
         from spark_rapids_tpu.bench.tpch_gen import generate_tpch as gen
@@ -81,6 +81,11 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
     elif suite == "mortgage":
         from spark_rapids_tpu.bench.mortgage import (
             build_mortgage_query as build_query, generate_mortgage as gen)
+    elif suite == "tpcxbb":
+        from spark_rapids_tpu.bench.tpcxbb_gen import (
+            generate_tpcxbb as gen)
+        from spark_rapids_tpu.bench.tpcxbb_queries import (
+            build_tpcxbb_query as build_query)
     else:
         from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds as gen
         from spark_rapids_tpu.bench.tpcds_queries import build_query
@@ -143,7 +148,7 @@ def main() -> None:
     ap.add_argument("--queries", default="q3,q6,q42,q52,q55")
     ap.add_argument("--iterations", type=int, default=1)
     ap.add_argument("--verify", action="store_true")
-    ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch", "mortgage"))
+    ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch", "mortgage", "tpcxbb"))
     ap.add_argument("--report", default=None,
                     help="write the JSON report to this path")
     args = ap.parse_args()

@@ -1,0 +1,128 @@
+"""TPCx-BB ("big bench") data: the TPC-DS tables plus the three
+clickstream/review/marketprice tables the BigBench queries add.
+
+Reference: TpcxbbLikeSpark.scala reads the BigBench data model —
+the retail tables shared with TPC-DS plus ``web_clickstreams``
+(views + purchases), ``product_reviews`` (rating + text), and
+``item_marketprices`` (competitor price windows).  The generator
+reuses :func:`generate_tpcds` for the shared tables and emits the
+three extras with the correlations the queries probe (clicks follow
+items/users, purchases mark wcs_sales_sk non-null, review ratings
+cluster per item, marketprice windows bracket real sold dates).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from spark_rapids_tpu.bench.tpcds_gen import (_DATE_SK_EPOCH,
+                                              _write_parquet,
+                                              generate_tpcds,
+                                              table_row_counts)
+
+__all__ = ["generate_tpcxbb", "tpcxbb_row_counts"]
+
+_WORDS = ("great product fast shipping works as described would buy again "
+          "poor quality broke after a week disappointed returned it "
+          "average okay for the price decent value excellent service "
+          "terrible support never again love it highly recommend").split()
+
+
+def tpcxbb_row_counts(sf: float) -> dict[str, int]:
+    base = table_row_counts(sf)
+    return {
+        "web_clickstreams": int(base["store_sales"] * 2.5),
+        "product_reviews": max(int(base["item"] * 1.5), 100),
+        "item_marketprices": max(int(base["item"] * 0.6), 60),
+    }
+
+
+_SCHEMA_VERSION = 1
+
+
+def generate_tpcxbb(data_dir: str, sf: float = 0.01,
+                    seed: int = 42, rows_per_file: int = 250_000) -> None:
+    generate_tpcds(data_dir, sf=sf, seed=seed,
+                   rows_per_file=rows_per_file)
+    # the marker encodes (schema version, sf, seed) and stale extras
+    # dirs are removed before regeneration — same discipline as
+    # generate_tpcds (a bare marker kept SF1 clickstreams alive under
+    # an SF0.01 regeneration: silently inconsistent joins)
+    import shutil
+    done = os.path.join(data_dir, "_TPCXBB_DONE")
+    stamp = f"v{_SCHEMA_VERSION} sf={sf:g} seed={seed}"
+    if os.path.exists(done) and open(done).read().strip() == stamp:
+        return
+    for t in ("web_clickstreams", "product_reviews",
+              "item_marketprices"):
+        shutil.rmtree(os.path.join(data_dir, t), ignore_errors=True)
+    rng = np.random.default_rng(seed + 7)
+    base = table_row_counts(sf)
+    counts = tpcxbb_row_counts(sf)
+    n_item = base["item"]
+    n_cust = base["customer"]
+
+    # -- web_clickstreams: views + purchases over real users/items ------
+    n = counts["web_clickstreams"]
+    user = rng.integers(1, n_cust + 1, n).astype(np.int32)
+    # ~8% anonymous sessions (null user)
+    user_obj = user.astype(object)
+    user_obj[rng.random(n) < 0.08] = None
+    sales = np.empty(n, dtype=object)
+    is_buy = rng.random(n) < 0.1          # 10% of clicks are purchases
+    sales[:] = None
+    sales[is_buy] = rng.integers(1, n // 10 + 2,
+                                 int(is_buy.sum())).astype(np.int32)
+    _write_parquet(os.path.join(data_dir, "web_clickstreams"), {
+        "wcs_click_date_sk": (rng.integers(36890, 37620, n)
+                              + _DATE_SK_EPOCH).astype(np.int32),
+        "wcs_click_time_sk": rng.integers(0, 86400, n).astype(np.int32),
+        "wcs_item_sk": rng.integers(1, n_item + 1, n).astype(np.int32),
+        "wcs_user_sk": user_obj,
+        "wcs_sales_sk": sales,
+    }, rows_per_file)
+
+    # -- product_reviews: per-item rating clusters + text ---------------
+    n = counts["product_reviews"]
+    item = rng.integers(1, n_item + 1, n).astype(np.int32)
+    item_bias = (item % 5).astype(np.float64)  # per-item rating level
+    rating = np.clip(np.round(1 + item_bias + rng.normal(0, 1, n)),
+                     1, 5).astype(np.int32)
+    content = np.array(
+        [" ".join(rng.choice(_WORDS, size=rng.integers(5, 15)))
+         for _ in range(n)], dtype=object)
+    item_obj = item.astype(object)
+    item_obj[rng.random(n) < 0.02] = None   # a few unattributed reviews
+    _write_parquet(os.path.join(data_dir, "product_reviews"), {
+        "pr_review_sk": np.arange(1, n + 1, dtype=np.int64),
+        "pr_review_date": (rng.integers(36890, 37620, n)
+                           + _DATE_SK_EPOCH).astype(np.int64),
+        "pr_review_rating": rating,
+        "pr_item_sk": item_obj,
+        "pr_user_sk": rng.integers(1, n_cust + 1, n).astype(np.int32),
+        "pr_review_content": content,
+    }, rows_per_file)
+
+    # -- item_marketprices: competitor price windows --------------------
+    n = counts["item_marketprices"]
+    imp_item = rng.integers(1, n_item + 1, n).astype(np.int32)
+    # plant windows for the Q24 anchor item (item 100 exists at every
+    # scale factor; the reference anchors on 10000, which only exists
+    # at SF >= ~0.1 — documented deviation in tpcxbb_queries.q24)
+    if n > 10:
+        imp_item[:3] = min(100, n_item)
+    start = (rng.integers(36890, 37500, n)
+             + _DATE_SK_EPOCH).astype(np.int32)
+    _write_parquet(os.path.join(data_dir, "item_marketprices"), {
+        "imp_sk": np.arange(1, n + 1, dtype=np.int64),
+        "imp_item_sk": imp_item,
+        "imp_competitor": np.array(
+            [f"comp_{i % 7}" for i in range(n)], dtype=object),
+        "imp_competitor_price": np.round(
+            rng.uniform(0.5, 120.0, n), 2),
+        "imp_start_date": start,
+        "imp_end_date": (start + rng.integers(10, 90, n)).astype(np.int32),
+    }, rows_per_file)
+    with open(done, "w") as f:
+        f.write(stamp + "\n")

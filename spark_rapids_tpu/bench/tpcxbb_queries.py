@@ -1,0 +1,774 @@
+"""TPCx-BB (BigBench) queries as DataFrame code.
+
+Reference: TpcxbbLikeSpark.scala (integration_tests .../tests/tpcxbb)
+— the reference implements 19 of the 30 BigBench queries as Spark SQL
+and REFUSES the other 11 (UDTF / external python / hive UDF stages,
+:808-2130); this module mirrors both: the same 19 run against the
+DataFrame API, and q1-q4, q8, q10, q18, q19, q27, q29, q30 raise with
+the reference's reasons.
+
+Documented deviations from the reference constants, forced by the
+pruned generator's domains (tpcds_gen.py):
+* q7 filters d_year 2001 (ref: 2004 — outside the generated 1998-2003
+  sales span) and q15 store 1 (ref: 10 — only >= SF1 has 10 stores).
+* q24 anchors item 100 (ref: 10000, which only exists at SF >= ~0.06).
+* q11's ``corr`` and q20/q25's mixed count(distinct)+plain aggregates
+  are expressed with their exact algebraic expansions (sums/counts and
+  a distinct-frame join) — same results, engine-supported plan shapes.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import (Average, Count, CountDistinct,
+                                              CountStar, Max, Min, Sum)
+from spark_rapids_tpu.expr.conditional import Coalesce, If
+from spark_rapids_tpu.expr.core import Literal, col, lit
+from spark_rapids_tpu.expr.math_ops import Round, Sqrt
+from spark_rapids_tpu.expr.predicates import In, IsNotNull, IsNull
+
+__all__ = ["TPCXBB_QUERIES", "UNSUPPORTED", "build_tpcxbb_query"]
+
+_EPOCH = 2415022  # d_date_sk of 1900-01-01 (tpcds_gen._DATE_SK_EPOCH)
+
+
+def _t(session, data_dir: str, table: str, columns=None):
+    return session.read_parquet(os.path.join(data_dir, table),
+                                columns=columns)
+
+
+def _sk(day: str) -> int:
+    """d_date_sk of an ISO day."""
+    d = datetime.date.fromisoformat(day)
+    return (d - datetime.date(1900, 1, 1)).days + _EPOCH
+
+
+def _date(day: str):
+    return lit(datetime.date.fromisoformat(day))
+
+
+def _flag(cond):
+    return If(cond, lit(1), lit(0))
+
+
+def q5(session, data_dir: str):
+    """Logistic-regression features: clicks per category vs demographics
+    (TpcxbbLikeSpark.scala Q5Like)."""
+    wcs = _t(session, data_dir, "web_clickstreams",
+             ["wcs_item_sk", "wcs_user_sk"]) \
+        .where(IsNotNull(col("wcs_user_sk")))
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_category_id"])
+    clicks = wcs.join(it, on=[("wcs_item_sk", "i_item_sk")]) \
+        .group_by("wcs_user_sk") \
+        .agg(Sum(_flag(col("i_category") == lit("Books")))
+             .alias("clicks_in_category"),
+             *[Sum(_flag(col("i_category_id") == lit(i)))
+               .alias(f"clicks_in_{i}") for i in range(1, 8)])
+    ct = _t(session, data_dir, "customer",
+            ["c_customer_sk", "c_current_cdemo_sk"])
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_gender", "cd_education_status"])
+    return clicks.join(ct, on=[("wcs_user_sk", "c_customer_sk")]) \
+        .join(cd, on=[("c_current_cdemo_sk", "cd_demo_sk")]) \
+        .select(col("clicks_in_category"),
+                _flag(In(col("cd_education_status"),
+                         [lit(s) for s in ("Advanced Degree", "College",
+                                           "4 yr Degree", "2 yr Degree")]))
+                .alias("college_education"),
+                _flag(col("cd_gender") == lit("M")).alias("male"),
+                *[col(f"clicks_in_{i}") for i in range(1, 8)])
+
+
+def _year_totals(session, data_dir, table, cust_col, date_col, val_exprs):
+    """Per-customer first/second-year totals with HAVING first > 0
+    (q6/q13 temp views)."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(In(col("d_year"), [lit(2001), lit(2002)]))
+    sales = _t(session, data_dir, table,
+               [cust_col, date_col] + val_exprs["cols"])
+    v = val_exprs["value"]
+    return sales.join(dd, on=[(date_col, "d_date_sk")]) \
+        .group_by(cust_col) \
+        .agg(Sum(If(col("d_year") == lit(2001), v, lit(0.0)))
+             .alias("first_year_total"),
+             Sum(If(col("d_year") == lit(2002), v, lit(0.0)))
+             .alias("second_year_total")) \
+        .where(col("first_year_total") > lit(0.0))
+
+
+def q6(session, data_dir: str):
+    """Store->web purchase-habit shift, top 100 by web increase ratio."""
+    half = {"cols": ["ss_ext_list_price", "ss_ext_wholesale_cost",
+                     "ss_ext_discount_amt", "ss_ext_sales_price"],
+            "value": ((col("ss_ext_list_price")
+                       - col("ss_ext_wholesale_cost")
+                       - col("ss_ext_discount_amt")
+                       + col("ss_ext_sales_price")) / lit(2.0))}
+    whalf = {"cols": ["ws_ext_list_price", "ws_ext_wholesale_cost",
+                      "ws_ext_discount_amt", "ws_ext_sales_price"],
+             "value": ((col("ws_ext_list_price")
+                        - col("ws_ext_wholesale_cost")
+                        - col("ws_ext_discount_amt")
+                        + col("ws_ext_sales_price")) / lit(2.0))}
+    store = _year_totals(session, data_dir, "store_sales",
+                         "ss_customer_sk", "ss_sold_date_sk", half) \
+        .select(col("ss_customer_sk").alias("s_cust"),
+                col("first_year_total").alias("s_first"),
+                col("second_year_total").alias("s_second"))
+    web = _year_totals(session, data_dir, "web_sales",
+                       "ws_bill_customer_sk", "ws_sold_date_sk", whalf) \
+        .select(col("ws_bill_customer_sk").alias("w_cust"),
+                col("first_year_total").alias("w_first"),
+                col("second_year_total").alias("w_second"))
+    c = _t(session, data_dir, "customer",
+           ["c_customer_sk", "c_first_name", "c_last_name",
+            "c_preferred_cust_flag", "c_birth_country", "c_login",
+            "c_email_address"])
+    wr = (col("w_second") / col("w_first"))
+    sr = (col("s_second") / col("s_first"))
+    return store.join(web, on=[("s_cust", "w_cust")]) \
+        .join(c, on=[("w_cust", "c_customer_sk")]) \
+        .where(wr > sr) \
+        .select(wr.alias("web_sales_increase_ratio"),
+                col("c_customer_sk"), col("c_first_name"),
+                col("c_last_name"), col("c_preferred_cust_flag"),
+                col("c_birth_country"), col("c_login"),
+                col("c_email_address")) \
+        .order_by(("web_sales_increase_ratio", False),
+                  ("c_customer_sk", True), ("c_first_name", True),
+                  ("c_last_name", True), ("c_preferred_cust_flag", True),
+                  ("c_birth_country", True), ("c_login", True)) \
+        .limit(100)
+
+
+def q7(session, data_dir: str):
+    """States with >=10 customers buying items priced >=20% above their
+    category average in one month (d_year 2001 deviation, see module
+    docstring)."""
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_current_price"])
+    avg_price = it.group_by("i_category") \
+        .agg((Average(col("i_current_price")) * lit(1.2))
+             .alias("avg_price")) \
+        .select(col("i_category").alias("ap_cat"), col("avg_price"))
+    high = it.join(avg_price, on=[("i_category", "ap_cat")]) \
+        .where(col("i_current_price") > col("avg_price")) \
+        .select(col("i_item_sk").alias("hp_item_sk"))
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") == lit(7))) \
+        .select(col("d_date_sk"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_customer_sk", "ss_item_sk", "ss_sold_date_sk"])
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_state"]) \
+        .where(IsNotNull(col("ca_state")))
+    c = _t(session, data_dir, "customer",
+           ["c_customer_sk", "c_current_addr_sk"])
+    return ss.join(high, on=[("ss_item_sk", "hp_item_sk")]) \
+        .join(dd, on=[("ss_sold_date_sk", "d_date_sk")], how="semi") \
+        .join(c, on=[("ss_customer_sk", "c_customer_sk")]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")]) \
+        .group_by("ca_state").agg(CountStar().alias("cnt")) \
+        .where(col("cnt") >= lit(10)) \
+        .order_by(("cnt", False), ("ca_state", True)).limit(10)
+
+
+def q9(session, data_dir: str):
+    """Total quantity over marital/education x state/profit slices."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where(col("d_year") == lit(2001)).select(col("d_date_sk"))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_country", "ca_state"])
+    cd = _t(session, data_dir, "customer_demographics",
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"])
+    st = _t(session, data_dir, "store", ["s_store_sk"])
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_addr_sk", "ss_store_sk",
+             "ss_cdemo_sk", "ss_quantity", "ss_sales_price",
+             "ss_net_profit"])
+    # the reference's three OR branches all use the SAME demographic
+    # pair (M / 4 yr Degree — TpcxbbLikeSpark.scala Q9Like), so the
+    # price bands legally collapse to 50..200; kept branch-by-branch
+    # for parity with the reference text
+    md = ((col("cd_marital_status") == lit("M"))
+          & (col("cd_education_status") == lit("4 yr Degree")))
+    sp = col("ss_sales_price")
+    demo_ok = ((md & (lit(100.0) <= sp) & (sp <= lit(150.0)))
+               | (md & (lit(50.0) <= sp) & (sp <= lit(200.0)))
+               | (md & (lit(150.0) <= sp) & (sp <= lit(200.0))))
+    npf = col("ss_net_profit")
+    us = col("ca_country") == lit("United States")
+
+    def states(*ab):
+        return In(col("ca_state"), [lit(s) for s in ab])
+
+    addr_ok = ((us & states("KY", "GA", "NM")
+                & (lit(0.0) <= npf) & (npf <= lit(2000.0)))
+               | (us & states("MT", "OR", "IN")
+                  & (lit(150.0) <= npf) & (npf <= lit(3000.0)))
+               | (us & states("WI", "MO", "WV")
+                  & (lit(50.0) <= npf) & (npf <= lit(25000.0))))
+    return ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")]) \
+        .join(ca, on=[("ss_addr_sk", "ca_address_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(cd, on=[("ss_cdemo_sk", "cd_demo_sk")]) \
+        .where(demo_ok & addr_ok) \
+        .agg(Sum(col("ss_quantity")).alias("sum_qty"))
+
+
+def q11(session, data_dir: str):
+    """corr(reviews_count, avg_rating) of products vs monthly revenue —
+    corr expanded algebraically over sum/count (module docstring)."""
+    pr = _t(session, data_dir, "product_reviews",
+            ["pr_item_sk", "pr_review_rating"]) \
+        .where(IsNotNull(col("pr_item_sk"))) \
+        .group_by("pr_item_sk") \
+        .agg(CountStar().alias("r_count"),
+             Average(col("pr_review_rating")).alias("avg_rating"))
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_date"]) \
+        .where((col("d_date") >= _date("2003-01-02"))
+               & (col("d_date") <= _date("2003-02-02"))) \
+        .select(col("d_date_sk"))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_item_sk", "ws_sold_date_sk", "ws_net_paid"]) \
+        .join(dd, on=[("ws_sold_date_sk", "d_date_sk")], how="semi") \
+        .where(IsNotNull(col("ws_item_sk"))) \
+        .group_by("ws_item_sk").agg(Sum(col("ws_net_paid"))
+                                    .alias("revenue"))
+    j = pr.join(ws, on=[("pr_item_sk", "ws_item_sk")]) \
+        .select(col("r_count").cast(T.DoubleType()).alias("x"),
+                col("avg_rating").alias("y"))
+    stats = j.agg(CountStar().alias("n"), Sum(col("x")).alias("sx"),
+                  Sum(col("y")).alias("sy"),
+                  Sum(col("x") * col("y")).alias("sxy"),
+                  Sum(col("x") * col("x")).alias("sxx"),
+                  Sum(col("y") * col("y")).alias("syy"))
+    n = col("n").cast(T.DoubleType())
+    num = n * col("sxy") - col("sx") * col("sy")
+    den = Sqrt((n * col("sxx") - col("sx") * col("sx"))
+               * (n * col("syy") - col("sy") * col("sy")))
+    return stats.select((num / den).alias("corr"))
+
+
+def q12(session, data_dir: str):
+    """Web views followed by an in-store purchase of the same-category
+    item within three months."""
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_category"]) \
+        .where(In(col("i_category"), [lit("Books"), lit("Electronics")]))
+    d0 = _sk("2001-09-02")
+    wcs = _t(session, data_dir, "web_clickstreams",
+             ["wcs_user_sk", "wcs_click_date_sk", "wcs_item_sk",
+              "wcs_sales_sk"]) \
+        .where((col("wcs_click_date_sk") >= lit(d0))
+               & (col("wcs_click_date_sk") <= lit(d0 + 30))
+               & IsNotNull(col("wcs_user_sk"))
+               & IsNull(col("wcs_sales_sk"))) \
+        .join(it.select(col("i_item_sk").alias("wi")),
+              on=[("wcs_item_sk", "wi")]) \
+        .select(col("wcs_user_sk"), col("wcs_click_date_sk"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_customer_sk", "ss_sold_date_sk", "ss_item_sk"]) \
+        .where((col("ss_sold_date_sk") >= lit(d0))
+               & (col("ss_sold_date_sk") <= lit(d0 + 90))
+               & IsNotNull(col("ss_customer_sk"))) \
+        .join(it.select(col("i_item_sk").alias("si")),
+              on=[("ss_item_sk", "si")]) \
+        .select(col("ss_customer_sk"), col("ss_sold_date_sk"))
+    return wcs.join(ss, on=[("wcs_user_sk", "ss_customer_sk")],
+                    condition=col("wcs_click_date_sk")
+                    < col("ss_sold_date_sk")) \
+        .select(col("wcs_user_sk")).distinct() \
+        .order_by(("wcs_user_sk", True))
+
+
+def q13(session, data_dir: str):
+    """Consecutive-year web-over-store growth, top 100 (tpc-ds q74
+    base)."""
+    store = _year_totals(session, data_dir, "store_sales",
+                         "ss_customer_sk", "ss_sold_date_sk",
+                         {"cols": ["ss_net_paid"],
+                          "value": col("ss_net_paid")}) \
+        .select(col("ss_customer_sk").alias("s_cust"),
+                col("first_year_total").alias("s_first"),
+                col("second_year_total").alias("s_second"))
+    web = _year_totals(session, data_dir, "web_sales",
+                       "ws_bill_customer_sk", "ws_sold_date_sk",
+                       {"cols": ["ws_net_paid"],
+                        "value": col("ws_net_paid")}) \
+        .select(col("ws_bill_customer_sk").alias("w_cust"),
+                col("first_year_total").alias("w_first"),
+                col("second_year_total").alias("w_second"))
+    c = _t(session, data_dir, "customer",
+           ["c_customer_sk", "c_first_name", "c_last_name"])
+    wr = (col("w_second") / col("w_first"))
+    sr = (col("s_second") / col("s_first"))
+    return store.join(web, on=[("s_cust", "w_cust")]) \
+        .join(c, on=[("w_cust", "c_customer_sk")]) \
+        .where(wr > sr) \
+        .select(col("c_customer_sk"), col("c_first_name"),
+                col("c_last_name"), sr.alias("storeSalesIncreaseRatio"),
+                wr.alias("webSalesIncreaseRatio")) \
+        .order_by(("webSalesIncreaseRatio", False),
+                  ("c_customer_sk", True), ("c_first_name", True),
+                  ("c_last_name", True)) \
+        .limit(100)
+
+
+def q14(session, data_dir: str):
+    """AM/PM sales ratio (tpc-ds q90 base)."""
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_ship_hdemo_sk", "ws_web_page_sk", "ws_sold_time_sk"])
+    hd = _t(session, data_dir, "household_demographics",
+            ["hd_demo_sk", "hd_dep_count"]) \
+        .where(col("hd_dep_count") == lit(5)).select(col("hd_demo_sk"))
+    wp = _t(session, data_dir, "web_page",
+            ["wp_web_page_sk", "wp_char_count"]) \
+        .where((col("wp_char_count") >= lit(5000))
+               & (col("wp_char_count") <= lit(6000))) \
+        .select(col("wp_web_page_sk"))
+    td = _t(session, data_dir, "time_dim", ["t_time_sk", "t_hour"]) \
+        .where(In(col("t_hour"), [lit(h) for h in (7, 8, 19, 20)]))
+    hourly = ws.join(hd, on=[("ws_ship_hdemo_sk", "hd_demo_sk")]) \
+        .join(wp, on=[("ws_web_page_sk", "wp_web_page_sk")]) \
+        .join(td, on=[("ws_sold_time_sk", "t_time_sk")]) \
+        .group_by("t_hour").agg(CountStar().alias("c")) \
+        .select(If((col("t_hour") >= lit(7)) & (col("t_hour") <= lit(8)),
+                   col("c"), lit(0)).alias("amc1"),
+                If((col("t_hour") >= lit(19))
+                   & (col("t_hour") <= lit(20)),
+                   col("c"), lit(0)).alias("pmc1"))
+    return hourly.agg(Sum(col("amc1")).alias("amc"),
+                      Sum(col("pmc1")).alias("pmc")) \
+        .select(If(col("pmc") > lit(0),
+                   col("amc").cast(T.DoubleType())
+                   / col("pmc").cast(T.DoubleType()),
+                   lit(-1.00)).alias("am_pm_ratio"))
+
+
+def q15(session, data_dir: str):
+    """Declining in-store categories via per-category regression slope
+    (store 1 deviation, see module docstring)."""
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_date"]) \
+        .where((col("d_date") >= _date("2001-09-02"))
+               & (col("d_date") <= _date("2002-09-02"))) \
+        .select(col("d_date_sk"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_category_id"]) \
+        .where(IsNotNull(col("i_category_id")))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_item_sk", "ss_sold_date_sk", "ss_store_sk",
+             "ss_net_paid"]) \
+        .where(col("ss_store_sk") == lit(1))
+    daily = ss.join(dd, on=[("ss_sold_date_sk", "d_date_sk")],
+                    how="semi") \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .group_by("i_category_id", "ss_sold_date_sk") \
+        .agg(Sum(col("ss_net_paid")).alias("y")) \
+        .select(col("i_category_id").alias("cat"),
+                col("ss_sold_date_sk").cast(T.DoubleType()).alias("x"),
+                col("y"))
+    reg = daily.group_by("cat").agg(
+        CountStar().alias("n"), Sum(col("x")).alias("sx"),
+        Sum(col("y")).alias("sy"),
+        Sum(col("x") * col("y")).alias("sxy"),
+        Sum(col("x") * col("x")).alias("sxx"))
+    n = col("n").cast(T.DoubleType())
+    slope = ((n * col("sxy") - col("sx") * col("sy"))
+             / (n * col("sxx") - col("sx") * col("sx")))
+    return reg.select(col("cat"), slope.alias("slope"),
+                      ((col("sy") - slope * col("sx")) / n)
+                      .alias("intercept")) \
+        .where(col("slope") <= lit(0.0)) \
+        .order_by(("cat", True))
+
+
+def q16(session, data_dir: str):
+    """Sales impact 30 days around a price change (tpc-ds q40 base)."""
+    anchor = _date("2001-03-16")
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_date"]) \
+        .where((col("d_date") >= _date("2001-02-14"))
+               & (col("d_date") <= _date("2001-04-15")))
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_order_number", "ws_item_sk", "ws_warehouse_sk",
+             "ws_sold_date_sk", "ws_sales_price"])
+    wr = _t(session, data_dir, "web_returns",
+            ["wr_order_number", "wr_item_sk", "wr_refunded_cash"]) \
+        .select(col("wr_order_number").alias("r_ord"),
+                col("wr_item_sk").alias("r_item"),
+                col("wr_refunded_cash"))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id"])
+    w = _t(session, data_dir, "warehouse",
+           ["w_warehouse_sk", "w_state"])
+    val = col("ws_sales_price") - Coalesce(col("wr_refunded_cash"),
+                                           lit(0.0))
+    return ws.join(wr, on=[("ws_order_number", "r_ord"),
+                           ("ws_item_sk", "r_item")], how="left") \
+        .join(it, on=[("ws_item_sk", "i_item_sk")]) \
+        .join(w, on=[("ws_warehouse_sk", "w_warehouse_sk")]) \
+        .join(dd, on=[("ws_sold_date_sk", "d_date_sk")]) \
+        .group_by("w_state", "i_item_id") \
+        .agg(Sum(If(col("d_date") < anchor, val, lit(0.0)))
+             .alias("sales_before"),
+             Sum(If(col("d_date") >= anchor, val, lit(0.0)))
+             .alias("sales_after")) \
+        .order_by(("w_state", True), ("i_item_id", True)).limit(100)
+
+
+def q17(session, data_dir: str):
+    """Promotional vs total sales ratio (tpc-ds q61 base)."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+             "ss_customer_sk", "ss_promo_sk", "ss_ext_sales_price"])
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") == lit(12)))
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_category"]) \
+        .where(In(col("i_category"), [lit("Books"), lit("Music")]))
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_gmt_offset"]) \
+        .where(col("s_gmt_offset") == lit(-5.0))
+    ca = _t(session, data_dir, "customer_address",
+            ["ca_address_sk", "ca_gmt_offset"]) \
+        .where(col("ca_gmt_offset") == lit(-5.0))
+    c = _t(session, data_dir, "customer",
+           ["c_customer_sk", "c_current_addr_sk"]) \
+        .join(ca, on=[("c_current_addr_sk", "ca_address_sk")],
+              how="semi")
+    p = _t(session, data_dir, "promotion",
+           ["p_promo_sk", "p_channel_email", "p_channel_dmail",
+            "p_channel_tv"])
+    per_channel = ss \
+        .join(dd, on=[("ss_sold_date_sk", "d_date_sk")], how="semi") \
+        .join(it, on=[("ss_item_sk", "i_item_sk")], how="semi") \
+        .join(st, on=[("ss_store_sk", "s_store_sk")], how="semi") \
+        .join(c, on=[("ss_customer_sk", "c_customer_sk")], how="semi") \
+        .join(p, on=[("ss_promo_sk", "p_promo_sk")]) \
+        .group_by("p_channel_email", "p_channel_dmail", "p_channel_tv") \
+        .agg(Sum(col("ss_ext_sales_price")).alias("total")) \
+        .select(If((col("p_channel_dmail") == lit("Y"))
+                   | (col("p_channel_email") == lit("Y"))
+                   | (col("p_channel_tv") == lit("Y")),
+                   col("total"), lit(0.0)).alias("promotional"),
+                col("total"))
+    return per_channel.agg(Sum(col("promotional")).alias("promotional"),
+                           Sum(col("total")).alias("total")) \
+        .select(col("promotional"), col("total"),
+                If(col("total") > lit(0.0),
+                   lit(100.0) * col("promotional") / col("total"),
+                   lit(0.0)).alias("promo_percent")) \
+        .order_by(("promotional", True), ("total", True)).limit(100)
+
+
+def q20(session, data_dir: str):
+    """Return-ratio segmentation features (count(distinct)+plain aggs
+    expanded into a distinct-frame join, see module docstring)."""
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_customer_sk", "ss_ticket_number", "ss_item_sk",
+             "ss_net_paid"])
+    plain_o = ss.group_by("ss_customer_sk") \
+        .agg(Count(col("ss_item_sk")).alias("orders_items"),
+             Sum(col("ss_net_paid")).alias("orders_money"))
+    dist_o = ss.group_by("ss_customer_sk") \
+        .agg(CountDistinct(col("ss_ticket_number"))
+             .alias("orders_count")) \
+        .select(col("ss_customer_sk").alias("oc_cust"),
+                col("orders_count"))
+    orders = plain_o.join(dist_o, on=[("ss_customer_sk", "oc_cust")])
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_customer_sk", "sr_ticket_number", "sr_item_sk",
+             "sr_return_amt"])
+    plain_r = sr.group_by("sr_customer_sk") \
+        .agg(Count(col("sr_item_sk")).alias("returns_items"),
+             Sum(col("sr_return_amt")).alias("returns_money"))
+    dist_r = sr.group_by("sr_customer_sk") \
+        .agg(CountDistinct(col("sr_ticket_number"))
+             .alias("returns_count")) \
+        .select(col("sr_customer_sk").alias("rc_cust"),
+                col("returns_count"))
+    returned = plain_r.join(dist_r, on=[("sr_customer_sk", "rc_cust")]) \
+        .select(col("sr_customer_sk"), col("returns_count"),
+                col("returns_items"), col("returns_money"))
+
+    def ratio(a, b):
+        r = (a.cast(T.DoubleType()) / b.cast(T.DoubleType()))
+        return Round(Coalesce(r, lit(0.0)), 7)
+
+    return orders.join(returned, on=[("ss_customer_sk",
+                                      "sr_customer_sk")], how="left") \
+        .select(col("ss_customer_sk").alias("user_sk"),
+                ratio(col("returns_count"), col("orders_count"))
+                .alias("orderRatio"),
+                ratio(col("returns_items"), col("orders_items"))
+                .alias("itemsRatio"),
+                ratio(col("returns_money"), col("orders_money"))
+                .alias("monetaryRatio"),
+                Round(Coalesce(col("returns_count").cast(T.DoubleType()),
+                               lit(0.0)), 0).alias("frequency")) \
+        .order_by(("user_sk", True))
+
+
+def q21(session, data_dir: str):
+    """Items returned then re-purchased on the web (tpc-ds q29 base)."""
+    d1 = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2003)) & (col("d_moy") == lit(1))) \
+        .select(col("d_date_sk").alias("d1_sk"))
+    d2 = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2003)) & (col("d_moy") >= lit(1))
+               & (col("d_moy") <= lit(7))) \
+        .select(col("d_date_sk").alias("d2_sk"))
+    d3 = _t(session, data_dir, "date_dim", ["d_date_sk", "d_year"]) \
+        .where((col("d_year") >= lit(2003))
+               & (col("d_year") <= lit(2005))) \
+        .select(col("d_date_sk").alias("d3_sk"))
+    sr = _t(session, data_dir, "store_returns",
+            ["sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+             "sr_ticket_number", "sr_return_quantity"]) \
+        .join(d2, on=[("sr_returned_date_sk", "d2_sk")])
+    ws = _t(session, data_dir, "web_sales",
+            ["ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+             "ws_quantity"]) \
+        .join(d3, on=[("ws_sold_date_sk", "d3_sk")])
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+             "ss_customer_sk", "ss_ticket_number", "ss_quantity"]) \
+        .join(d1, on=[("ss_sold_date_sk", "d1_sk")])
+    st = _t(session, data_dir, "store", ["s_store_sk", "s_store_id",
+                                         "s_store_name"])
+    it = _t(session, data_dir, "item", ["i_item_sk", "i_item_id",
+                                        "i_item_desc"])
+    return sr.join(ws, on=[("sr_item_sk", "ws_item_sk"),
+                           ("sr_customer_sk", "ws_bill_customer_sk")]) \
+        .join(ss, on=[("sr_ticket_number", "ss_ticket_number"),
+                      ("sr_item_sk", "ss_item_sk"),
+                      ("sr_customer_sk", "ss_customer_sk")]) \
+        .join(st, on=[("ss_store_sk", "s_store_sk")]) \
+        .join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .group_by("i_item_id", "i_item_desc", "s_store_id",
+                  "s_store_name") \
+        .agg(Sum(col("ss_quantity")).alias("store_sales_quantity"),
+             Sum(col("sr_return_quantity"))
+             .alias("store_returns_quantity"),
+             Sum(col("ws_quantity")).alias("web_sales_quantity")) \
+        .order_by(("i_item_id", True), ("i_item_desc", True),
+                  ("s_store_id", True), ("s_store_name", True)) \
+        .limit(100)
+
+
+def q22(session, data_dir: str):
+    """Inventory change 30 days around a price change (tpc-ds q21
+    base)."""
+    anchor = _date("2001-05-08")
+    dd = _t(session, data_dir, "date_dim", ["d_date_sk", "d_date"]) \
+        .where((col("d_date") >= _date("2001-04-08"))
+               & (col("d_date") <= _date("2001-06-07")))
+    inv = _t(session, data_dir, "inventory",
+             ["inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+              "inv_quantity_on_hand"])
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_item_id", "i_current_price"]) \
+        .where((col("i_current_price") >= lit(0.98))
+               & (col("i_current_price") <= lit(1.5)))
+    w = _t(session, data_dir, "warehouse",
+           ["w_warehouse_sk", "w_warehouse_name"])
+    agg = inv.join(it, on=[("inv_item_sk", "i_item_sk")]) \
+        .join(w, on=[("inv_warehouse_sk", "w_warehouse_sk")]) \
+        .join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .group_by("w_warehouse_name", "i_item_id") \
+        .agg(Sum(If(col("d_date") < anchor,
+                    col("inv_quantity_on_hand"), lit(0)))
+             .alias("inv_before"),
+             Sum(If(col("d_date") >= anchor,
+                    col("inv_quantity_on_hand"), lit(0)))
+             .alias("inv_after"))
+    ratio = (col("inv_after").cast(T.DoubleType())
+             / col("inv_before").cast(T.DoubleType()))
+    return agg.where((col("inv_before") > lit(0))
+                     & (ratio >= lit(2.0 / 3.0))
+                     & (ratio <= lit(1.5))) \
+        .order_by(("w_warehouse_name", True), ("i_item_id", True)) \
+        .limit(100)
+
+
+def q23(session, data_dir: str):
+    """Coefficient-of-variation pairs across consecutive months
+    (tpc-ds q39 base)."""
+    from spark_rapids_tpu.expr.aggregates import stddev_samp
+    dd = _t(session, data_dir, "date_dim",
+            ["d_date_sk", "d_year", "d_moy"]) \
+        .where((col("d_year") == lit(2001)) & (col("d_moy") >= lit(1))
+               & (col("d_moy") <= lit(2)))
+    inv = _t(session, data_dir, "inventory",
+             ["inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+              "inv_quantity_on_hand"])
+    cov = inv.join(dd, on=[("inv_date_sk", "d_date_sk")]) \
+        .group_by("inv_warehouse_sk", "inv_item_sk", "d_moy") \
+        .agg(stddev_samp(col("inv_quantity_on_hand")).alias("stdev"),
+             Average(col("inv_quantity_on_hand")).alias("mean")) \
+        .where((col("mean") > lit(0.0))
+               & (col("stdev") / col("mean") >= lit(1.3))) \
+        .select(col("inv_warehouse_sk"), col("inv_item_sk"),
+                col("d_moy"), (col("stdev") / col("mean")).alias("cov"))
+    inv1 = cov.where(col("d_moy") == lit(1)) \
+        .select(col("inv_warehouse_sk"), col("inv_item_sk"),
+                col("d_moy"), col("cov"))
+    inv2 = cov.where(col("d_moy") == lit(2)) \
+        .select(col("inv_warehouse_sk").alias("w2"),
+                col("inv_item_sk").alias("i2"),
+                col("d_moy").alias("moy2"), col("cov").alias("cov2"))
+    return inv1.join(inv2, on=[("inv_warehouse_sk", "w2"),
+                               ("inv_item_sk", "i2")]) \
+        .select(col("inv_warehouse_sk"), col("inv_item_sk"),
+                col("d_moy"), col("cov"), col("moy2"), col("cov2")) \
+        .order_by(("inv_warehouse_sk", True), ("inv_item_sk", True))
+
+
+def q24(session, data_dir: str):
+    """Cross-price elasticity of demand (anchor item 100 deviation,
+    see module docstring)."""
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_current_price"]) \
+        .where(col("i_item_sk") == lit(100))
+    imp = _t(session, data_dir, "item_marketprices",
+             ["imp_sk", "imp_item_sk", "imp_competitor_price",
+              "imp_start_date", "imp_end_date"])
+    comp = it.join(imp, on=[("i_item_sk", "imp_item_sk")]) \
+        .select(col("i_item_sk"), col("imp_sk"),
+                ((col("imp_competitor_price") - col("i_current_price"))
+                 / col("i_current_price")).alias("price_change"),
+                col("imp_start_date"),
+                (col("imp_end_date") - col("imp_start_date"))
+                .alias("no_days_comp_price"))
+
+    def quants(table, item_col, date_col, qty_col, cur, prev):
+        sales = _t(session, data_dir, table,
+                   [item_col, date_col, qty_col])
+        j = sales.join(comp.select(
+            col("i_item_sk").alias("c_item"), col("imp_sk"),
+            col("price_change"), col("imp_start_date"),
+            col("no_days_comp_price")), on=[(item_col, "c_item")])
+        in_cur = ((col(date_col) >= col("imp_start_date"))
+                  & (col(date_col) < (col("imp_start_date")
+                                      + col("no_days_comp_price"))))
+        in_prev = ((col(date_col) >= (col("imp_start_date")
+                                      - col("no_days_comp_price")))
+                   & (col(date_col) < col("imp_start_date")))
+        return j.group_by(item_col, "imp_sk", "price_change") \
+            .agg(Sum(If(in_cur, col(qty_col), lit(0))).alias(cur),
+                 Sum(If(in_prev, col(qty_col), lit(0))).alias(prev))
+
+    ws = quants("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                "ws_quantity", "current_ws_quant", "prev_ws_quant")
+    ss = quants("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                "ss_quantity", "current_ss_quant", "prev_ss_quant") \
+        .select(col("ss_item_sk"), col("imp_sk").alias("ss_imp"),
+                col("current_ss_quant"), col("prev_ss_quant"))
+    num = (col("current_ss_quant") + col("current_ws_quant")
+           - col("prev_ss_quant") - col("prev_ws_quant")) \
+        .cast(T.DoubleType())
+    den = ((col("prev_ss_quant") + col("prev_ws_quant"))
+           .cast(T.DoubleType()) * col("price_change"))
+    return ws.join(ss, on=[("ws_item_sk", "ss_item_sk"),
+                           ("imp_sk", "ss_imp")]) \
+        .group_by("ws_item_sk") \
+        .agg(Average(num / den).alias("cross_price_elasticity"))
+
+
+def q25(session, data_dir: str):
+    """RFM segmentation features over store + web purchases
+    (count(distinct) expansion, see module docstring)."""
+    cutoff = _date("2002-01-02")
+    recency_sk = _sk("2003-01-02")
+
+    def channel(table, cust, date_col, order_col, paid_col):
+        dd = _t(session, data_dir, "date_dim",
+                ["d_date_sk", "d_date"]) \
+            .where(col("d_date") > cutoff).select(col("d_date_sk"))
+        s = _t(session, data_dir, table,
+               [cust, date_col, order_col, paid_col]) \
+            .where(IsNotNull(col(cust))) \
+            .join(dd, on=[(date_col, "d_date_sk")])
+        plain = s.group_by(cust) \
+            .agg(Max(col(date_col)).alias("most_recent_date"),
+                 Sum(col(paid_col)).alias("amount"))
+        dist = s.group_by(cust) \
+            .agg(CountDistinct(col(order_col)).alias("frequency")) \
+            .select(col(cust).alias("d_cust"), col("frequency"))
+        return plain.join(dist, on=[(cust, "d_cust")]) \
+            .select(col(cust).alias("cid"), col("frequency"),
+                    col("most_recent_date"), col("amount"))
+
+    both = channel("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                   "ss_ticket_number", "ss_net_paid") \
+        .union(channel("web_sales", "ws_bill_customer_sk",
+                       "ws_sold_date_sk", "ws_order_number",
+                       "ws_net_paid"))
+    return both.group_by("cid") \
+        .agg(Max(col("most_recent_date")).alias("mrd"),
+             Sum(col("frequency")).alias("frequency"),
+             Sum(col("amount")).alias("totalspend")) \
+        .select(col("cid"),
+                If(lit(recency_sk) - col("mrd") < lit(60),
+                   lit(1.0), lit(0.0)).alias("recency"),
+                col("frequency"), col("totalspend")) \
+        .order_by(("cid", True))
+
+
+def q26(session, data_dir: str):
+    """Book-buyer clustering features: per-class purchase counts."""
+    it = _t(session, data_dir, "item",
+            ["i_item_sk", "i_category", "i_class_id"]) \
+        .where(col("i_category") == lit("Books"))
+    ss = _t(session, data_dir, "store_sales",
+            ["ss_customer_sk", "ss_item_sk"]) \
+        .where(IsNotNull(col("ss_customer_sk")))
+    null_i = Literal(None, T.IntegerType())
+    return ss.join(it, on=[("ss_item_sk", "i_item_sk")]) \
+        .group_by("ss_customer_sk") \
+        .agg(Count(col("ss_item_sk")).alias("item_count"),
+             *[Count(If(col("i_class_id") == lit(i), lit(1), null_i))
+               .alias(f"id{i}") for i in range(1, 16)]) \
+        .where(col("item_count") > lit(5)) \
+        .select(col("ss_customer_sk").alias("cid"),
+                *[col(f"id{i}") for i in range(1, 16)]) \
+        .order_by(("cid", True))
+
+
+def q28(session, data_dir: str):
+    """Sentiment-classifier data prep: the 10% testing split of reviews
+    (the reference's multi-insert writes train+test tables; the
+    returned frame here is the testing selection)."""
+    pr = _t(session, data_dir, "product_reviews",
+            ["pr_review_sk", "pr_review_rating", "pr_review_content"])
+    return pr.where(col("pr_review_sk") % lit(10) == lit(0)) \
+        .select(col("pr_review_sk"), col("pr_review_rating"),
+                col("pr_review_content")) \
+        .order_by(("pr_review_sk", True))
+
+
+UNSUPPORTED = {
+    "q1": "Q1 uses UDTF", "q2": "Q2 uses UDTF",
+    "q3": "Q3 calls python", "q4": "Q4 calls python",
+    "q8": "Q8 calls python", "q10": "Q10 uses UDF",
+    "q18": "Q18 uses UDF", "q19": "Q19 uses UDF",
+    "q27": "Q27 uses UDF", "q29": "Q29 uses UDTF",
+    "q30": "Q30 uses UDTF",
+}
+
+TPCXBB_QUERIES = {
+    "q5": q5, "q6": q6, "q7": q7, "q9": q9, "q11": q11, "q12": q12,
+    "q13": q13, "q14": q14, "q15": q15, "q16": q16, "q17": q17,
+    "q20": q20, "q21": q21, "q22": q22, "q23": q23, "q24": q24,
+    "q25": q25, "q26": q26, "q28": q28,
+}
+
+
+def build_tpcxbb_query(name: str, session, data_dir: str):
+    if name in UNSUPPORTED:
+        # the reference refuses these the same way
+        # (TpcxbbLikeSpark.scala UnsupportedOperationException)
+        raise NotImplementedError(UNSUPPORTED[name])
+    return TPCXBB_QUERIES[name](session, data_dir)
