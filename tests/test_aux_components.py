@@ -191,3 +191,22 @@ def test_join_adaptive_reader_respects_disable():
                   T.StructField("w", T.DoubleType())]))
     out = left.join(right, on="cat", how="inner")
     assert "AdaptiveShuffleReaderExec" not in out.explain()
+
+
+def test_rows_match_tolerant_verifier():
+    """The bench verifier's paired fallback: boundary-noise floats
+    accepted, real differences rejected, NaN/None/mixed rows pair
+    without any float ordering (q47's 103.1275 boundary flip)."""
+    import math
+    from spark_rapids_tpu.bench.runner import _rows_match
+
+    assert _rows_match([("a", 103.1275001)], [("a", 103.1274999)])
+    assert not _rows_match([("a", 103.13)], [("a", 103.12)])
+    assert _rows_match([("a", 1.5), ("a", None)],
+                       [("a", None), ("a", 1.5000000001)])
+    assert _rows_match([(1, float("nan")), (2, 3.0)],
+                       [(2, 3.0000000001), (1, float("nan"))])
+    assert _rows_match([(1.2e8 * (1 + 4e-6),)], [(1.2e8,)])
+    assert not _rows_match([("a", 1.0), ("a", 1.0)],
+                           [("a", 1.0), ("a", 2.0)])
+    assert not _rows_match([("a", 1.0)], [("b", 1.0)])
