@@ -31,6 +31,9 @@ def main():
         print(f"resuming: {len(done)} queries already recorded",
               flush=True)
     queries = sorted(QUERIES, key=lambda q: int(q[1:]))
+    assemble_only = os.environ.get("SWEEP_ASSEMBLE_ONLY") == "1"
+    if assemble_only:
+        queries = [q for q in queries if q in done]
     t0 = time.time()
     with open(CKPT, "a") as ck:
         for name in queries:
@@ -58,13 +61,17 @@ def main():
     sp = sorted(r["speedup"] for r in oks if r.get("speedup"))
     out = {
         "description": (
-            "TPC-DS FULL 99-query differential sweep, SF1, device engine "
-            "(XLA:CPU backend, warm persistent compile cache, best of 2 "
+            "TPC-DS SF1 differential sweep, device engine (XLA:CPU "
+            "backend, warm persistent compile cache, best of 2 "
             "iterations) vs single-threaded numpy host oracle; 1-core "
-            "build VM. Device==oracle verified per query."),
+            "build VM. Device==oracle verified per query. Queries "
+            "missing from this record were cut by the round's wall "
+            "clock (the q72-class numpy oracles run >30min each at "
+            "SF1), not by failures — SF0.01 verification for all 99 "
+            "is artifacts/tpcds_99_sf001_verify.txt."),
         "generated_by": "scripts/sf1_sweep.py (iterations=2, verify)",
         "host_cpus": os.cpu_count(),
-        "summary": {"verified": len(oks), "total": len(queries),
+        "summary": {"verified": len(oks), "total": len(QUERIES),
                     "median_speedup": sp[len(sp) // 2] if sp else None,
                     "min_speedup": sp[0] if sp else None,
                     "max_speedup": sp[-1] if sp else None,
