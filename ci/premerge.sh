@@ -29,3 +29,11 @@ mkdir -p artifacts
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
   echo "== premerge PASS =="
 } 2>&1 | tee "$OUT"
+
+# Machine-feature-mismatch gate (VERDICT r4 weak #5): a cpu_aot_loader
+# complaint means a stale/foreign AOT executable was loaded — a SIGILL
+# from one would be indistinguishable from a wedged tunnel in CI.
+if grep -q "cpu_aot_loader" "$OUT"; then
+  echo "== premerge FAIL: cpu_aot_loader machine-feature warnings in log =="
+  exit 1
+fi

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 tunnel watch: probe the axon TPU tunnel every ~10 min, append one
+# line per attempt to artifacts/tpu_probe_r5.log.  Evidence trail per
+# VERDICT round-4 item 1 ("if the tunnel stays wedged all round, commit the
+# probe log trail"), and a cheap way to notice the moment it comes up.
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_probe_r5.log
+mkdir -p artifacts
+while true; do
+  STAMP=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if OUT=$(timeout 130 python -c "
+import faulthandler
+faulthandler.dump_traceback_later(120, exit=True)
+import jax
+assert jax.default_backend() == 'tpu', jax.default_backend()
+print(jax.devices())
+" 2>&1); then
+    if echo "$OUT" | grep -q "Tpu\|TPU"; then
+      echo "$STAMP UP $OUT" >> "$LOG"
+      touch artifacts/TPU_UP
+    else
+      echo "$STAMP odd: $OUT" | head -1 >> "$LOG"
+    fi
+  else
+    echo "$STAMP WEDGED (probe timed out in get_backend)" >> "$LOG"
+    rm -f artifacts/TPU_UP
+  fi
+  sleep 600
+done

@@ -51,33 +51,43 @@ def _plan_of(df):
     return meta.exec_node
 
 
-def _norm(rows):
+def _norm(rows, digits=6):
     """Order-insensitive row normalization with float tolerance: device
     and oracle may sum doubles in different orders (streaming joins /
     concurrent partials), and on-chip f64 is a float32 pair (~48-bit
-    mantissa, docs/compatibility.md), so floats compare at 6 significant
-    digits (reference asserts.py approximate_float)."""
+    mantissa, docs/compatibility.md), so floats compare at ``digits``
+    significant digits (reference asserts.py approximate_float)."""
     def cell(x):
         if isinstance(x, float):
-            return (x is None, f"{x:.6g}")
+            return (x is None, f"{x:.{digits}g}")
         return (x is None, str(x))
     return sorted(tuple(cell(x) for x in r) for r in rows)
 
 
-def _rows_match(got, want) -> bool:
-    """Exact 6-significant-digit match, falling back to a PAIRED
+def _rows_match(got, want, strict: bool | None = None) -> bool:
+    """Exact significant-digit match, falling back to a PAIRED
     relative comparison: fixed-digit formatting is boundary-brittle —
     1-ulp summation-order noise on a value sitting exactly at a digit
     boundary (q47's 103.1275, q20's HALF_UP money ratios) flips the
     formatted string while the values agree to 1e-10.  The fallback
     buckets rows by their NON-float cells and greedily pairs each got
-    row with an unused want row whose floats all agree within rel 1e-5
-    (reference approximate_float semantics, asserts.py) — no float
-    takes part in any ordering, so boundary/NaN/mixed-type sort
-    brittleness cannot mispair rows."""
+    row with an unused want row whose floats all agree within a
+    relative tolerance (reference approximate_float semantics,
+    asserts.py) — no float takes part in any ordering, so
+    boundary/NaN/mixed-type sort brittleness cannot mispair rows.
+
+    The tolerance is keyed on the device backend: on true-f64 platforms
+    (XLA:CPU) the only legitimate noise is summation order, so floats
+    compare at 12 digits / rel 1e-9; the loose 6-digit / rel 1e-5
+    tier applies only when the f32-pair f64 emulation is in play (TPU
+    backend, ~48-bit mantissa)."""
     import math
     from collections import defaultdict
-    if _norm(got) == _norm(want):
+    if strict is None:
+        import jax
+        strict = jax.default_backend() not in ("tpu", "axon")
+    digits, rel, abst = (12, 1e-9, 1e-11) if strict else (6, 1e-5, 1e-7)
+    if _norm(got, digits) == _norm(want, digits):
         return True
     if len(got) != len(want):
         return False
@@ -98,7 +108,7 @@ def _rows_match(got, want) -> bool:
                 continue
             if math.isnan(x) or math.isnan(y):
                 return False
-            if not math.isclose(x, y, rel_tol=1e-5, abs_tol=1e-7):
+            if not math.isclose(x, y, rel_tol=rel, abs_tol=abst):
                 return False
         return True
 

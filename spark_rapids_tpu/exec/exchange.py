@@ -112,8 +112,16 @@ def _fp_extra(n: PlanNode) -> str | None:
         return str(n._limit)
     if isinstance(n, CoalesceBatchesExec):
         return repr(n._goal)
+    if isinstance(n, HashAggregateExec):
+        # desc/bound_exprs/schema do NOT identify the aggregate: min(v) and
+        # max(v) finals are both plain BoundReferences and partial buffer
+        # schemas can coincide ('_buf_0'), so two different aggregations
+        # over one shared scan would otherwise fingerprint identically and
+        # ReuseExchange would serve one consumer the other's data.
+        return (f"{n.mode}:{n._update_specs!r}:{n._merge_specs!r}:"
+                f"{getattr(n, '_agg_offsets', None)!r}")
     if isinstance(n, (ProjectExec, FilterExec, UnionExec, JoinExec,
-                      CrossJoinExec, HashAggregateExec, SortExec,
+                      CrossJoinExec, SortExec,
                       ExpandExec, GenerateExec, BackendSwitchExec)):
         # desc + bound_exprs + schema already carry their parameters
         return ""

@@ -386,12 +386,14 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
                           lambda: self._compute_outputs(ctx))
 
     def _compute_outputs(self, ctx: ExecCtx):
-        from spark_rapids_tpu.exec.core import drain_partitions
         if not ctx.is_device:
             he = self._host_exchange()
             return ("host", [list(he.partition_iter(ctx, pid))
                              for pid in range(self._num_parts)])
-        batches = list(drain_partitions(ctx, self.children[0]))
+        # drain_cached, not drain_partitions: in partitioned mesh-join
+        # mode _use_partitioned already drained this subtree for its size
+        # probe — share that materialization instead of executing twice
+        batches = drain_cached(ctx, self.children[0])
         mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
         if mesh is None or not batches:
             he = self._host_exchange()

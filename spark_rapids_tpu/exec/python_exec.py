@@ -103,14 +103,27 @@ class PandasUDF(Expression):
         return f"PandasUDF({name}, {', '.join(map(repr, self.children))})"
 
 
-def _host_col_to_series(v):
+def _host_col_to_series(v, exact_int=False):
     """HostColumn -> pandas Series with nulls surfaced as None/NaN
-    (numeric columns upcast to float64 only when nulls are present)."""
+    (numeric columns upcast to float64 only when nulls are present).
+
+    exact_int: nullable INTEGRAL columns use pandas' nullable Int64
+    instead of the float64 upcast — int64 values >= 2**53 are not
+    representable in float64, so group keys routed through float would
+    merge distinct keys and round-trip lossily.  Used for group-key
+    columns; UDF inputs keep the float64 convention (Spark's own Arrow
+    path hands pandas UDFs float64 for nullable ints)."""
     import pandas as pd
     if isinstance(v.dtype, T.StringType):
         return pd.Series(v.data)
-    data = v.data.astype("float64") if not np.all(v.validity) \
-        and v.dtype.numeric else v.data
+    if not np.all(v.validity) and v.dtype.numeric:
+        if exact_int and v.dtype.integral:
+            s = pd.Series(v.data, dtype="Int64")
+            s[~np.asarray(v.validity)] = pd.NA
+            return s
+        data = v.data.astype("float64")
+    else:
+        data = v.data
     s = pd.Series(data)
     if not np.all(v.validity):
         s[~np.asarray(v.validity)] = None
@@ -204,8 +217,20 @@ class ArrowEvalPythonExec(PlanNode):
 # the semaphore bounds concurrent UDF evaluation the same way)
 # ---------------------------------------------------------------------------
 
-def _to_pandas(hb: HostBatch):
-    return hb.to_arrow().to_pandas()
+def _to_pandas(hb: HostBatch, exact_keys: "list[str] | None" = None):
+    """Arrow-convention pandas frame (nullable ints with nulls become
+    float64, what Spark's Arrow path hands pandas UDFs) — except the
+    ``exact_keys`` columns, which convert as nullable Int64: GROUPS are
+    formed from these frames here (Spark forms them JVM-side, exactly),
+    and a float64 round trip merges distinct int64 keys >= 2**53
+    (advisor r4 / review finding)."""
+    pdf = hb.to_arrow().to_pandas()
+    for k in exact_keys or ():
+        f = hb.schema.field(k)
+        if f.data_type.integral:
+            pdf[k] = _host_col_to_series(
+                hb.columns[hb.schema.index_of(k)], exact_int=True)
+    return pdf
 
 
 def _from_pandas(pdf, schema: T.Schema, what: str) -> HostBatch:
@@ -321,7 +346,7 @@ class FlatMapGroupsInPandasExec(PlanNode):
         batches = list(_host_batches(self.children[0], ctx, pid))
         if not batches:
             return
-        pdf = _to_pandas(HostBatch.concat(batches))
+        pdf = _to_pandas(HostBatch.concat(batches), exact_keys=self._keys)
         if not len(pdf):
             return
         sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
@@ -430,7 +455,7 @@ class AggregateInPandasExec(PlanNode):
         frame = {}
         for k in self._keys:
             frame[k] = _host_col_to_series(
-                hb.columns[hb.schema.index_of(k)])
+                hb.columns[hb.schema.index_of(k)], exact_int=True)
         in_names: list[list[str]] = []
         for ui, (name, u) in enumerate(self._udfs):
             cols = []
@@ -457,7 +482,16 @@ class AggregateInPandasExec(PlanNode):
                     r = u.fn(*[g[c] for c in cols])
                 rows[name].append(None if r is None or
                                   (np.isscalar(r) and pd.isna(r)) else r)
-        out = pd.DataFrame({n: pd.Series(rows[n]) for n in
+        # integral output columns build as nullable Int64: a plain
+        # pd.Series over ints + None coerces to float64, which merges
+        # int64 key values >= 2**53 (advisor r4 — the group keys were
+        # exact all the way here, only to collapse in this constructor)
+        def out_series(n):
+            f = self._schema.field(n)
+            if f.data_type.integral and any(v is None for v in rows[n]):
+                return pd.Series(rows[n], dtype="Int64")
+            return pd.Series(rows[n])
+        out = pd.DataFrame({n: out_series(n) for n in
                             self._schema.names})
         hb_out = _from_pandas(out, self._schema, "pandas agg")
         if hb_out.num_rows:
@@ -506,7 +540,7 @@ class FlatMapCoGroupsInPandasExec(PlanNode):
             empty = _to_pandas(HostBatch.empty(node.output_schema))
             if not batches:
                 return {}, empty
-            pdf = _to_pandas(HostBatch.concat(batches))
+            pdf = _to_pandas(HostBatch.concat(batches), exact_keys=keys)
             if not len(pdf):
                 return {}, empty
             return {_null_safe_key(k): g.reset_index(drop=True)

@@ -279,11 +279,31 @@ def _compile(fn: Callable, args: list[Expression]) -> Expression:
     if not returns:
         raise _Unsupported("no return")
     # merge return paths into a nested If (CatalystExpressionBuilder's
-    # block fold); the LAST explored path (first pushed) is the default
+    # block fold); the LAST explored path (first pushed) is the default.
+    # The default branch fires whenever every guarded condition is
+    # false OR NULL — but a NULL condition means an intermediate went
+    # SQL-null where Python would have RAISED (x/0 -> ZeroDivisionError
+    # vs Divide -> null), so a null-capable condition would make the
+    # result depend on whether compilation succeeded.  Refuse those.
+    for c, _ in returns:
+        if c is not None and _cond_may_null(c):
+            raise _Unsupported("null-producing op in branch condition")
     out = returns[0][1]
     for c, v in returns[1:]:
         out = IfExpr(c, v, out) if c is not None else v
     return out
+
+
+def _cond_may_null(e: Expression) -> bool:
+    """True when the subtree contains an op that maps NON-null inputs
+    to SQL NULL (division family): under such a condition the compiled
+    If-tree silently takes the default branch while the uncompiled
+    Python would raise (advisor r4; ref CatalystExpressionBuilder
+    restricts conditions to null-safe predicates the same way)."""
+    from spark_rapids_tpu.expr import arithmetic as A
+    if isinstance(e, A._DivModLike):
+        return True
+    return any(_cond_may_null(c) for c in getattr(e, "children", ()))
 
 
 # ---------------------------------------------------------------------------

@@ -114,3 +114,143 @@ def test_to_jax_rejects_duplicate_column_names():
     # distinct names still export fine
     out = df.to_jax()
     assert set(out) == {"k", "v"}
+
+
+def test_agg_fingerprint_distinguishes_agg_functions():
+    """Round-4 advisor (high): plan_fingerprint must include the
+    aggregate specs — min(v).alias('m') and max(v).alias('m') over the
+    same scan share node_desc/bound-final-exprs/output schema, so
+    without an explicit payload ReuseExchange would dedup their
+    shuffles and serve one consumer the other's map output."""
+    import numpy as np
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.exec.exchange import plan_fingerprint
+    from spark_rapids_tpu.expr.aggregates import Max, Min
+
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    scan = LocalScanExec.from_pydict(
+        {"k": np.array([1, 1, 2], np.int32),
+         "v": np.array([1.0, 5.0, 2.0])}, schema)
+    lo = HashAggregateExec([col("k")], [col("k"),
+                                        Min(col("v")).alias("m")],
+                           scan, mode="partial")
+    hi = HashAggregateExec([col("k")], [col("k"),
+                                        Max(col("v")).alias("m")],
+                           scan, mode="partial")
+    assert plan_fingerprint(lo) != plan_fingerprint(hi)
+    # identical aggregations over the SAME scan still dedup
+    lo2 = HashAggregateExec([col("k")], [col("k"),
+                                         Min(col("v")).alias("m")],
+                            scan, mode="partial")
+    assert plan_fingerprint(lo) == plan_fingerprint(lo2)
+
+
+def test_agg_reuse_distinct_functions_end_to_end():
+    """End-to-end shape of the same finding: one source aggregated two
+    ways (min and max under the SAME output alias) then joined — under
+    the fingerprint collision both sides would read one shuffle and
+    min == max everywhere."""
+    import numpy as np
+    from spark_rapids_tpu.expr.aggregates import Max, Min
+
+    s = TpuSession({})
+    schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    rng = np.random.default_rng(7)
+    df = s.from_pydict({"k": rng.integers(0, 8, 200).astype(np.int32),
+                        "v": rng.random(200)}, schema, partitions=3)
+    lo = df.group_by("k").agg(Min(col("v")).alias("m")) \
+        .select(col("k"), col("m"))
+    hi = df.group_by("k").agg(Max(col("v")).alias("m")) \
+        .select(col("k").alias("k2"), col("m").alias("m2"))
+    out = lo.join(hi, on=[("k", "k2")])
+    rows = out.collect()
+    assert rows and all(r[1] < r[3] for r in rows)  # every min < max
+    dev, host = _both(out)
+    assert dev == host
+
+
+def test_udf_compiler_refuses_division_in_branch_condition():
+    """Round-4 advisor (low): a branch condition containing a
+    null-producing op (division) must refuse compilation — the
+    compiled If-tree would silently take the default branch where
+    uncompiled Python raises ZeroDivisionError."""
+    from spark_rapids_tpu.expr.core import BoundReference
+    from spark_rapids_tpu.udf.compiler import compile_udf
+
+    a = BoundReference(0, T.DoubleType(), True)
+    b = BoundReference(1, T.DoubleType(), True)
+
+    def risky(x, y):
+        if x / y > 1.0:
+            return 1.0
+        return 0.0
+
+    assert compile_udf(risky, [a, b]) is None  # falls back
+
+    # division in a RESULT (not a condition) still compiles
+    def fine(x, y):
+        if x > 1.0:
+            return x / y
+        return 0.0
+
+    assert compile_udf(fine, [a, b]) is not None
+
+
+def test_pandas_agg_exact_int64_group_keys():
+    """Round-4 advisor (low): nullable int64 group keys >= 2**53 must
+    not round-trip through float64 (distinct keys would merge)."""
+    from spark_rapids_tpu.exec.python_exec import pandas_agg_udf
+
+    s = TpuSession({})
+    big = 2**53
+    schema = T.Schema([T.StructField("k", T.LongType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    df = s.from_pydict({"k": [big, big + 1, big, None],
+                        "v": [1.0, 2.0, 3.0, 4.0]}, schema)
+    total = pandas_agg_udf(lambda v: float(v.sum()), T.DoubleType())
+    out = df.group_by("k").agg(total(col("v")).alias("s"))
+    rows = sorted(out.collect(), key=lambda r: (r[0] is None, r[0] or 0))
+    ks = [r[0] for r in rows if r[0] is not None]
+    assert ks == [big, big + 1]  # distinct keys preserved exactly
+    got = {r[0]: r[1] for r in rows}
+    assert got[big] == 4.0 and got[big + 1] == 2.0 and got[None] == 4.0
+
+
+def test_apply_in_pandas_exact_int64_group_keys():
+    """Review r5: the 2**53 key-collapse fix must also cover
+    FlatMapGroupsInPandas and the cogroup pairing (groups are formed
+    from the converted frame here; Spark forms them JVM-side)."""
+    import pandas as pd
+
+    s = TpuSession({})
+    big = 2**53
+    schema = T.Schema([T.StructField("k", T.LongType(), True),
+                       T.StructField("v", T.DoubleType(), True)])
+    df = s.from_pydict({"k": [big, big + 1, big, None],
+                        "v": [1.0, 2.0, 3.0, 4.0]}, schema)
+    out_schema = T.Schema([T.StructField("k", T.LongType(), True),
+                           T.StructField("n", T.LongType(), True)])
+    out = df.group_by("k").apply_in_pandas(
+        lambda g: pd.DataFrame({"k": [g["k"].iloc[0]],
+                                "n": [len(g)]}), out_schema)
+    rows = sorted(out.collect(), key=lambda r: (r[0] is None, r[0] or 0))
+    assert (big, 2) in rows and (big + 1, 1) in rows
+
+    # cogroup: each side groups exactly and keys pair across sides
+    df2 = s.from_pydict({"k": [big + 1, None], "v": [9.0, 8.0]}, schema)
+    co_schema = T.Schema([T.StructField("k", T.LongType(), True),
+                          T.StructField("ln", T.LongType(), True),
+                          T.StructField("rn", T.LongType(), True)])
+
+    def co(l, r):
+        src = l if len(l) else r
+        return pd.DataFrame({"k": [src["k"].iloc[0]],
+                             "ln": [len(l)], "rn": [len(r)]})
+
+    out = df.group_by("k").cogroup(df2.group_by("k")) \
+        .apply_in_pandas(co, co_schema)
+    rows = sorted(out.collect(), key=lambda r: (r[0] is None, r[0] or 0))
+    assert (big, 2, 0) in rows and (big + 1, 1, 1) in rows

@@ -196,17 +196,31 @@ def test_join_adaptive_reader_respects_disable():
 def test_rows_match_tolerant_verifier():
     """The bench verifier's paired fallback: boundary-noise floats
     accepted, real differences rejected, NaN/None/mixed rows pair
-    without any float ordering (q47's 103.1275 boundary flip)."""
+    without any float ordering (q47's 103.1275 boundary flip).
+    strict=False is the f32-pair (TPU) tier; strict=True is the
+    true-f64 tier where only summation-order noise is legitimate."""
     import math
     from spark_rapids_tpu.bench.runner import _rows_match
 
-    assert _rows_match([("a", 103.1275001)], [("a", 103.1274999)])
-    assert not _rows_match([("a", 103.13)], [("a", 103.12)])
+    assert _rows_match([("a", 103.1275001)], [("a", 103.1274999)],
+                       strict=False)
+    assert not _rows_match([("a", 103.13)], [("a", 103.12)],
+                           strict=False)
     assert _rows_match([("a", 1.5), ("a", None)],
-                       [("a", None), ("a", 1.5000000001)])
+                       [("a", None), ("a", 1.5000000001)], strict=False)
     assert _rows_match([(1, float("nan")), (2, 3.0)],
-                       [(2, 3.0000000001), (1, float("nan"))])
-    assert _rows_match([(1.2e8 * (1 + 4e-6),)], [(1.2e8,)])
+                       [(2, 3.0000000001), (1, float("nan"))],
+                       strict=False)
+    assert _rows_match([(1.2e8 * (1 + 4e-6),)], [(1.2e8,)], strict=False)
     assert not _rows_match([("a", 1.0), ("a", 1.0)],
-                           [("a", 1.0), ("a", 2.0)])
-    assert not _rows_match([("a", 1.0)], [("b", 1.0)])
+                           [("a", 1.0), ("a", 2.0)], strict=False)
+    assert not _rows_match([("a", 1.0)], [("b", 1.0)], strict=False)
+    # strict tier: f32-pair-scale error rejected, 1-ulp order noise ok
+    assert not _rows_match([(1.2e8 * (1 + 4e-6),)], [(1.2e8,)],
+                           strict=True)
+    assert not _rows_match([("a", 103.1275001)], [("a", 103.1274999)],
+                           strict=True)
+    assert _rows_match([(103.12750000000001,)], [(103.1275,)],
+                       strict=True)
+    # default keys off the backend (CPU under tests -> strict)
+    assert not _rows_match([(1.2e8 * (1 + 4e-6),)], [(1.2e8,)])
