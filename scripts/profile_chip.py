@@ -1,0 +1,138 @@
+"""On-chip phase profiler for one TPC-DS query (default q6).
+
+Answers VERDICT round-4 item 1: WHERE does the on-chip wall time go?
+Breaks a device run into the phases the engine can actually trade
+against each other:
+
+  * host decode + staging (arrow -> padded numpy matrices)
+  * H2D transfer bytes + seconds (jnp.asarray at batch construction)
+  * device compute (everything else inside collect)
+  * per-operator totalTime map (inclusive, reference GpuMetricNames)
+
+Usage:  python scripts/profile_chip.py [--sf 1] [--query q6] [--iters 2]
+Writes a JSON record to artifacts/profile_chip_<query>_sf<sf>.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# transfer instrumentation: wrap the three DeviceColumn constructors'
+# jnp.asarray calls by patching jnp.asarray inside the column module
+TRANSFER = {"bytes": 0, "seconds": 0.0, "calls": 0}
+STAGING = {"seconds": 0.0}
+
+
+def _instrument():
+    import numpy as _np
+    import jax.numpy as jnp
+
+    real_asarray = jnp.asarray
+
+    def timed_asarray(x, *a, **kw):
+        # only time true H2D transfers (host numpy -> device); tracer /
+        # device-array passthroughs are not transfers
+        if not isinstance(x, (_np.ndarray, _np.generic)):
+            return real_asarray(x, *a, **kw)
+        t0 = time.perf_counter()
+        out = real_asarray(x, *a, **kw)
+        try:
+            out.block_until_ready()
+        except AttributeError:
+            pass
+        TRANSFER["seconds"] += time.perf_counter() - t0
+        TRANSFER["bytes"] += getattr(out, "nbytes", 0)
+        TRANSFER["calls"] += 1
+        return out
+
+    jnp.asarray = timed_asarray
+
+    # staging: time ColumnBatch.from_arrow minus its transfer part
+    from spark_rapids_tpu.columnar.batch import ColumnBatch
+    real_from_arrow = ColumnBatch.__dict__["from_arrow"].__func__
+
+    def timed_from_arrow(rb, capacity=None, string_widths=None):
+        t0 = time.perf_counter()
+        xfer0 = TRANSFER["seconds"]
+        out = real_from_arrow(rb, capacity, string_widths)
+        dt = time.perf_counter() - t0
+        STAGING["seconds"] += dt - (TRANSFER["seconds"] - xfer0)
+        return out
+
+    ColumnBatch.from_arrow = staticmethod(timed_from_arrow)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--query", default="q6")
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--suite", default="tpcds")
+    args = ap.parse_args()
+
+    import jax
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    print(f"backend: {backend}", flush=True)
+
+    data_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".bench_data", f"sf{args.sf:g}")
+    if args.suite == "tpch":
+        from spark_rapids_tpu.bench.tpch_gen import generate_tpch as gen
+        from spark_rapids_tpu.bench.tpch_queries import (
+            build_tpch_query as build_query)
+    else:
+        from spark_rapids_tpu.bench.tpcds_gen import generate_tpcds as gen
+        from spark_rapids_tpu.bench.tpcds_queries import build_query
+    gen(data_dir, sf=args.sf)
+
+    _instrument()
+
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.bench.runner import _collect_rows, _plan_of
+    session = TpuSession({})
+    df = build_query(args.query, session, data_dir)
+    plan = _plan_of(df)
+
+    record = {"query": args.query, "sf": args.sf, "backend": backend,
+              "iters": []}
+    for it in range(args.iters):
+        TRANSFER.update(bytes=0, seconds=0.0, calls=0)
+        STAGING["seconds"] = 0.0
+        metrics: dict = {}
+        t0 = time.perf_counter()
+        rows = _collect_rows(df, "device", plan, metrics_out=metrics)
+        wall = time.perf_counter() - t0
+        rec = {
+            "iter": it, "wall_s": round(wall, 3), "rows": len(rows),
+            "h2d_bytes": TRANSFER["bytes"],
+            "h2d_s": round(TRANSFER["seconds"], 3),
+            "h2d_mbps": round(TRANSFER["bytes"] / 1e6 /
+                              max(TRANSFER["seconds"], 1e-9), 1),
+            "h2d_calls": TRANSFER["calls"],
+            "staging_s": round(STAGING["seconds"], 3),
+            "other_s": round(wall - TRANSFER["seconds"] -
+                             STAGING["seconds"], 3),
+            "op_totalTime": {k: round(v.get("totalTime", 0.0), 3)
+                             for k, v in sorted(metrics.items())},
+        }
+        record["iters"].append(rec)
+        print(json.dumps(rec), flush=True)
+
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", f"profile_chip_{args.query}_sf{args.sf:g}.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,14 @@ print(jax.devices())
       echo "$STAMP odd: $OUT" | head -1 >> "$LOG"
     fi
   else
-    echo "$STAMP WEDGED (probe timed out in get_backend)" >> "$LOG"
+    RC=$?
+    if [ "$RC" -eq 124 ]; then
+      echo "$STAMP WEDGED (probe timed out in get_backend)" >> "$LOG"
+    else
+      # fast nonzero exit = jax initialized but not on the TPU (e.g.
+      # a cpu fallback) — responsive environment, NOT a wedge
+      echo "$STAMP DOWN rc=$RC: $(echo "$OUT" | tail -1)" >> "$LOG"
+    fi
     rm -f artifacts/TPU_UP
   fi
   sleep 600

@@ -97,9 +97,10 @@ class DeviceColumn:
 
     # -- construction helpers ----------------------------------------------
     @staticmethod
-    def from_numpy(data: np.ndarray, validity: np.ndarray | None,
-                   dtype: T.DataType, capacity: int) -> "DeviceColumn":
-        """Pad host numpy data to ``capacity`` and move to device."""
+    def stage_fixed(data: np.ndarray, validity: np.ndarray | None,
+                    capacity: int) -> tuple:
+        """Pad host numpy data to ``capacity``; returns (data, validity)
+        host leaves (no device move — see batch._PackBuilder)."""
         n = data.shape[0]
         assert n <= capacity, (n, capacity)
         if validity is None:
@@ -110,6 +111,34 @@ class DeviceColumn:
         dfull[:n] = data
         # zero out null slots for deterministic padding semantics
         dfull[:n][~validity] = 0
+        return dfull, vfull
+
+    @staticmethod
+    def stage_var_width(matrix: np.ndarray, lengths: np.ndarray,
+                        validity: np.ndarray | None, capacity: int,
+                        elem_dtype: np.dtype, default_width: int = 1) -> tuple:
+        """Pad a [n, w] element/byte matrix + lengths to ``capacity``;
+        returns (matrix, validity, lengths) host leaves."""
+        n = matrix.shape[0]
+        width = matrix.shape[1] if matrix.ndim == 2 else default_width
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vfull = np.zeros(capacity, dtype=np.bool_)
+        vfull[:n] = validity
+        dfull = np.zeros((capacity, width), dtype=elem_dtype)
+        lfull = np.zeros(capacity, dtype=np.int32)
+        if n:
+            dfull[:n] = matrix
+            lfull[:n] = lengths
+            dfull[:n][~validity] = 0
+            lfull[:n][~validity] = 0
+        return dfull, vfull, lfull
+
+    @staticmethod
+    def from_numpy(data: np.ndarray, validity: np.ndarray | None,
+                   dtype: T.DataType, capacity: int) -> "DeviceColumn":
+        """Pad host numpy data to ``capacity`` and move to device."""
+        dfull, vfull = DeviceColumn.stage_fixed(data, validity, capacity)
         return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull), dtype)
 
     @staticmethod
@@ -117,19 +146,8 @@ class DeviceColumn:
                           validity: np.ndarray | None, capacity: int,
                           dtype: T.ArrayType) -> "DeviceColumn":
         """Array column from a padded [n, max_len] element matrix."""
-        n = matrix.shape[0]
-        width = matrix.shape[1] if matrix.ndim == 2 else 1
-        if validity is None:
-            validity = np.ones(n, dtype=np.bool_)
-        vfull = np.zeros(capacity, dtype=np.bool_)
-        vfull[:n] = validity
-        dfull = np.zeros((capacity, width), dtype=dtype.np_dtype)
-        lfull = np.zeros(capacity, dtype=np.int32)
-        if n:
-            dfull[:n] = matrix
-            lfull[:n] = lengths
-            dfull[:n][~validity] = 0
-            lfull[:n][~validity] = 0
+        dfull, vfull, lfull = DeviceColumn.stage_var_width(
+            matrix, lengths, validity, capacity, dtype.np_dtype)
         return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
                             dtype, jnp.asarray(lfull))
 
@@ -137,18 +155,8 @@ class DeviceColumn:
     def strings_from_numpy(byte_matrix: np.ndarray, lengths: np.ndarray,
                            validity: np.ndarray | None,
                            capacity: int) -> "DeviceColumn":
-        n = byte_matrix.shape[0]
-        width = byte_matrix.shape[1] if byte_matrix.ndim == 2 else 4
-        if validity is None:
-            validity = np.ones(n, dtype=np.bool_)
-        vfull = np.zeros(capacity, dtype=np.bool_)
-        vfull[:n] = validity
-        dfull = np.zeros((capacity, width), dtype=np.uint8)
-        lfull = np.zeros(capacity, dtype=np.int32)
-        if n:
-            dfull[:n] = byte_matrix
-            lfull[:n] = lengths
-            dfull[:n][~validity] = 0
-            lfull[:n][~validity] = 0
+        dfull, vfull, lfull = DeviceColumn.stage_var_width(
+            byte_matrix, lengths, validity, capacity, np.dtype(np.uint8),
+            default_width=4)
         return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
                             T.StringType(), jnp.asarray(lfull))

@@ -497,15 +497,17 @@ def device_to_host(b: ColumnBatch) -> HostBatch:
 
 
 def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
-    """H2D: HostBatch -> ColumnBatch (reference HostColumnarToGpu)."""
-    import jax.numpy as jnp
+    """H2D: HostBatch -> ColumnBatch (reference HostColumnarToGpu).
+    Columns are staged into per-dtype packed buffers and moved with one
+    transfer per dtype (columnar/batch._PackBuilder)."""
     import numpy as np
-    from spark_rapids_tpu.columnar.batch import round_capacity
+    from spark_rapids_tpu.columnar.batch import _PackBuilder, round_capacity
     from spark_rapids_tpu.columnar.column import (DeviceColumn,
                                                   round_string_width)
     n = b.num_rows
     cap = capacity or round_capacity(max(n, 1))
-    cols = []
+    pack = _PackBuilder()
+    col_specs = []
     for f, col in zip(b.schema, b.columns):
         if isinstance(f.data_type, T.StringType):
             enc = [(x.encode("utf-8") if x is not None else b"")
@@ -517,8 +519,9 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
             for i, e in enumerate(enc):
                 bm[i, :len(e)] = np.frombuffer(e, dtype=np.uint8)
                 lens[i] = len(e)
-            cols.append(DeviceColumn.strings_from_numpy(
-                bm, lens, col.validity, cap))
+            staged = DeviceColumn.stage_var_width(
+                bm, lens, col.validity, cap, np.dtype(np.uint8),
+                default_width=4)
         elif isinstance(f.data_type, T.ArrayType):
             vals = [(v if v is not None else []) for v in col.data]
             maxw = max((len(v) for v in vals), default=1)
@@ -528,9 +531,9 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
             for i, v in enumerate(vals):
                 m[i, :len(v)] = v
                 lens[i] = len(v)
-            cols.append(DeviceColumn.arrays_from_numpy(
-                m, lens, col.validity, cap, f.data_type))
+            staged = DeviceColumn.stage_var_width(
+                m, lens, col.validity, cap, f.data_type.np_dtype)
         else:
-            cols.append(DeviceColumn.from_numpy(
-                col.data, col.validity, f.data_type, cap))
-    return ColumnBatch(cols, jnp.asarray(n, dtype=jnp.int32), b.schema)
+            staged = DeviceColumn.stage_fixed(col.data, col.validity, cap)
+        col_specs.append(pack.add_staged(staged))
+    return pack.build(n, b.schema, col_specs)
