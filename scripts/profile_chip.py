@@ -25,11 +25,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # transfer instrumentation: wrap the three DeviceColumn constructors'
 # jnp.asarray calls by patching jnp.asarray inside the column module
 TRANSFER = {"bytes": 0, "seconds": 0.0, "calls": 0}
+PUT = {"bytes": 0, "seconds": 0.0, "calls": 0}
+GET = {"seconds": 0.0, "calls": 0}
 STAGING = {"seconds": 0.0}
 
 
 def _instrument():
     import numpy as _np
+    import jax
     import jax.numpy as jnp
 
     real_asarray = jnp.asarray
@@ -37,7 +40,7 @@ def _instrument():
     def timed_asarray(x, *a, **kw):
         # only time true H2D transfers (host numpy -> device); tracer /
         # device-array passthroughs are not transfers
-        if not isinstance(x, (_np.ndarray, _np.generic)):
+        if not isinstance(x, (_np.ndarray, _np.generic, int, float, bool)):
             return real_asarray(x, *a, **kw)
         t0 = time.perf_counter()
         out = real_asarray(x, *a, **kw)
@@ -52,16 +55,46 @@ def _instrument():
 
     jnp.asarray = timed_asarray
 
+    real_put = jax.device_put
+
+    def timed_put(x, *a, **kw):
+        t0 = time.perf_counter()
+        out = real_put(x, *a, **kw)
+        try:
+            out.block_until_ready()
+        except AttributeError:
+            pass
+        PUT["seconds"] += time.perf_counter() - t0
+        PUT["bytes"] += getattr(out, "nbytes", 0)
+        PUT["calls"] += 1
+        return out
+
+    jax.device_put = timed_put
+    # the pack builder binds jax.device_put at call time (module attr),
+    # so patching the jax module attribute covers it
+
+    real_get = jax.device_get
+
+    def timed_get(x, *a, **kw):
+        t0 = time.perf_counter()
+        out = real_get(x, *a, **kw)
+        GET["seconds"] += time.perf_counter() - t0
+        GET["calls"] += 1
+        return out
+
+    jax.device_get = timed_get
+
     # staging: time ColumnBatch.from_arrow minus its transfer part
     from spark_rapids_tpu.columnar.batch import ColumnBatch
     real_from_arrow = ColumnBatch.__dict__["from_arrow"].__func__
 
-    def timed_from_arrow(rb, capacity=None, string_widths=None):
+    def timed_from_arrow(rb, capacity=None, string_widths=None, codec=None):
         t0 = time.perf_counter()
-        xfer0 = TRANSFER["seconds"]
-        out = real_from_arrow(rb, capacity, string_widths)
+        xfer0 = TRANSFER["seconds"] + PUT["seconds"]
+        out = real_from_arrow(rb, capacity, string_widths, codec)
         dt = time.perf_counter() - t0
-        STAGING["seconds"] += dt - (TRANSFER["seconds"] - xfer0)
+        STAGING["seconds"] += dt - (TRANSFER["seconds"] + PUT["seconds"]
+                                    - xfer0)
         return out
 
     ColumnBatch.from_arrow = staticmethod(timed_from_arrow)
@@ -105,6 +138,8 @@ def main():
               "iters": []}
     for it in range(args.iters):
         TRANSFER.update(bytes=0, seconds=0.0, calls=0)
+        PUT.update(bytes=0, seconds=0.0, calls=0)
+        GET.update(seconds=0.0, calls=0)
         STAGING["seconds"] = 0.0
         metrics: dict = {}
         t0 = time.perf_counter()
@@ -112,14 +147,19 @@ def main():
         wall = time.perf_counter() - t0
         rec = {
             "iter": it, "wall_s": round(wall, 3), "rows": len(rows),
-            "h2d_bytes": TRANSFER["bytes"],
-            "h2d_s": round(TRANSFER["seconds"], 3),
-            "h2d_mbps": round(TRANSFER["bytes"] / 1e6 /
-                              max(TRANSFER["seconds"], 1e-9), 1),
-            "h2d_calls": TRANSFER["calls"],
+            "h2d_bytes": TRANSFER["bytes"] + PUT["bytes"],
+            "h2d_s": round(TRANSFER["seconds"] + PUT["seconds"], 3),
+            "h2d_mbps": round((TRANSFER["bytes"] + PUT["bytes"]) / 1e6 /
+                              max(TRANSFER["seconds"] + PUT["seconds"],
+                                  1e-9), 1),
+            "h2d_calls": TRANSFER["calls"] + PUT["calls"],
+            "scalar_asarray_calls": TRANSFER["calls"],
+            "scalar_asarray_s": round(TRANSFER["seconds"], 3),
+            "d2h_calls": GET["calls"],
+            "d2h_s": round(GET["seconds"], 3),
             "staging_s": round(STAGING["seconds"], 3),
-            "other_s": round(wall - TRANSFER["seconds"] -
-                             STAGING["seconds"], 3),
+            "other_s": round(wall - TRANSFER["seconds"] - PUT["seconds"] -
+                             GET["seconds"] - STAGING["seconds"], 3),
             "op_totalTime": {k: round(v.get("totalTime", 0.0), 3)
                              for k, v in sorted(metrics.items())},
         }

@@ -24,9 +24,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import wirecodec as wc
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_string_width
 
 __all__ = ["ColumnBatch", "round_capacity"]
+
+#: wire-codec default (overridable per call; SRT_WIRE_CODEC=0 disables
+#: globally for debugging)
+_CODEC_DEFAULT = __import__("os").environ.get("SRT_WIRE_CODEC", "1") != "0"
+#: below this capacity transfers are latency-bound, not bandwidth-bound:
+#: the codec would only multiply compiled unpack variants (a 6x
+#: test-suite slowdown when engaged for every tiny batch)
+_CODEC_MIN_CAPACITY = 2048
+
+
+def _codec_auto(cap: int, codec: bool | None) -> bool:
+    if codec is not None:
+        return codec
+    return _CODEC_DEFAULT and cap >= _CODEC_MIN_CAPACITY
 
 _MIN_CAPACITY = 8
 
@@ -46,13 +61,19 @@ _MIN_CAPACITY = 8
 
 
 class _PackBuilder:
-    """Accumulates per-column host leaves and materializes them on device
-    with one transfer per dtype group + one unpack program."""
+    """Accumulates per-column host leaves — raw or wire-codec encoded
+    (columnar/wirecodec.py) — and materializes them on device with one
+    transfer per dtype group + one unpack/decode program."""
 
-    def __init__(self):
+    def __init__(self, capacity: int, codec: bool = True):
+        self.capacity = capacity
+        self.codec = codec
         self.groups: dict[str, list] = {}   # dtype key -> host 1-D chunks
         self.offsets: dict[str, int] = {}   # dtype key -> elements so far
         self.leaves: list[tuple] = []       # (gkey, offset, size, shape)
+        self.i64_params: list[int] = []
+        self.f64_params: list[float] = []
+        self.col_specs: list[tuple] = []
 
     def _add_leaf(self, arr: np.ndarray) -> int:
         gkey = arr.dtype.str
@@ -63,55 +84,149 @@ class _PackBuilder:
         self.leaves.append((gkey, off, flat.size, arr.shape))
         return len(self.leaves) - 1
 
-    def add_staged(self, staged: tuple) -> tuple[int, bool]:
-        """Add one column's staged (padded-to-capacity) leaves —
-        (data, validity) from stage_fixed or (data, validity, lengths)
-        from stage_var_width.  Returns the (leaf index, has_lengths)
-        col_spec entry for :meth:`build`."""
-        di = self._add_leaf(staged[0])
-        self._add_leaf(staged[1])
-        if len(staged) == 3:
-            self._add_leaf(staged[2])
-        return di, len(staged) == 3
+    def _add_i64(self, v: int) -> int:
+        self.i64_params.append(int(v))
+        return len(self.i64_params) - 1
 
-    def build(self, num_rows: int, schema: T.Schema,
-              col_specs: list[tuple]) -> "ColumnBatch":
-        """One device_put per dtype group, one jitted unpack.
+    def _add_f64(self, v: float) -> int:
+        self.f64_params.append(float(v))
+        return len(self.f64_params) - 1
 
-        ``col_specs``: per column (leaf_index_of_data, has_lengths).
-        Leaves were added in (data, validity[, lengths]) order.
-        """
+    # -- column registration ------------------------------------------------
+    def _val_desc(self, validity: np.ndarray | None, n: int) -> tuple:
+        """Validity spec: all-valid columns ship nothing (decode derives
+        the mask from num_rows); others ship 1 bit/row."""
+        if validity is None or bool(validity.all()):
+            return ("av",)
+        return ("vbits", self._add_leaf(
+            wc.pack_bits_host(validity.astype(np.uint8), 1, self.capacity)))
+
+    def add_fixed(self, data: np.ndarray, validity: np.ndarray | None):
+        """Fixed-width column from UNPADDED host data (+ validity)."""
+        n = data.shape[0]
+        if validity is not None and not validity.all():
+            data = np.where(validity, data, data.dtype.type(0))
+        if self.codec:
+            desc = wc.encode_fixed(data, validity, self.capacity,
+                                   self._add_leaf, self._add_i64,
+                                   self._add_f64)
+        else:
+            full = np.zeros((self.capacity,) + data.shape[1:],
+                            dtype=data.dtype)
+            full[:n] = data
+            desc = ("raw", self._add_leaf(full))
+        self.col_specs.append(("fixed", desc, self._val_desc(validity, n)))
+
+    def add_var(self, matrix: np.ndarray, lengths: np.ndarray,
+                validity: np.ndarray | None, width: int):
+        """Var-width (string/array) column from an UNPADDED [n, w]
+        matrix + lengths."""
+        n = matrix.shape[0]
+        cap = self.capacity
+        if validity is not None and not validity.all():
+            matrix = np.where(validity[:, None], matrix,
+                              matrix.dtype.type(0))
+            lengths = np.where(validity, lengths, 0)
+        mfull = np.zeros((cap, width), dtype=matrix.dtype)
+        mfull[:n] = matrix
+        mdesc = ("raw", self._add_leaf(mfull))
+        if self.codec:
+            ldesc = wc.encode_lengths(lengths, cap, width, self._add_leaf,
+                                      self._add_i64)
+        else:
+            lfull = np.zeros(cap, dtype=np.int32)
+            lfull[:n] = lengths
+            ldesc = ("raw", self._add_leaf(lfull))
+        self.col_specs.append(("var", mdesc, self._val_desc(validity, n),
+                               ldesc))
+
+    def add_dict_string(self, indices: np.ndarray,
+                        dict_matrix: np.ndarray, dict_lengths: np.ndarray,
+                        validity: np.ndarray | None):
+        """Dictionary-encoded string column: bit-packed int32 indices +
+        a pow2-row-padded dictionary byte matrix; decode is one gather."""
+        cap = self.capacity
+        k, w = dict_matrix.shape
+        kp = round_capacity(max(k, 1))
+        mfull = np.zeros((kp, w), dtype=np.uint8)
+        mfull[:k] = dict_matrix
+        lfull = np.zeros(kp, dtype=np.int32)
+        lfull[:k] = dict_lengths
+        if validity is not None and not validity.all():
+            indices = np.where(validity, indices, 0)
+        idesc = wc.encode_fixed(indices, validity, cap, self._add_leaf,
+                                self._add_i64, self._add_f64) \
+            if self.codec else None
+        if idesc is None:
+            full = np.zeros(cap, dtype=np.int32)
+            full[:indices.shape[0]] = indices
+            idesc = ("raw", self._add_leaf(full))
+        self.col_specs.append(("dict", idesc,
+                               self._val_desc(validity, indices.shape[0]),
+                               self._add_leaf(mfull),
+                               self._add_leaf(lfull)))
+
+    # -- materialization ----------------------------------------------------
+    def build(self, num_rows: int, schema: T.Schema) -> "ColumnBatch":
+        """One device_put per dtype group, one jitted unpack+decode."""
         nr = self._add_leaf(np.asarray([num_rows], dtype=np.int32))
+        ip = self._add_leaf(np.asarray(self.i64_params, dtype=np.int64)) \
+            if self.i64_params else -1
+        fp = self._add_leaf(np.asarray(self.f64_params, dtype=np.float64)) \
+            if self.f64_params else -1
         gkeys = tuple(sorted(self.groups))
         host_bufs = tuple(
             self.groups[k][0] if len(self.groups[k]) == 1
             else np.concatenate(self.groups[k]) for k in gkeys)
         dev_bufs = tuple(jax.device_put(b) for b in host_bufs)
-        spec = (gkeys, tuple(self.leaves), nr)
+        spec = (self.capacity, gkeys, tuple(self.leaves),
+                tuple(self.col_specs), nr, ip, fp)
         arrays = _packed_unpack_cached(spec)(dev_bufs)
-        cols = []
-        for f, (di, has_len) in zip(schema, col_specs):
-            data = arrays[di]
-            validity = arrays[di + 1]
-            lengths = arrays[di + 2] if has_len else None
-            cols.append(DeviceColumn(data, validity, f.data_type, lengths))
-        return ColumnBatch(cols, arrays[nr], schema)
+        cols = [DeviceColumn(d, v, f.data_type, ln)
+                for f, (d, v, ln) in zip(schema, arrays[0])]
+        return ColumnBatch(cols, arrays[1], schema)
 
 
 @_functools.lru_cache(maxsize=1024)
 def _packed_unpack_cached(spec):
-    gkeys, leaves, nr_index = spec
+    cap, gkeys, leaves, col_specs, nr_idx, ip_idx, fp_idx = spec
 
     def unpack(bufs):
+        import jax.numpy as jnp
         by_key = dict(zip(gkeys, bufs))
-        out = []
-        for i, (gkey, off, size, shape) in enumerate(leaves):
+
+        def leaf(i):
+            gkey, off, size, shape = leaves[i]
             piece = jax.lax.slice(by_key[gkey], (off,), (off + size,))
-            if i == nr_index:
-                out.append(piece[0])
-            else:
-                out.append(piece.reshape(shape))
-        return tuple(out)
+            return piece.reshape(shape)
+
+        nr = leaf(nr_idx)[0]
+        i64p = leaf(ip_idx) if ip_idx >= 0 else None
+        f64p = leaf(fp_idx) if fp_idx >= 0 else None
+        out_cols = []
+        for cspec in col_specs:
+            kind = cspec[0]
+            validity = wc.decode_validity(cspec[2], leaf, cap, nr)
+            if kind == "fixed":
+                data = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
+                zero = jnp.zeros((), data.dtype)
+                data = jnp.where(validity, data, zero)
+                out_cols.append((data, validity, None))
+            elif kind == "var":
+                data = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
+                lens = wc.decode_data(cspec[3], leaf, i64p, f64p, cap)
+                data = jnp.where(validity[:, None], data,
+                                 jnp.zeros((), data.dtype))
+                lens = jnp.where(validity, lens, 0)
+                out_cols.append((data, validity, lens))
+            else:  # dict string
+                idx = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
+                mat, dlens = leaf(cspec[3]), leaf(cspec[4])
+                data = jnp.where(validity[:, None], mat[idx],
+                                 jnp.zeros((), mat.dtype))
+                lens = jnp.where(validity, dlens[idx], 0)
+                out_cols.append((data, validity, lens))
+        return tuple(out_cols), nr
 
     return jax.jit(unpack)
 
@@ -188,19 +303,21 @@ class ColumnBatch:
     # ------------------------------------------------------------------
     @staticmethod
     def from_arrow(rb, capacity: int | None = None,
-                   string_widths: dict[str, int] | None = None) -> "ColumnBatch":
+                   string_widths: dict[str, int] | None = None,
+                   codec: bool | None = None) -> "ColumnBatch":
         """Build a device batch from a pyarrow.RecordBatch (H2D transfer)."""
         with _arrow_guard():
-            return ColumnBatch._from_arrow_locked(rb, capacity, string_widths)
+            return ColumnBatch._from_arrow_locked(rb, capacity,
+                                                  string_widths, codec)
 
     @staticmethod
-    def _from_arrow_locked(rb, capacity=None, string_widths=None):
+    def _from_arrow_locked(rb, capacity=None, string_widths=None,
+                           codec=None):
         import pyarrow as pa
         n = rb.num_rows
         cap = capacity or round_capacity(max(n, 1))
         schema = T.Schema.from_arrow(rb.schema)
-        pack = _PackBuilder()
-        col_specs = []
+        pack = _PackBuilder(cap, _codec_auto(cap, codec))
         for i, field in enumerate(schema):
             arr = rb.column(i)
             if isinstance(arr, pa.ChunkedArray):
@@ -208,19 +325,25 @@ class ColumnBatch:
             validity = T.arrow_validity_numpy(arr)
             if isinstance(field.data_type, T.StringType):
                 w = (string_widths or {}).get(field.name)
-                bm, lens = _strings_to_matrix(arr, w)
-                staged = DeviceColumn.stage_var_width(
-                    bm, lens, validity, cap, np.dtype(np.uint8),
-                    default_width=4)
+                dic = wc.maybe_dict_arrow(arr, n) if pack.codec else None
+                if dic is not None:
+                    idx, dictionary = dic
+                    # honor the scan's width hint so batches across
+                    # files keep one compiled width bucket
+                    dm, dlens = _strings_to_matrix(dictionary, w)
+                    pack.add_dict_string(idx, dm, dlens, validity)
+                else:
+                    bm, lens = _strings_to_matrix(arr, w)
+                    pack.add_var(bm, lens, validity,
+                                 bm.shape[1] if bm.ndim == 2 else 4)
             elif isinstance(field.data_type, T.ArrayType):
                 m, lens = _lists_to_matrix(arr, field.data_type)
-                staged = DeviceColumn.stage_var_width(
-                    m, lens, validity, cap, field.data_type.np_dtype)
+                pack.add_var(m, lens, validity,
+                             m.shape[1] if m.ndim == 2 else 1)
             else:
                 data = T.arrow_fixed_to_numpy(arr, field.data_type)
-                staged = DeviceColumn.stage_fixed(data, validity, cap)
-            col_specs.append(pack.add_staged(staged))
-        return pack.build(n, schema, col_specs)
+                pack.add_fixed(data, validity)
+        return pack.build(n, schema)
 
     def to_arrow(self):
         """Copy the batch back to host as a pyarrow.RecordBatch (D2H).
@@ -231,8 +354,11 @@ class ColumnBatch:
         (observed as a segfault under the virtual multi-device CPU mesh).
         """
         import pyarrow as pa
-        n = self.host_num_rows()
-        host_cols = jax.device_get([(c.data, c.validity, c.lengths) for c in self.columns])
+        # one device_get for num_rows + leaves (one round trip, not two)
+        n, host_cols = jax.device_get(
+            (self.num_rows,
+             [(c.data, c.validity, c.lengths) for c in self.columns]))
+        n = int(n)
         with _arrow_guard():
             return self._to_arrow_locked(n, host_cols)
 

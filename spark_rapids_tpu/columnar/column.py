@@ -114,49 +114,8 @@ class DeviceColumn:
         return dfull, vfull
 
     @staticmethod
-    def stage_var_width(matrix: np.ndarray, lengths: np.ndarray,
-                        validity: np.ndarray | None, capacity: int,
-                        elem_dtype: np.dtype, default_width: int = 1) -> tuple:
-        """Pad a [n, w] element/byte matrix + lengths to ``capacity``;
-        returns (matrix, validity, lengths) host leaves."""
-        n = matrix.shape[0]
-        width = matrix.shape[1] if matrix.ndim == 2 else default_width
-        if validity is None:
-            validity = np.ones(n, dtype=np.bool_)
-        vfull = np.zeros(capacity, dtype=np.bool_)
-        vfull[:n] = validity
-        dfull = np.zeros((capacity, width), dtype=elem_dtype)
-        lfull = np.zeros(capacity, dtype=np.int32)
-        if n:
-            dfull[:n] = matrix
-            lfull[:n] = lengths
-            dfull[:n][~validity] = 0
-            lfull[:n][~validity] = 0
-        return dfull, vfull, lfull
-
-    @staticmethod
     def from_numpy(data: np.ndarray, validity: np.ndarray | None,
                    dtype: T.DataType, capacity: int) -> "DeviceColumn":
         """Pad host numpy data to ``capacity`` and move to device."""
         dfull, vfull = DeviceColumn.stage_fixed(data, validity, capacity)
         return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull), dtype)
-
-    @staticmethod
-    def arrays_from_numpy(matrix: np.ndarray, lengths: np.ndarray,
-                          validity: np.ndarray | None, capacity: int,
-                          dtype: T.ArrayType) -> "DeviceColumn":
-        """Array column from a padded [n, max_len] element matrix."""
-        dfull, vfull, lfull = DeviceColumn.stage_var_width(
-            matrix, lengths, validity, capacity, dtype.np_dtype)
-        return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
-                            dtype, jnp.asarray(lfull))
-
-    @staticmethod
-    def strings_from_numpy(byte_matrix: np.ndarray, lengths: np.ndarray,
-                           validity: np.ndarray | None,
-                           capacity: int) -> "DeviceColumn":
-        dfull, vfull, lfull = DeviceColumn.stage_var_width(
-            byte_matrix, lengths, validity, capacity, np.dtype(np.uint8),
-            default_width=4)
-        return DeviceColumn(jnp.asarray(dfull), jnp.asarray(vfull),
-                            T.StringType(), jnp.asarray(lfull))

@@ -324,6 +324,8 @@ class HashAggregateExec(PlanNode):
     # this bound — peak concat storage stays ~2x the bound while the
     # n-way merge keeps the sort count at O(total/bound), not O(batches)
     _MERGE_PENDING_CAP = 1 << 23
+    #: batches whose group counts sync to host in one stacked device_get
+    _SYNC_CHUNK = 8
 
     def _run_device(self, ctx: ExecCtx, child_it, key_idx) \
             -> Iterator[ColumnBatch]:
@@ -367,21 +369,47 @@ class HashAggregateExec(PlanNode):
             parts = [merged]
             total_cap = cap
 
+        # Group-count syncs are CHUNKED: each host round trip over a
+        # tunneled backend costs tens of ms of pure latency, so up to
+        # _SYNC_CHUNK updated buffers are dispatched asynchronously and
+        # their counts fetched in ONE device_get of a stacked vector
+        # (one barrier per chunk, not per batch).  HBM backpressure:
+        # a chunk holds at most _SYNC_CHUNK un-shrunk buffers; the
+        # OOM-spill-retry hook covers the peak.
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        def flush_chunk(chunk: list) -> None:
+            nonlocal total_cap
+            if not chunk:
+                return
+            if len(chunk) == 1:
+                ngs = [chunk[0].host_num_rows()]
+            else:
+                ngs = _jax.device_get(
+                    ctx.dispatch(_jnp.stack, [c.num_rows for c in chunk]))
+            for part, ng in zip(chunk, ngs):
+                ng = int(ng)
+                if ng == 0 and key_idx:
+                    continue
+                cap = round_capacity(max(ng, 1))
+                part = ctx.dispatch(dk.shrink_capacity, part, cap)
+                parts.append(part)
+                total_cap += cap
+                if total_cap >= self._MERGE_PENDING_CAP:
+                    merge_pending()
+
+        chunk: list = []
         for b in child_it:
             if self.mode == "final":
                 part = _relabel_d(b, self._buffer_schema)
             else:
                 part = ctx.dispatch(update_jit, b)
-            # one host sync per batch (shrink soundness + backpressure)
-            ng = part.host_num_rows()
-            if ng == 0 and key_idx:
-                continue
-            cap = round_capacity(max(int(ng), 1))
-            part = ctx.dispatch(dk.shrink_capacity, part, cap)
-            parts.append(part)
-            total_cap += cap
-            if total_cap >= self._MERGE_PENDING_CAP:
-                merge_pending()
+            chunk.append(part)
+            if len(chunk) >= self._SYNC_CHUNK:
+                flush_chunk(chunk)
+                chunk = []
+        flush_chunk(chunk)
         merge_pending()
         running = parts[0] if parts else None
         if running is None:

@@ -471,8 +471,11 @@ def device_to_host(b: ColumnBatch) -> HostBatch:
     import jax
     import numpy as np
     from spark_rapids_tpu.host.batch import HostColumn
-    n = b.host_num_rows()
-    host = jax.device_get([(c.data, c.validity, c.lengths) for c in b.columns])
+    # ONE device_get for num_rows + all column leaves: separate fetches
+    # pay a full host round trip each on a tunneled backend
+    n, host = jax.device_get(
+        (b.num_rows, [(c.data, c.validity, c.lengths) for c in b.columns]))
+    n = int(n)
     cols = []
     for f, (data, validity, lengths) in zip(b.schema, host):
         v = np.asarray(validity[:n], dtype=np.bool_)
@@ -502,12 +505,11 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
     transfer per dtype (columnar/batch._PackBuilder)."""
     import numpy as np
     from spark_rapids_tpu.columnar.batch import _PackBuilder, round_capacity
-    from spark_rapids_tpu.columnar.column import (DeviceColumn,
-                                                  round_string_width)
+    from spark_rapids_tpu.columnar.column import round_string_width
+    from spark_rapids_tpu.columnar.batch import _codec_auto
     n = b.num_rows
     cap = capacity or round_capacity(max(n, 1))
-    pack = _PackBuilder()
-    col_specs = []
+    pack = _PackBuilder(cap, _codec_auto(cap, None))
     for f, col in zip(b.schema, b.columns):
         if isinstance(f.data_type, T.StringType):
             enc = [(x.encode("utf-8") if x is not None else b"")
@@ -519,9 +521,7 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
             for i, e in enumerate(enc):
                 bm[i, :len(e)] = np.frombuffer(e, dtype=np.uint8)
                 lens[i] = len(e)
-            staged = DeviceColumn.stage_var_width(
-                bm, lens, col.validity, cap, np.dtype(np.uint8),
-                default_width=4)
+            pack.add_var(bm, lens, col.validity, w)
         elif isinstance(f.data_type, T.ArrayType):
             vals = [(v if v is not None else []) for v in col.data]
             maxw = max((len(v) for v in vals), default=1)
@@ -531,9 +531,7 @@ def host_to_device(b: HostBatch, capacity: int | None = None) -> ColumnBatch:
             for i, v in enumerate(vals):
                 m[i, :len(v)] = v
                 lens[i] = len(v)
-            staged = DeviceColumn.stage_var_width(
-                m, lens, col.validity, cap, f.data_type.np_dtype)
+            pack.add_var(m, lens, col.validity, w)
         else:
-            staged = DeviceColumn.stage_fixed(col.data, col.validity, cap)
-        col_specs.append(pack.add_staged(staged))
-    return pack.build(n, b.schema, col_specs)
+            pack.add_fixed(np.asarray(col.data), col.validity)
+    return pack.build(n, b.schema)

@@ -73,16 +73,23 @@ def _jit_group_by_part(batch: ColumnBatch, ids: jax.Array, num_parts: int):
     counts = jnp.sum(ids[None, :] == jnp.arange(num_parts,
                                                 dtype=jnp.int32)[:, None],
                      axis=1, dtype=jnp.int32)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix, on device
     cols = dk.gather_columns(batch.columns, order, batch.num_rows)
-    return ColumnBatch(cols, batch.num_rows, batch.schema), counts
+    return ColumnBatch(cols, batch.num_rows, batch.schema), counts, starts
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
-def _jit_slice_part(sorted_batch: ColumnBatch, start, count, out_cap: int):
-    """Copy rows [start, start+count) into a fresh out_cap batch."""
+def _jit_slice_part(sorted_batch: ColumnBatch, starts, counts, p,
+                    out_cap: int):
+    """Copy partition ``p``'s rows [starts[p], starts[p]+counts[p]) into
+    a fresh out_cap batch.  ``starts``/``counts`` stay device-resident
+    and ``p`` is a cached device scalar: the per-partition offsets never
+    round-trip to host (only the counts vector does, once per batch,
+    for the static capacity choice)."""
+    start = starts[p]
     idx = jnp.clip(start + jnp.arange(out_cap, dtype=jnp.int32), 0,
                    sorted_batch.capacity - 1)
-    return dk.take(sorted_batch, idx, count)
+    return dk.take(sorted_batch, idx, counts[p])
 
 
 def _fp_extra(n: PlanNode) -> str | None:
@@ -212,15 +219,15 @@ class ShuffleExchangeExec(PlanNode):
             transport = make_transport(ctx.conf, ctx)
             for bi, b in enumerate(batches):
                 ids = self.partitioning.device_ids(b, bi)
-                sb, counts_d = ctx.dispatch(_jit_group_by_part, b, ids, n)
+                sb, counts_d, starts_d = ctx.dispatch(
+                    _jit_group_by_part, b, ids, n)
                 counts = np.asarray(jax.device_get(counts_d))
-                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
                 for p in range(n):
                     if counts[p] == 0:
                         continue
                     piece = ctx.dispatch(
-                        _jit_slice_part, sb, jnp.asarray(starts[p], jnp.int32),
-                        jnp.asarray(counts[p], jnp.int32),
+                        _jit_slice_part, sb, starts_d, counts_d,
+                        dk.device_scalar(p),
                         round_capacity(int(counts[p])))
                     transport.write_partition(self.shuffle_id, bi, p, piece)
             return transport

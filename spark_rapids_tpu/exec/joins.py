@@ -90,6 +90,10 @@ class JoinExec(PlanNode):
     (e.g. casts) join correctly.
     """
 
+    #: stream batches whose probe totals sync to host in one stacked
+    #: device_get (see _run_device_stream)
+    _SYNC_CHUNK = 8
+
     def __init__(self, left: PlanNode, right: PlanNode,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
@@ -267,6 +271,48 @@ class JoinExec(PlanNode):
               + (list(rb2.schema.fields) if self.include_right else []))
         kf_schema = T.Schema(kf)
         matched = None
+
+        # Probe totals sync in CHUNKS: each stream batch's match count
+        # must reach the host to pick the static gather capacity, but a
+        # host round trip over a tunneled backend costs tens of ms of
+        # pure latency — so up to _SYNC_CHUNK probes are dispatched
+        # asynchronously and their totals fetched in ONE device_get of
+        # a stacked vector (one barrier per chunk, not per batch).
+        def flush(pending):
+            nonlocal matched
+            if not pending:
+                return
+            if len(pending) == 1:
+                totals = [int(jax.device_get(pending[0][2]))]
+            else:
+                totals = [int(t) for t in jax.device_get(ctx.dispatch(
+                    jnp.stack, [p[2] for p in pending]))]
+            for (lb, lb2, _td, probe_arrays), total in zip(pending, totals):
+                if total == 0:
+                    if jt == "full" and matched is None:
+                        matched = jnp.zeros(rb2.capacity, jnp.bool_)
+                    continue
+                out_cap = round_capacity(max(total, 1))
+                if jt == "full":
+                    out, bm = ctx.dispatch(
+                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                        stream_jt, out_cap, self.include_right, kf_schema,
+                        track_matched=True)
+                    matched = bm if matched is None else matched | bm
+                else:
+                    out = ctx.dispatch(
+                        _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
+                        stream_jt, out_cap, self.include_right, kf_schema)
+                out = self._project_out(
+                    out, lb.num_columns, lb2.num_columns, n_right_raw,
+                    device=True)
+                if self._condition is not None:
+                    out = self._condition_jit()(out)
+                if self._swapped and self.include_right:
+                    out = self._reorder_device(out, lb.num_columns)
+                yield ColumnBatch(out.columns, out.num_rows, self._schema)
+
+        pending = []
         for lb in self._stream_batches(ctx, pid):
             lb2, lkeys = self._augment_device(lb, self._lkeys_b)
             if prep is not None:
@@ -275,30 +321,11 @@ class JoinExec(PlanNode):
             else:
                 probe_arrays, total_dev = ctx.dispatch(
                     _jit_probe, lb2, rb2, lkeys, rkeys, stream_jt)
-            total = int(jax.device_get(total_dev))
-            if total == 0:
-                if jt == "full" and matched is None:
-                    matched = jnp.zeros(rb2.capacity, jnp.bool_)
-                continue
-            out_cap = round_capacity(max(total, 1))
-            if jt == "full":
-                out, bm = ctx.dispatch(
-                    _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
-                    stream_jt, out_cap, self.include_right, kf_schema,
-                    track_matched=True)
-                matched = bm if matched is None else matched | bm
-            else:
-                out = ctx.dispatch(
-                    _jit_gather, lb2, rb2, probe_arrays, lb2.capacity,
-                    stream_jt, out_cap, self.include_right, kf_schema)
-            out = self._project_out(
-                out, lb.num_columns, lb2.num_columns, n_right_raw,
-                device=True)
-            if self._condition is not None:
-                out = self._condition_jit()(out)
-            if self._swapped and self.include_right:
-                out = self._reorder_device(out, lb.num_columns)
-            yield ColumnBatch(out.columns, out.num_rows, self._schema)
+            pending.append((lb, lb2, total_dev, probe_arrays))
+            if len(pending) >= self._SYNC_CHUNK:
+                yield from flush(pending)
+                pending = []
+        yield from flush(pending)
         if jt == "full":
             if matched is None:
                 matched = jnp.zeros(rb2.capacity, jnp.bool_)

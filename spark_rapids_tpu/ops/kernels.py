@@ -22,7 +22,21 @@ from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
 __all__ = ["compact", "take", "concat_batches", "slice_batch",
-           "gather_columns", "shrink_capacity", "pad_capacity"]
+           "gather_columns", "shrink_capacity", "pad_capacity",
+           "device_scalar"]
+
+
+@__import__("functools").lru_cache(maxsize=65536)
+def device_scalar(value, dtype_str: str = "int32") -> jax.Array:
+    """Device-resident scalar cached by value.
+
+    A tiny host->device transfer costs tens of milliseconds of pure
+    round-trip latency on a tunneled PJRT backend, and the same small
+    values (partition ids, limits, zero offsets) recur on every batch —
+    profiled at ~4s/iteration of TPC-DS q6 before caching.  The analog
+    of the reference pinning small Scalars on the GPU across kernel
+    launches (GpuScalar caching, GpuExpressionsUtils.scala)."""
+    return jnp.asarray(value, jnp.dtype(dtype_str))
 
 
 def _gather_column(col: DeviceColumn, perm: jax.Array,
@@ -68,6 +82,8 @@ def take(batch: ColumnBatch, indices: jax.Array,
 
 def slice_batch(batch: ColumnBatch, limit: jax.Array) -> ColumnBatch:
     """Keep the first ``limit`` rows (GpuLocalLimit, limit.scala)."""
+    if isinstance(limit, int):
+        limit = device_scalar(limit)  # cached: no per-call H2D round trip
     new_count = jnp.minimum(batch.num_rows, jnp.asarray(limit, jnp.int32))
     identity = jnp.arange(batch.capacity, dtype=jnp.int32)
     cols = gather_columns(batch.columns, identity, new_count)
