@@ -70,30 +70,50 @@ class _PackBuilder:
         self.codec = codec
         self.groups: dict[str, list] = {}   # dtype key -> host 1-D chunks
         self.offsets: dict[str, int] = {}   # dtype key -> elements so far
-        self.leaves: list[tuple] = []       # (gkey, offset, size, shape)
+        self.leaves: list[tuple] = []       # ("g"|"w", ...) — see _add_leaf
         self.i64_params: list[int] = []
-        self.f64_params: list[float] = []
         self.col_specs: list[tuple] = []
 
     def _add_leaf(self, arr: np.ndarray) -> int:
-        gkey = arr.dtype.str
+        """Register one host buffer.
+
+        Every dtype of width <= 4 bytes rides ONE shared uint32 word
+        buffer (little-endian byte view; decode is a 32-bit bitcast,
+        which lowers on TPU — only 64-bit bitcasts don't): a tunneled
+        device_put costs ~75ms of per-call overhead, so a batch ships
+        as one u32 transfer plus (rare) i64/f64 raw leaves instead of
+        one transfer per dtype.  Leaf records:
+          ("g", gkey, elem_off, elem_size, shape)     — plain group
+          ("w", word_off, word_size, dtype, shape, n) — u32-view leaf
+        """
+        dt = arr.dtype
         flat = np.ravel(arr)
+        if dt.itemsize <= 4 and dt.kind in "uifb":
+            by = flat.view(np.uint8) if dt.kind == "b" else flat
+            by = by.view(np.uint8)
+            pad = (-by.size) % 4
+            if pad:
+                by = np.concatenate([by, np.zeros(pad, np.uint8)])
+            words = by.view(np.uint32)
+            woff = self.offsets.get("<u4", 0)
+            self.groups.setdefault("<u4", []).append(words)
+            self.offsets["<u4"] = woff + words.size
+            self.leaves.append(("w", woff, words.size, dt.str, arr.shape,
+                                flat.size))
+            return len(self.leaves) - 1
+        gkey = dt.str
         off = self.offsets.get(gkey, 0)
         self.groups.setdefault(gkey, []).append(flat)
         self.offsets[gkey] = off + flat.size
-        self.leaves.append((gkey, off, flat.size, arr.shape))
+        self.leaves.append(("g", gkey, off, flat.size, arr.shape))
         return len(self.leaves) - 1
 
     def _add_i64(self, v: int) -> int:
         self.i64_params.append(int(v))
         return len(self.i64_params) - 1
 
-    def _add_f64(self, v: float) -> int:
-        self.f64_params.append(float(v))
-        return len(self.f64_params) - 1
-
     # -- column registration ------------------------------------------------
-    def _val_desc(self, validity: np.ndarray | None, n: int) -> tuple:
+    def _val_desc(self, validity: np.ndarray | None) -> tuple:
         """Validity spec: all-valid columns ship nothing (decode derives
         the mask from num_rows); others ship 1 bit/row."""
         if validity is None or bool(validity.all()):
@@ -108,14 +128,13 @@ class _PackBuilder:
             data = np.where(validity, data, data.dtype.type(0))
         if self.codec:
             desc = wc.encode_fixed(data, validity, self.capacity,
-                                   self._add_leaf, self._add_i64,
-                                   self._add_f64)
+                                   self._add_leaf, self._add_i64)
         else:
             full = np.zeros((self.capacity,) + data.shape[1:],
                             dtype=data.dtype)
             full[:n] = data
             desc = ("raw", self._add_leaf(full))
-        self.col_specs.append(("fixed", desc, self._val_desc(validity, n)))
+        self.col_specs.append(("fixed", desc, self._val_desc(validity)))
 
     def add_var(self, matrix: np.ndarray, lengths: np.ndarray,
                 validity: np.ndarray | None, width: int):
@@ -137,7 +156,7 @@ class _PackBuilder:
             lfull = np.zeros(cap, dtype=np.int32)
             lfull[:n] = lengths
             ldesc = ("raw", self._add_leaf(lfull))
-        self.col_specs.append(("var", mdesc, self._val_desc(validity, n),
+        self.col_specs.append(("var", mdesc, self._val_desc(validity),
                                ldesc))
 
     def add_dict_string(self, indices: np.ndarray,
@@ -155,32 +174,34 @@ class _PackBuilder:
         if validity is not None and not validity.all():
             indices = np.where(validity, indices, 0)
         idesc = wc.encode_fixed(indices, validity, cap, self._add_leaf,
-                                self._add_i64, self._add_f64) \
-            if self.codec else None
-        if idesc is None:
-            full = np.zeros(cap, dtype=np.int32)
-            full[:indices.shape[0]] = indices
-            idesc = ("raw", self._add_leaf(full))
+                                self._add_i64)
         self.col_specs.append(("dict", idesc,
-                               self._val_desc(validity, indices.shape[0]),
+                               self._val_desc(validity),
                                self._add_leaf(mfull),
                                self._add_leaf(lfull)))
 
     # -- materialization ----------------------------------------------------
     def build(self, num_rows: int, schema: T.Schema) -> "ColumnBatch":
-        """One device_put per dtype group, one jitted unpack+decode."""
+        """One device_put per dtype group — with the u32 word routing in
+        :meth:`_add_leaf`, typically ONE transfer total — plus one jitted
+        unpack+decode.  The i64 decode params (FOR bases) ship as u32
+        word pairs and are rebuilt arithmetically on device (64-bit
+        bitcasts don't lower on TPU; shifts do)."""
         nr = self._add_leaf(np.asarray([num_rows], dtype=np.int32))
-        ip = self._add_leaf(np.asarray(self.i64_params, dtype=np.int64)) \
-            if self.i64_params else -1
-        fp = self._add_leaf(np.asarray(self.f64_params, dtype=np.float64)) \
-            if self.f64_params else -1
+        ip = -1
+        if self.i64_params:
+            p = np.asarray(self.i64_params, np.int64)
+            pairs = np.empty(2 * p.size, np.uint32)
+            pairs[0::2] = (p & 0xFFFFFFFF).astype(np.uint32)
+            pairs[1::2] = ((p >> 32) & 0xFFFFFFFF).astype(np.uint32)
+            ip = self._add_leaf(pairs)
         gkeys = tuple(sorted(self.groups))
         host_bufs = tuple(
             self.groups[k][0] if len(self.groups[k]) == 1
             else np.concatenate(self.groups[k]) for k in gkeys)
         dev_bufs = tuple(jax.device_put(b) for b in host_bufs)
         spec = (self.capacity, gkeys, tuple(self.leaves),
-                tuple(self.col_specs), nr, ip, fp)
+                tuple(self.col_specs), nr, ip)
         arrays = _packed_unpack_cached(spec)(dev_bufs)
         cols = [DeviceColumn(d, v, f.data_type, ln)
                 for f, (d, v, ln) in zip(schema, arrays[0])]
@@ -189,38 +210,58 @@ class _PackBuilder:
 
 @_functools.lru_cache(maxsize=1024)
 def _packed_unpack_cached(spec):
-    cap, gkeys, leaves, col_specs, nr_idx, ip_idx, fp_idx = spec
+    cap, gkeys, leaves, col_specs, nr_idx, ip_idx = spec
 
     def unpack(bufs):
         import jax.numpy as jnp
         by_key = dict(zip(gkeys, bufs))
 
         def leaf(i):
-            gkey, off, size, shape = leaves[i]
-            piece = jax.lax.slice(by_key[gkey], (off,), (off + size,))
-            return piece.reshape(shape)
+            rec = leaves[i]
+            if rec[0] == "g":
+                _, gkey, off, size, shape = rec
+                piece = jax.lax.slice(by_key[gkey], (off,), (off + size,))
+                return piece.reshape(shape)
+            _, woff, wsize, dtype_str, shape, nelem = rec
+            words = jax.lax.slice(by_key["<u4"], (woff,), (woff + wsize,))
+            dt = np.dtype(dtype_str)
+            if dt.str == "<u4":
+                arr = words
+            elif dt.kind == "b":
+                arr = jax.lax.bitcast_convert_type(
+                    words, jnp.uint8).reshape(-1)[:nelem] != 0
+                return arr.reshape(shape)
+            elif dt.itemsize == 4:
+                arr = jax.lax.bitcast_convert_type(words, dt)
+            else:
+                arr = jax.lax.bitcast_convert_type(
+                    words, dt).reshape(-1)[:nelem]
+            return arr.reshape(shape)
 
         nr = leaf(nr_idx)[0]
-        i64p = leaf(ip_idx) if ip_idx >= 0 else None
-        f64p = leaf(fp_idx) if fp_idx >= 0 else None
+        i64p = None
+        if ip_idx >= 0:
+            pw = leaf(ip_idx)
+            i64p = ((pw[1::2].astype(jnp.int64) << 32)
+                    | pw[0::2].astype(jnp.int64))
         out_cols = []
         for cspec in col_specs:
             kind = cspec[0]
             validity = wc.decode_validity(cspec[2], leaf, cap, nr)
             if kind == "fixed":
-                data = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
+                data = wc.decode_data(cspec[1], leaf, i64p, cap)
                 zero = jnp.zeros((), data.dtype)
                 data = jnp.where(validity, data, zero)
                 out_cols.append((data, validity, None))
             elif kind == "var":
-                data = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
-                lens = wc.decode_data(cspec[3], leaf, i64p, f64p, cap)
+                data = wc.decode_data(cspec[1], leaf, i64p, cap)
+                lens = wc.decode_data(cspec[3], leaf, i64p, cap)
                 data = jnp.where(validity[:, None], data,
                                  jnp.zeros((), data.dtype))
                 lens = jnp.where(validity, lens, 0)
                 out_cols.append((data, validity, lens))
             else:  # dict string
-                idx = wc.decode_data(cspec[1], leaf, i64p, f64p, cap)
+                idx = wc.decode_data(cspec[1], leaf, i64p, cap)
                 mat, dlens = leaf(cspec[3]), leaf(cspec[4])
                 data = jnp.where(validity[:, None], mat[idx],
                                  jnp.zeros((), mat.dtype))

@@ -120,13 +120,15 @@ def _valid_minmax(data: np.ndarray, validity: np.ndarray | None):
 
 
 def encode_fixed(data: np.ndarray, validity: np.ndarray | None, cap: int,
-                 add_leaf, add_i64, add_f64):
+                 add_leaf, add_i64):
     """Encode one fixed-width column's data leaf.
 
     ``data`` is the UNPADDED host array (null slots already zeroed).
     ``add_leaf(arr)`` registers a host buffer and returns its leaf index;
-    ``add_i64``/``add_f64`` register scalar decode params and return
-    param indices.  Returns the data_desc spec tuple.
+    ``add_i64`` registers a dynamic decode param (the FOR base) and
+    returns its param index.  Divisors/scales come from tiny fixed menus
+    so they ride the spec as STATIC program constants.  Returns the
+    data_desc spec tuple.
     """
     dt = data.dtype
     out_dtype = dt.str
@@ -138,14 +140,13 @@ def encode_fixed(data: np.ndarray, validity: np.ndarray | None, cap: int,
 
     if dt.kind == "b":
         return ("bits", add_leaf(pack_bits_host(
-            data.astype(np.uint8), 1, cap)), 1, out_dtype,
-            add_i64(0), add_i64(1))
+            data.astype(np.uint8), 1, cap)), 1, out_dtype, add_i64(0), 1)
     if dt.kind in "iu":
         mm = _valid_minmax(data.astype(np.int64, copy=False), validity)
         if mm is None:
             return ("bits", add_leaf(pack_bits_host(
                 np.zeros(0, np.uint32), 1, cap)), 1, out_dtype,
-                add_i64(0), add_i64(1))
+                add_i64(0), 1)
         vmin, vmax = int(mm[0]), int(mm[1])
         div = 1
         if dt.itemsize == 8 and vmax - vmin >= (1 << 32):
@@ -166,7 +167,7 @@ def encode_fixed(data: np.ndarray, validity: np.ndarray | None, cap: int,
         if validity is not None and not validity.all():
             enc = np.where(validity, enc, 0)
         return ("bits", add_leaf(pack_bits_host(enc, bits, cap)), bits,
-                out_dtype, add_i64(vmin), add_i64(div))
+                out_dtype, add_i64(vmin), div)
     if dt.kind == "f" and dt.itemsize == 8:
         v = data
         # -0.0 round-trips to +0.0 through the integer path; the values
@@ -195,7 +196,7 @@ def encode_fixed(data: np.ndarray, validity: np.ndarray | None, cap: int,
             if validity is not None and not validity.all():
                 enc = np.where(validity, enc, 0)
             return ("fbits", add_leaf(pack_bits_host(enc, bits, cap)),
-                    bits, out_dtype, add_i64(vmin), add_f64(scale))
+                    bits, out_dtype, add_i64(vmin), scale)
         return raw()
     return raw()
 
@@ -206,7 +207,7 @@ def encode_lengths(lengths: np.ndarray, cap: int, max_len: int,
     bits = bits_needed(max(int(max_len), 1))
     return ("bits", add_leaf(pack_bits_host(
         lengths.astype(np.uint32), bits, cap)), bits, "<i4",
-        add_i64(0), add_i64(1))
+        add_i64(0), 1)
 
 
 def maybe_dict_arrow(arr, n: int):
@@ -234,31 +235,31 @@ def maybe_dict_arrow(arr, n: int):
 # ---------------------------------------------------------------------------
 
 def decode_validity(desc, leaf, cap: int, nr):
-    """bool[cap] from a validity desc; ``leaf`` resolves leaf indices to
-    traced arrays, ``nr`` is the traced row count."""
+    """bool[cap] from a validity desc — ("av",) derives the mask from
+    the row count, ("vbits", i) unpacks 1 bit/row; ``leaf`` resolves
+    leaf indices to traced arrays, ``nr`` is the traced row count."""
     import jax.numpy as jnp
-    kind = desc[0]
-    if kind == "av":
+    if desc[0] == "av":
         return jnp.arange(cap, dtype=jnp.int32) < nr
-    if kind == "vbits":
-        return _unpack_bits_device(leaf(desc[1]), cap, 1) != 0
-    return leaf(desc[1])  # ("raw", leaf_idx)
+    return _unpack_bits_device(leaf(desc[1]), cap, 1) != 0
 
 
-def decode_data(desc, leaf, i64p, f64p, cap: int):
+def decode_data(desc, leaf, i64p, cap: int):
     """Traced decode of a data/lengths desc to its full-capacity array
-    (padding/null slots NOT yet zeroed — the caller masks by validity)."""
+    (padding/null slots NOT yet zeroed — the caller masks by validity).
+    Divisors/scales are static program constants; only the FOR base is
+    dynamic (read from the i64 params vector)."""
     import jax.numpy as jnp
     kind = desc[0]
     if kind == "raw":
         return leaf(desc[1])
-    _, li, bits, out_dtype, pbase, pdiv = desc
+    _, li, bits, out_dtype, pbase, factor = desc
     raw = _unpack_bits_device(leaf(li), cap, bits)
     dt = np.dtype(out_dtype)
     if kind == "fbits":
         return ((raw.astype(jnp.float64) + i64p[pbase].astype(jnp.float64))
-                * f64p[pdiv]).astype(dt.str)
+                * factor).astype(dt.str)
     if dt.kind == "b":
         return raw != 0
-    val = (raw.astype(jnp.int64) + i64p[pbase]) * i64p[pdiv]
+    val = (raw.astype(jnp.int64) + i64p[pbase]) * factor
     return val.astype(dt.str)

@@ -217,6 +217,22 @@ class ShuffleExchangeExec(PlanNode):
             from spark_rapids_tpu.columnar.batch import round_capacity
             from spark_rapids_tpu.shuffle import make_transport
             transport = make_transport(ctx.conf, ctx)
+            # Map-side tiny-input coalescing: when the whole map side is
+            # below the advisory partition size, splitting it n ways
+            # only buys n slice programs + n downstream per-partition
+            # chains of dispatch latency.  Putting EVERYTHING in
+            # partition 0 is correct for every partitioning (all rows of
+            # any key land in one partition) — the map-side counterpart
+            # of the reader's AQE small-partition coalescing
+            # (GpuCustomShuffleReaderExec; Spark's AQE does this on the
+            # read side only because its map side is fixed at plan time).
+            if n > 1 and len(batches) >= 1:
+                total_bytes = sum(b.device_size_bytes() for b in batches)
+                if total_bytes <= ADVISORY_PARTITION_BYTES.get(
+                        ctx.conf.settings):
+                    for bi, b in enumerate(batches):
+                        transport.write_partition(self.shuffle_id, bi, 0, b)
+                    return transport
             for bi, b in enumerate(batches):
                 ids = self.partitioning.device_ids(b, bi)
                 sb, counts_d, starts_d = ctx.dispatch(

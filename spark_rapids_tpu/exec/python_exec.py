@@ -24,6 +24,7 @@ from spark_rapids_tpu.conf import ConfEntry, register
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.expr.core import Expression, bind
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+from spark_rapids_tpu.ops import host_kernels as hk
 
 __all__ = ["PandasUDF", "pandas_udf", "ArrowEvalPythonExec",
            "PandasAggUDF", "pandas_agg_udf", "MapInPandasExec",
@@ -566,3 +567,231 @@ class FlatMapCoGroupsInPandasExec(PlanNode):
     def node_desc(self) -> str:
         return (f"FlatMapCoGroupsInPandasExec[{self._lkeys} x "
                 f"{self._rkeys}]")
+
+
+class PandasWindowUDF(Expression):
+    """Window-aggregate pandas UDF: evaluated over each row's window
+    frame (Series slice in, ONE scalar out per row) — planned into
+    WindowInPandasExec, never evaluated inline (reference
+    GpuWindowInPandasExec's PythonUDF-in-WindowExpression plan,
+    shims/spark300/.../GpuWindowInPandasExec.scala:1-408)."""
+
+    sql_name = "PandasWindowUDF"
+
+    def __init__(self, fn: Callable, children: Sequence[Expression],
+                 return_type: T.DataType):
+        self.fn = fn
+        self.children = tuple(children)
+        self.return_type = return_type
+
+    def with_new_children(self, children):
+        return PandasWindowUDF(self.fn, children, self.return_type)
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _eval(self, vals, ctx):
+        raise ValueError("PandasWindowUDF must be planned by "
+                         "WindowInPandasExec (use .over(window_spec))")
+
+    def over(self, spec):
+        """``udf(col).over(window_spec)`` — Spark's pandas-UDF-over-
+        window surface (WindowInPandasExec plan)."""
+        from spark_rapids_tpu.expr.window import WindowExpression
+        return WindowExpression(self, spec)
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "<lambda>")
+        return f"PandasWindowUDF({name}, {', '.join(map(repr, self.children))})"
+
+
+def pandas_window_udf(fn: Callable, return_type: T.DataType | None = None):
+    """``pandas_window_udf(lambda s: s.mean())(col("v")).over(spec)`` —
+    ``fn`` receives each row's frame as pandas Series and returns one
+    scalar for that row (Spark's GROUPED_AGG pandas UDF over a window)."""
+
+    def apply(*cols):
+        return PandasWindowUDF(fn, list(cols), return_type or T.DoubleType())
+
+    return apply
+
+
+class WindowInPandasExec(PlanNode):
+    """Append one column per pandas window UDF expression.
+
+    The reference streams (window-bound columns + UDF inputs) to Python
+    workers, which evaluate the UDF over each row's slice
+    (GpuWindowInPandasExec.scala:107-180 computeWindowBoundHelpers and
+    :234-330 bounds-column projection).  Here the same shape runs
+    in-process: per partition group, compute each row's [lower, upper)
+    frame indices from the shared WindowSpec, then call the UDF with the
+    input Series sliced to that frame.  Like the reference
+    (requiredChildDistribution, :88-97) the planner clusters rows by the
+    partition keys first; an empty partition-by collapses to a single
+    group with the reference's own performance warning semantics.
+    """
+
+    def __init__(self, window_exprs: Sequence[Expression], child: PlanNode,
+                 keys_partitioned: bool = False):
+        super().__init__([child])
+        from spark_rapids_tpu.expr.core import Alias, output_name
+        from spark_rapids_tpu.expr.window import WindowExpression
+        self._keys_partitioned = bool(keys_partitioned)
+        self._names = [output_name(e) for e in window_exprs]
+        self._wexprs = []
+        for e in window_exprs:
+            if isinstance(e, Alias):
+                e = e.children[0]
+            assert isinstance(e, WindowExpression), e
+            assert isinstance(e.function, PandasWindowUDF), e.function
+            self._wexprs.append(e)
+        spec0 = self._wexprs[0].spec
+        for e in self._wexprs[1:]:
+            if e.spec != spec0:
+                raise ValueError("one WindowInPandasExec handles one "
+                                 "WindowSpec; split plans per spec")
+        self.spec = spec0
+        cs = child.output_schema
+        self._part_b = [bind(p, cs) for p in self.spec.partition_by]
+        self._order_b = [(bind(o[0], cs), o[1] if len(o) > 1 else True,
+                          o[2] if len(o) > 2 else None)
+                         for o in self.spec.order_by]
+        self._udfs = [PandasWindowUDF(w.function.fn,
+                                      [bind(c, cs)
+                                       for c in w.function.children],
+                                      w.function.return_type)
+                      for w in self._wexprs]
+        self._schema = T.Schema(
+            list(cs.fields)
+            + [T.StructField(n, u.return_type, True)
+               for n, u in zip(self._names, self._udfs)])
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def bound_exprs(self):
+        return ([e for e in self._part_b] + [e for e, _, _ in self._order_b]
+                + [c for u in self._udfs for c in u.children])
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.children[0].num_partitions(ctx) \
+            if self._keys_partitioned else 1
+
+    @staticmethod
+    def _bounds(gn: int, peer_start: np.ndarray, peer_end: np.ndarray,
+                frame) -> tuple[np.ndarray, np.ndarray]:
+        """[lower, upper) frame rows for one group (group-local).
+        ``peer_start``/``peer_end``: each row's order-peer group extent
+        (Spark's default ordered frame is RANGE UNBOUNDED..CURRENT ROW =
+        peers included; GpuWindowExpression's frame resolution)."""
+        i = np.arange(gn)
+        from spark_rapids_tpu.ops.window import CURRENT_ROW, UNBOUNDED
+        if frame.mode == "rows":
+            lo = np.zeros(gn, np.int64) if frame.lower is UNBOUNDED \
+                else np.clip(i + frame.lower, 0, gn)
+            hi = np.full(gn, gn, np.int64) if frame.upper is UNBOUNDED \
+                else np.clip(i + frame.upper + 1, 0, gn)
+        else:  # range: UNBOUNDED/CURRENT_ROW only (planner contract)
+            lo = peer_start if frame.lower is CURRENT_ROW \
+                else np.zeros(gn, np.int64)
+            hi = peer_end if frame.upper is CURRENT_ROW \
+                else np.full(gn, gn, np.int64)
+        return lo, hi
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        import pandas as pd
+        from spark_rapids_tpu.expr.core import eval_host
+        from spark_rapids_tpu.ops.sort import SortOrder
+        child = self.children[0]
+        if self._keys_partitioned:
+            batches = list(_host_batches(child, ctx, pid))
+        else:
+            batches = [b for p in range(child.num_partitions(ctx))
+                       for b in _host_batches(child, ctx, p)]
+        if not batches:
+            return
+        hb = HostBatch.concat(batches)
+        n = hb.num_rows
+        if not n:
+            return
+        # sort rows by (partition keys, order keys) — required child
+        # ordering, reference GpuWindowInPandasExec.scala:99-100
+        key_cols = [eval_host(e, hb) for e in self._part_b] \
+            + [eval_host(e, hb) for e, _, _ in self._order_b]
+        tmp = HostBatch(key_cols, T.Schema(
+            [T.StructField(f"k{i}", c.dtype, True)
+             for i, c in enumerate(key_cols)]))
+        orders = [SortOrder(i, True, True)
+                  for i in range(len(self._part_b))] \
+            + [SortOrder(len(self._part_b) + i, asc, nf)
+               for i, (_, asc, nf) in enumerate(self._order_b)]
+        perm = hk.host_sort_permutation(tmp, orders)
+        hb = hk.host_take(hb, perm)
+
+        def codes(cols):
+            """int group codes over the SORTED batch (key columns are
+            permuted, not re-evaluated): rows equal on ``cols`` share a
+            code (nulls are one group, Spark window key semantics)."""
+            if not cols:
+                return np.zeros(n, np.int64)
+            parts = []
+            for c in cols:
+                s = _host_col_to_series(c.take(perm), exact_int=True)
+                parts.append(pd.factorize(s, use_na_sentinel=False)[0])
+            code = parts[0].astype(np.int64)
+            for p in parts[1:]:
+                code = code * (int(p.max()) + 2) + p
+            return code
+
+        gcode = codes(key_cols[:len(self._part_b)])
+        ocode = codes(key_cols[len(self._part_b):])
+        gchange = np.concatenate([[True], gcode[1:] != gcode[:-1]])
+        seg_starts = np.flatnonzero(gchange)
+        seg_ends = np.concatenate([seg_starts[1:], [n]])
+
+        in_series = [[_host_col_to_series(eval_host(c, hb))
+                      for c in u.children] for u in self._udfs]
+        sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
+        out_vals: list[list] = [[None] * n for _ in self._udfs]
+        for s0, s1 in zip(seg_starts, seg_ends):
+            gn = s1 - s0
+            oc = ocode[s0:s1]
+            ochange = np.concatenate([[True], oc[1:] != oc[:-1]])
+            peer_id = np.cumsum(ochange) - 1
+            # each row's order-peer group extent [start, end), group-local
+            pstarts = np.flatnonzero(ochange)
+            peer_start = pstarts[peer_id]
+            peer_end = np.concatenate([pstarts[1:], [gn]])[peer_id]
+            for ui, (w, u) in enumerate(zip(self._wexprs, self._udfs)):
+                lo, hi = self._bounds(gn, peer_start, peer_end,
+                                      w.spec.resolved_frame())
+                series = [s.iloc[s0:s1].reset_index(drop=True)
+                          for s in in_series[ui]]
+                vals = out_vals[ui]
+                with _udf_slot(sem):
+                    for i in range(gn):
+                        r = u.fn(*[s.iloc[lo[i]:hi[i]] for s in series])
+                        vals[s0 + i] = None if r is None or (
+                            np.isscalar(r) and pd.isna(r)) else r
+        out_cols = list(hb.columns)
+        for (name, u), vals in zip(zip(self._names, self._udfs), out_vals):
+            f = self._schema.field(name)
+            if f.data_type.integral and any(v is None for v in vals):
+                s = pd.Series(vals, dtype="Int64")
+            else:
+                s = pd.Series(vals)
+            hcol = _from_pandas(pd.DataFrame({name: s}),
+                                T.Schema([f]), "pandas window").columns[0]
+            out_cols.append(hcol)
+        yield _emit(HostBatch(out_cols, self._schema), ctx)
+
+    def node_desc(self) -> str:
+        return (f"WindowInPandasExec[{self._names}, "
+                f"part={len(self._part_b)}, order={len(self._order_b)}]")

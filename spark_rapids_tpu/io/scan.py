@@ -205,16 +205,70 @@ class FileScanExec(PlanNode):
         mode = READER_TYPE[self.format_name].get(ctx.conf.settings)
         rbs = self._decode_iter(ctx, files, mode)
         if ctx.is_device:
-            for rb in rbs:
-                if rb.num_rows == 0:
-                    continue
-                yield ColumnBatch.from_arrow(
-                    rb, string_widths=self._width_map(rb))
+            yield from self._device_batches(rbs)
         else:
             for rb in rbs:
                 if rb.num_rows == 0:
                     continue
                 yield _arrow_to_host(rb, self._schema)
+
+    def _device_batches(self, rbs) -> Iterator:
+        """Stage-and-transfer pipeline: a worker thread encodes and
+        device_puts batch k+1 while the consumer computes on batch k.
+        Host-side staging (arrow decode + wire-codec encode) is the
+        scan's serial CPU cost; overlapping it with device compute hides
+        it entirely on multi-batch scans (reference: the multithreaded
+        reader's decode-ahead does the same for the host half,
+        GpuMultiFileReader.scala).  Window of 2 bounds host+HBM usage."""
+        import queue
+        import threading
+        q: queue.Queue = queue.Queue(maxsize=2)
+        DONE = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for rb in rbs:
+                    if stop.is_set():
+                        return
+                    if rb.num_rows == 0:
+                        continue
+                    if not put(ColumnBatch.from_arrow(
+                            rb, string_widths=self._width_map(rb))):
+                        return
+                put(DONE)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                put(e)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="scan-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer abandoned the scan (limit) or errored: release
+            # the worker, which may be blocked on a full queue
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
     def _width_map(self, rb) -> dict[str, int] | None:
         if self._string_width is None:

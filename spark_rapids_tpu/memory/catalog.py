@@ -413,6 +413,32 @@ class DeviceSemaphore:
         self._sem.release()
 
 
+_SYNC_DISPATCH: bool | None = None
+
+
+def _sync_dispatch() -> bool:
+    """Whether dispatches block for synchronous OOM capture.
+
+    On a tunneled PJRT backend each ``block_until_ready`` costs a host
+    round trip (~60ms; 94 dispatches = 4.8s of a 10s TPC-DS q6 SF1
+    iteration) while completing no useful work — there the engine
+    dispatches asynchronously and the spill-retry loop catches only
+    errors that surface at dispatch/sync points (best-effort, like the
+    reference with the retry iterator disabled).  Local backends keep
+    the reference's synchronous DeviceMemoryEventHandler semantics.
+    SRT_SYNC_DISPATCH=0/1 forces either mode."""
+    global _SYNC_DISPATCH
+    if _SYNC_DISPATCH is None:
+        import os
+        force = os.environ.get("SRT_SYNC_DISPATCH")
+        if force is not None:
+            _SYNC_DISPATCH = force != "0"
+        else:
+            import jax
+            _SYNC_DISPATCH = jax.default_backend() not in ("tpu", "axon")
+    return _SYNC_DISPATCH
+
+
 def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
                          max_retries: int = 3, spill_bytes: int | None = None,
                          **kwargs):
@@ -422,7 +448,8 @@ def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
     while True:
         try:
             out = fn(*args, **kwargs)
-            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            if _sync_dispatch():
+                jax.block_until_ready(jax.tree_util.tree_leaves(out))
             return out
         except (RuntimeError, jax.errors.JaxRuntimeError) as ex:
             msg = str(ex)

@@ -37,8 +37,14 @@ def _scan(rng, n=300, parts=3):
 
 
 def _partition_rows(plan, backend):
-    """rows per output partition on a backend."""
-    ctx = ExecCtx(backend=backend)
+    """rows per output partition on a backend.
+
+    Map-side tiny-input coalescing is pinned OFF: these tests assert
+    the partitioning kernels' exact placement, which the coalescer
+    intentionally overrides for sub-advisory-size map sides."""
+    from spark_rapids_tpu.conf import TpuConf
+    ctx = ExecCtx(backend=backend, conf=TpuConf(
+        {"spark.sql.adaptive.advisoryPartitionSizeInBytes": 0}))
     out = []
     for pid in range(plan.num_partitions(ctx)):
         rows = []
@@ -275,3 +281,25 @@ def test_exchange_reuse_single_materialization():
     for d, h in zip(dev, host):
         assert d[0] == h[0] and d[1] == h[1]
         assert abs(d[2] - h[2]) < 1e-9 and abs(d[4] - h[4]) < 1e-9
+
+
+def test_map_side_tiny_coalesce(rng):
+    """Sub-advisory map sides write everything to partition 0 on the
+    device backend (map-side counterpart of AQE small-partition
+    coalescing) with identical query results."""
+    from spark_rapids_tpu.conf import TpuConf
+    plan = ShuffleExchangeExec(HashPartitioning([col("k")], 5),
+                               _scan(rng))
+    ctx = ExecCtx(backend="device")  # default advisory: 64MB >> input
+    parts = []
+    for pid in range(plan.num_partitions(ctx)):
+        rows = []
+        for b in plan.partition_iter(ctx, pid):
+            rows.extend(device_to_host(b).to_rows())
+        parts.append(rows)
+    assert len(parts[0]) == 300
+    assert all(not p for p in parts[1:])
+    # content parity with the sliced host path
+    host = _partition_rows(plan, "host")
+    assert sorted((r for p in host for r in p), key=_sort_key) == \
+        sorted(parts[0], key=_sort_key)

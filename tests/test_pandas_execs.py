@@ -313,3 +313,129 @@ def test_cogroup_udf_mutating_empty_side_isolated():
         .apply_in_pandas(fn, sch).collect()
     # every call saw the pristine 2-column right frame
     assert all(n == 2 for _, n in out)
+
+
+# ---------------------------------------------------------------------------
+# WindowInPandasExec (reference GpuWindowInPandasExec.scala:1-408)
+# ---------------------------------------------------------------------------
+
+def _window_df(s, n=48, null_keys=False):
+    rng = np.random.default_rng(11)
+    k = rng.integers(0, 4, n).astype(np.int32)
+    t = rng.integers(0, 20, n).astype(np.int32)  # order key with peers
+    data = {"k": k, "t": t, "v": rng.normal(size=n)}
+    df = s.from_pydict(data, T.Schema([
+        T.StructField("k", T.IntegerType(), True),
+        T.StructField("t", T.IntegerType(), True),
+        T.StructField("v", T.DoubleType(), True)]), partitions=3)
+    if null_keys:
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.core import Literal, lit
+        df = df.select(
+            If(col("k") >= lit(np.int32(3)),
+               Literal(None, T.IntegerType()), col("k")).alias("k"),
+            col("t"), col("v"))
+    return df
+
+
+def _window_oracle(df, frame_fn):
+    """Expected (k, t, v, w) rows: for each row, frame_fn(group_pdf, i)
+    gives its [lo, hi) frame over the (k,t)-sorted group."""
+    rows = df.collect()
+    pdf = pd.DataFrame({"k": pd.array([r[0] for r in rows], dtype="Int64"),
+                        "t": [r[1] for r in rows],
+                        "v": [r[2] for r in rows]})
+    pdf = pdf.sort_values(["k", "t"], kind="stable").reset_index(drop=True)
+    out = []
+    for _, g in pdf.groupby("k", dropna=False):
+        g = g.reset_index(drop=True)
+        for i in range(len(g)):
+            lo, hi = frame_fn(g, i)
+            out.append(float(g["v"].iloc[lo:hi].mean()))
+    pdf["w"] = out
+    return pdf
+
+
+def _assert_window_matches(got_rows, want_pdf):
+    got = sorted((r[0] if r[0] is not None else -99, r[1],
+                  round(r[2], 9), round(r[3], 9)) for r in got_rows)
+    want = sorted((int(k) if not pd.isna(k) else -99, int(t),
+                   round(v, 9), round(w, 9))
+                  for k, t, v, w in want_pdf.itertuples(index=False))
+    assert got == want
+
+
+@pytest.mark.parametrize("null_keys", [False, True])
+def test_window_in_pandas_whole_partition(null_keys):
+    from spark_rapids_tpu.exec.python_exec import pandas_window_udf
+    from spark_rapids_tpu.expr.window import WindowSpec
+    s = TpuSession({})
+    df = _window_df(s, null_keys=null_keys)
+    spec = WindowSpec(partition_by=(col("k"),))
+    w = pandas_window_udf(lambda v: v.mean())(col("v")).over(spec)
+    out = df.select(col("k"), col("t"), col("v"), w.alias("w"))
+    want = _window_oracle(df, lambda g, i: (0, len(g)))
+    _assert_window_matches(out.collect(), want)
+    # the plan actually routed through WindowInPandasExec
+    ov, meta = out._overridden(quiet=True)
+    assert "WindowInPandasExec" in meta.exec_node.tree_string()
+
+
+def test_window_in_pandas_rows_frame():
+    from spark_rapids_tpu.exec.python_exec import pandas_window_udf
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    s = TpuSession({})
+    df = _window_df(s)
+    # ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING
+    spec = WindowSpec(partition_by=(col("k"),),
+                      order_by=((col("t"), True),),
+                      frame=WindowFrame("rows", -2, 1))
+    w = pandas_window_udf(lambda v: v.mean())(col("v")).over(spec)
+    out = df.select(col("k"), col("t"), col("v"), w.alias("w"))
+    want = _window_oracle(
+        df, lambda g, i: (max(i - 2, 0), min(i + 2, len(g))))
+    _assert_window_matches(out.collect(), want)
+
+
+def test_window_in_pandas_default_ordered_frame_includes_peers():
+    from spark_rapids_tpu.exec.python_exec import pandas_window_udf
+    from spark_rapids_tpu.expr.window import WindowSpec
+    s = TpuSession({})
+    df = _window_df(s)
+    # default frame with order_by = RANGE UNBOUNDED..CURRENT ROW: the
+    # frame extends through the END of the current row's peer group
+    spec = WindowSpec(partition_by=(col("k"),),
+                      order_by=((col("t"), True),))
+    w = pandas_window_udf(lambda v: v.mean())(col("v")).over(spec)
+    out = df.select(col("k"), col("t"), col("v"), w.alias("w"))
+
+    def frame(g, i):
+        t = g["t"].iloc[i]
+        return 0, int((g["t"] <= t).sum())
+
+    want = _window_oracle(df, frame)
+    _assert_window_matches(out.collect(), want)
+
+
+def test_window_in_pandas_global_window_and_multi_udf_inputs():
+    from spark_rapids_tpu.exec.python_exec import pandas_window_udf
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    s = TpuSession({})
+    df = _window_df(s, n=20)
+    # empty partition-by: one global group (reference logs the same
+    # single-partition warning and proceeds)
+    spec = WindowSpec(order_by=((col("t"), True),),
+                      frame=WindowFrame("rows", None, 0))
+    w = pandas_window_udf(
+        lambda v, t: float((v * t).sum()))(col("v"), col("t")).over(spec)
+    out = df.select(col("t"), col("v"), w.alias("w")).collect()
+    rows = df.collect()
+    pdf = pd.DataFrame({"t": [r[1] for r in rows],
+                        "v": [r[2] for r in rows]})
+    pdf = pdf.sort_values("t", kind="stable").reset_index(drop=True)
+    want = [float((pdf["v"].iloc[:i + 1] * pdf["t"].iloc[:i + 1]).sum())
+            for i in range(len(pdf))]
+    got = sorted((r[0], round(r[1], 9), round(r[2], 9)) for r in out)
+    wantrows = sorted((int(t), round(v, 9), round(wv, 9)) for t, v, wv in
+                      zip(pdf["t"], pdf["v"], want))
+    assert got == wantrows
