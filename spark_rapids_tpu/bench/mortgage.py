@@ -324,3 +324,91 @@ MORTGAGE_QUERIES = {
 
 def build_mortgage_query(name: str, session, data_dir: str):
     return MORTGAGE_QUERIES[name](session, data_dir)
+
+
+def train_pipeline(session, data_dir: str, steps: int = 200) -> dict:
+    """Mortgage ETL -> columnar handoff -> jitted training loop
+    (BASELINE config 5; reference docs/ml-integration.md:8-11 +
+    ColumnarRdd.scala:42-49 hand the plugin's device table straight to
+    XGBoost).  Here the engine's device batches flow through
+    ``interop.to_jax`` with no host round trip and train a jitted
+    logistic-regression delinquency model on the chip.
+
+    Returns a verified record: the loss must strictly decrease and the
+    trained model must beat the majority-class baseline on accuracy."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import interop
+
+    t0 = time.perf_counter()
+    perf = read_performance(session, data_dir)
+    acq = read_acquisition(session, data_dir)
+    # per-loan label: ever delinquent; features from acquisition
+    labels = perf.group_by("loan_id").agg(
+        Max(col("current_loan_delinquency_status")).alias("max_status"))
+    labels = labels.select(
+        col("loan_id").alias("l_loan_id"),
+        (col("max_status") >= lit(1)).alias("delinquent"))
+    feats = acq.select(
+        col("loan_id"), col("orig_interest_rate"), col("orig_upb"),
+        col("orig_loan_term"), col("orig_ltv"), col("dti"),
+        col("borrower_credit_score")) \
+        .join(labels, on=[("loan_id", "l_loan_id")], how="inner")
+    cols = interop.to_jax(feats)
+    etl_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    names = ["orig_interest_rate", "orig_upb", "orig_loan_term",
+             "orig_ltv", "dti", "borrower_credit_score"]
+    feat_arrays = []
+    for nm in names:
+        v, valid = cols[nm]
+        x = jnp.where(valid, v.astype(jnp.float64), jnp.nan)
+        mean = jnp.nanmean(x)
+        std = jnp.nanstd(x) + 1e-9
+        feat_arrays.append(jnp.where(jnp.isnan(x), 0.0, (x - mean) / std))
+    X = jnp.stack(feat_arrays, axis=1).astype(jnp.float32)
+    yv, yvalid = cols["delinquent"]
+    y = (yv & yvalid).astype(jnp.float32)
+    n, k = X.shape
+
+    def loss_fn(w, b):
+        z = X @ w + b
+        # numerically-stable BCE with logits
+        return jnp.mean(jnp.maximum(z, 0) - z * y +
+                        jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+
+    @jax.jit
+    def step(w, b, lr):
+        l, (gw, gb) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+        return w - lr * gw, b - lr * gb, l
+
+    w = jnp.zeros(k, jnp.float32)
+    b = jnp.asarray(0.0, jnp.float32)
+    loss0 = float(grad_fn(w, b)[0])
+    losses = []
+    for i in range(steps):
+        w, b, l = step(w, b, jnp.float32(0.5))
+        if i % 50 == 0 or i == steps - 1:
+            losses.append(float(l))
+    pred = (X @ w + b) > 0
+    acc = float(jnp.mean(pred == (y > 0.5)))
+    base = float(jnp.maximum(jnp.mean(y), 1 - jnp.mean(y)))
+    train_s = time.perf_counter() - t0
+
+    rec = {
+        "pipeline": "mortgage_etl_to_train",
+        "rows": int(n), "features": int(k), "steps": steps,
+        "loss0": round(loss0, 6), "loss_final": round(losses[-1], 6),
+        "accuracy": round(acc, 4),
+        "majority_baseline": round(base, 4),
+        "etl_s": round(etl_s, 3), "train_s": round(train_s, 3),
+        "backend": jax.default_backend(),
+        "ok": bool(losses[-1] < loss0 and acc >= base),
+    }
+    return rec

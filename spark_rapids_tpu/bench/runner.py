@@ -213,11 +213,27 @@ def main() -> None:
     ap.add_argument("--iterations", type=int, default=1)
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--suite", default="tpcds", choices=("tpcds", "tpch", "mortgage", "tpcxbb"))
+    ap.add_argument("--train", action="store_true",
+                    help="mortgage suite: run the ETL -> to_jax -> "
+                         "jitted training pipeline (BASELINE config 5)")
     ap.add_argument("--report", default=None,
                     help="write the JSON report to this path")
     args = ap.parse_args()
 
     data_dir = os.path.join(args.data_dir, f"sf{args.sf:g}")
+    if args.train:
+        assert args.suite == "mortgage", "--train is a mortgage mode"
+        from spark_rapids_tpu.bench.mortgage import (generate_mortgage,
+                                                     train_pipeline)
+        from spark_rapids_tpu.session import TpuSession
+        generate_mortgage(data_dir, sf=args.sf)
+        rec = train_pipeline(TpuSession({}), data_dir)
+        out = json.dumps(rec, indent=2)
+        print(out)
+        if args.report:
+            with open(args.report, "w") as f:
+                f.write(out + "\n")
+        return
     reports = run_benchmark(data_dir, args.sf,
                             [q.strip() for q in args.queries.split(",")],
                             iterations=args.iterations, verify=args.verify,

@@ -89,8 +89,7 @@ class _PackBuilder:
         dt = arr.dtype
         flat = np.ravel(arr)
         if dt.itemsize <= 4 and dt.kind in "uifb":
-            by = flat.view(np.uint8) if dt.kind == "b" else flat
-            by = by.view(np.uint8)
+            by = flat.view(np.uint8)
             pad = (-by.size) % 4
             if pad:
                 by = np.concatenate([by, np.zeros(pad, np.uint8)])

@@ -22,7 +22,8 @@ from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
 
 __all__ = ["MapDecomposeExec", "keys_name", "vals_name",
-           "size_name", "decomposable"]
+           "size_name", "decomposable", "hashed_decomposable",
+           "key_hash64"]
 
 
 def keys_name(map_col: str) -> str:
@@ -51,6 +52,45 @@ def decomposable(mt: T.DataType) -> bool:
                for t in (mt.key_type, mt.value_type))
 
 
+def _plain_value(t: T.DataType) -> bool:
+    return (t.np_dtype is not None
+            and not isinstance(t, (T.ArrayType, T.DateType,
+                                   T.TimestampType, T.StringType)))
+
+
+def hashed_decomposable(mt: T.DataType) -> bool:
+    """STRING-key maps with numeric/boolean values decompose through a
+    64-bit key hash: the keys array stores ``key_hash64(key)`` and the
+    planner hashes each (literal) lookup key the same way, so
+    ``m['weight']`` runs on device as an int64 MapLookup (reference
+    runs GetMapValue on device for string keys too,
+    complexTypeExtractors.scala).  ``map_keys`` would expose hashes,
+    so such uses keep the raw host path (plan/maps.py tagging)."""
+    if not isinstance(mt, T.MapType):
+        return False
+    return isinstance(mt.key_type, T.StringType) \
+        and _plain_value(mt.value_type)
+
+
+_HASH_CACHE: dict = {}
+
+
+def key_hash64(s: str) -> int:
+    """Stable 64-bit key hash (blake2b-8).  Distinct keys colliding
+    within one map row would make the binary-search lookup ambiguous;
+    the decompose exec detects that (probability ~2^-64 per pair) and
+    refuses rather than answer wrong."""
+    h = _HASH_CACHE.get(s)
+    if h is None:
+        import hashlib
+        h = int.from_bytes(
+            hashlib.blake2b(s.encode("utf-8"), digest_size=8).digest(),
+            "little", signed=True)
+        if len(_HASH_CACHE) < (1 << 20):
+            _HASH_CACHE[s] = h
+    return h
+
+
 class MapDecomposeExec(PlanNode):
     """Replace each named map column with (sorted keys array, aligned
     values array).  Runs on the host right above the scan — the input
@@ -66,9 +106,11 @@ class MapDecomposeExec(PlanNode):
         for f in child.output_schema:
             if f.name in self._maps:
                 mt = f.data_type
-                assert decomposable(mt), mt
+                assert decomposable(mt) or hashed_decomposable(mt), mt
+                kt = T.LongType() if isinstance(mt.key_type, T.StringType) \
+                    else mt.key_type
                 fields.append(T.StructField(keys_name(f.name),
-                                            T.ArrayType(mt.key_type), True))
+                                            T.ArrayType(kt), True))
                 fields.append(T.StructField(vals_name(f.name),
                                             T.ArrayType(mt.value_type), True))
                 # entries whose VALUE is null are dropped from the
@@ -98,14 +140,30 @@ class MapDecomposeExec(PlanNode):
                     cols.append(c)
                     continue
                 n = len(c.data)
+                hashed = isinstance(f.data_type.key_type, T.StringType)
                 keys = np.empty(n, dtype=object)
                 vals = np.empty(n, dtype=object)
                 sizes = np.full(n, -1, dtype=np.int32)
                 for i in range(n):
                     if c.validity[i]:
                         d = c.data[i]
-                        items = sorted((k, v) for k, v in d.items()
-                                       if v is not None)
+                        if hashed:
+                            # collisions checked over ALL keys (a
+                            # dropped null-valued entry colliding with
+                            # a kept one would make its lookup return
+                            # the kept value instead of null)
+                            all_h = {key_hash64(k) for k in d}
+                            if len(all_h) != len(d):
+                                raise RuntimeError(
+                                    "map key hash collision in "
+                                    f"'{f.name}' — disable "
+                                    "spark.rapids.sql.decomposeMaps")
+                            items = sorted((key_hash64(k), v)
+                                           for k, v in d.items()
+                                           if v is not None)
+                        else:
+                            items = sorted((k, v) for k, v in d.items()
+                                           if v is not None)
                         keys[i] = [k for k, _ in items]
                         vals[i] = [v for _, v in items]
                         sizes[i] = len(d)
@@ -114,8 +172,9 @@ class MapDecomposeExec(PlanNode):
                         vals[i] = None
                 validity = np.asarray(c.validity, np.bool_)
                 mt = f.data_type
+                kt = T.LongType() if hashed else mt.key_type
                 cols.append(HostColumn(keys, validity.copy(),
-                                       T.ArrayType(mt.key_type)))
+                                       T.ArrayType(kt)))
                 cols.append(HostColumn(vals, validity.copy(),
                                        T.ArrayType(mt.value_type)))
                 cols.append(HostColumn(sizes, np.ones(n, np.bool_),

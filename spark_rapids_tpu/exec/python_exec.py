@@ -735,24 +735,22 @@ class WindowInPandasExec(PlanNode):
         perm = hk.host_sort_permutation(tmp, orders)
         hb = hk.host_take(hb, perm)
 
-        def codes(cols):
-            """int group codes over the SORTED batch (key columns are
-            permuted, not re-evaluated): rows equal on ``cols`` share a
-            code (nulls are one group, Spark window key semantics)."""
-            if not cols:
-                return np.zeros(n, np.int64)
-            parts = []
+        def change_flags(cols):
+            """bool[n] over the SORTED batch: row differs from its
+            predecessor on any of ``cols`` (row 0 True; per-column
+            factorize codes, so no composite product to overflow;
+            nulls are one group, Spark window key semantics)."""
+            ch = np.zeros(n, bool)
+            if n:
+                ch[0] = True
             for c in cols:
                 s = _host_col_to_series(c.take(perm), exact_int=True)
-                parts.append(pd.factorize(s, use_na_sentinel=False)[0])
-            code = parts[0].astype(np.int64)
-            for p in parts[1:]:
-                code = code * (int(p.max()) + 2) + p
-            return code
+                code = pd.factorize(s, use_na_sentinel=False)[0]
+                ch[1:] |= code[1:] != code[:-1]
+            return ch
 
-        gcode = codes(key_cols[:len(self._part_b)])
-        ocode = codes(key_cols[len(self._part_b):])
-        gchange = np.concatenate([[True], gcode[1:] != gcode[:-1]])
+        gchange = change_flags(key_cols[:len(self._part_b)])
+        ochange_g = change_flags(key_cols[len(self._part_b):])
         seg_starts = np.flatnonzero(gchange)
         seg_ends = np.concatenate([seg_starts[1:], [n]])
 
@@ -762,8 +760,9 @@ class WindowInPandasExec(PlanNode):
         out_vals: list[list] = [[None] * n for _ in self._udfs]
         for s0, s1 in zip(seg_starts, seg_ends):
             gn = s1 - s0
-            oc = ocode[s0:s1]
-            ochange = np.concatenate([[True], oc[1:] != oc[:-1]])
+            ochange = ochange_g[s0:s1].copy()
+            if gn:
+                ochange[0] = True
             peer_id = np.cumsum(ochange) - 1
             # each row's order-peer group extent [start, end), group-local
             pstarts = np.flatnonzero(ochange)

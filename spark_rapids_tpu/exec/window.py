@@ -114,6 +114,10 @@ class WindowExec(PlanNode):
 
     @property
     def output_batching(self):
+        # the bounded-memory global stream emits one batch per input
+        # batch; only the grouped single-batch path guarantees one
+        if self._global_streamable():
+            return None
         return RequireSingleBatch
 
     def num_partitions(self, ctx: ExecCtx) -> int:
@@ -122,8 +126,45 @@ class WindowExec(PlanNode):
         return 1
 
     # ------------------------------------------------------------------
+    def _global_streamable(self) -> bool:
+        """True when the whole-input window can run as a bounded-memory
+        two-pass stream: empty partition-by + empty order-by makes every
+        row's frame the ENTIRE input, so plain aggregates reduce to one
+        running state + a broadcast — no single giant batch (VERDICT r4
+        item 10; the reference's contract is single batch per GROUP, not
+        per world, GpuWindowExec.scala:92)."""
+        if self.spec.partition_by or self.spec.order_by:
+            return False
+        for w, inp in zip(self._wexprs, self._fn_inputs):
+            f = w.function
+            if not isinstance(f, A.AggregateFunction):
+                return False
+            try:
+                op = window_agg_op(f)
+            except ValueError:
+                return False
+            if op not in ("sum", "count", "count_star", "min", "max",
+                          "avg"):
+                return False
+            if inp is not None and (inp.dtype.np_dtype is None
+                                    or isinstance(inp.dtype,
+                                                  (T.StringType,
+                                                   T.ArrayType))):
+                return False
+        return True
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         child = self.children[0]
+        if ctx.is_device and not self._keys_partitioned \
+                and self._global_streamable():
+            it = self._stream_global(ctx)
+            first = next(it, None)
+            if first is not None:
+                yield first
+                yield from it
+                return
+            # empty input: fall through to the single-batch path so the
+            # empty-schema contract stays identical
         if self._keys_partitioned:
             batches = list(child.partition_iter(ctx, pid))
             if not batches:
@@ -144,6 +185,107 @@ class WindowExec(PlanNode):
             big = hk.host_concat(batches) if batches \
                 else HostBatch.empty(child.output_schema)
             yield self._run_host(big)
+
+    # ------------------------------------------------------------------
+    def _stream_global(self, ctx: ExecCtx) -> Iterator[ColumnBatch]:
+        """Two-pass bounded-memory whole-input window: pass 1 streams
+        child batches, folding each into an O(1) running state per
+        expression and parking the batch SPILLABLE in the BufferCatalog
+        (HBM -> host -> disk, so the working set never needs one giant
+        batch); pass 2 un-parks each batch and appends the broadcast
+        finals."""
+        import jax
+        import jax.numpy as jnp
+        from spark_rapids_tpu.memory.catalog import (SpillableColumnarBatch,
+                                                     SpillPriority)
+        inputs = self._fn_inputs
+
+        def update(b: ColumnBatch):
+            """Per-wexpr state (sum, count, min, max, rows).  Integral
+            inputs accumulate in int64 (an f64 fold would round sums and
+            extremes past 2^53 — the single-batch segment kernels are
+            exact there, and the two paths must agree)."""
+            out = []
+            real = b.row_mask()
+            rows = jnp.sum(real, dtype=jnp.int64)
+            for e in inputs:
+                if e is None:
+                    z = jnp.zeros((), jnp.int64)
+                    out.append((z, rows, z, z, rows))
+                    continue
+                c = eval_device(e, b)
+                valid = c.validity & real
+                acc = jnp.float64 if c.data.dtype.kind == "f" else jnp.int64
+                x = jnp.where(valid, c.data, 0).astype(acc)
+                cnt = jnp.sum(valid, dtype=jnp.int64)
+                big = jnp.asarray(jnp.inf if acc == jnp.float64
+                                  else jnp.iinfo(jnp.int64).max, acc)
+                small = jnp.asarray(-jnp.inf if acc == jnp.float64
+                                    else jnp.iinfo(jnp.int64).min, acc)
+                xd = c.data.astype(acc)
+                mn = jnp.min(jnp.where(valid, xd, big))
+                mx = jnp.max(jnp.where(valid, xd, small))
+                out.append((jnp.sum(x), cnt, mn, mx, rows))
+            return tuple(out)
+
+        def merge(a, b):
+            return tuple((sa + sb, ca + cb, jnp.minimum(mna, mnb),
+                          jnp.maximum(mxa, mxb), ra + rb)
+                         for (sa, ca, mna, mxa, ra),
+                             (sb, cb, mnb, mxb, rb) in zip(a, b))
+
+        if not hasattr(self, "_gs_jits"):
+            self._gs_jits = (jax.jit(update), jax.jit(merge))
+        upd_jit, merge_jit = self._gs_jits[:2]
+
+        child = self.children[0]
+        parked, state = [], None
+        for p in range(child.num_partitions(ctx)):
+            for b in child.partition_iter(ctx, p):
+                part = ctx.dispatch(upd_jit, b)
+                state = part if state is None \
+                    else ctx.dispatch(merge_jit, state, part)
+                parked.append(SpillableColumnarBatch(
+                    b, ctx.catalog, SpillPriority.READ_SHUFFLE))
+        if state is None:
+            return
+
+        def append(b: ColumnBatch, st):
+            cols = list(b.columns)
+            real = b.row_mask()
+            for (s, cnt, mn, mx, rows), w, dt in zip(
+                    st, self._wexprs, self._out_dtypes):
+                op = window_agg_op(w.function)
+                if op == "count_star":
+                    val, ok = rows, jnp.bool_(True)
+                elif op == "count":
+                    val, ok = cnt, jnp.bool_(True)
+                elif op == "sum":
+                    val, ok = s, cnt > 0
+                elif op == "avg":
+                    val = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                    ok = cnt > 0
+                elif op == "min":
+                    val, ok = mn, cnt > 0
+                else:
+                    val, ok = mx, cnt > 0
+                np_dt = dt.np_dtype
+                data = jnp.broadcast_to(
+                    jnp.where(ok, val, 0).astype(np_dt.str),
+                    (b.capacity,))
+                validity = real & ok
+                cols.append(DeviceColumn(
+                    jnp.where(validity, data, jnp.zeros((), data.dtype)),
+                    validity, dt))
+            return ColumnBatch(cols, b.num_rows, self._schema)
+
+        if len(self._gs_jits) == 2:
+            self._gs_jits = self._gs_jits + (jax.jit(append),)
+        app_jit = self._gs_jits[2]
+        for sb in parked:
+            b = sb.get()
+            sb.close()
+            yield ctx.dispatch(app_jit, b, state)
 
     # ------------------------------------------------------------------
     def _run_device(self, big: ColumnBatch) -> ColumnBatch:
@@ -189,8 +331,11 @@ class WindowExec(PlanNode):
             [SortOrder(len(part_cols) + i, asc,
                        nf if nf is not None else None)
              for i, (_, asc, nf) in enumerate(self._order_b)]
-        perm = hk.host_sort_permutation(tmp, orders) if n else \
-            np.zeros(0, np.int64)
+        # empty spec: the zero-column tmp batch reports num_rows 0, so
+        # host_sort_permutation would return an EMPTY identity — an
+        # unordered global window keeps the input order directly
+        perm = hk.host_sort_permutation(tmp, orders) if n and orders else \
+            np.arange(n, dtype=np.int64)
         base = big.take(perm)
         sp = [c.take(perm) for c in part_cols]
         so = [c.take(perm) for c in order_cols]

@@ -1,0 +1,254 @@
+"""ORC stripe-statistics reader + predicate pruning.
+
+The reference builds ORC SearchArguments so the reader skips whole
+stripes whose statistics cannot match the pushed-down predicate
+(GpuOrcScan.scala:240-245 pushedFilters -> SearchArgument,
+:327-360 stripe selection).  pyarrow's ORC binding exposes stripe
+COUNTS but not the statistics values, so this module reads them from
+the file itself: the ORC file tail is
+
+    [data][stripe footers][metadata][footer][postscript][ps_len byte]
+
+where the metadata section is a protobuf ``Metadata`` message holding
+one ``StripeStatistics`` per stripe (orc_proto.proto).  Only the tiny
+subset needed for pruning is parsed — a hand-rolled varint walker, no
+generated code — and only NONE/ZLIB compression (the common ORC
+defaults) is handled; anything else returns None and the scan keeps
+every stripe (pruning is an optimization, never a correctness gate).
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+__all__ = ["stripe_column_stats", "stripe_may_match"]
+
+# orc_proto.proto CompressionKind
+_NONE, _ZLIB = 0, 1
+
+
+def _varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes):
+    """Iterate (field_number, wire_type, value) over a protobuf buffer.
+    value: int for varint(0)/fixed(1,5), bytes for length-delimited(2)."""
+    pos, n = 0, len(buf)
+    while pos < n:
+        key, pos = _varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:  # groups: unsupported, bail conservatively
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decompress(buf: bytes, kind: int) -> bytes:
+    """An ORC compressed stream is chunked: each chunk has a 3-byte
+    little-endian header ``(length << 1) | is_original``."""
+    if kind == _NONE:
+        return buf
+    out, pos = [], 0
+    while pos + 3 <= len(buf):
+        hdr = buf[pos] | (buf[pos + 1] << 8) | (buf[pos + 2] << 16)
+        pos += 3
+        ln, orig = hdr >> 1, hdr & 1
+        chunk = buf[pos:pos + ln]
+        pos += ln
+        out.append(chunk if orig else
+                   zlib.decompressobj(-15).decompress(chunk))
+    return b"".join(out)
+
+
+def _col_stats(buf: bytes) -> dict:
+    """ColumnStatistics: numberOfValues=1, intStatistics=2,
+    doubleStatistics=3, stringStatistics=4, dateStatistics=7,
+    hasNull=10."""
+    st: dict = {"n": None, "has_null": None, "min": None, "max": None}
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:
+            st["n"] = v
+        elif fno == 10 and wt == 0:
+            st["has_null"] = bool(v)
+        elif fno == 2 and wt == 2:  # IntegerStatistics: sint64 min=1 max=2
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    st["min"] = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    st["max"] = _zigzag(v2)
+        elif fno == 3 and wt == 2:  # DoubleStatistics: double min=1 max=2
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 1:
+                    st["min"] = struct.unpack("<d", v2)[0]
+                elif f2 == 2 and w2 == 1:
+                    st["max"] = struct.unpack("<d", v2)[0]
+        elif fno == 4 and wt == 2:  # StringStatistics: string min=1 max=2
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:
+                    st["min"] = v2.decode("utf-8", "replace")
+                elif f2 == 2 and w2 == 2:
+                    st["max"] = v2.decode("utf-8", "replace")
+        elif fno == 7 and wt == 2:  # DateStatistics: sint32 days min=1 max=2
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    st["min"] = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    st["max"] = _zigzag(v2)
+    return st
+
+
+def stripe_column_stats(path: str) -> list[list[dict]] | None:
+    """Per-stripe, per-flattened-column statistics, or None when the
+    file can't be parsed (unsupported compression, nested types, any
+    surprise — caller must treat None as "keep every stripe").
+
+    For a flat struct schema, flattened column 0 is the root struct and
+    columns 1..N are the fields in file-schema order."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            flen = f.tell()
+            tail_len = min(flen, 1 << 14)
+            f.seek(flen - tail_len)
+            tail = f.read(tail_len)
+            ps_len = tail[-1]
+            ps = tail[-1 - ps_len:-1]
+            footer_len = meta_len = 0
+            kind = _NONE
+            for fno, wt, v in _fields(ps):
+                if fno == 1 and wt == 0:
+                    footer_len = v
+                elif fno == 2 and wt == 0:
+                    kind = v
+                elif fno == 5 and wt == 0:
+                    meta_len = v
+            if kind not in (_NONE, _ZLIB):
+                return None
+            if meta_len == 0:
+                return None
+            need = 1 + ps_len + footer_len + meta_len
+            if need > tail_len:
+                f.seek(flen - need)
+                tail = f.read(need)
+            meta_buf = tail[-1 - ps_len - footer_len - meta_len:
+                            -1 - ps_len - footer_len]
+        meta = _decompress(meta_buf, kind)
+        stripes = []
+        for fno, wt, v in _fields(meta):
+            if fno == 1 and wt == 2:  # StripeStatistics
+                cols = [_col_stats(v2) for f2, w2, v2 in _fields(v)
+                        if f2 == 1 and w2 == 2]
+                stripes.append(cols)
+        return stripes or None
+    except Exception:  # noqa: BLE001 - pruning is best-effort
+        return None
+
+
+def stripe_may_match(pred, stats: list[dict],
+                     col_index: dict[str, int]) -> bool:
+    """Conservative interval check: False ONLY when no row in the
+    stripe can satisfy ``pred`` (engine Expression).  Unknown operators
+    and missing statistics answer True (keep the stripe)."""
+    from spark_rapids_tpu.expr import predicates as P
+    from spark_rapids_tpu.expr.core import Literal, UnresolvedAttribute
+
+    def col_lit(e):
+        """(stats, literal, flipped) for a col-vs-literal comparison."""
+        a, b = e.children
+        if isinstance(a, UnresolvedAttribute) and isinstance(b, Literal):
+            i = col_index.get(a.name)
+            return (stats[i] if i is not None and i < len(stats) else None,
+                    b.value, False)
+        if isinstance(b, UnresolvedAttribute) and isinstance(a, Literal):
+            i = col_index.get(b.name)
+            return (stats[i] if i is not None and i < len(stats) else None,
+                    a.value, True)
+        return None, None, False
+
+    def cmp_ok(st, lit, lo_op):
+        """May any value v in [min,max] satisfy ``v <op> lit``?"""
+        if st is None or lit is None:
+            return True
+        mn, mx = st.get("min"), st.get("max")
+        if mn is None or mx is None:
+            return True
+        if not isinstance(lit, type(mn)) and not (
+                isinstance(lit, (int, float)) and isinstance(mn, (int, float))):
+            return True  # type mismatch (e.g. date vs int): no claim
+        try:
+            return lo_op(mn, mx, lit)
+        except TypeError:
+            return True
+
+    def may(e) -> bool:
+        if isinstance(e, P.And):
+            return may(e.children[0]) and may(e.children[1])
+        if isinstance(e, P.Or):
+            return may(e.children[0]) or may(e.children[1])
+        if isinstance(e, P.EqualTo):
+            st, lit, _ = col_lit(e)
+            return cmp_ok(st, lit, lambda mn, mx, v: mn <= v <= mx)
+        if isinstance(e, P.LessThan):
+            st, lit, flip = col_lit(e)
+            if flip:  # lit < col  <=>  col > lit
+                return cmp_ok(st, lit, lambda mn, mx, v: mx > v)
+            return cmp_ok(st, lit, lambda mn, mx, v: mn < v)
+        if isinstance(e, P.LessThanOrEqual):
+            st, lit, flip = col_lit(e)
+            if flip:
+                return cmp_ok(st, lit, lambda mn, mx, v: mx >= v)
+            return cmp_ok(st, lit, lambda mn, mx, v: mn <= v)
+        if isinstance(e, P.GreaterThan):
+            st, lit, flip = col_lit(e)
+            if flip:  # lit > col  <=>  col < lit
+                return cmp_ok(st, lit, lambda mn, mx, v: mn < v)
+            return cmp_ok(st, lit, lambda mn, mx, v: mx > v)
+        if isinstance(e, P.GreaterThanOrEqual):
+            st, lit, flip = col_lit(e)
+            if flip:
+                return cmp_ok(st, lit, lambda mn, mx, v: mn <= v)
+            return cmp_ok(st, lit, lambda mn, mx, v: mx >= v)
+        if isinstance(e, P.IsNull):
+            c = e.children[0]
+            if isinstance(c, UnresolvedAttribute):
+                i = col_index.get(c.name)
+                if i is not None and i < len(stats):
+                    hn = stats[i].get("has_null")
+                    if hn is not None:
+                        return hn
+            return True
+        if isinstance(e, P.IsNotNull):
+            c = e.children[0]
+            if isinstance(c, UnresolvedAttribute):
+                i = col_index.get(c.name)
+                if i is not None and i < len(stats):
+                    nv = stats[i].get("n")
+                    if nv is not None:
+                        return nv > 0
+            return True
+        return True  # unknown operator: no claim
+
+    return may(pred)

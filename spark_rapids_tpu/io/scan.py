@@ -160,6 +160,8 @@ class FileScanExec(PlanNode):
             raise ValueError(f"predicate not pushable: {pushdown!r}")
         self._string_width = string_width
         self._buckets_cache: dict[int, list[list[str]]] = {}
+        #: stripes/row-groups skipped via statistics pruning (diagnostic)
+        self.stripes_skipped = 0
         full = self._read_schema()
         if self._columns:
             fields = [full.field(c) for c in self._columns]
@@ -378,7 +380,11 @@ class ParquetScanExec(FileScanExec):
 
 
 class OrcScanExec(FileScanExec):
-    """ORC scan (reference GpuOrcScanBase, GpuOrcScan.scala:63)."""
+    """ORC scan (reference GpuOrcScanBase, GpuOrcScan.scala:63) with
+    stripe pruning: stripes whose statistics cannot match the pushdown
+    predicate are skipped without being read (reference SearchArgument
+    stripe selection, GpuOrcScan.scala:240-245,327-360; statistics read
+    by io/orc_meta.py since pyarrow doesn't expose them)."""
 
     format_name = "orc"
 
@@ -387,13 +393,37 @@ class OrcScanExec(FileScanExec):
         return T.Schema.from_arrow(orc.ORCFile(self._files[0]).schema)
 
     def _read_file(self, path: str, batch_rows: int = 1 << 16):
+        import pyarrow as pa
         import pyarrow.orc as orc
+        from spark_rapids_tpu.io import orc_meta
         f = orc.ORCFile(path)
         cols = self._schema.names
-        import pyarrow as pa
         filt = _to_arrow_filter(self._pushdown) if self._pushdown is not None \
             else None
+        stats = None
+        if self._pushdown is not None:
+            # flattened-stats index: root struct is column 0, fields
+            # follow in FILE schema order — valid ONLY for flat schemas
+            # (nested types interleave their children into the id
+            # space, which would compare predicates against the wrong
+            # column's statistics); nested files skip pruning entirely
+            file_schema = f.schema
+            if all(not pa.types.is_nested(fld.type)
+                   for fld in file_schema):
+                if not hasattr(self, "_orc_stats_cache"):
+                    self._orc_stats_cache = {}
+                if path not in self._orc_stats_cache:
+                    self._orc_stats_cache[path] = \
+                        orc_meta.stripe_column_stats(path)
+                stats = self._orc_stats_cache[path]
+                col_index = {n: i + 1
+                             for i, n in enumerate(file_schema.names)}
         for stripe in range(f.nstripes):
+            if stats is not None and stripe < len(stats) and \
+                    not orc_meta.stripe_may_match(
+                        self._pushdown, stats[stripe], col_index):
+                self.stripes_skipped += 1
+                continue
             out = f.read_stripe(stripe, columns=cols)
             # read_stripe returns columns in file order; re-select to the
             # requested order (RecordBatch or Table depending on version)
@@ -401,8 +431,8 @@ class OrcScanExec(FileScanExec):
                 out = pa.Table.from_batches([out])
             out = out.select(cols)
             if filt is not None:
-                # no stripe-level pushdown in pyarrow ORC: apply post-read
-                # (same result; reference pushes to the cuDF ORC reader)
+                # residual row-level filter over surviving stripes (the
+                # reference applies the same SearchArgument rows too)
                 out = out.filter(filt)
             yield from out.to_batches(max_chunksize=batch_rows)
 

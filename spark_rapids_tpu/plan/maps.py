@@ -21,8 +21,9 @@ from __future__ import annotations
 
 from spark_rapids_tpu.conf import ConfEntry, TpuConf, _bool, register
 from spark_rapids_tpu.exec.maps_exec import (MapDecomposeExec, decomposable,
-                                             keys_name, size_name,
-                                             vals_name)
+                                             hashed_decomposable,
+                                             key_hash64, keys_name,
+                                             size_name, vals_name)
 from spark_rapids_tpu.expr.collections import (GetMapValue, MapKeys,
                                                MapLookup, MapValues, Size)
 from spark_rapids_tpu.expr.core import Expression, UnresolvedAttribute, col
@@ -117,15 +118,25 @@ def _escaping(n: L.LogicalPlan, names: set, bad: set) -> None:
             _escaping(c, names, bad)
 
 
-def _rewrite_expr(e: Expression, names: set) -> Expression:
+def _rewrite_expr(e: Expression, names: set, hashed: set = frozenset()) \
+        -> Expression:
     def rw(node):
         kids = getattr(node, "children", ())
         m = kids[0] if kids else None
         if not (isinstance(m, UnresolvedAttribute) and m.name in names):
             return node
         if isinstance(node, GetMapValue):
+            key = node.children[1]
+            if m.name in hashed:
+                # string-key map: the stored keys are key_hash64 values,
+                # so hash the (literal — enforced in decompose_maps)
+                # lookup key identically at plan time
+                from spark_rapids_tpu import types as T
+                from spark_rapids_tpu.expr.core import Literal
+                key = Literal(None if key.value is None
+                              else key_hash64(key.value), T.LongType())
             return MapLookup(col(keys_name(m.name)), col(vals_name(m.name)),
-                             node.children[1])
+                             key)
         if isinstance(node, Size):
             # the split's size column counts null-valued entries the
             # keys array dropped, and already encodes legacy
@@ -136,7 +147,8 @@ def _rewrite_expr(e: Expression, names: set) -> Expression:
     return e.transform_up(rw)
 
 
-def _rebuild(n: L.LogicalPlan, names: set) -> L.LogicalPlan:
+def _rebuild(n: L.LogicalPlan, names: set,
+             hashed: set = frozenset()) -> L.LogicalPlan:
     from dataclasses import fields as dfields, replace
 
     if isinstance(n, L.Scan):
@@ -148,17 +160,18 @@ def _rebuild(n: L.LogicalPlan, names: set) -> L.LogicalPlan:
     for f in dfields(n):
         v = getattr(n, f.name)
         if isinstance(v, L.LogicalPlan):
-            kw[f.name] = _rebuild(v, names)
+            kw[f.name] = _rebuild(v, names, hashed)
         elif isinstance(v, Expression):
-            kw[f.name] = _rewrite_expr(v, names)
+            kw[f.name] = _rewrite_expr(v, names, hashed)
         elif isinstance(v, list) and v and isinstance(v[0], list):
-            kw[f.name] = [[_rewrite_expr(e, names) if
+            kw[f.name] = [[_rewrite_expr(e, names, hashed) if
                            isinstance(e, Expression) else e for e in inner]
                           for inner in v]
         elif isinstance(v, list):
             kw[f.name] = [
-                _rebuild(x, names) if isinstance(x, L.LogicalPlan) else
-                _rewrite_expr(x, names) if isinstance(x, Expression) else x
+                _rebuild(x, names, hashed) if isinstance(x, L.LogicalPlan)
+                else _rewrite_expr(x, names, hashed)
+                if isinstance(x, Expression) else x
                 for x in v]
     return replace(n, **kw) if kw else n
 
@@ -170,11 +183,15 @@ def decompose_maps(plan: L.LogicalPlan, conf: TpuConf) -> L.LogicalPlan:
     # candidate map columns: decomposable dtype, unique across scans, no
     # name collision with the reserved split names
     seen: dict[str, int] = {}
+    hashed: set = set()
     for n in nodes:
         if isinstance(n, L.Scan):
             for f in n.schema:
                 if decomposable(f.data_type):
                     seen[f.name] = seen.get(f.name, 0) + 1
+                elif hashed_decomposable(f.data_type):
+                    seen[f.name] = seen.get(f.name, 0) + 1
+                    hashed.add(f.name)
     all_names = {f.name for n in nodes if isinstance(n, L.Scan)
                  for f in n.schema}
     names = {m for m, cnt in seen.items()
@@ -211,8 +228,24 @@ def decompose_maps(plan: L.LogicalPlan, conf: TpuConf) -> L.LogicalPlan:
                 bad |= e.references() & names
             else:
                 _bare_uses(e, names, bad)
+    # hashed (string-key) maps additionally require every lookup key
+    # to be a string LITERAL: the stored keys are plan-time hashes, so
+    # a data-dependent key expression has nothing to compare against
+    from spark_rapids_tpu.expr.core import Literal as _Lit
+
+    def _literal_keys_only(e) -> None:
+        for node in e.walk() if hasattr(e, "walk") else ():
+            if isinstance(node, GetMapValue):
+                m = node.children[0]
+                if isinstance(m, UnresolvedAttribute) and m.name in hashed \
+                        and not isinstance(node.children[1], _Lit):
+                    bad.add(m.name)
+
+    for n in nodes:
+        for e in _node_exprs(n):
+            _literal_keys_only(e)
     _escaping(plan, names, bad)
     names -= bad
     if not names:
         return plan
-    return _rebuild(plan, names)
+    return _rebuild(plan, names, hashed & names)

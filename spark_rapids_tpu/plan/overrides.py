@@ -120,25 +120,8 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         inner = first.children[0] if isinstance(first, Alias) else first
         cur, keys_partitioned = _ensure_window_distribution(
             c, inner.spec, conf)
-        from spark_rapids_tpu.exec.python_exec import (PandasWindowUDF,
-                                                       WindowInPandasExec)
-
-        def _is_udf(w):
-            i = w.children[0] if isinstance(w, Alias) else w
-            return isinstance(i.function, PandasWindowUDF)
-
-        # split mixed native/pandas-UDF expressions like _lower_project
-        native_ws = [w for w in node.window_exprs if not _is_udf(w)]
-        udf_ws = [w for w in node.window_exprs if _is_udf(w)]
-        if native_ws:
-            ex = WindowExec(native_ws, cur.exec_node,
-                            keys_partitioned=keys_partitioned)
-            cur = PlannedNode(ex, list(native_ws), [cur])
-        if udf_ws:
-            ex = WindowInPandasExec(udf_ws, cur.exec_node,
-                                    keys_partitioned=keys_partitioned)
-            cur = PlannedNode(ex, list(udf_ws), [cur])
-        return cur
+        return _stack_window_execs(cur, node.window_exprs,
+                                   keys_partitioned)
     if isinstance(node, L.Expand):
         c = lower(node.child, conf)
         from spark_rapids_tpu.exec.expand import ExpandExec
@@ -395,28 +378,34 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
     cur = c
     for spec, spec_windows in by_spec.items():
         cur, keys_partitioned = _ensure_window_distribution(cur, spec, conf)
-        # pandas window UDFs run in WindowInPandasExec (reference
-        # GpuWindowInPandasExec); mixed specs were split above, but a
-        # spec mixing UDF and native functions splits again here
-        from spark_rapids_tpu.exec.python_exec import (PandasWindowUDF,
-                                                       WindowInPandasExec)
-
-        def _is_udf(w):
-            inner = w.children[0] if isinstance(w, Alias) else w
-            return isinstance(inner.function, PandasWindowUDF)
-
-        udf_ws = [w for w in spec_windows if _is_udf(w)]
-        native_ws = [w for w in spec_windows if not _is_udf(w)]
-        if native_ws:
-            ex = WindowExec(native_ws, cur.exec_node,
-                            keys_partitioned=keys_partitioned)
-            cur = PlannedNode(ex, list(native_ws), [cur])
-        if udf_ws:
-            ex = WindowInPandasExec(udf_ws, cur.exec_node,
-                                    keys_partitioned=keys_partitioned)
-            cur = PlannedNode(ex, list(udf_ws), [cur])
+        cur = _stack_window_execs(cur, spec_windows, keys_partitioned)
     ex = ProjectExec(plain, cur.exec_node)
     return PlannedNode(ex, list(plain), [cur])
+
+
+def _stack_window_execs(cur: PlannedNode, spec_windows,
+                        keys_partitioned: bool) -> PlannedNode:
+    """Plan one spec's window expressions, splitting pandas window UDFs
+    into WindowInPandasExec (reference GpuWindowInPandasExec) and
+    native functions into WindowExec, stacked over ``cur``."""
+    from spark_rapids_tpu.exec.python_exec import (PandasWindowUDF,
+                                                   WindowInPandasExec)
+
+    def _is_udf(w):
+        inner = w.children[0] if isinstance(w, Alias) else w
+        return isinstance(inner.function, PandasWindowUDF)
+
+    native_ws = [w for w in spec_windows if not _is_udf(w)]
+    udf_ws = [w for w in spec_windows if _is_udf(w)]
+    if native_ws:
+        ex = WindowExec(native_ws, cur.exec_node,
+                        keys_partitioned=keys_partitioned)
+        cur = PlannedNode(ex, list(native_ws), [cur])
+    if udf_ws:
+        ex = WindowInPandasExec(udf_ws, cur.exec_node,
+                                keys_partitioned=keys_partitioned)
+        cur = PlannedNode(ex, list(udf_ws), [cur])
+    return cur
 
 
 def _lower_aggregate(node: L.Aggregate, conf: TpuConf) -> PlannedNode:

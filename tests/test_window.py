@@ -191,3 +191,64 @@ def _row_key(r):
     return tuple((x is None, 0 if x is None else x)
                  if x is None or isinstance(x, (int, float))
                  else (False, str(x)) for x in r)
+
+
+def test_global_window_streams_bounded_memory(rng):
+    """Empty-partition-by plain-aggregate windows run as a two-pass
+    stream: one running state + spillable parked batches, emitting one
+    output batch PER input batch instead of one world-sized batch
+    (VERDICT r4 item 10; reference contract is single batch per GROUP,
+    GpuWindowExec.scala:92)."""
+    from spark_rapids_tpu.exec.core import ExecCtx, device_to_host
+    scan = _scan(rng, n=300)
+    gspec = WindowSpec()
+    plan = WindowExec([
+        WindowExpression(Sum(col("v")), gspec).alias("sv"),
+        WindowExpression(CountStar(), gspec).alias("c"),
+        WindowExpression(Count(col("v")), gspec).alias("cv"),
+        WindowExpression(Min(col("v")), gspec).alias("mn"),
+        WindowExpression(Max(col("f")), gspec).alias("mx"),
+        WindowExpression(Average(col("v")), gspec).alias("av"),
+    ], scan)
+    assert plan._global_streamable()
+    assert plan.output_batching is None
+    rows = assert_tpu_and_cpu_equal(plan)
+    assert len(rows) == 300
+    # the device path must emit MULTIPLE batches (bounded memory), not
+    # one world batch
+    with ExecCtx(backend="device") as ctx:
+        batches = list(plan.partition_iter(ctx, 0))
+        assert len(batches) > 1
+        got = [r for b in batches for r in device_to_host(b).to_rows()]
+    assert len(got) == 300
+
+
+def test_global_window_streaming_exact_int64(rng):
+    """int64 extremes/sums past 2^53 stay exact through the streaming
+    accumulator (an f64 fold would round them)."""
+    big = (1 << 60) + 12345
+    scan = LocalScanExec.from_pydict(
+        {"v": [big, big + 7, None, -big]},
+        T.Schema([T.StructField("v", T.LongType(), True)]),
+        rows_per_batch=2)
+    gspec = WindowSpec()
+    plan = WindowExec([
+        WindowExpression(Max(col("v")), gspec).alias("mx"),
+        WindowExpression(Min(col("v")), gspec).alias("mn"),
+        WindowExpression(Sum(col("v")), gspec).alias("s"),
+    ], scan)
+    rows = assert_tpu_and_cpu_equal(plan)
+    # sum over [big, big+7, None, -big] = big + 7, exactly
+    assert rows[0][1:] == (big + 7, -big, big + 7)
+
+
+def test_global_window_with_order_keeps_single_batch(rng):
+    """An ordered global window (running frame) is NOT streamable — it
+    must keep the sorted single-batch path."""
+    plan = WindowExec([
+        WindowExpression(Sum(col("v")),
+                         WindowSpec(order_by=((col("o"), True),)))
+        .alias("rs")], _scan(rng, n=100))
+    assert not plan._global_streamable()
+    assert plan.output_batching is not None
+    assert_tpu_and_cpu_equal(plan)
