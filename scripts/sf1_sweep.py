@@ -1,14 +1,28 @@
-"""Full TPC-DS SF1 verified sweep with per-query checkpointing.
+"""Full 99-query TPC-DS SF1 verified sweep with per-query checkpointing
+and ORACLE TIME CAPPING.
 
-Writes one JSON line per query to the checkpoint as it goes (a crashed
-or killed run resumes where it left off) and assembles
-bench_results_sf1_cpu.json at the end.  Usage:
+Round-4 verdict item 3: the sweep stopped at q71 because the heaviest
+numpy oracles run >30min each at SF1, silently excluding the quarter of
+the suite most likely to regress.  Here every query reports:
+
+* device_warm_s — median of 3 in-process device-engine iterations
+  (XLA:CPU backend; iteration 0's compile cost is discarded),
+* oracle_s + ok — the SF1 numpy oracle, run in a KILLABLE subprocess
+  under SWEEP_ORACLE_CAP_S (default 400s).  When the cap fires, the
+  query is instead VERIFIED at SF0.1 (cheap oracle, same plan) and the
+  record carries ``oracle_capped`` plus ``speedup_lb = cap / device``
+  — an honest lower bound, never reported as an exact speedup.
+
+Writes one JSON line per query to the checkpoint (a killed run resumes)
+and assembles bench_results_sf1_cpu.json at the end.  Usage:
 
     JAX_PLATFORMS=cpu python scripts/sf1_sweep.py [checkpoint.jsonl]
 """
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -18,7 +32,101 @@ from spark_rapids_tpu.bench.tpcds_queries import QUERIES  # noqa: E402
 
 CKPT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sf1_sweep_ckpt.jsonl"
 DATA = ".bench_data/sf1"
+DATA_SMALL = ".bench_data/sf0.1"
 OUT = "bench_results_sf1_cpu.json"
+ORACLE_CAP_S = float(os.environ.get("SWEEP_ORACLE_CAP_S", "400"))
+
+_ORACLE_CODE = """
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.bench.runner import _collect_rows, _plan_of, _rows_match
+from spark_rapids_tpu.bench.tpcds_queries import build_query
+name, data, rows_path = sys.argv[1], sys.argv[2], sys.argv[3]
+with open(rows_path) as f:
+    device_rows = [tuple(r) for r in json.load(f)]
+s = TpuSession({})
+df = build_query(name, s, data)
+plan = _plan_of(df)
+t0 = time.perf_counter()
+oracle = _collect_rows(df, "host", plan)
+dt = time.perf_counter() - t0
+print("ORACLE_RESULT:" + json.dumps(
+    {"oracle_s": round(dt, 4), "ok": _rows_match(device_rows, oracle)}))
+"""
+
+
+def _oracle_subprocess(name: str, device_rows) -> dict | None:
+    """SF1 oracle under the cap; None when the cap fires."""
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump([list(r) for r in device_rows], f)
+        rows_path = f.name
+    try:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _ORACLE_CODE, name, DATA, rows_path],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            start_new_session=True)
+        try:
+            out, _ = p.communicate(timeout=ORACLE_CAP_S)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), 9)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.communicate()
+            return None
+        for line in (out or "").splitlines():
+            if line.startswith("ORACLE_RESULT:"):
+                return json.loads(line[len("ORACLE_RESULT:"):])
+        return {"oracle_s": None, "ok": False,
+                "error": f"oracle rc={p.returncode} with no result"}
+    finally:
+        os.unlink(rows_path)
+
+
+def _sweep_one(name: str) -> dict:
+    rec = {"query": name}
+    try:
+        r = run_benchmark(DATA, 1.0, [name], iterations=3, verify=False,
+                          generate=False)[0]
+        if "error" in r:
+            return {**rec, "ok": False, "error": r["error"]}
+        times = sorted(r.get("device_s_all") or [0])
+        rec["device_warm_s"] = times[len(times) // 2]
+        rec["rows"] = r.get("rows")
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.bench.runner import (_collect_rows, _plan_of)
+        from spark_rapids_tpu.bench.tpcds_queries import build_query
+        s = TpuSession({})
+        df = build_query(name, s, DATA)
+        device_rows = _collect_rows(df, "device", _plan_of(df))
+        orc = _oracle_subprocess(name, device_rows)
+        if orc is not None and orc.get("oracle_s") is not None:
+            rec["oracle_s"] = orc["oracle_s"]
+            rec["ok"] = orc["ok"]
+            rec["speedup"] = round(orc["oracle_s"] /
+                                   max(rec["device_warm_s"], 1e-9), 2)
+        elif orc is not None:
+            # CRASHED oracle (not a timeout): record the failure
+            # honestly, never as an oracle_capped lower bound
+            rec["ok"] = False
+            rec["error"] = orc.get("error", "oracle crashed")
+        else:
+            # cap fired: verify the plan at SF0.1 and report the bound
+            small = run_benchmark(DATA_SMALL, 0.1, [name], iterations=1,
+                                  verify=True, generate=False)[0]
+            rec["ok"] = bool(small.get("ok"))
+            rec["oracle_capped"] = ORACLE_CAP_S
+            rec["verified_at_sf"] = 0.1
+            rec["speedup_lb"] = round(ORACLE_CAP_S /
+                                      max(rec["device_warm_s"], 1e-9), 2)
+            if "error" in small:
+                rec["verify_error"] = small["error"]
+    except Exception as e:  # noqa: BLE001 - per-query isolation
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
 
 
 def main():
@@ -28,59 +136,53 @@ def main():
             for line in f:
                 r = json.loads(line)
                 done[r["query"]] = r
-        print(f"resuming: {len(done)} queries already recorded",
-              flush=True)
+        print(f"resuming: {len(done)} queries already recorded", flush=True)
     queries = sorted(QUERIES, key=lambda q: int(q[1:]))
-    assemble_only = os.environ.get("SWEEP_ASSEMBLE_ONLY") == "1"
-    if assemble_only:
-        queries = [q for q in queries if q in done]
     t0 = time.time()
     with open(CKPT, "a") as ck:
         for name in queries:
             if name in done:
                 continue
-            r = run_benchmark(DATA, 1.0, [name], iterations=2,
-                              verify=True, generate=False)[0]
-            times = r.get("device_s_all") or [0]
-            rec = {"query": name, "ok": r.get("ok"),
-                   "rows": r.get("rows"),
-                   "device_warm_s": min(times),
-                   "oracle_s": r.get("oracle_s")}
-            if r.get("oracle_s"):
-                rec["speedup"] = round(r["oracle_s"] /
-                                       max(min(times), 1e-9), 2)
-            if "error" in r:
-                rec["error"] = r["error"]
+            rec = _sweep_one(name)
             ck.write(json.dumps(rec) + "\n")
             ck.flush()
             done[name] = rec
-            print(f"{name}: ok={rec['ok']} "
-                  f"speedup={rec.get('speedup')}", flush=True)
+            print(f"{name}: ok={rec.get('ok')} "
+                  f"speedup={rec.get('speedup', rec.get('speedup_lb'))}"
+                  f"{' (lb)' if 'speedup_lb' in rec else ''}", flush=True)
     recs = [done[q] for q in queries]
     oks = [r for r in recs if r.get("ok")]
-    sp = sorted(r["speedup"] for r in oks if r.get("speedup"))
+    exact = sorted(r["speedup"] for r in oks if "speedup" in r)
+    lbs = [r for r in oks if "speedup_lb" in r]
     out = {
         "description": (
-            "TPC-DS SF1 differential sweep, device engine (XLA:CPU "
-            "backend, warm persistent compile cache, best of 2 "
+            "TPC-DS SF1 sweep, device engine (XLA:CPU backend, warm "
+            "persistent in-process compile cache, median of 3 "
             "iterations) vs single-threaded numpy host oracle; 1-core "
-            "build VM. Device==oracle verified per query. Queries "
-            "missing from this record were cut by the round's wall "
-            "clock (the q72-class numpy oracles run >30min each at "
-            "SF1), not by failures — SF0.01 verification for all 99 "
-            "is artifacts/tpcds_99_sf001_verify.txt."),
-        "generated_by": "scripts/sf1_sweep.py (iterations=2, verify)",
+            "build VM.  Device==oracle verified per query at SF1; "
+            "queries whose SF1 oracle exceeded the "
+            f"{ORACLE_CAP_S:.0f}s cap are verified at SF0.1 instead "
+            "and report speedup_lb = cap/device (a lower bound, "
+            "excluded from median_speedup)."),
+        "generated_by": "scripts/sf1_sweep.py (iterations=3, capped "
+                        "oracle)",
         "host_cpus": os.cpu_count(),
-        "summary": {"verified": len(oks), "total": len(QUERIES),
-                    "median_speedup": sp[len(sp) // 2] if sp else None,
-                    "min_speedup": sp[0] if sp else None,
-                    "max_speedup": sp[-1] if sp else None,
-                    "wall_s": round(time.time() - t0, 1)},
+        "summary": {
+            "verified": len(oks), "total": len(QUERIES),
+            "oracle_capped": len(lbs),
+            "median_speedup": exact[len(exact) // 2] if exact else None,
+            "min_speedup": exact[0] if exact else None,
+            "max_speedup": exact[-1] if exact else None,
+            "min_speedup_lb": min((r["speedup_lb"] for r in lbs),
+                                  default=None),
+            "wall_s": round(time.time() - t0, 1),
+        },
         "queries": recs,
     }
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps(out["summary"]))
+        f.write("\n")
+    print(json.dumps(out["summary"]), flush=True)
 
 
 if __name__ == "__main__":

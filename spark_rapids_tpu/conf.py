@@ -157,6 +157,14 @@ TEST_ALLOWED_NONTPU = conf(
     "spark.rapids.sql.test.allowedNonTpu", "",
     "Comma separated exec names allowed on CPU in test mode.", internal=True)
 
+SCAN_REUSE = bool_conf(
+    "spark.rapids.sql.scanReuse", True,
+    "Share one materialization among identical scans (same files, "
+    "columns, pushdown) within a plan, parked spillable in the buffer "
+    "catalog — leaf-level ReuseExchange (Spark's rule the reference "
+    "inherits); q28-style multi-branch plans otherwise re-read and "
+    "re-transfer the same table per branch.")
+
 MAX_READER_BATCH_SIZE_BYTES = bytes_conf(
     "spark.rapids.sql.reader.batchSizeBytes", 1 << 30,
     "Soft cap on bytes per scan batch, converted to a row cap through a "

@@ -140,9 +140,9 @@ class CoalesceBatchesExec(PlanNode):
             if not batches:
                 return
             if len(batches) == 1:
-                yield batches[0]
+                yield self._maybe_shrink(ctx, batches[0])
             elif ctx.is_device:
-                yield dk.concat_batches(batches)
+                yield self._maybe_shrink(ctx, dk.concat_batches(batches))
             else:
                 yield hk.host_concat(batches)
             return
@@ -160,11 +160,64 @@ class CoalesceBatchesExec(PlanNode):
         if pending:
             yield self._flush(ctx, pending)
 
+    def _upstream_can_shrink(self) -> bool:
+        """True when an operator below (this side of any exchange) can
+        leave batches much emptier than their capacity — filters,
+        limits, residual-condition joins.  Dense pipelines skip the
+        per-batch row-count sync entirely: a blocking host round trip
+        per coalesced batch would serialize the async dispatch pipeline
+        for zero benefit (review finding)."""
+        if not hasattr(self, "_shrink_possible"):
+            from spark_rapids_tpu.exec.basic import (FilterExec,
+                                                     GlobalLimitExec,
+                                                     LocalLimitExec)
+            from spark_rapids_tpu.exec.exchange import (
+                AdaptiveShuffleReaderExec, ShuffleExchangeExec)
+            from spark_rapids_tpu.exec.joins import JoinExec
+            found = False
+
+            def walk(n):
+                nonlocal found
+                if found or isinstance(n, (ShuffleExchangeExec,
+                                           AdaptiveShuffleReaderExec)):
+                    # exchange slices are already right-sized
+                    return
+                if isinstance(n, (FilterExec, LocalLimitExec,
+                                  GlobalLimitExec)) or \
+                        (isinstance(n, JoinExec)
+                         and n._condition is not None):
+                    found = True
+                    return
+                for c in n.children:
+                    walk(c)
+
+            walk(self.children[0])
+            self._shrink_possible = found
+        return self._shrink_possible
+
+    def _maybe_shrink(self, ctx: ExecCtx, b):
+        """Repack a sparse batch (selective upstream filter) to its
+        pow2 row bucket: every downstream sort/segment program runs at
+        batch CAPACITY, so a 4M-capacity batch holding 400k filtered
+        rows would pay 8x its useful sort work (TPC-DS q28's
+        count-distinct branches).  Costs one row-count sync + a slice
+        program; only probed when the upstream subtree can actually
+        leave batches sparse."""
+        if not ctx.is_device or not self._upstream_can_shrink():
+            return b
+        from spark_rapids_tpu.columnar.batch import round_capacity
+        n = b.host_num_rows()
+        cap = round_capacity(max(n, 1))
+        if cap > b.capacity // 2:
+            return b
+        return ctx.dispatch(dk.shrink_capacity, b, cap)
+
     def _flush(self, ctx: ExecCtx, batches: list):
         if len(batches) == 1:
-            return batches[0]
-        return dk.concat_batches(batches) if ctx.is_device \
+            return self._maybe_shrink(ctx, batches[0])
+        out = dk.concat_batches(batches) if ctx.is_device \
             else hk.host_concat(batches)
+        return self._maybe_shrink(ctx, out)
 
 
 def _host_bytes(b: HostBatch) -> int:

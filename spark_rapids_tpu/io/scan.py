@@ -162,6 +162,13 @@ class FileScanExec(PlanNode):
         self._buckets_cache: dict[int, list[list[str]]] = {}
         #: stripes/row-groups skipped via statistics pruning (diagnostic)
         self.stripes_skipped = 0
+        #: set by the planner when this scan's (files, columns, pushdown)
+        #: fingerprint appears MORE THAN ONCE in the plan: consumers then
+        #: share one materialization parked spillable in the catalog
+        #: instead of re-decoding + re-transferring per instance (q28
+        #: reads store_sales 12x; the reference's analog is Spark's
+        #: ReuseExchange over identical scan-bearing subtrees)
+        self.share_output = False
         full = self._read_schema()
         if self._columns:
             fields = [full.field(c) for c in self._columns]
@@ -202,11 +209,36 @@ class FileScanExec(PlanNode):
             self._buckets_cache[nparts] = buckets
         return self._buckets_cache[nparts][pid]
 
+    def scan_fingerprint(self) -> tuple:
+        """Structural identity: two scans with equal fingerprints read
+        the same files, columns, and pushdown — identical output."""
+        return (self.format_name, tuple(self._files),
+                tuple(self._schema.names), repr(self._pushdown),
+                self._string_width, self._requested_parts)
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         files = self._partition_files(ctx, pid)
         mode = READER_TYPE[self.format_name].get(ctx.conf.settings)
         rbs = self._decode_iter(ctx, files, mode)
         if ctx.is_device:
+            if self.share_output:
+                from spark_rapids_tpu.memory.catalog import (
+                    SpillableColumnarBatch, SpillPriority)
+                parked = ctx.cached(
+                    ("scan_share", self.scan_fingerprint(), pid),
+                    lambda: [SpillableColumnarBatch(
+                        b, ctx.catalog, SpillPriority.READ_SHUFFLE)
+                        for b in self._device_batches(rbs)])
+                for sb in parked:
+                    b = sb.get()
+                    # unpin immediately: the yielded pytree keeps the
+                    # arrays alive for this consumer, while the catalog
+                    # stays free to spill the parked copy between
+                    # consumers (a held pin would make the whole shared
+                    # table permanently unspillable — review finding)
+                    sb.unpin()
+                    yield b
+                return
             yield from self._device_batches(rbs)
         else:
             for rb in rbs:

@@ -62,13 +62,35 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jax.Array,
 def compact(batch: ColumnBatch, keep: jax.Array) -> ColumnBatch:
     """Filter: keep rows where ``keep`` (bool[capacity]) is True.
 
-    Order-preserving via stable argsort on the drop flag.  Padding and rows
-    beyond ``num_rows`` are always dropped.
+    Order-preserving front-pack via exclusive-scan + scatter: kept row i
+    lands at cumsum(keep)[i]-1, dropped rows scatter out of bounds and
+    are discarded (mode='drop').  O(n) — the previous stable-argsort
+    formulation cost a full O(n log n) multi-pass sort per filter, which
+    dominated multi-branch scan-filter-agg plans (TPC-DS q28: 12
+    filtered branches).  Padding and rows beyond ``num_rows`` are
+    always dropped; scatter into zero-initialized outputs reproduces
+    the zeroed-padding invariant directly.
     """
     keep = keep & batch.row_mask()
-    perm = jnp.argsort(~keep, stable=True)
+    cap = batch.capacity
+    dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    idx = jnp.where(keep, dest, cap)  # cap = out of bounds -> dropped
     new_count = jnp.sum(keep, dtype=jnp.int32)
-    cols = gather_columns(batch.columns, perm, new_count)
+    cols = []
+    for c in batch.columns:
+        validity = jnp.zeros(cap, jnp.bool_).at[idx].set(
+            c.validity, mode="drop")
+        data = jnp.zeros_like(c.data).at[idx].set(
+            jnp.where((keep & c.validity)[(...,) + (None,) *
+                                          (c.data.ndim - 1)],
+                      c.data, jnp.zeros((), c.data.dtype)),
+            mode="drop")
+        if c.is_var_width:
+            lengths = jnp.zeros(cap, jnp.int32).at[idx].set(
+                jnp.where(keep & c.validity, c.lengths, 0), mode="drop")
+            cols.append(DeviceColumn(data, validity, c.dtype, lengths))
+        else:
+            cols.append(DeviceColumn(data, validity, c.dtype))
     return ColumnBatch(cols, new_count, batch.schema)
 
 

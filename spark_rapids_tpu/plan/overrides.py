@@ -467,6 +467,7 @@ class TpuOverrides:
         self._insert_coalesce(root)
         self._insert_transitions(root)
         self._align_mesh_outputs(root)
+        self._mark_shared_scans(root)
         explain_mode = self.conf.explain
         if explain and explain_mode and explain_mode != "NONE":
             text = self.explain(root, only_fallback=(explain_mode
@@ -479,6 +480,36 @@ class TpuOverrides:
 
     def apply(self, root: PlannedNode) -> PlanNode:
         return self.prepare(root, explain=True)
+
+    def _mark_shared_scans(self, root: PlannedNode) -> None:
+        """Scans whose (files, columns, pushdown) fingerprint appears
+        more than once in the final exec tree share one spillable
+        materialization per partition (io/scan.py share_output).
+        TPC-DS q28 reads store_sales 12x through its bucket branches —
+        without sharing, each instance re-decodes, re-encodes, and
+        re-transfers the same table (reference analog: ReuseExchange
+        over identical subtrees, here applied at the leaf)."""
+        from spark_rapids_tpu.conf import SCAN_REUSE
+        from spark_rapids_tpu.io.scan import FileScanExec
+        if not self.conf.get(SCAN_REUSE):
+            return
+        # count CONSUMPTIONS per fingerprint, not instances: a builder
+        # reusing one DataFrame makes the exec tree a DAG whose single
+        # scan object is pulled once per referencing branch — each pull
+        # re-executes without sharing
+        groups: dict = {}
+
+        def walk(n: PlanNode):
+            if isinstance(n, FileScanExec):
+                groups.setdefault(n.scan_fingerprint(), []).append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(root.exec_node)
+        for g in groups.values():
+            if len(g) > 1:
+                for n in g:
+                    n.share_output = True
 
     def root_backend(self, root: PlannedNode) -> str:
         return root.backend
