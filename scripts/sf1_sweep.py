@@ -45,6 +45,12 @@ from spark_rapids_tpu.bench.tpcds_queries import build_query
 name, data, rows_path = sys.argv[1], sys.argv[2], sys.argv[3]
 with open(rows_path) as f:
     device_rows = [tuple(r) for r in json.load(f)]
+# date/timestamp cells serialized via str(); normalize the oracle side
+# identically before comparison
+import datetime
+def _norm_cells(rows):
+    return [tuple(str(x) if isinstance(x, (datetime.date, datetime.datetime))
+                  else x for x in r) for r in rows]
 s = TpuSession({})
 df = build_query(name, s, data)
 plan = _plan_of(df)
@@ -52,7 +58,8 @@ t0 = time.perf_counter()
 oracle = _collect_rows(df, "host", plan)
 dt = time.perf_counter() - t0
 print("ORACLE_RESULT:" + json.dumps(
-    {"oracle_s": round(dt, 4), "ok": _rows_match(device_rows, oracle)}))
+    {"oracle_s": round(dt, 4),
+     "ok": _rows_match(device_rows, _norm_cells(oracle))}))
 """
 
 
@@ -60,7 +67,8 @@ def _oracle_subprocess(name: str, device_rows) -> dict | None:
     """SF1 oracle under the cap; None when the cap fires."""
     with tempfile.NamedTemporaryFile("w", suffix=".json",
                                      delete=False) as f:
-        json.dump([list(r) for r in device_rows], f)
+        json.dump([list(r) for r in device_rows], f,
+                  default=str)
         rows_path = f.name
     try:
         p = subprocess.Popen(
