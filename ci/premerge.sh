@@ -24,6 +24,18 @@ mkdir -p artifacts
   echo "== premerge @ ${STAMP} (commit $(git rev-parse --short HEAD)) =="
   echo "-- unit + differential suite (CPU mesh) --"
   python -m pytest tests/ -q --durations=10
+  echo "-- shuffle fault-tolerance chaos suite (seeded, CPU-only) --"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_shuffle_fault_tolerance.py -q
+  # the fault registry must be INERT when spark.rapids.test.faults is
+  # unset: no registry object, so every injection site is one None check
+  JAX_PLATFORMS=cpu python - <<'PY'
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.faults import FaultRegistry
+assert FaultRegistry.from_conf(TpuConf({})) is None, \
+    "fault registry must be inert when spark.rapids.test.faults is unset"
+assert FaultRegistry.from_conf(None) is None
+print("fault registry inert without spark.rapids.test.faults: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -242,6 +242,22 @@ METRICS_ENABLED = bool_conf(
     "spark.rapids.sql.metrics.enabled", True,
     "Collect per-operator metrics (rows/batches/time). (ref GpuExec.scala:47-55)")
 
+TEST_FAULTS = conf(
+    "spark.rapids.test.faults", "",
+    "Deterministic fault-injection plan: 'point:action,k=v;...' rules "
+    "interpreted by spark_rapids_tpu/faults.py and threaded through the "
+    "TCP shuffle server/client, the local shuffle store, and the spill "
+    "path. Empty (the default) builds no registry at all, so every "
+    "injection site is a single None check. Test-only: never set in "
+    "production. (reference: RapidsShuffleTestHelper exercises failure "
+    "paths with mocked transports; here the REAL transport runs under "
+    "seeded faults)")
+
+TEST_FAULTS_SEED = int_conf(
+    "spark.rapids.test.faults.seed", 0,
+    "Seed for the fault plan's per-rule PRNGs (probabilistic triggers, "
+    "corrupted-byte selection), so a chaos run replays identically.")
+
 
 class TpuConf:
     """An immutable snapshot of settings, queried through typed entries.

@@ -454,9 +454,18 @@ class RemoteShuffleReaderExec(PlanNode):
         return self._num_parts
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
-        from spark_rapids_tpu.shuffle.tcp import fetch_remote
-        yield from fetch_remote(self.address, self.shuffle_id, pid,
-                                device=ctx.is_device)
+        # the retrying fetch (shuffle/retry.py): transient peer failures
+        # reconnect and resume mid-partition instead of killing the
+        # whole reduce-side pull (reference: RapidsShuffleIterator
+        # surfacing fetch failures to stage retry).  One fault registry
+        # per execution so nth/times counters span all pulls.
+        from spark_rapids_tpu.faults import FaultRegistry
+        from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+        faults = ctx.cached(("fault_registry",),
+                            lambda: FaultRegistry.from_conf(ctx.conf))
+        yield from fetch_remote_with_retry(self.address, self.shuffle_id,
+                                           pid, device=ctx.is_device,
+                                           conf=ctx.conf, faults=faults)
 
     def node_desc(self) -> str:
         return (f"RemoteShuffleReaderExec[{self.address[0]}:"

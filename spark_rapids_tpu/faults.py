@@ -1,0 +1,172 @@
+"""Deterministic, conf-driven fault injection for robustness testing.
+
+Reference motivation (SURVEY §2.6): the UCX shuffle plane survives
+transport failures by surfacing them to Spark's stage-retry machinery
+(RapidsShuffleIterator), and the reference proves that behavior with
+mocked transports (RapidsShuffleTestHelper.scala:26-95).  Here the REAL
+server/client/store/spill code runs under seeded faults instead: the
+engine carries injection points that are inert (a single ``is None``
+check) unless ``spark.rapids.test.faults`` names a plan, so robustness
+behavior is testable in-process on CPU with no cluster and no mocks.
+
+Spec grammar (``spark.rapids.test.faults``)::
+
+    spec  := rule (';' rule)*
+    rule  := point ':' action (',' key '=' value)*
+
+Injection points wired today (site -> actions it interprets):
+
+    tcp.server.frame    per outgoing data frame (ctx: shuffle, part,
+                        frame).  Actions: ``reset`` (abrupt connection
+                        close mid-stream), ``stall`` (sleep ``seconds``
+                        before sending, to trip the client timeout),
+                        ``corrupt`` (flip one seeded byte of the wire
+                        payload AFTER the checksum was computed —
+                        in-transit corruption), ``error`` (send a
+                        server error frame instead of data).
+    tcp.client.connect  before dialing a peer (ctx: host, port).
+                        Action ``reset`` fails the dial.
+    store.fetch         local shuffle store reads (ctx: shuffle, part).
+                        Action ``error`` raises from the store — over
+                        TCP it reaches the client as an error frame.
+    memory.oom          run_with_spill_retry dispatch (ctx: op).
+                        Action ``oom`` raises a simulated XLA
+                        RESOURCE_EXHAUSTED, driving the spill-retry
+                        loop exactly like a real HBM exhaustion.
+
+Trigger keys (all optional):
+
+    nth=N      first eligible hit that fires (1-based, default 1) —
+               "reset after 2 frames" is ``nth=3`` on a frame point
+    times=N    how many hits fire once triggered (default 1 so a retry
+               can succeed; 0 = every hit forever)
+    p=F        per-hit probability, drawn from the rule's seeded PRNG
+    seconds=F  action parameter (stall duration)
+
+Any other ``key=value`` is a FILTER compared (as strings) against the
+call-site context, e.g. ``shuffle=9,part=0`` scopes a rule to one
+partition stream and ``frame=2`` fires on the third frame regardless of
+how many eligible hits preceded it.
+
+Determinism: every rule owns a ``random.Random`` seeded from
+``spark.rapids.test.faults.seed`` plus the rule's index and text, so a
+fault plan replays identically run to run and process to process.
+Counters live on the registry instance — components build ONE registry
+at construction (transport, catalog), so a ``times=1`` rule fires once
+per component lifetime, not once per fetch attempt.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from spark_rapids_tpu.conf import TEST_FAULTS, TEST_FAULTS_SEED
+
+__all__ = ["FaultRegistry", "FaultRule", "FaultAction", "InjectedFault"]
+
+#: keys with registry-level meaning; everything else in a rule is a
+#: context filter
+_RESERVED = ("nth", "times", "p", "seconds")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by injection sites whose action surfaces as an error."""
+
+
+class FaultRule:
+    def __init__(self, index: int, text: str, seed: int):
+        self.text = text
+        point, _, rest = text.partition(":")
+        self.point = point.strip()
+        if not self.point or not rest.strip():
+            raise ValueError(f"fault rule {text!r}: want 'point:action"
+                             "[,k=v...]'")
+        parts = [p.strip() for p in rest.split(",")]
+        self.action = parts[0]
+        self.params: dict[str, str] = {}
+        for kv in parts[1:]:
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"fault rule {text!r}: bad param {kv!r}")
+            self.params[k.strip()] = v.strip()
+        self.nth = int(self.params.get("nth", 1))
+        self.times = int(self.params.get("times", 1))
+        self.p = float(self.params.get("p", 1.0))
+        self.filters = {k: v for k, v in self.params.items()
+                        if k not in _RESERVED}
+        self.rng = random.Random(f"{seed}:{index}:{text}")
+        self.hits = 0
+        self.fired = 0
+
+    def _try_fire(self, ctx: dict) -> bool:
+        for k, v in self.filters.items():
+            if k not in ctx or str(ctx[k]) != v:
+                return False
+        self.hits += 1
+        if self.hits < self.nth:
+            return False
+        if self.times > 0 and self.fired >= self.times:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultAction:
+    """What an injection site got back: the action name, its params,
+    and the rule's seeded PRNG (for e.g. picking the corrupted byte)."""
+
+    __slots__ = ("point", "action", "params", "rng")
+
+    def __init__(self, rule: FaultRule):
+        self.point = rule.point
+        self.action = rule.action
+        self.params = rule.params
+        self.rng = rule.rng
+
+    def param(self, key: str, default: float) -> float:
+        return float(self.params.get(key, default))
+
+
+class FaultRegistry:
+    """Parsed fault plan + firing state.  Thread-safe: the TCP server
+    checks points from its per-connection threads."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.rules = [FaultRule(i, r.strip(), seed)
+                      for i, r in enumerate(spec.split(";")) if r.strip()]
+        self._lock = threading.Lock()
+        #: audit log of fired injections: (point, action, ctx)
+        self.log: list[tuple[str, str, dict]] = []
+
+    @classmethod
+    def from_conf(cls, conf) -> "FaultRegistry | None":
+        """None (inert) unless spark.rapids.test.faults is set.  Accepts
+        a TpuConf or a raw settings dict."""
+        if conf is None:
+            return None
+        settings = conf.settings if hasattr(conf, "settings") else dict(conf)
+        spec = TEST_FAULTS.get(settings)
+        if not spec:
+            return None
+        return cls(spec, TEST_FAULTS_SEED.get(settings))
+
+    def check(self, point: str, **ctx) -> FaultAction | None:
+        """Called by an injection site; returns the action to perform
+        when a rule on this point matches and its trigger fires."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point:
+                    continue
+                if rule._try_fire(ctx):
+                    self.log.append((point, rule.action, dict(ctx)))
+                    return FaultAction(rule)
+        return None
+
+    def fired_count(self, point: str | None = None) -> int:
+        with self._lock:
+            return len([1 for p, _, _ in self.log
+                        if point is None or p == point])

@@ -116,6 +116,11 @@ class BufferCatalog:
             self._arena_shared = True
         self._spill_dir_base = spill_dir
         self._spill_dir_made: str | None = None
+        # deterministic fault plan (spark.rapids.test.faults): the
+        # memory.oom point drives run_with_spill_retry exactly like a
+        # real XLA RESOURCE_EXHAUSTED; None when unset (inert)
+        from spark_rapids_tpu.faults import FaultRegistry
+        self.faults = FaultRegistry.from_conf(settings)
         self.metrics = {"device_spills": 0, "host_spills": 0,
                         "bytes_spilled_to_host": 0,
                         "bytes_spilled_to_disk": 0}
@@ -444,9 +449,20 @@ def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
                          **kwargs):
     """Dispatch ``fn(*args, **kwargs)``; on XLA OOM spill from the catalog
     and retry (the DeviceMemoryEventHandler.onAllocFailure loop)."""
+    faults = getattr(catalog, "faults", None)
     attempt = 0
     while True:
         try:
+            if faults is not None:
+                act = faults.check("memory.oom",
+                                   op=getattr(fn, "__name__", str(fn)))
+                if act is not None:
+                    # same shape as a real XLA HBM exhaustion so the
+                    # handler below spills and retries, proving the
+                    # recovery path without a real device
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected fault: simulated "
+                        "HBM OOM (spark.rapids.test.faults memory.oom)")
             out = fn(*args, **kwargs)
             if _sync_dispatch():
                 jax.block_until_ready(jax.tree_util.tree_leaves(out))

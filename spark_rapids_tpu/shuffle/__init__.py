@@ -10,6 +10,12 @@ partition bytes to consumers.  `LocalShuffleTransport` (shuffle/local.py)
 is the single-process plane; the mesh collective path (parallel/
 mesh_shuffle.py) is the ICI plane the planner picks for mesh-sharded
 plans.
+
+Fault tolerance: cross-process pulls go through shuffle/retry.py —
+resumable retrying fetches (exponential backoff + jitter, per-peer
+circuit breaker) over tcp.py's checksummed frame protocol; the
+deterministic fault-injection plan (spark.rapids.test.faults,
+spark_rapids_tpu/faults.py) exercises every failure path in-process.
 """
 from __future__ import annotations
 

@@ -33,11 +33,16 @@ class LocalShuffleTransport:
     """In-process ShuffleTransport (see shuffle/__init__.py SPI)."""
 
     def __init__(self, conf: TpuConf, ctx=None):
+        from spark_rapids_tpu.faults import FaultRegistry
         self.conf = conf
         self.ctx = ctx
         self.codec_name = conf.get(SHUFFLE_COMPRESSION_CODEC)
         self.codec = get_codec(self.codec_name)
         self.max_metadata = conf.get(SHUFFLE_MAX_METADATA_SIZE)
+        # deterministic fault plan (spark.rapids.test.faults; None when
+        # unset = every injection site is one is-None check).  One
+        # registry per transport so nth/times counters span its lifetime.
+        self.faults = FaultRegistry.from_conf(conf)
         self._lock = threading.Lock()
         # (shuffle_id, part_id) -> list of stored items in map order
         self._store: dict[tuple, list] = {}
@@ -93,6 +98,7 @@ class LocalShuffleTransport:
         """Stream one reduce partition's batches, optionally only the
         map-batch slice [lo, hi) — the adaptive reader's skew-split
         groups fetch their own range without materializing the rest."""
+        self._check_fetch_fault(shuffle_id, part_id)
         with self._lock:
             items = list(self._store.get((shuffle_id, part_id), ()))
         for item in items[lo:hi]:
@@ -121,6 +127,7 @@ class LocalShuffleTransport:
         RapidsShuffleServer: acquire from catalog -> copy to bounce
         buffer -> send)."""
         import struct
+        self._check_fetch_fault(shuffle_id, part_id)
         with self._lock:
             items = list(self._store.get((shuffle_id, part_id), ()))
         for item in items[lo:hi]:
@@ -141,6 +148,19 @@ class LocalShuffleTransport:
                     yield struct.pack(">I", raw_size) + data
                 else:
                     yield data
+
+    def _check_fetch_fault(self, shuffle_id, part_id) -> None:
+        """store.fetch injection point: a simulated store failure — over
+        the TCP plane it reaches the client as an error frame, exactly
+        like a real catalog/codec failure would."""
+        if self.faults is not None:
+            from spark_rapids_tpu.faults import InjectedFault
+            act = self.faults.check("store.fetch", shuffle=shuffle_id,
+                                    part=part_id)
+            if act is not None:
+                raise InjectedFault(
+                    f"injected fault: store.fetch {act.action} "
+                    f"(shuffle={shuffle_id} part={part_id})")
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,249 @@
+"""Resumable retrying shuffle fetches + per-peer circuit breaker.
+
+Reference mapping (SURVEY §2.6): the UCX client surfaces every
+transport failure to Spark's stage-retry machinery instead of wedging
+the reduce task (RapidsShuffleIterator; fetch deadline via
+spark.network.timeout), and Spark's own block transfer layer retries at
+the transport level first (RetryingBlockTransferor behind
+spark.shuffle.io.maxRetries/retryWait).  The TPU engine has no Spark
+scheduler above it, so the transport-level ladder lives HERE:
+
+* ``fetch_remote_with_retry`` wraps the raw ``fetch_remote`` stream in
+  an exponential-backoff + jitter loop.  On reconnect it RESUMES the
+  partition stream at ``lo + delivered`` using the protocol's existing
+  lo/hi map-batch range fields — a batch is counted delivered only
+  after it was fully received, checksum-verified, and yielded, so a
+  retry never duplicates or drops a batch.  Progress resets the
+  ladder: a reconnect that delivered at least one new batch starts
+  again from zero failed attempts, so a long stream cannot exhaust its
+  retries across many independent hiccups.
+* ``remote_partition_sizes_with_retry`` gives the metadata plane the
+  same ladder.
+* A per-peer circuit breaker counts CONSECUTIVE failed attempts across
+  all fetches to that peer; past the threshold, further fetches fail
+  fast with a diagnosable error (peer, failure count, last cause)
+  instead of burning the full backoff ladder per partition against a
+  dead host.  After ``circuitBreaker.resetSeconds`` one probe attempt
+  is allowed through (half-open); success closes the breaker.
+
+With no faults and a healthy peer the success path is exactly ONE
+``fetch_remote`` call — the retry layer adds no round trips.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Iterator
+
+from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.shuffle.tcp import (TCP_CHECKSUM, TCP_INFLIGHT_LIMIT,
+                                          TCP_TIMEOUT, ShuffleFetchError,
+                                          _max_frame, fetch_remote,
+                                          remote_partition_sizes)
+
+__all__ = ["fetch_remote_with_retry", "remote_partition_sizes_with_retry",
+           "PeerCircuitBreaker", "reset_circuit_breakers"]
+
+TCP_MAX_RETRIES = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.maxRetries", 3,
+    "Transport-level retries per shuffle fetch before the failure "
+    "propagates to the caller. Each retry reconnects and RESUMES the "
+    "partition stream from the last fully-delivered batch (the "
+    "protocol's lo/hi range fields), so no batch is duplicated or "
+    "dropped; an attempt that delivers at least one new batch resets "
+    "the ladder. (reference: spark.shuffle.io.maxRetries, "
+    "RetryingBlockTransferor)", conv=int))
+TCP_RETRY_WAIT = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.retryWaitSeconds", 0.5,
+    "Base wait before the first shuffle-fetch retry; each further "
+    "retry multiplies it by retryBackoffMultiplier, with +-50% "
+    "deterministic jitter so a burst of reduce tasks does not "
+    "reconnect in lockstep. (reference: spark.shuffle.io.retryWait)",
+    conv=float))
+TCP_RETRY_BACKOFF = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.retryBackoffMultiplier", 2.0,
+    "Multiplier applied to retryWaitSeconds per consecutive failed "
+    "shuffle-fetch attempt (exponential backoff).", conv=float))
+TCP_BREAKER_FAILURES = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.circuitBreaker.maxFailures", 8,
+    "Consecutive failed fetch attempts against one peer (across all "
+    "partitions) that trip its circuit breaker: further fetches fail "
+    "fast with a diagnosable error instead of burning the full backoff "
+    "ladder per partition against a dead peer. Any success resets the "
+    "count.", conv=int))
+TCP_BREAKER_RESET = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.circuitBreaker.resetSeconds", 30.0,
+    "Cooldown after a peer's circuit breaker opens before ONE probe "
+    "attempt is allowed through (half-open); a successful probe closes "
+    "the breaker, a failed one re-opens it for another cooldown.",
+    conv=float))
+
+
+class PeerCircuitBreaker:
+    """Consecutive-failure counter for one peer address."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self._lock = threading.Lock()
+        self.failures = 0
+        self.last_error: str | None = None
+        self._opened_at: float | None = None
+
+    def before_attempt(self, reset_seconds: float) -> None:
+        """Fail fast while open; allow one probe after the cooldown."""
+        with self._lock:
+            if self._opened_at is None:
+                return
+            age = time.monotonic() - self._opened_at
+            if age < reset_seconds:
+                raise ShuffleFetchError(
+                    f"circuit breaker open for shuffle peer {self.peer}: "
+                    f"{self.failures} consecutive fetch failures "
+                    f"(last: {self.last_error}); next probe in "
+                    f"{reset_seconds - age:.1f}s")
+            # half-open: let this attempt probe the peer
+
+    def record_failure(self, err: BaseException, threshold: int) -> None:
+        with self._lock:
+            self.failures += 1
+            self.last_error = f"{type(err).__name__}: {err}"
+            if self.failures >= threshold:
+                self._opened_at = time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self.last_error = None
+            self._opened_at = None
+
+
+_BREAKERS: dict = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def _breaker(peer) -> PeerCircuitBreaker:
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(peer)
+        if b is None:
+            b = _BREAKERS[peer] = PeerCircuitBreaker(peer)
+        return b
+
+
+def reset_circuit_breakers() -> None:
+    """Forget all peer state (tests; a deliberate cluster-topology
+    change where old addresses are known stale)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def _settings(conf) -> dict:
+    return conf.settings if conf is not None else {}
+
+
+def remote_partition_sizes_with_retry(address, shuffle_id: "int | str",
+                                      conf=None, timeout: float | None = None,
+                                      max_retries: int | None = None,
+                                      retry_wait: float | None = None,
+                                      backoff: float | None = None,
+                                      faults=None) -> tuple[dict, dict]:
+    """Metadata plane with the same retry ladder + circuit breaker as
+    the data plane."""
+    s = _settings(conf)
+    max_retries = TCP_MAX_RETRIES.get(s) if max_retries is None \
+        else int(max_retries)
+    retry_wait = TCP_RETRY_WAIT.get(s) if retry_wait is None \
+        else float(retry_wait)
+    backoff = TCP_RETRY_BACKOFF.get(s) if backoff is None else float(backoff)
+    if timeout is None:
+        timeout = TCP_TIMEOUT.get(s)
+    threshold = TCP_BREAKER_FAILURES.get(s)
+    reset_s = TCP_BREAKER_RESET.get(s)
+    peer = tuple(address)
+    breaker = _breaker(peer)
+    rng = random.Random(f"meta:{peer}:{shuffle_id}")
+    attempt = 0
+    while True:
+        breaker.before_attempt(reset_s)
+        try:
+            out = remote_partition_sizes(peer, shuffle_id, timeout=timeout,
+                                         faults=faults)
+            breaker.record_success()
+            return out
+        except ShuffleFetchError as e:
+            breaker.record_failure(e, threshold)
+            attempt += 1
+            if attempt > max_retries:
+                raise ShuffleFetchError(
+                    f"metadata fetch of shuffle {shuffle_id} from {peer}: "
+                    f"giving up after {attempt} attempts: {e}") from e
+            _backoff_sleep(retry_wait, backoff, attempt, rng)
+
+
+def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
+                            lo: int = 0, hi: int | None = None,
+                            device: bool = True, conf=None, faults=None,
+                            inflight_limit: int | None = None,
+                            max_frame: int | None = None,
+                            timeout: float | None = None,
+                            checksum: bool | None = None,
+                            max_retries: int | None = None,
+                            retry_wait: float | None = None,
+                            backoff: float | None = None) -> Iterator:
+    """Stream one reduce partition's batches, surviving transport
+    failures: on a retryable error, reconnect with exponential backoff
+    + jitter and resume at the last fully-delivered batch offset."""
+    s = _settings(conf)
+    max_retries = TCP_MAX_RETRIES.get(s) if max_retries is None \
+        else int(max_retries)
+    retry_wait = TCP_RETRY_WAIT.get(s) if retry_wait is None \
+        else float(retry_wait)
+    backoff = TCP_RETRY_BACKOFF.get(s) if backoff is None else float(backoff)
+    if inflight_limit is None:
+        inflight_limit = TCP_INFLIGHT_LIMIT.get(s)
+    if max_frame is None:
+        max_frame = _max_frame(conf)
+    if timeout is None:
+        timeout = TCP_TIMEOUT.get(s)
+    if checksum is None:
+        checksum = TCP_CHECKSUM.get(s)
+    threshold = TCP_BREAKER_FAILURES.get(s)
+    reset_s = TCP_BREAKER_RESET.get(s)
+    peer = tuple(address)
+    breaker = _breaker(peer)
+    rng = random.Random(f"fetch:{peer}:{shuffle_id}:{part_id}")
+    delivered = 0     # batches fully yielded downstream, across attempts
+    failures = 0      # consecutive failed attempts with NO new batches
+    while True:
+        breaker.before_attempt(reset_s)
+        before = delivered
+        try:
+            for batch in fetch_remote(peer, shuffle_id, part_id,
+                                      lo=lo + delivered, hi=hi,
+                                      device=device,
+                                      inflight_limit=inflight_limit,
+                                      max_frame=max_frame, timeout=timeout,
+                                      checksum=checksum, faults=faults):
+                yield batch
+                delivered += 1
+            breaker.record_success()
+            return
+        except ShuffleFetchError as e:
+            breaker.record_failure(e, threshold)
+            failures = 1 if delivered > before else failures + 1
+            if failures > max_retries:
+                raise ShuffleFetchError(
+                    f"fetch of shuffle {shuffle_id} part {part_id} from "
+                    f"{peer}: giving up after {failures} consecutive "
+                    f"failed attempts ({delivered} batches delivered, "
+                    f"resume offset {lo + delivered}): {e}") from e
+            _backoff_sleep(retry_wait, backoff, failures, rng)
+
+
+def _backoff_sleep(base: float, mult: float, attempt: int,
+                   rng: random.Random) -> None:
+    """attempt-th (1-based) backoff: base * mult^(attempt-1), jittered
+    to [0.5x, 1.5x) from the caller's deterministically-seeded PRNG."""
+    pause = base * (mult ** (attempt - 1)) * (0.5 + rng.random())
+    if pause > 0:
+        time.sleep(pause)

@@ -11,21 +11,30 @@ partition ranges on demand, and peers fetch with a length-prefixed,
 type-tagged frame protocol:
 
     request  (JSON frame): {"op": "fetch", "shuffle_id": .., "part_id":
-              .., "lo": .., "hi": .., "window": <client ack window>}
+              .., "lo": .., "hi": .., "window": <client ack window>,
+              "crc": [<checksum algos the client can verify>]}
               | {"op": "meta", "shuffle_id": ..}
     response: [8-byte big-endian length][1-byte tag][payload] frames:
               tag 0x03 = JSON header/metadata (fetch headers carry the
-              server's codec, so compression is negotiated, not
-              assumed), 0x00 = batch data (Arrow IPC bytes, codec-
-              compressed with a 4-byte raw-size prefix when the header
-              says so), 0x01 = end of stream, 0x02 = server-side error
-              (payload is the message — a store failure reaches the
-              client as a diagnosable ShuffleFetchError, not a
-              connection reset).
+              server's codec and its checksum pick, so compression AND
+              integrity are negotiated, not assumed), 0x00 = batch data
+              (Arrow IPC bytes, codec-compressed with a 4-byte raw-size
+              prefix when the header says so, prefixed with a 4-byte
+              CRC32C/CRC32 when a checksum was negotiated), 0x01 = end
+              of stream, 0x02 = server-side error (payload is the
+              message — a store failure reaches the client as a
+              diagnosable ShuffleFetchError, not a connection reset).
 
 The server throttles at the CLIENT-declared ``window`` (carried in the
 request), so both endpoints count the same bytes and a conf mismatch
-cannot deadlock the ack exchange.
+cannot deadlock the ack exchange.  Request/ack frames are capped at 64
+KiB (``_MAX_CTRL_FRAME``): a desynced peer lying in a control frame's
+length prefix cannot make the server attempt a multi-GiB allocation.
+Transport failures (reset, stall past the deadline, checksum mismatch)
+raise the retryable ``ShuffleTransportError``; shuffle/retry.py wraps
+the client in a resumable backoff ladder with a per-peer circuit
+breaker, and spark_rapids_tpu/faults.py can inject failures
+deterministically at every seam.
 
 Within a slice the mesh collective path (parallel/mesh_shuffle.py) is
 the ICI plane; this module is the inter-process/DCN plane.  The
@@ -38,15 +47,17 @@ import json
 import socket
 import struct
 import threading
+import time
+import zlib
 from typing import Iterable
 
-from spark_rapids_tpu.conf import ConfEntry, register, parse_bytes
+from spark_rapids_tpu.conf import ConfEntry, register, parse_bytes, _bool
 from spark_rapids_tpu.shuffle.compression import get_codec
 from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
 from spark_rapids_tpu.shuffle.serializer import deserialize_batch
 
 __all__ = ["TcpShuffleTransport", "TcpShuffleServer", "ShuffleFetchError",
-           "fetch_remote", "remote_partition_sizes"]
+           "ShuffleTransportError", "fetch_remote", "remote_partition_sizes"]
 
 TCP_PORT = register(ConfEntry(
     "spark.rapids.shuffle.tcp.port", 0,
@@ -77,6 +88,17 @@ TCP_TIMEOUT = register(ConfEntry(
     "(reference: fetch timeout via spark.network.timeout, "
     "GpuShuffleEnv.scala:60-62, propagated through "
     "RapidsShuffleIterator).", conv=float))
+TCP_CHECKSUM = register(ConfEntry(
+    "spark.rapids.shuffle.tcp.checksumEnabled", True,
+    "Per-data-frame integrity checksum (CRC32C when the C binding is "
+    "available, CRC32 otherwise), negotiated through the fetch header "
+    "so old/new peers interoperate: the client advertises the "
+    "algorithms it knows, the server echoes its pick and prefixes each "
+    "frame with the 4-byte checksum. Corruption surfaces as a "
+    "retryable ShuffleFetchError at the frame boundary instead of a "
+    "poisoned Arrow deserialize. (reference: UCX delegates integrity "
+    "to the fabric; a DCN-style TCP plane must carry its own)",
+    conv=_bool))
 
 _LEN = struct.Struct(">Q")
 _TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
@@ -85,6 +107,23 @@ _TAG_DATA, _TAG_END, _TAG_ERROR, _TAG_JSON = b"\x00", b"\x01", b"\x02", b"\x03"
 #: configs stay fetchable while a desynced/non-protocol peer still gets
 #: a clean error instead of a garbage-length allocation
 _MAX_FRAME_MIN = 2 << 30
+#: request/ack frames are small JSON — a desynced or malicious peer
+#: must not be able to make the server attempt a multi-GiB allocation
+#: by lying in a control frame's length prefix
+_MAX_CTRL_FRAME = 64 << 10
+
+#: frame checksum algorithms this endpoint can verify, in preference
+#: order; negotiation picks the first name both peers know, so a build
+#: without the C crc32c binding still interoperates via zlib's crc32
+_CRC_ALGOS: dict = {}
+try:
+    import google_crc32c as _gcrc32c
+
+    _CRC_ALGOS["crc32c"] = _gcrc32c.value
+except ImportError:  # pragma: no cover - env without the binding
+    pass
+_CRC_ALGOS["crc32"] = zlib.crc32
+_CRC = struct.Struct(">I")
 
 
 def _max_frame(conf=None) -> int:
@@ -95,6 +134,12 @@ def _max_frame(conf=None) -> int:
 
 class ShuffleFetchError(RuntimeError):
     """A peer reported a server-side failure while serving a fetch."""
+
+
+class ShuffleTransportError(ShuffleFetchError):
+    """The transport itself failed (reset, stall past the timeout,
+    desynced or corrupted frame) — always retryable: the map output is
+    still intact at the peer, only this connection's stream died."""
 
 
 def _send_frame(sock: socket.socket, tag: bytes, payload: bytes = b"") -> None:
@@ -129,6 +174,12 @@ class TcpShuffleServer:
     def __init__(self, store: LocalShuffleTransport, bind: str = "127.0.0.1",
                  port: int = 0, advertise: str = ""):
         self._store = store
+        # deterministic fault plan (spark.rapids.test.faults), owned by
+        # the store so counters span this server's whole lifetime
+        self._faults = getattr(store, "faults", None)
+        self.metrics = {"meta_requests": 0, "fetch_requests": 0,
+                        "data_frames_sent": 0, "bytes_sent": 0,
+                        "faults_injected": 0}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind, port))
@@ -154,7 +205,7 @@ class TcpShuffleServer:
             with conn:
                 while True:
                     try:
-                        _, body = _recv_frame(conn)
+                        _, body = _recv_frame(conn, _MAX_CTRL_FRAME)
                         req = json.loads(body.decode())
                     except (ConnectionError, ValueError):
                         return
@@ -172,6 +223,7 @@ class TcpShuffleServer:
 
     def _serve_one(self, conn: socket.socket, req: dict) -> None:
         if req.get("op") == "meta":
+            self.metrics["meta_requests"] += 1
             sizes = self._store.partition_sizes(req["shuffle_id"])
             batches = {str(p): self._store.batch_sizes(req["shuffle_id"], p)
                        for p in sizes}
@@ -184,19 +236,58 @@ class TcpShuffleServer:
             _send_frame(conn, _TAG_ERROR,
                         f"unknown op {req.get('op')!r}".encode())
             return
+        self.metrics["fetch_requests"] += 1
         window = int(req.get("window") or TCP_INFLIGHT_LIMIT.default)
-        _send_frame(conn, _TAG_JSON, json.dumps(
-            {"codec": self._store.codec_name}).encode())
+        # checksum negotiation: the client advertises the algorithms it
+        # can verify; pick the first this server also knows and echo it
+        # in the header.  An old peer sends/understands no "crc" key and
+        # gets the unprefixed frames it expects.
+        offered = req.get("crc") or []
+        if isinstance(offered, str):
+            offered = [offered]
+        crc_name = next((n for n in offered if n in _CRC_ALGOS), None)
+        header = {"codec": self._store.codec_name}
+        if crc_name is not None:
+            header["crc"] = crc_name
+        crc_fn = _CRC_ALGOS.get(crc_name)
+        _send_frame(conn, _TAG_JSON, json.dumps(header).encode())
         sent_window = 0
-        for raw in self._store.fetch_partition_serialized(
+        for i, raw in enumerate(self._store.fetch_partition_serialized(
                 req["shuffle_id"], req["part_id"],
-                req.get("lo", 0), req.get("hi")):
-            _send_frame(conn, _TAG_DATA, raw)
-            sent_window += len(raw)
+                req.get("lo", 0), req.get("hi"))):
+            payload = raw if crc_fn is None else \
+                _CRC.pack(crc_fn(raw) & 0xFFFFFFFF) + raw
+            if self._faults is not None:
+                act = self._faults.check(
+                    "tcp.server.frame", shuffle=req["shuffle_id"],
+                    part=req["part_id"], frame=i)
+                if act is not None:
+                    self.metrics["faults_injected"] += 1
+                    if act.action == "reset":
+                        # abrupt mid-stream close: the client sees a
+                        # peer reset, never an END or error frame
+                        raise ConnectionError("injected fault: reset")
+                    if act.action == "error":
+                        _send_frame(conn, _TAG_ERROR,
+                                    b"injected fault: server error frame")
+                        return
+                    if act.action == "stall":
+                        time.sleep(act.param("seconds", 5.0))
+                    elif act.action == "corrupt":
+                        # flip one seeded byte AFTER the checksum was
+                        # computed: in-transit corruption as the client
+                        # verifier sees it
+                        flipped = bytearray(payload)
+                        flipped[act.rng.randrange(len(flipped))] ^= 0xFF
+                        payload = bytes(flipped)
+            _send_frame(conn, _TAG_DATA, payload)
+            self.metrics["data_frames_sent"] += 1
+            self.metrics["bytes_sent"] += len(payload)
+            sent_window += len(payload)
             if sent_window >= window:
                 # wait for the client before sending further frames
                 # (inflight throttle at the client-declared window)
-                tag, _ = _recv_frame(conn)
+                tag, _ = _recv_frame(conn, _MAX_CTRL_FRAME)
                 if tag != _TAG_JSON:
                     return
                 sent_window = 0
@@ -226,17 +317,21 @@ class TcpShuffleTransport(LocalShuffleTransport):
             advertise=conf.get(TCP_ADVERTISE_ADDRESS))
         self.address = self._server.address
 
+    @property
+    def server_metrics(self) -> dict:
+        return self._server.metrics
+
     def fetch_from(self, address, shuffle_id: "int | str", part_id: int,
                    lo: int = 0, hi: int | None = None,
                    device: bool = True) -> Iterable:
-        """Client entry honoring this transport's conf: the fetch window
-        comes from spark.rapids.shuffle.tcp.maxBytesInFlight (reference:
-        the transport owns its inflight throttle, not the call site)."""
-        return fetch_remote(address, shuffle_id, part_id, lo=lo, hi=hi,
-                            device=device,
-                            inflight_limit=self.conf.get(TCP_INFLIGHT_LIMIT),
-                            max_frame=_max_frame(self.conf),
-                            timeout=self.conf.get(TCP_TIMEOUT))
+        """Client entry honoring this transport's conf: window, timeout,
+        checksum, and the retry/backoff/circuit-breaker ladder all come
+        from the conf (reference: the transport owns its inflight
+        throttle and its failure policy, not the call site)."""
+        from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+        return fetch_remote_with_retry(address, shuffle_id, part_id,
+                                       lo=lo, hi=hi, device=device,
+                                       conf=self.conf, faults=self.faults)
 
     def close(self) -> None:
         self._server.close()
@@ -250,20 +345,37 @@ def _resolve_timeout(timeout: float | None) -> float | None:
     return t if t > 0 else None
 
 
+def _check_connect_fault(faults, address) -> None:
+    if faults is not None:
+        act = faults.check("tcp.client.connect", host=address[0],
+                           port=address[1])
+        if act is not None:
+            raise ConnectionError("injected fault: connect reset")
+
+
 def remote_partition_sizes(address, shuffle_id: "int | str",
-                           timeout: float | None = None) -> tuple[dict, dict]:
+                           timeout: float | None = None,
+                           faults=None) -> tuple[dict, dict]:
     """Metadata plane: (partition_sizes, batch_sizes) from a peer
     (reference MetadataRequest/Response flatbuffer RPC).  A wedged peer
-    raises ShuffleFetchError after ``timeout`` seconds."""
+    raises ShuffleFetchError after ``timeout`` seconds; a reset or
+    mid-frame close is wrapped with the same context instead of leaking
+    a raw ConnectionError to the reduce task."""
     tmo = _resolve_timeout(timeout)
     try:
+        _check_connect_fault(faults, tuple(address))
         with socket.create_connection(tuple(address), timeout=tmo) as sock:
             _send_frame(sock, _TAG_JSON, json.dumps(
                 {"op": "meta", "shuffle_id": shuffle_id}).encode())
             tag, body = _recv_frame(sock)
     except TimeoutError as e:
-        raise ShuffleFetchError(
-            f"metadata fetch from {address} stalled past {tmo}s") from e
+        raise ShuffleTransportError(
+            f"metadata fetch of shuffle {shuffle_id} from {address} "
+            f"stalled past {tmo}s") from e
+    except (ConnectionError, OSError) as e:
+        raise ShuffleTransportError(
+            f"metadata fetch of shuffle {shuffle_id} from {address} "
+            f"failed: {type(e).__name__}: {e}") from e
     if tag == _TAG_ERROR:
         raise ShuffleFetchError(body.decode())
     meta = json.loads(body.decode())
@@ -275,30 +387,43 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                  hi: int | None = None, device: bool = True,
                  inflight_limit: int | None = None,
                  max_frame: int = _MAX_FRAME_MIN,
-                 timeout: float | None = None) -> Iterable:
+                 timeout: float | None = None,
+                 checksum: bool = True, faults=None) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
     (reference RapidsShuffleClient.scala: TransferRequest -> bounce
-    buffers -> reassembled device buffers).  The wire codec comes from
-    the server's response header — never assumed by the client.  A peer
-    that stalls past ``timeout`` seconds (connect, send, or receive)
-    raises ShuffleFetchError instead of wedging the reduce task;
-    timeout=0 disables the deadline."""
+    buffers -> reassembled device buffers).  The wire codec and frame
+    checksum come from the server's response header — never assumed by
+    the client.  Every transport failure — a stall past ``timeout``
+    (connect, send, or receive; 0 disables the deadline), a reset or
+    mid-frame close, a frame failing its negotiated checksum — raises
+    ShuffleTransportError (retryable; see shuffle/retry.py) instead of
+    wedging or poisoning the reduce task."""
     window = int(inflight_limit or TCP_INFLIGHT_LIMIT.default)
     tmo = _resolve_timeout(timeout)
     try:
+        _check_connect_fault(faults, tuple(address))
         with socket.create_connection(tuple(address), timeout=tmo) as sock:
-            _send_frame(sock, _TAG_JSON, json.dumps(
-                {"op": "fetch", "shuffle_id": shuffle_id,
-                 "part_id": part_id, "lo": lo, "hi": hi,
-                 "window": window}).encode())
+            req = {"op": "fetch", "shuffle_id": shuffle_id,
+                   "part_id": part_id, "lo": lo, "hi": hi,
+                   "window": window}
+            if checksum:
+                req["crc"] = list(_CRC_ALGOS)
+            _send_frame(sock, _TAG_JSON, json.dumps(req).encode())
             tag, body = _recv_frame(sock)
             if tag == _TAG_ERROR:
                 raise ShuffleFetchError(body.decode())
             if tag != _TAG_JSON:
-                raise ShuffleFetchError(f"bad fetch header tag {tag!r}")
-            codec = get_codec(json.loads(body.decode()).get("codec",
-                                                            "none"))
+                raise ShuffleTransportError(f"bad fetch header tag {tag!r}")
+            header = json.loads(body.decode())
+            codec = get_codec(header.get("codec", "none"))
+            crc_name = header.get("crc")
+            crc_fn = _CRC_ALGOS.get(crc_name)
+            if crc_name is not None and crc_fn is None:
+                raise ShuffleFetchError(
+                    f"peer {address} negotiated unknown frame checksum "
+                    f"{crc_name!r} (offered {list(_CRC_ALGOS)})")
             recv_window = 0
+            index = lo
             while True:
                 tag, frame = _recv_frame(sock, max_frame)
                 if tag == _TAG_END:
@@ -309,6 +434,20 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                 if recv_window >= window:
                     _send_frame(sock, _TAG_JSON, b"{}")
                     recv_window = 0
+                if crc_fn is not None:
+                    if len(frame) <= _CRC.size:
+                        raise ShuffleTransportError(
+                            f"malformed frame: {len(frame)} bytes with a "
+                            f"{crc_name} prefix negotiated")
+                    (want,) = _CRC.unpack(frame[:_CRC.size])
+                    frame = frame[_CRC.size:]
+                    got = crc_fn(frame) & 0xFFFFFFFF
+                    if got != want:
+                        raise ShuffleTransportError(
+                            f"frame {index} of shuffle {shuffle_id} part "
+                            f"{part_id} from {address} failed its "
+                            f"{crc_name} check (sent {want:#010x}, "
+                            f"computed {got:#010x}): corrupted in transit")
                 if codec is not None:
                     if len(frame) < 4:
                         raise ShuffleFetchError(
@@ -321,7 +460,12 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                             f"> max frame {max_frame}")
                     frame = codec.decompress(frame[4:], raw_size)
                 yield deserialize_batch(frame, device=device)
+                index += 1
     except TimeoutError as e:
-        raise ShuffleFetchError(
+        raise ShuffleTransportError(
             f"fetch of shuffle {shuffle_id} part {part_id} from "
             f"{address} stalled past {tmo}s") from e
+    except (ConnectionError, OSError) as e:
+        raise ShuffleTransportError(
+            f"fetch of shuffle {shuffle_id} part {part_id} from "
+            f"{address} failed: {type(e).__name__}: {e}") from e
