@@ -26,6 +26,12 @@ mkdir -p artifacts
   python -m pytest tests/ -q --durations=10
   echo "-- shuffle fault-tolerance chaos suite (seeded, CPU-only) --"
   JAX_PLATFORMS=cpu python -m pytest tests/test_shuffle_fault_tolerance.py -q
+  echo "-- OOM chaos suite: TPC-H under memory.oom.until_rows storm --"
+  # split-and-retry must return exact-oracle results with nonzero
+  # oom_splits, and retry_sync must recover flush-point OOMs with
+  # async dispatch (SRT_SYNC_DISPATCH=0 behavior)
+  JAX_PLATFORMS=cpu python -m pytest tests/test_oom_chaos.py \
+    tests/test_oom_retry.py -q
   # the fault registry must be INERT when spark.rapids.test.faults is
   # unset: no registry object, so every injection site is one None check
   JAX_PLATFORMS=cpu python - <<'PY'

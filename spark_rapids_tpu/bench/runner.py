@@ -43,6 +43,12 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None):
             agg = metrics_out.setdefault(name, {})
             for k, v in m.values.items():
                 agg[k] = round(agg.get(k, 0.0) + v, 4)
+        cat = ctx.cache.get("catalog")
+        if cat is not None:
+            # memory-plane counters (spills, oom_retries/oom_splits,
+            # device_bytes_peak) live on the BufferCatalog, not on any
+            # one exec — report them alongside the per-exec metrics
+            metrics_out["BufferCatalog"] = dict(cat.metrics)
         return out
 
 

@@ -374,22 +374,47 @@ class HashAggregateExec(PlanNode):
         # _SYNC_CHUNK updated buffers are dispatched asynchronously and
         # their counts fetched in ONE device_get of a stacked vector
         # (one barrier per chunk, not per batch).  HBM backpressure:
-        # a chunk holds at most _SYNC_CHUNK un-shrunk buffers; the
-        # OOM-spill-retry hook covers the peak.
+        # a chunk holds at most _SYNC_CHUNK un-shrunk buffers.  Each
+        # chunk entry retains its SOURCE batch (parked spillable, so it
+        # pins no HBM): an OOM surfacing at the stacked sync — where
+        # async backends report it — is recovered by re-running the
+        # updates from the sources through the splitting retry scope,
+        # and the cross-batch merge makes the extra partial buffers
+        # semantically free.
         import jax as _jax
         import jax.numpy as _jnp
+        from spark_rapids_tpu.memory.catalog import (SpillableColumnarBatch,
+                                                     SpillPriority)
+
+        def update_pairs(src) -> list:
+            return ctx.dispatch_retry(update_jit, src, op="agg_update",
+                                      pairs=True)
 
         def flush_chunk(chunk: list) -> None:
             nonlocal total_cap
             if not chunk:
                 return
-            if len(chunk) == 1:
-                ngs = [chunk[0].host_num_rows()]
-            else:
-                ngs = _jax.device_get(
-                    ctx.dispatch(_jnp.stack, [c.num_rows for c in chunk]))
-            for part, ng in zip(chunk, ngs):
+
+            def redo() -> None:
+                new = []
+                for src, part in chunk:
+                    if src is None:     # final mode: no dispatch to redo
+                        new.append((None, part))
+                    else:
+                        new.extend(update_pairs(src))
+                chunk[:] = new
+
+            def sync_counts():
+                if len(chunk) == 1:
+                    return [chunk[0][1].host_num_rows()]
+                return list(_jax.device_get(ctx.dispatch(
+                    _jnp.stack, [p.num_rows for _s, p in chunk])))
+
+            ngs = ctx.retry_sync(sync_counts, redo=redo, op="agg_flush")
+            for (src, part), ng in zip(chunk, ngs):
                 ng = int(ng)
+                if isinstance(src, SpillableColumnarBatch):
+                    src.close()
                 if ng == 0 and key_idx:
                     continue
                 cap = round_capacity(max(ng, 1))
@@ -402,10 +427,11 @@ class HashAggregateExec(PlanNode):
         chunk: list = []
         for b in child_it:
             if self.mode == "final":
-                part = _relabel_d(b, self._buffer_schema)
+                chunk.append((None, _relabel_d(b, self._buffer_schema)))
             else:
-                part = ctx.dispatch(update_jit, b)
-            chunk.append(part)
+                src = SpillableColumnarBatch(b, ctx.catalog,
+                                             SpillPriority.READ_SHUFFLE)
+                chunk.extend(update_pairs(src))
             if len(chunk) >= self._SYNC_CHUNK:
                 flush_chunk(chunk)
                 chunk = []

@@ -157,9 +157,14 @@ class ProjectExec(PlanNode):
             offset = jnp.asarray(0, jnp.int64)
             for b in child_it:
                 if self._paware:
+                    # augment BEFORE the retry scope: the partition-aware
+                    # columns ride along as data, so a split slices them
+                    # with their rows and the global offsets stay exact
                     b = self._with_paware_device(b, pid, offset)
                     offset = offset + b.num_rows
-                yield fn(b)
+                # elementwise: splitting on OOM yields identical rows
+                # in order (reference GpuProjectExec withRetry)
+                yield from ctx.dispatch_retry(fn, b, op="project")
         else:
             offset = 0
             for b in child_it:
@@ -287,7 +292,9 @@ class FilterExec(PlanNode):
         if ctx.is_device:
             fn = self._jit_fn()
             for b in child_it:
-                yield fn(b)
+                # row-wise predicate: split pieces filter to the same
+                # surviving rows in order (GpuFilterExec withRetry)
+                yield from ctx.dispatch_retry(fn, b, op="filter")
         else:
             for b in child_it:
                 c = eval_host(self._cond, b)

@@ -174,6 +174,41 @@ class ExecCtx:
         with self.semaphore:
             return run_with_spill_retry(fn, self.catalog, *args, **kwargs)
 
+    def dispatch_retry(self, fn, batch, *, split: bool = True,
+                       op: str | None = None, pairs: bool = False,
+                       checkpoint=None, restore=None) -> list:
+        """Run ``fn(batch)`` under the full OOM retry scope
+        (memory/retry.py): spill on RESOURCE_EXHAUSTED, and when spill
+        frees nothing split the batch in half by rows and retry each
+        half — the reference's RmmRapidsRetryIterator.withRetry.
+        Returns the outputs in row order (one unless a split happened);
+        ``split=False`` is withRetryNoSplit for steps whose partial
+        outputs would break semantics.  ``pairs=True`` returns
+        ``(piece, output)`` tuples so callers can retain the processed
+        pieces for a later :meth:`retry_sync` redo."""
+        if not self.is_device:
+            r = fn(batch)
+            return [(batch, r)] if pairs else [r]
+        from spark_rapids_tpu.memory import retry as _retry
+        with self.semaphore:
+            return _retry.with_retry(
+                fn, self.catalog, batch,
+                split=_retry.split_half if split else None, op=op,
+                pairs=pairs, checkpoint=checkpoint, restore=restore,
+                settings=self.conf.settings)
+
+    def retry_sync(self, sync_fn, *, redo=None, op: str = "sync"):
+        """Guard a blocking sync of asynchronously dispatched device
+        work (chunk-flush device_get): on OOM spill, ``redo()`` the
+        poisoned dispatches from retained inputs, and sync again — the
+        async-backend OOMs that used to surface outside every retry
+        loop are recovered here."""
+        if not self.is_device:
+            return sync_fn()
+        from spark_rapids_tpu.memory import retry as _retry
+        return _retry.retry_sync(sync_fn, self.catalog, redo=redo, op=op,
+                                 settings=self.conf.settings)
+
     def close(self) -> None:
         """End-of-execution cleanup: close shuffle transports, then the
         BufferCatalog (spilled disk files, host arena) if created."""

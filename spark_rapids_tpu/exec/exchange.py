@@ -226,7 +226,16 @@ class ShuffleExchangeExec(PlanNode):
             # of the reader's AQE small-partition coalescing
             # (GpuCustomShuffleReaderExec; Spark's AQE does this on the
             # read side only because its map side is fixed at plan time).
-            if n > 1 and len(batches) >= 1:
+            # It is an ADAPTIVE rewrite, so it obeys the same gates as
+            # the read side: off when spark.sql.adaptive.enabled is
+            # false, and off when an allow_coalesce=False reader
+            # consumes this exchange — explicit repartition(n) promises
+            # n non-degenerate partitions (Spark's REPARTITION_BY_NUM
+            # contract).
+            coalesce_ok = (ADAPTIVE_ENABLED.get(ctx.conf.settings)
+                           and not getattr(self, "_no_map_coalesce",
+                                           False))
+            if coalesce_ok and n > 1 and len(batches) >= 1:
                 total_bytes = sum(b.device_size_bytes() for b in batches)
                 if total_bytes <= ADVISORY_PARTITION_BYTES.get(
                         ctx.conf.settings):
@@ -311,6 +320,11 @@ class AdaptiveShuffleReaderExec(PlanNode):
         assert isinstance(child, ShuffleExchangeExec)
         self.allow_skew_split = allow_skew_split
         self.allow_coalesce = allow_coalesce
+        if not allow_coalesce:
+            # the exchange materializes before its consumers run, so it
+            # cannot discover this reader then — flag it at plan time:
+            # the map side must keep all n partitions non-degenerate
+            child._no_map_coalesce = True
 
     @property
     def output_schema(self) -> T.Schema:

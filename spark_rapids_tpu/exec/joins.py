@@ -278,15 +278,43 @@ class JoinExec(PlanNode):
         # pure latency — so up to _SYNC_CHUNK probes are dispatched
         # asynchronously and their totals fetched in ONE device_get of
         # a stacked vector (one barrier per chunk, not per batch).
+        # Each pending entry retains its stream batch: an OOM surfacing
+        # at the stacked sync (where async backends report it) is
+        # recovered by re-probing from the retained batches through the
+        # splitting retry scope — a split stream batch just produces
+        # two gathers instead of one.
+        def probe(piece):
+            lb2, lkeys = self._augment_device(piece, self._lkeys_b)
+            if prep is not None:
+                probe_arrays, total_dev = _jit_probe_fast(
+                    lb2, prep, lkeys[0], stream_jt)
+            else:
+                probe_arrays, total_dev = _jit_probe(
+                    lb2, rb2, lkeys, rkeys, stream_jt)
+            return lb2, total_dev, probe_arrays
+
+        def probe_entries(lb) -> list:
+            return [(piece, l2, td, pa) for piece, (l2, td, pa)
+                    in ctx.dispatch_retry(probe, lb, op="join_probe",
+                                          pairs=True)]
+
         def flush(pending):
             nonlocal matched
             if not pending:
                 return
-            if len(pending) == 1:
-                totals = [int(jax.device_get(pending[0][2]))]
-            else:
-                totals = [int(t) for t in jax.device_get(ctx.dispatch(
+
+            def redo() -> None:
+                pending[:] = [e for p in pending
+                              for e in probe_entries(p[0])]
+
+            def sync_totals():
+                if len(pending) == 1:
+                    return [int(jax.device_get(pending[0][2]))]
+                return [int(t) for t in jax.device_get(ctx.dispatch(
                     jnp.stack, [p[2] for p in pending]))]
+
+            totals = ctx.retry_sync(sync_totals, redo=redo,
+                                    op="join_flush")
             for (lb, lb2, _td, probe_arrays), total in zip(pending, totals):
                 if total == 0:
                     if jt == "full" and matched is None:
@@ -314,14 +342,7 @@ class JoinExec(PlanNode):
 
         pending = []
         for lb in self._stream_batches(ctx, pid):
-            lb2, lkeys = self._augment_device(lb, self._lkeys_b)
-            if prep is not None:
-                probe_arrays, total_dev = ctx.dispatch(
-                    _jit_probe_fast, lb2, prep, lkeys[0], stream_jt)
-            else:
-                probe_arrays, total_dev = ctx.dispatch(
-                    _jit_probe, lb2, rb2, lkeys, rkeys, stream_jt)
-            pending.append((lb, lb2, total_dev, probe_arrays))
+            pending.extend(probe_entries(lb))
             if len(pending) >= self._SYNC_CHUNK:
                 yield from flush(pending)
                 pending = []

@@ -108,8 +108,15 @@ class SortExec(PlanNode):
         if not batches:
             return
         if ctx.is_device:
-            b = batches[0] if len(batches) == 1 else dk.concat_batches(batches)
-            yield ctx.dispatch(self._jit_fn(), b)
+            b = batches[0] if len(batches) == 1 \
+                else ctx.dispatch(dk.concat_batches, batches)
+            # withRetryNoSplit (reference GpuSortExec): a sort's output
+            # is a TOTAL order over its input — emitting independently
+            # sorted halves would break it, so on OOM this scope only
+            # spills and retries whole (no merge kernel exists to
+            # recombine split outputs; see ops/sort.py)
+            yield ctx.dispatch_retry(self._jit_fn(), b, split=False,
+                                     op="sort")[0]
         else:
             b = batches[0] if len(batches) == 1 else hk.host_concat(batches)
             yield hk.host_sort(b, self._orders)

@@ -242,9 +242,14 @@ class WindowExec(PlanNode):
         parked, state = [], None
         for p in range(child.num_partitions(ctx)):
             for b in child.partition_iter(ctx, p):
-                part = ctx.dispatch(upd_jit, b)
-                state = part if state is None \
-                    else ctx.dispatch(merge_jit, state, part)
+                # splitting retry scope: the state merge is associative,
+                # so an OOMed update re-run over row-halves folds to the
+                # identical state (reference withRetry over the
+                # pre-process step, GpuWindowExec)
+                for part in ctx.dispatch_retry(upd_jit, b,
+                                               op="window_update"):
+                    state = part if state is None \
+                        else ctx.dispatch(merge_jit, state, part)
                 parked.append(SpillableColumnarBatch(
                     b, ctx.catalog, SpillPriority.READ_SHUFFLE))
         if state is None:
@@ -285,7 +290,10 @@ class WindowExec(PlanNode):
         for sb in parked:
             b = sb.get()
             sb.close()
-            yield ctx.dispatch(app_jit, b, state)
+            # appending broadcast finals is elementwise given the fixed
+            # state: splitting on OOM yields the same rows in order
+            yield from ctx.dispatch_retry(
+                lambda bb: app_jit(bb, state), b, op="window_apply")
 
     # ------------------------------------------------------------------
     def _run_device(self, big: ColumnBatch) -> ColumnBatch:

@@ -29,19 +29,34 @@ Injection points wired today (site -> actions it interprets):
     store.fetch         local shuffle store reads (ctx: shuffle, part).
                         Action ``error`` raises from the store — over
                         TCP it reaches the client as an error frame.
-    memory.oom          run_with_spill_retry dispatch (ctx: op).
-                        Action ``oom`` raises a simulated XLA
+    memory.oom          run_with_spill_retry dispatch (ctx: op) and the
+                        operator retry scopes in memory/retry.py (ctx:
+                        op, and rows at with_retry sites).  Action
+                        ``oom`` raises a simulated XLA
                         RESOURCE_EXHAUSTED, driving the spill-retry
                         loop exactly like a real HBM exhaustion.
+    memory.oom.until_rows
+                        with_retry dispatch sites only (ctx: op, rows).
+                        Action ``oom`` with ``until_rows=N`` keeps
+                        raising the simulated OOM while the dispatched
+                        batch holds MORE than N rows — the exhaustion
+                        "persists" until split-and-retry shrinks the
+                        working set below the threshold, making the
+                        split path deterministically provable without a
+                        real device.
 
 Trigger keys (all optional):
 
     nth=N      first eligible hit that fires (1-based, default 1) —
                "reset after 2 frames" is ``nth=3`` on a frame point
     times=N    how many hits fire once triggered (default 1 so a retry
-               can succeed; 0 = every hit forever)
+               can succeed; 0 = every hit forever).  Rules carrying
+               ``until_rows`` default to 0: the row threshold is the
+               natural stop condition
     p=F        per-hit probability, drawn from the rule's seeded PRNG
     seconds=F  action parameter (stall duration)
+    until_rows=N  fire only when the site reports a ``rows`` context
+               above N (sites that report no row count never match)
 
 Any other ``key=value`` is a FILTER compared (as strings) against the
 call-site context, e.g. ``shuffle=9,part=0`` scopes a rule to one
@@ -66,7 +81,7 @@ __all__ = ["FaultRegistry", "FaultRule", "FaultAction", "InjectedFault"]
 
 #: keys with registry-level meaning; everything else in a rule is a
 #: context filter
-_RESERVED = ("nth", "times", "p", "seconds")
+_RESERVED = ("nth", "times", "p", "seconds", "until_rows")
 
 
 class InjectedFault(RuntimeError):
@@ -90,7 +105,12 @@ class FaultRule:
                 raise ValueError(f"fault rule {text!r}: bad param {kv!r}")
             self.params[k.strip()] = v.strip()
         self.nth = int(self.params.get("nth", 1))
-        self.times = int(self.params.get("times", 1))
+        self.until_rows = (int(self.params["until_rows"])
+                           if "until_rows" in self.params else None)
+        # until_rows rules fire forever by default: the row threshold,
+        # not a hit budget, is what stops them
+        self.times = int(self.params.get(
+            "times", 0 if self.until_rows is not None else 1))
         self.p = float(self.params.get("p", 1.0))
         self.filters = {k: v for k, v in self.params.items()
                         if k not in _RESERVED}
@@ -99,6 +119,10 @@ class FaultRule:
         self.fired = 0
 
     def _try_fire(self, ctx: dict) -> bool:
+        if self.until_rows is not None:
+            rows = ctx.get("rows")
+            if rows is None or int(rows) <= self.until_rows:
+                return False
         for k, v in self.filters.items():
             if k not in ctx or str(ctx[k]) != v:
                 return False

@@ -169,6 +169,12 @@ class FileScanExec(PlanNode):
         #: reads store_sales 12x; the reference's analog is Spark's
         #: ReuseExchange over identical scan-bearing subtrees)
         self.share_output = False
+        #: how many consumptions the planner counted for the shared
+        #: fingerprint (0 = unknown): the last one closes the parked
+        #: entries so the shared table's catalog registration (and its
+        #: host/disk spill storage) is released as soon as every branch
+        #: has read it, not at catalog close
+        self.share_consumers = 0
         full = self._read_schema()
         if self._columns:
             fields = [full.field(c) for c in self._columns]
@@ -224,8 +230,9 @@ class FileScanExec(PlanNode):
             if self.share_output:
                 from spark_rapids_tpu.memory.catalog import (
                     SpillableColumnarBatch, SpillPriority)
+                key = ("scan_share", self.scan_fingerprint(), pid)
                 parked = ctx.cached(
-                    ("scan_share", self.scan_fingerprint(), pid),
+                    key,
                     lambda: [SpillableColumnarBatch(
                         b, ctx.catalog, SpillPriority.READ_SHUFFLE)
                         for b in self._device_batches(rbs)])
@@ -238,6 +245,21 @@ class FileScanExec(PlanNode):
                     # table permanently unspillable — review finding)
                     sb.unpin()
                     yield b
+                # consumer-counted close: once every sharing branch has
+                # drained this partition, the parked entries are dead
+                # weight in the catalog (formerly leaked until catalog
+                # close — a session running many queries accumulated
+                # every shared table in the spill tiers)
+                if self.share_consumers:
+                    ckey = ("scan_share_left", self.scan_fingerprint(), pid)
+                    with ctx._lock:
+                        left = ctx.cache.get(ckey, self.share_consumers) - 1
+                        ctx.cache[ckey] = left
+                        if left <= 0:
+                            ctx.cache.pop(key, None)
+                    if left <= 0:
+                        for sb in parked:
+                            sb.close()
                 return
             yield from self._device_batches(rbs)
         else:

@@ -9,6 +9,11 @@ from spark_rapids_tpu.memory.catalog import (BufferCatalog, DeviceSemaphore,
                                              SpillPriority,
                                              SpillableColumnarBatch,
                                              run_with_spill_retry)
+from spark_rapids_tpu.memory.retry import (SplitAndRetryOOM, is_oom,
+                                           retry_sync, split_half,
+                                           with_retry, with_retry_no_split)
 
 __all__ = ["BufferCatalog", "DeviceSemaphore", "SpillPriority",
-           "SpillableColumnarBatch", "run_with_spill_retry"]
+           "SpillableColumnarBatch", "run_with_spill_retry",
+           "SplitAndRetryOOM", "is_oom", "retry_sync", "split_half",
+           "with_retry", "with_retry_no_split"]

@@ -123,7 +123,13 @@ class BufferCatalog:
         self.faults = FaultRegistry.from_conf(settings)
         self.metrics = {"device_spills": 0, "host_spills": 0,
                         "bytes_spilled_to_host": 0,
-                        "bytes_spilled_to_disk": 0}
+                        "bytes_spilled_to_disk": 0,
+                        # OOM retry framework (memory/retry.py):
+                        # attempts re-run after an exhaustion, inputs
+                        # halved when spill freed nothing, and the HBM
+                        # pressure high-watermark of registered batches
+                        "oom_retries": 0, "oom_splits": 0,
+                        "device_bytes_peak": 0}
 
     @property
     def _arena(self):
@@ -150,6 +156,8 @@ class BufferCatalog:
             self._next_id += 1
             self._entries[bid] = _Entry(bid, priority, size, batch=batch)
             self.device_used += size
+            if self.device_used > self.metrics["device_bytes_peak"]:
+                self.metrics["device_bytes_peak"] = self.device_used
             if self.device_used > self.device_limit:
                 self._spill_device_locked(self.device_used
                                           - self.device_limit)
@@ -305,6 +313,8 @@ class BufferCatalog:
         e.treedef = None
         e.tier = "device"
         self.device_used += e.size
+        if self.device_used > self.metrics["device_bytes_peak"]:
+            self.metrics["device_bytes_peak"] = self.device_used
         if self.device_used > self.device_limit:
             self._spill_device_locked(self.device_used - self.device_limit)
 
@@ -374,24 +384,36 @@ class SpillableColumnarBatch:
         self._id = catalog.add_batch(batch, priority)
         self._closed = False
         self._pins = 0
+        # pin accounting is lock-protected: plan branches sharing one
+        # parked list (scan reuse) and concurrent partition workers
+        # get/unpin the same handle from different threads; an unlocked
+        # read-modify-write loses pins and lets the catalog spill HBM
+        # still in use
+        self._lock = threading.Lock()
 
     def get(self) -> ColumnBatch:
         """Materialize AND pin; pair every get() with an unpin() once the
         batch is no longer referenced (reference incRefCount/close
         contract) so the catalog cannot spill HBM still in use."""
-        b = self._catalog.acquire(self._id)
-        self._pins += 1
-        return b
+        with self._lock:
+            assert not self._closed, "get() after close()"
+            b = self._catalog.acquire(self._id)
+            self._pins += 1
+            return b
 
     def unpin(self) -> None:
-        assert self._pins > 0
-        self._catalog.release(self._id)
-        self._pins -= 1
+        with self._lock:
+            assert self._pins > 0
+            self._catalog.release(self._id)
+            self._pins -= 1
 
     def close(self) -> None:
-        if not self._closed:
+        with self._lock:
+            if self._closed:
+                return
             while self._pins:
-                self.unpin()
+                self._catalog.release(self._id)
+                self._pins -= 1
             self._catalog.remove(self._id)
             self._closed = True
 
@@ -471,6 +493,8 @@ def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
             msg = str(ex)
             if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
                 raise
+            catalog.metrics["oom_retries"] = \
+                catalog.metrics.get("oom_retries", 0) + 1
             attempt += 1
             if attempt > max_retries:
                 raise

@@ -20,6 +20,16 @@ A most-significant pad flag forces batch padding rows to sort last.
 Note: no 64-bit bitcasts anywhere — TPU v5e XLA does not implement
 bitcast-convert on 64-bit element types (verified empirically); s64/f64
 arithmetic and comparisons are supported (emulated).
+
+OOM retry contract (memory/retry.py): ``sort_batch`` is a TOTAL order
+over its input and no pairwise sorted-merge kernel exists here, so
+exec/sortexec.py runs it under ``with_retry_no_split`` (reference
+GpuSortExec's withRetryNoSplit, GpuSortExec.scala) — on HBM exhaustion
+the scope spills and re-attempts the whole batch but never splits it:
+independently sorted halves would interleave and break the order.
+Operators whose outputs compose row-wise (project/filter) or through an
+associative merge (aggregate update, window state) use the splitting
+scope instead.
 """
 from __future__ import annotations
 

@@ -156,7 +156,11 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         # planner-inserted shuffles (aggregation) get the coalescing
         # reader.  A downstream JOIN may still wrap this exchange in a
         # split-only skew reader (_aqe_join_reader), which can raise —
-        # never lower — the effective partition count.
+        # never lower — the effective partition count.  The map-side
+        # tiny-input coalescer obeys the same contract: flag the
+        # exchange so a sub-advisory map side still keeps all n
+        # partitions non-degenerate (REPARTITION_BY_NUM).
+        ex._no_map_coalesce = True
         return PlannedNode(ex, list(node.keys), [c])
     if isinstance(node, L.MapInPandas):
         from spark_rapids_tpu.exec.python_exec import MapInPandasExec
@@ -510,6 +514,11 @@ class TpuOverrides:
             if len(g) > 1:
                 for n in g:
                     n.share_output = True
+                    # consumptions of this fingerprint in the tree: the
+                    # LAST consumer to drain a partition closes the
+                    # parked spillable entries (io/scan.py), so a shared
+                    # table doesn't stay registered until catalog close
+                    n.share_consumers = len(g)
 
     def root_backend(self, root: PlannedNode) -> str:
         return root.backend

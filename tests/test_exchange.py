@@ -303,3 +303,66 @@ def test_map_side_tiny_coalesce(rng):
     host = _partition_rows(plan, "host")
     assert sorted((r for p in host for r in p), key=_sort_key) == \
         sorted(parts[0], key=_sort_key)
+
+
+def test_map_side_coalesce_gated_off_when_aqe_disabled(rng):
+    """spark.sql.adaptive.enabled=false must disable the map-side
+    tiny-input coalescer (it is an ADAPTIVE rewrite): partition
+    placement matches the host path exactly."""
+    from spark_rapids_tpu.conf import TpuConf
+    plan = ShuffleExchangeExec(HashPartitioning([col("k")], 5),
+                               _scan(rng))
+    ctx = ExecCtx(backend="device", conf=TpuConf(
+        {"spark.sql.adaptive.enabled": False}))
+    parts = []
+    for pid in range(plan.num_partitions(ctx)):
+        rows = []
+        for b in plan.partition_iter(ctx, pid):
+            rows.extend(device_to_host(b).to_rows())
+        parts.append(rows)
+    assert sum(1 for p in parts if p) > 1  # NOT all in partition 0
+    host = _partition_rows(plan, "host")
+    for dev_p, host_p in zip(parts, host):
+        assert sorted(dev_p, key=_sort_key) == sorted(host_p, key=_sort_key)
+
+
+def test_map_side_coalesce_gated_off_for_repartition_reader(rng):
+    """An allow_coalesce=False reader (explicit repartition(n)) promises
+    n non-degenerate partitions: the exchange it consumes must keep all
+    n even for sub-advisory map sides (REPARTITION_BY_NUM contract)."""
+    from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+    shuffle = ShuffleExchangeExec(RoundRobinPartitioning(5), _scan(rng))
+    reader = AdaptiveShuffleReaderExec(shuffle, allow_coalesce=False)
+    assert shuffle._no_map_coalesce
+    ctx = ExecCtx(backend="device")  # default advisory: 64MB >> input
+    counts = []
+    for pid in range(reader.num_partitions(ctx)):
+        counts.append(sum(device_to_host(b).num_rows
+                          for b in reader.partition_iter(ctx, pid)))
+    assert len(counts) == 5
+    assert all(c == 60 for c in counts)  # 300 rows round-robin over 5
+
+
+def test_repartition_n_keeps_n_partitions_end_to_end(rng):
+    """df.repartition(n) through the full planner: n output partitions,
+    none degenerate, rows intact (the coalescer used to fold tiny map
+    sides into one partition even under an explicit repartition)."""
+    from spark_rapids_tpu.exec.core import device_to_host as d2h
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    df = s.from_pydict({
+        "k": [int(x) for x in rng.integers(0, 40, 200)],
+        "v": [int(x) for x in rng.integers(-100, 100, 200)],
+    }, T.Schema([T.StructField("k", T.IntegerType(), True),
+                 T.StructField("v", T.LongType(), True)]),
+        partitions=2).repartition(4)
+    ov, meta = df._overridden(quiet=True)
+    plan = meta.exec_node
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        nparts = plan.num_partitions(ctx)
+        counts = [sum(d2h(b).num_rows for b in plan.partition_iter(ctx, p))
+                  for p in range(nparts)]
+    assert nparts == 4
+    assert all(c > 0 for c in counts) and sum(counts) == 200
+    assert sorted(df.collect()) == sorted(
+        collect_host(plan, s.conf))

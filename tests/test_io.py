@@ -302,3 +302,72 @@ def test_orc_stripe_stats_parser(tmp_path):
         assert a["max"] == seen - 1
         assert a["has_null"] is False
     assert seen == n
+
+
+def test_shared_scan_single_decode_and_release(pq_dir):
+    """A scan marked share_output decodes ONCE per partition, every
+    consumer sees the same rows, and the last consumer releases the
+    parked catalog entries (formerly leaked until catalog close —
+    q28-style plans accumulated every shared table in the spill
+    tiers)."""
+    from spark_rapids_tpu.exec.core import device_to_host
+    scan = ParquetScanExec(pq_dir, partitions=2)
+    scan.share_output = True
+    scan.share_consumers = 3
+    with ExecCtx(backend="device") as ctx:
+        baseline = len(ctx.catalog._entries)
+        rows = []
+        for consumer in range(3):
+            for pid in range(scan.num_partitions(ctx)):
+                got = []
+                for b in scan.partition_iter(ctx, pid):
+                    got.extend(device_to_host(b).to_rows())
+                rows.append(sorted(got, key=_sort_key))
+            if consumer < 2:
+                # parked entries still registered for later consumers
+                assert len(ctx.catalog._entries) > baseline
+                assert any(k[0] == "scan_share" for k in ctx.cache
+                           if isinstance(k, tuple))
+        # last consumer closed the parked entries and dropped the cache
+        assert len(ctx.catalog._entries) == baseline
+        assert not any(k[0] == "scan_share" for k in ctx.cache
+                       if isinstance(k, tuple))
+    # all three consumers read identical data
+    assert rows[0:2] == rows[2:4] == rows[4:6]
+    assert sum(len(r) for r in rows[0:2]) == 50 + 60 + 70 + 80
+
+
+def test_shared_scan_planner_counts_consumers(pq_dir):
+    """The planner marks duplicate-fingerprint scans shared AND records
+    the consumer count that drives the release."""
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    df = s.read_parquet(pq_dir, columns=["a", "b"])
+    agg1 = df.group_by("a").agg(Sum(col("b")))
+    agg2 = df.where(col("a") > lit(50)).group_by("a").agg(Sum(col("b")))
+    u = agg1.union(agg2)
+    ov, meta = u._overridden(quiet=True)
+
+    def scans(n, acc):
+        if isinstance(n, ParquetScanExec):
+            acc.append(n)
+        for c in n.children:
+            scans(c, acc)
+        return acc
+
+    marked = [sc for sc in scans(meta.exec_node, [])
+              if getattr(sc, "share_output", False)]
+    assert marked, "duplicate scans were not marked shared"
+    assert all(sc.share_consumers >= 2 for sc in marked)
+    # end to end: results match the host oracle (floats tolerate
+    # summation-order noise between streaming and oracle aggregation)
+    import math
+    got = sorted(u.collect(), key=_sort_key)
+    want = sorted(collect_host(meta.exec_node, s.conf), key=_sort_key)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        for gc, wc in zip(g, w):
+            if isinstance(gc, float):
+                assert math.isclose(gc, wc, rel_tol=1e-9)
+            else:
+                assert gc == wc

@@ -1,0 +1,301 @@
+"""Operator-level OOM retry framework unit tests (memory/retry.py).
+
+Mirrors the reference's RmmRetryIteratorSuite / WithRetrySuite coverage:
+split ordering, the row floor, checkpoint/restore bracketing, spillable
+input pin/close lifecycle, the no-split scope, and the sync-point redo
+path — all driven by the deterministic ``memory.oom`` /
+``memory.oom.until_rows`` fault points (no real device exhaustion).
+"""
+import threading
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.faults import FaultRegistry
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.memory import (BufferCatalog, SpillPriority,
+                                     SpillableColumnarBatch,
+                                     SplitAndRetryOOM, retry_sync,
+                                     split_half, with_retry,
+                                     with_retry_no_split)
+
+SCHEMA = T.Schema([T.StructField("a", T.LongType(), True)])
+
+
+def _batch(n=100):
+    return HostBatch.from_pydict(
+        {"a": list(range(n))}, SCHEMA).to_device()
+
+
+def _vals(b):
+    return [r[0] for r in HostBatch.from_device(b).to_rows()]
+
+
+def _cat(faults: str | None = None):
+    cat = BufferCatalog(device_limit=10 << 20, host_limit=1 << 24)
+    if faults:
+        cat.faults = FaultRegistry(faults, seed=0)
+    return cat
+
+
+# ---------------------------------------------------------------------------
+# split_half
+# ---------------------------------------------------------------------------
+
+def test_split_half_order_and_rows():
+    lo, hi = split_half(_batch(101))
+    assert lo.host_num_rows() == 51 and hi.host_num_rows() == 50
+    assert _vals(lo) + _vals(hi) == list(range(101))
+
+
+def test_split_half_single_row_raises():
+    with pytest.raises(SplitAndRetryOOM):
+        split_half(_batch(1))
+
+
+# ---------------------------------------------------------------------------
+# with_retry
+# ---------------------------------------------------------------------------
+
+def test_with_retry_passthrough_no_fault():
+    cat = _cat()
+    out = with_retry(lambda b: b, cat, _batch(64), op="ident")
+    assert len(out) == 1 and _vals(out[0]) == list(range(64))
+    assert cat.metrics["oom_retries"] == 0
+    assert cat.metrics["oom_splits"] == 0
+    cat.close()
+
+
+def test_until_rows_storm_splits_in_order():
+    """OOM persists while the dispatched piece is above the threshold:
+    the scope must halve until every piece fits, emitting partial
+    outputs in row order (reference splitSpillableInHalfByRows)."""
+    cat = _cat("memory.oom.until_rows:oom,until_rows=20")
+    out = with_retry(lambda b: b, cat, _batch(100), op="ident",
+                     min_split_rows=4)
+    assert [v for p in out for v in _vals(p)] == list(range(100))
+    assert all(p.host_num_rows() <= 20 for p in out)
+    assert cat.metrics["oom_splits"] > 0
+    assert cat.metrics["oom_retries"] >= cat.metrics["oom_splits"]
+    cat.close()
+
+
+def test_row_floor_stops_splitting():
+    """A half below minSplitRows must not be produced: the OOM
+    propagates as SplitAndRetryOOM at the floor."""
+    cat = _cat("memory.oom.until_rows:oom,until_rows=20")
+    with pytest.raises(SplitAndRetryOOM, match="split"):
+        with_retry(lambda b: b, cat, _batch(100), op="ident",
+                   min_split_rows=32)
+    cat.close()
+
+
+def test_max_retries_exhausted_propagates_oom():
+    """When spill keeps reporting progress but the OOM persists, the
+    attempt budget bounds the loop and the ORIGINAL exhaustion
+    propagates (attempts are checked before the split decision)."""
+    cat = _cat("memory.oom:oom,times=0")  # times=0: unlimited
+    cat.spill_device = lambda target: 1   # spill always "frees" a byte
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with_retry(lambda b: b, cat, _batch(64), op="ident",
+                   max_retries=3)
+    assert cat.metrics["oom_retries"] == 4  # 3 retries + the final one
+    cat.close()
+
+
+def test_with_retry_no_split_raises_split_oom():
+    """withRetryNoSplit semantics: when spill frees nothing the scope
+    must NOT split (total-order outputs) — SplitAndRetryOOM instead."""
+    cat = _cat("memory.oom.until_rows:oom,until_rows=20")
+    with pytest.raises(SplitAndRetryOOM, match="splitting disabled"):
+        with_retry_no_split(lambda b: b, cat, _batch(100), op="sort")
+    cat.close()
+
+
+def test_checkpoint_restore_brackets_attempts():
+    """A failed attempt must leave no half-applied state (reference
+    Retryable.checkpoint/restore): fn mutates an accumulator and the
+    scope restores it before each re-attempt."""
+    cat = _cat()
+    state = {"applied": 0}
+    restored = []
+    calls = {"n": 0}
+
+    def fn(b):
+        state["applied"] += b.host_num_rows()  # mutate BEFORE failing
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: synthetic")
+        return b
+
+    out = with_retry(
+        fn, cat, _batch(100), op="agg", min_split_rows=4,
+        checkpoint=lambda: dict(state),
+        restore=lambda s: (restored.append(True), state.update(s)))
+    # first attempt (100 rows) failed and was restored; the surviving
+    # pieces' contributions are exactly the emitted rows
+    assert restored == [True]
+    assert state["applied"] == sum(p.host_num_rows() for p in out)
+    assert [v for p in out for v in _vals(p)] == list(range(100))
+    cat.close()
+
+
+def test_spillable_input_closed_on_split_and_unpinned_on_success():
+    cat = _cat("memory.oom.until_rows:oom,until_rows=60")
+    src = SpillableColumnarBatch(_batch(100), cat, SpillPriority.READ_SHUFFLE)
+    out = with_retry(lambda b: b, cat, src, op="ident", min_split_rows=4)
+    assert [v for p in out for v in _vals(p)] == list(range(100))
+    # the original spillable was replaced by its halves and closed
+    assert src._closed
+    assert src._pins == 0
+    cat.close()
+
+
+def test_spillable_input_unpinned_on_success_no_fault():
+    cat = _cat()
+    src = SpillableColumnarBatch(_batch(64), cat, SpillPriority.READ_SHUFFLE)
+    out = with_retry(lambda b: b, cat, src, op="ident")
+    assert len(out) == 1 and _vals(out[0]) == list(range(64))
+    assert not src._closed and src._pins == 0  # spillable again
+    src.close()
+    cat.close()
+
+
+def test_pairs_mode_returns_processed_pieces():
+    cat = _cat("memory.oom.until_rows:oom,until_rows=30")
+    out = with_retry(lambda b: b, cat, _batch(100), op="ident",
+                     pairs=True, min_split_rows=4)
+    assert len(out) > 1
+    for piece, result in out:
+        assert _vals(piece) == _vals(result)
+    cat.close()
+
+
+def test_retry_recovers_after_spill_frees_memory():
+    """When spilling DOES free device bytes the piece is retried whole
+    — no split (the reference's plain RetryOOM path)."""
+    cat = _cat("memory.oom:oom,times=1")
+    # an unpinned spillable gives the spill pass something to evict
+    parked = SpillableColumnarBatch(_batch(256), cat, SpillPriority.READ_SHUFFLE)
+    out = with_retry(lambda b: b, cat, _batch(100), op="ident")
+    assert len(out) == 1 and _vals(out[0]) == list(range(100))
+    assert cat.metrics["oom_retries"] == 1
+    assert cat.metrics["oom_splits"] == 0
+    assert cat.metrics["device_spills"] >= 1
+    parked.close()
+    cat.close()
+
+
+def test_disabled_conf_falls_back_to_plain_dispatch():
+    """oomRetry.enabled=false: only the legacy spill-and-retry hook
+    runs; until_rows rules never fire there (no rows context) so the
+    fn executes once, unsplit."""
+    cat = _cat("memory.oom.until_rows:oom,until_rows=20")
+    settings = {"spark.rapids.memory.tpu.oomRetry.enabled": False}
+    out = with_retry(lambda b: b, cat, _batch(100), op="ident",
+                     settings=settings)
+    assert len(out) == 1 and out[0].host_num_rows() == 100
+    assert cat.metrics["oom_splits"] == 0
+    cat.close()
+
+
+def test_non_oom_error_propagates_immediately():
+    cat = _cat()
+
+    def boom(b):
+        raise RuntimeError("schema mismatch")
+
+    with pytest.raises(RuntimeError, match="schema mismatch"):
+        with_retry(boom, cat, _batch(64), op="ident")
+    assert cat.metrics["oom_retries"] == 0
+    cat.close()
+
+
+# ---------------------------------------------------------------------------
+# retry_sync (the async-dispatch sync-point gap)
+# ---------------------------------------------------------------------------
+
+def test_retry_sync_redoes_poisoned_work():
+    cat = _cat("memory.oom:oom,op=flushpt,times=1")
+    redone = []
+    vals = {"x": 1}
+
+    def redo():
+        redone.append(True)
+        vals["x"] = 2  # re-derive the poisoned value
+
+    assert retry_sync(lambda: vals["x"], cat, redo=redo,
+                      op="flushpt") == 2
+    assert redone == [True]
+    assert cat.metrics["oom_retries"] == 1
+    cat.close()
+
+
+def test_retry_sync_passthrough_without_fault():
+    cat = _cat()
+    assert retry_sync(lambda: 7, cat, op="flushpt") == 7
+    assert cat.metrics["oom_retries"] == 0
+    cat.close()
+
+
+def test_retry_sync_budget_exhausts():
+    cat = _cat("memory.oom:oom,op=flushpt,times=0")
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        retry_sync(lambda: 7, cat, op="flushpt", max_retries=2)
+    cat.close()
+
+
+# ---------------------------------------------------------------------------
+# fault rule semantics for until_rows
+# ---------------------------------------------------------------------------
+
+def test_until_rows_rule_needs_rows_context():
+    reg = FaultRegistry("memory.oom.until_rows:oom,until_rows=100",
+                        seed=0)
+    assert reg.check("memory.oom.until_rows") is None  # no rows ctx
+    assert reg.check("memory.oom.until_rows", rows=100) is None
+    assert reg.check("memory.oom.until_rows", rows=101) is not None
+    # unlimited by default (times=0 when until_rows present)
+    assert reg.check("memory.oom.until_rows", rows=5000) is not None
+
+
+# ---------------------------------------------------------------------------
+# SpillableColumnarBatch pin thread-safety (shared-scan satellite)
+# ---------------------------------------------------------------------------
+
+def test_spillable_concurrent_get_unpin_race():
+    """Concurrent consumers of one parked spillable (shared scans) must
+    never corrupt the pin count or trip the closed assertion."""
+    cat = _cat()
+    sb = SpillableColumnarBatch(_batch(128), cat, SpillPriority.READ_SHUFFLE)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                b = sb.get()
+                assert b.host_num_rows() == 128
+                sb.unpin()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert sb._pins == 0
+    sb.close()
+    sb.close()  # idempotent
+    assert sb._closed
+    cat.close()
+
+
+def test_catalog_tracks_device_bytes_peak():
+    cat = _cat()
+    sb = SpillableColumnarBatch(_batch(256), cat, SpillPriority.READ_SHUFFLE)
+    assert cat.metrics["device_bytes_peak"] > 0
+    assert cat.metrics["device_bytes_peak"] >= cat.device_used
+    sb.close()
+    cat.close()
