@@ -32,6 +32,12 @@ mkdir -p artifacts
   # async dispatch (SRT_SYNC_DISPATCH=0 behavior)
   JAX_PLATFORMS=cpu python -m pytest tests/test_oom_chaos.py \
     tests/test_oom_retry.py -q
+  echo "-- stage-recovery chaos suite: peer death + spill corruption --"
+  # lineage recomputation must return exact-oracle results with nonzero
+  # stage_recomputes, and the spill-file leak check must find the spill
+  # dir empty after ExecCtx close
+  JAX_PLATFORMS=cpu python -m pytest tests/test_recovery_chaos.py \
+    tests/test_stage_recovery.py -q
   # the fault registry must be INERT when spark.rapids.test.faults is
   # unset: no registry object, so every injection site is one None check
   JAX_PLATFORMS=cpu python - <<'PY'

@@ -32,7 +32,7 @@ from spark_rapids_tpu.host.batch import HostBatch
 __all__ = [
     "ExecCtx", "PlanNode", "CoalesceGoal", "TargetSize", "RequireSingleBatch",
     "collect", "collect_host", "collect_device", "Metrics",
-    "drain_partitions",
+    "drain_partitions", "drain_partitions_indexed",
 ]
 
 CONCURRENT_TASKS = register(ConfEntry(
@@ -119,6 +119,12 @@ class ExecCtx:
     # per-run stage cache: exchanges materialize their shuffle output here
     # once per execution (reference: shuffle files / ShuffleBufferCatalog)
     cache: dict = field(default_factory=dict)
+    # shuffle_id -> ShuffleLineage (exec/recovery.py): how each shuffle's
+    # map outputs were produced, so a terminal fetch loss re-executes
+    # exactly the dead map partitions instead of failing the query
+    # (reference: MapOutputTracker registrations driving DAGScheduler
+    # stage resubmission on FetchFailed)
+    lineage: dict = field(default_factory=dict)
     _lock: threading.RLock = field(default_factory=threading.RLock)
     _inflight: dict = field(default_factory=dict)
 
@@ -208,6 +214,14 @@ class ExecCtx:
         from spark_rapids_tpu.memory import retry as _retry
         return _retry.retry_sync(sync_fn, self.catalog, redo=redo, op=op,
                                  settings=self.conf.settings)
+
+    def register_lineage(self, shuffle_id, lineage) -> None:
+        with self._lock:
+            self.lineage[shuffle_id] = lineage
+
+    def lineage_for(self, shuffle_id):
+        with self._lock:
+            return self.lineage.get(shuffle_id)
 
     def close(self) -> None:
         """End-of-execution cleanup: close shuffle transports, then the
@@ -416,11 +430,23 @@ def drain_partitions(ctx: ExecCtx, node: PlanNode) -> Iterator:
     consumed (reference RapidsCachingWriter storing map output spillable,
     RapidsShuffleInternalManager.scala:90-155).
     """
+    for _pid, b in drain_partitions_indexed(ctx, node):
+        yield b
+
+
+def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
+    """drain_partitions, but yielding ``(partition_id, batch)`` so the
+    consumer knows which child partition produced each batch — the
+    shuffle exchange records this as the map-output lineage
+    (exec/recovery.py re-drains exactly the partitions whose outputs
+    were lost).  Same worker pool, same spillable parking, same
+    partition-ordered delivery."""
     n = node.num_partitions(ctx)
     workers = min(ctx.task_concurrency, n) if ctx.is_device else 1
     if workers <= 1 or n <= 1:
         for pid in range(n):
-            yield from node.partition_iter(ctx, pid)
+            for b in node.partition_iter(ctx, pid):
+                yield pid, b
         return
 
     import concurrent.futures as cf
@@ -439,9 +465,9 @@ def drain_partitions(ctx: ExecCtx, node: PlanNode) -> Iterator:
                                thread_name_prefix="tpu-task") as pool:
         futures = [pool.submit(drain, pid) for pid in range(n)]
         try:
-            for fut in futures:
+            for pid, fut in enumerate(futures):
                 for sb in fut.result():
-                    yield sb.get()
+                    yield pid, sb.get()
                     sb.close()
         finally:
             # early consumer exit / error: release every still-registered

@@ -207,15 +207,26 @@ class ShuffleExchangeExec(PlanNode):
         (reference RapidsCachingWriter.write storing spillable partition
         tables, RapidsShuffleInternalManager.scala:90-155; transport
         loaded by reflection, RapidsShuffleTransport.scala:638-658).
-        Host backend keeps plain batch lists (the oracle path)."""
-        from spark_rapids_tpu.exec.core import drain_partitions
+        Host backend keeps plain batch lists (the oracle path).
+
+        The device path also registers a ShuffleLineage handle in the
+        ExecCtx: which child partition produced each map batch, and
+        whether the tiny-input coalesce rewrite applied — everything
+        needed to re-execute exactly the lost map partitions after a
+        terminal fetch failure (exec/recovery.py; reference:
+        MapOutputTracker lineage driving DAGScheduler stage
+        resubmission)."""
+        from spark_rapids_tpu.exec.core import (drain_partitions,
+                                                drain_partitions_indexed)
         child = self.children[0]
-        batches = list(drain_partitions(ctx, child))
-        self.partitioning.prepare(batches, ctx.is_device)
-        n = self.partitioning.num_partitions
         if ctx.is_device:
-            from spark_rapids_tpu.columnar.batch import round_capacity
+            from spark_rapids_tpu.exec.recovery import ShuffleLineage
             from spark_rapids_tpu.shuffle import make_transport
+            indexed = list(drain_partitions_indexed(ctx, child))
+            map_src = {bi: cpid for bi, (cpid, _) in enumerate(indexed)}
+            batches = [b for _, b in indexed]
+            self.partitioning.prepare(batches, True)
+            n = self.partitioning.num_partitions
             transport = make_transport(ctx.conf, ctx)
             # Map-side tiny-input coalescing: when the whole map side is
             # below the advisory partition size, splitting it n ways
@@ -235,27 +246,20 @@ class ShuffleExchangeExec(PlanNode):
             coalesce_ok = (ADAPTIVE_ENABLED.get(ctx.conf.settings)
                            and not getattr(self, "_no_map_coalesce",
                                            False))
+            coalesced = False
             if coalesce_ok and n > 1 and len(batches) >= 1:
                 total_bytes = sum(b.device_size_bytes() for b in batches)
-                if total_bytes <= ADVISORY_PARTITION_BYTES.get(
-                        ctx.conf.settings):
-                    for bi, b in enumerate(batches):
-                        transport.write_partition(self.shuffle_id, bi, 0, b)
-                    return transport
+                coalesced = total_bytes <= ADVISORY_PARTITION_BYTES.get(
+                    ctx.conf.settings)
             for bi, b in enumerate(batches):
-                ids = self.partitioning.device_ids(b, bi)
-                sb, counts_d, starts_d = ctx.dispatch(
-                    _jit_group_by_part, b, ids, n)
-                counts = np.asarray(jax.device_get(counts_d))
-                for p in range(n):
-                    if counts[p] == 0:
-                        continue
-                    piece = ctx.dispatch(
-                        _jit_slice_part, sb, starts_d, counts_d,
-                        dk.device_scalar(p),
-                        round_capacity(int(counts[p])))
-                    transport.write_partition(self.shuffle_id, bi, p, piece)
+                self._write_map_batch(ctx, transport, bi, b, coalesced, n)
+            ctx.register_lineage(self.shuffle_id, ShuffleLineage(
+                exchange=self, coalesced=coalesced, num_parts=n,
+                map_src=map_src, conf_fp=getattr(self, "_conf_fp", None)))
             return transport
+        batches = list(drain_partitions(ctx, child))
+        self.partitioning.prepare(batches, False)
+        n = self.partitioning.num_partitions
         out: list[list] = [[] for _ in range(n)]
         for bi, b in enumerate(batches):
             if b.num_rows == 0:
@@ -267,6 +271,31 @@ class ShuffleExchangeExec(PlanNode):
                     out[p].append(piece)
         return out
 
+    def _write_map_batch(self, ctx: ExecCtx, transport, bi: int, b,
+                         coalesced: bool, n: int,
+                         epoch: int | None = None) -> None:
+        """Partition one map batch and hand its pieces to the transport.
+        Shared by the initial materialization (epoch=None -> current) and
+        recovery recomputation, which tags writes with the post-
+        invalidation epoch so a straggler from the dead attempt can
+        never displace them."""
+        from spark_rapids_tpu.columnar.batch import round_capacity
+        if coalesced:
+            transport.write_partition(self.shuffle_id, bi, 0, b,
+                                      epoch=epoch)
+            return
+        ids = self.partitioning.device_ids(b, bi)
+        sb, counts_d, starts_d = ctx.dispatch(_jit_group_by_part, b, ids, n)
+        counts = np.asarray(jax.device_get(counts_d))
+        for p in range(n):
+            if counts[p] == 0:
+                continue
+            piece = ctx.dispatch(
+                _jit_slice_part, sb, starts_d, counts_d,
+                dk.device_scalar(p), round_capacity(int(counts[p])))
+            transport.write_partition(self.shuffle_id, bi, p, piece,
+                                      epoch=epoch)
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         yield from self.partition_iter_slice(ctx, pid, 0, None)
 
@@ -274,10 +303,13 @@ class ShuffleExchangeExec(PlanNode):
                              hi: int | None) -> Iterator:
         """One reduce partition's batches, restricted to map-batch slice
         [lo, hi) — each adaptive skew-split group materializes only its
-        own range."""
+        own range.  Device pulls run inside the stage-recovery loop:
+        a terminal MapOutputLostError invalidates and recomputes exactly
+        the lost map outputs, then resumes the pull where it stopped."""
         shuffled = self._shuffled(ctx)
         if ctx.is_device:
-            yield from shuffled.fetch_partition(self.shuffle_id, pid, lo, hi)
+            from spark_rapids_tpu.exec.recovery import recovering_fetch
+            yield from recovering_fetch(ctx, self, shuffled, pid, lo, hi)
         else:
             yield from shuffled[pid][lo:hi]
 

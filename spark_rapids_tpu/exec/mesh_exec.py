@@ -21,6 +21,7 @@ aggregation-buffer contract the reference's partial/final modes use
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Iterator, Sequence
 
@@ -41,8 +42,8 @@ from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
                                                     exchange_local,
                                                     partition_ids_for_keys)
 
-__all__ = ["MeshAggregateExec", "MeshExchangeExec", "MeshJoinExec",
-           "mesh_for"]
+__all__ = ["DeviceSliceLost", "MeshAggregateExec", "MeshExchangeExec",
+           "MeshJoinExec", "mesh_for"]
 
 
 class _MeshOutputMixin:
@@ -64,6 +65,53 @@ class _MeshOutputMixin:
             # host-backend batches (oracle path) carry no placement
             yield jax.device_put(b, target) \
                 if isinstance(b, ColumnBatch) else b
+
+
+class DeviceSliceLost(RuntimeError):
+    """A mesh device slice died under a collective program (injected
+    ``mesh.slice.lost`` fault, or an XLA/PJRT device-loss status): the
+    on-mesh outputs are unrecoverable, but the child lineage is intact
+    so the exec can recompute single-device."""
+
+
+# status fragments PJRT/XLA surface when a participating device (or the
+# ICI link to it) is gone mid-program, as opposed to a program bug
+_DEVICE_LOSS_MARKERS = ("UNAVAILABLE", "DATA_LOSS", "device is lost",
+                        "Device lost", "heartbeat timeout")
+
+
+def _check_slice_fault(ctx: ExecCtx, op: str, mesh) -> None:
+    """Deterministic injection point ``mesh.slice.lost`` (ctx: op,
+    devices): fires before the collective launches, as a real slice
+    loss would surface at program dispatch."""
+    faults = getattr(ctx.catalog, "faults", None)
+    if faults is None:
+        return
+    devices = ",".join(str(d.id) for d in mesh.devices.flat)
+    if faults.check("mesh.slice.lost", op=op, devices=devices) is not None:
+        raise DeviceSliceLost(
+            f"injected fault: mesh slice lost under {op} "
+            f"(devices [{devices}])")
+
+
+def _reraise_unless_slice_lost(err: BaseException) -> None:
+    """Let slice-loss errors fall through to the single-device
+    recompute; anything else propagates unchanged."""
+    if isinstance(err, DeviceSliceLost):
+        return
+    text = f"{type(err).__name__}: {err}"
+    if any(m in text for m in _DEVICE_LOSS_MARKERS):
+        return
+    raise err
+
+
+def _note_slice_recovery(ctx: ExecCtx, wall_s: float) -> None:
+    """A lost slice was replaced by a single-device recompute: account
+    it as one stage recovery so chaos/bench metrics see mesh losses and
+    shuffle losses through the same counters (exec/recovery.py)."""
+    m = ctx.catalog.metrics
+    m["stage_recomputes"] = m.get("stage_recomputes", 0) + 1
+    m["recovery_wall_s"] = m.get("recovery_wall_s", 0.0) + wall_s
 
 
 def mesh_for(ctx: ExecCtx, size: int, axis_name: str = "data"):
@@ -273,14 +321,25 @@ class MeshAggregateExec(_MeshOutputMixin, PlanNode):
         from spark_rapids_tpu.exec.core import drain_partitions
         batches = list(drain_partitions(ctx, self.children[0]))
         mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
-        if mesh is None or not batches:
-            out = [list(self._complete_exec().partition_iter(ctx, 0))]
-            out += [[] for _ in range(self.mesh_size - 1)]
-        else:
-            shards = place_shards(batches, self.mesh_size)
-            stacked = shard_batches(shards, mesh, self.axis_name)
-            result = self._program(mesh)(stacked)
-            out = [[b] for b in unshard_batch(result)]
+        t0 = None
+        if mesh is not None and batches:
+            try:
+                _check_slice_fault(ctx, "meshagg", mesh)
+                shards = place_shards(batches, self.mesh_size)
+                stacked = shard_batches(shards, mesh, self.axis_name)
+                result = self._program(mesh)(stacked)
+                return [[b] for b in unshard_batch(result)]
+            except Exception as err:
+                _reraise_unless_slice_lost(err)
+                t0 = time.perf_counter()
+        # single-device recompute: the complete-mode aggregation is the
+        # mesh program's lineage (same layout contract), re-run on the
+        # default device — also the degenerate path when the mesh never
+        # existed or the child produced nothing
+        out = [list(self._complete_exec().partition_iter(ctx, 0))]
+        out += [[] for _ in range(self.mesh_size - 1)]
+        if t0 is not None:
+            _note_slice_recovery(ctx, time.perf_counter() - t0)
         return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
@@ -395,14 +454,26 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
         # probe — share that materialization instead of executing twice
         batches = drain_cached(ctx, self.children[0])
         mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
-        if mesh is None or not batches:
-            he = self._host_exchange()
-            return ("host", [list(he.partition_iter(ctx, pid))
-                             for pid in range(self._num_parts)])
-        shards = place_shards(batches, self.mesh_size)
-        stacked = shard_batches(shards, mesh, self.axis_name)
-        result = self._program(mesh)(stacked)
-        return ("mesh", unshard_batch(result))
+        t0 = None
+        if mesh is not None and batches:
+            try:
+                _check_slice_fault(ctx, "meshex", mesh)
+                shards = place_shards(batches, self.mesh_size)
+                stacked = shard_batches(shards, mesh, self.axis_name)
+                result = self._program(mesh)(stacked)
+                return ("mesh", unshard_batch(result))
+            except Exception as err:
+                _reraise_unless_slice_lost(err)
+                t0 = time.perf_counter()
+        # single-device recompute from lineage: the in-process exchange
+        # over the same child and keys — also the degenerate path when
+        # the mesh never existed or the child produced nothing
+        he = self._host_exchange()
+        out = ("host", [list(he.partition_iter(ctx, pid))
+                        for pid in range(self._num_parts)])
+        if t0 is not None:
+            _note_slice_recovery(ctx, time.perf_counter() - t0)
+        return out
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         yield from self._aligned(self._partition_iter_mesh(ctx, pid))

@@ -1,0 +1,206 @@
+"""Lineage-based stage recovery: recompute lost map outputs in place.
+
+Reference mapping (SURVEY §2.6): when a reduce task hits a terminal
+fetch failure, Spark raises FetchFailedException carrying (shuffleId,
+mapId) and the DAGScheduler resubmits the lost map stage — the lineage
+recomputation model of RDDs (Zaharia et al., NSDI 2012).  The plugin
+inherits that machinery (RapidsShuffleIterator surfaces transport
+failures as FetchFailed); this standalone engine has no DAGScheduler,
+so the equivalent loop lives here:
+
+1. every ShuffleExchangeExec registers a :class:`ShuffleLineage` when
+   it materializes — which child partition produced each map batch,
+   whether the tiny-input coalesce applied, and a conf fingerprint
+   binding the recorded lineage to the settings it ran under;
+2. a reduce pull runs inside :func:`recovering_fetch`; a terminal
+   ``MapOutputLostError`` (dead peer, corrupt spill read-back, slot
+   invalidated mid-pull) names exactly the lost ``(shuffle_id,
+   map_id)`` outputs;
+3. recovery invalidates those outputs (bumping their epochs so a
+   straggling write from the dead attempt is discarded), re-executes
+   ONLY the child partitions that produced them, rewrites the outputs
+   tagged with the new epochs, and resumes the pull where it stopped —
+   nothing already delivered is re-fetched;
+4. a per-stage attempt budget
+   (``spark.rapids.shuffle.recovery.maxStageAttempts``) bounds the
+   loop: outputs that keep dying surface ``StageRecoveryExhausted``
+   instead of recomputing forever.
+
+This is layer 3 of the fault-tolerance ladder (docs/tuning-guide.md
+"Fault tolerance"): transient transport failures never get here
+(shuffle/retry.py resumes them), OOMs never get here (memory/retry.py
+splits them); only confirmed DATA LOSS drives recomputation.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+from spark_rapids_tpu.conf import ConfEntry, register, _bool
+from spark_rapids_tpu.shuffle.errors import (MapOutputLostError,
+                                             StageRecoveryExhausted)
+
+__all__ = ["ShuffleLineage", "recovering_fetch", "conf_fingerprint",
+           "StageRecoveryExhausted"]
+
+RECOVERY_ENABLED = register(ConfEntry(
+    "spark.rapids.shuffle.recovery.enabled", True,
+    "Recompute lost map outputs from lineage instead of failing the "
+    "query: a terminal shuffle-fetch loss (dead peer, corrupt spill "
+    "read-back) invalidates exactly the lost (shuffle, map) outputs, "
+    "re-executes their producing partitions, and resumes the pull "
+    "(reference: FetchFailed -> DAGScheduler map-stage resubmission). "
+    "Disabled, the same losses fail fast with an error naming the lost "
+    "map outputs.", conv=_bool))
+RECOVERY_MAX_ATTEMPTS = register(ConfEntry(
+    "spark.rapids.shuffle.recovery.maxStageAttempts", 4,
+    "Recovery attempts allowed per shuffle stage before giving up with "
+    "StageRecoveryExhausted — map outputs that keep dying after this "
+    "many recomputations indicate a persistent failure recomputation "
+    "cannot outrun (reference spark.stage.maxConsecutiveAttempts).",
+    conv=int))
+
+
+def conf_fingerprint(conf) -> str:
+    """Stable digest of the effective settings.  Stamped onto each
+    exchange at plan time (plan/overrides.py) and recorded in its
+    lineage: recomputation is only deterministic under the exact conf
+    the original map ran with, so the pairing is recorded, auditable,
+    and asserted at recompute time."""
+    settings = getattr(conf, "settings", None)
+    if settings is None:
+        settings = dict(conf) if conf else {}
+    h = hashlib.sha1()
+    for k in sorted(settings, key=str):
+        h.update(f"{k}={settings[k]};".encode())
+    return h.hexdigest()
+
+
+@dataclass
+class ShuffleLineage:
+    """How one shuffle's map outputs were produced — enough to re-run
+    any subset of them deterministically.
+
+    ``map_src`` maps each flat map-batch index (the transport's map_id)
+    to the child partition that produced it; re-draining that child
+    partition yields the same batch sequence, so the k-th produced
+    batch refills the k-th flat index recorded for that partition.
+    """
+
+    exchange: Any            # the ShuffleExchangeExec (owns partitioning)
+    coalesced: bool          # tiny-input rewrite applied on attempt 0
+    num_parts: int           # reduce partition count the maps split into
+    map_src: dict            # flat map_id -> child partition id
+    conf_fp: str | None = None
+
+    def recompute(self, ctx, transport, epochs: dict[int, int]) -> int:
+        """Re-execute the child partitions owning the given map ids and
+        rewrite their outputs tagged with the post-invalidation epochs.
+        Returns the number of map outputs actually rewritten."""
+        if self.conf_fp is not None:
+            now = conf_fingerprint(ctx.conf)
+            if now != self.conf_fp:
+                raise RuntimeError(
+                    f"shuffle {self.exchange.shuffle_id}: conf changed "
+                    f"since the map stage ran ({self.conf_fp[:12]} -> "
+                    f"{now[:12]}); lineage recomputation would not be "
+                    "deterministic")
+        flat_by_cpid: dict[int, list[int]] = {}
+        for bi in sorted(self.map_src):
+            flat_by_cpid.setdefault(self.map_src[bi], []).append(bi)
+        wanted = set(epochs)
+        child = self.exchange.children[0]
+        # uninstrumented iter: a recovery re-drain must not inflate the
+        # child's output metrics a second time
+        impl = type(child).partition_iter
+        impl = getattr(impl, "__wrapped__", impl)
+        done = 0
+        for cpid in sorted({self.map_src[bi] for bi in wanted}):
+            flat = flat_by_cpid[cpid]
+            for k, b in enumerate(impl(child, ctx, cpid)):
+                if k >= len(flat):
+                    break  # nondeterministic child grew; extra output
+                    # has no recorded slot and must not be invented
+                bi = flat[k]
+                if bi not in wanted:
+                    continue
+                self.exchange._write_map_batch(
+                    ctx, transport, bi, b, self.coalesced,
+                    self.num_parts, epoch=epochs[bi])
+                done += 1
+        return done
+
+
+class _RecoveryState:
+    """Per-execution recovery bookkeeping, shared by every concurrent
+    reduce pull: one lock per shuffle serializes its recoveries, and the
+    attempt counters enforce the per-stage budget."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.attempts: dict = {}
+        self._shuffle_locks: dict = {}
+
+    def lock_for(self, shuffle_id) -> threading.Lock:
+        with self._lock:
+            return self._shuffle_locks.setdefault(shuffle_id,
+                                                  threading.Lock())
+
+
+def recovering_fetch(ctx, exchange, transport, pid: int, lo: int,
+                     hi: int | None) -> Iterator:
+    """Pull one reduce partition's map-batch slice through the stage-
+    recovery loop: terminal losses invalidate + recompute + resume at
+    the first undelivered batch (epoch tagging in the store guarantees
+    the resumed stream never mixes attempts)."""
+    delivered = 0
+    while True:
+        try:
+            for b in transport.fetch_partition(
+                    exchange.shuffle_id, pid, lo + delivered, hi):
+                delivered += 1
+                yield b
+            return
+        except MapOutputLostError as err:
+            _recover(ctx, transport, err)
+
+
+def _recover(ctx, transport, err: MapOutputLostError) -> None:
+    """Handle one observed loss: invalidate + recompute the lost map
+    outputs, or raise when recovery is disabled, has no lineage, or the
+    stage's attempt budget ran out."""
+    settings = ctx.conf.settings
+    if not RECOVERY_ENABLED.get(settings):
+        raise err
+    lineage = ctx.lineage_for(err.shuffle_id)
+    if lineage is None:
+        # nothing recorded (remote-only shuffle id, host path): terminal
+        raise err
+    state = ctx.cached(("stage_recovery_state",), _RecoveryState)
+    with state.lock_for(err.shuffle_id):
+        # a concurrent pull may have recovered these outputs while we
+        # waited: only map ids whose epoch has NOT advanced past what
+        # this reader observed are still lost
+        still_lost = {m: e for m, e in err.lost.items()
+                      if transport.map_epoch(err.shuffle_id, m) <= e}
+        if not still_lost:
+            return
+        budget = RECOVERY_MAX_ATTEMPTS.get(settings)
+        used = state.attempts.get(err.shuffle_id, 0)
+        if used >= budget:
+            raise StageRecoveryExhausted(err.shuffle_id, used,
+                                         still_lost) from err
+        state.attempts[err.shuffle_id] = used + 1
+        t0 = time.perf_counter()
+        new_epochs = transport.invalidate_map_outputs(err.shuffle_id,
+                                                      still_lost)
+        done = lineage.recompute(ctx, transport, new_epochs)
+        m = ctx.catalog.metrics
+        m["stage_recomputes"] = m.get("stage_recomputes", 0) + 1
+        m["map_outputs_recomputed"] = \
+            m.get("map_outputs_recomputed", 0) + done
+        m["recovery_wall_s"] = \
+            m.get("recovery_wall_s", 0.0) + (time.perf_counter() - t0)

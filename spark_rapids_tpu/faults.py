@@ -29,6 +29,32 @@ Injection points wired today (site -> actions it interprets):
     store.fetch         local shuffle store reads (ctx: shuffle, part).
                         Action ``error`` raises from the store — over
                         TCP it reaches the client as an error frame.
+    shuffle.peer.dead   terminal peer death, checked on every store /
+                        remote fetch (ctx: shuffle, part).  Any action
+                        name works (use ``dead``); once triggered the
+                        fetch raises MapOutputLostError naming every
+                        map output in the requested slice, driving the
+                        stage-recovery layer instead of the transient
+                        retry ladder.  Points ending in ``.dead``
+                        default to ``times=0`` (a dead peer stays
+                        dead); give an explicit ``times=N`` to model a
+                        peer that is replaced after N failed pulls.
+    spill.disk.corrupt  before a disk spill file is read back (ctx:
+                        buffer_id, priority, size).  Action ``corrupt``
+                        flips one seeded byte of the on-disk payload so
+                        the CRC32C read-back check fails and the
+                        catalog surfaces SpillCorruptionError — data
+                        loss, not a crash.
+    spill.disk.enospc   on each spill-to-disk write (ctx: buffer_id,
+                        priority, size).  Action ``enospc`` makes the
+                        write fail like a full disk; the catalog treats
+                        the buffer as unspillable and lets the PR 2
+                        OOM split-and-retry scope absorb the pressure.
+    mesh.slice.lost     around a mesh program launch (ctx: op, devices).
+                        Action ``lost`` simulates losing a device slice
+                        mid-execution; mesh execs fall back to the
+                        single-device recompute path and count a stage
+                        recompute.
     memory.oom          run_with_spill_retry dispatch (ctx: op) and the
                         operator retry scopes in memory/retry.py (ctx:
                         op, and rows at with_retry sites).  Action
@@ -108,9 +134,12 @@ class FaultRule:
         self.until_rows = (int(self.params["until_rows"])
                            if "until_rows" in self.params else None)
         # until_rows rules fire forever by default: the row threshold,
-        # not a hit budget, is what stops them
-        self.times = int(self.params.get(
-            "times", 0 if self.until_rows is not None else 1))
+        # not a hit budget, is what stops them.  ``.dead`` points also
+        # default to forever — a dead peer stays dead unless the plan
+        # explicitly revives it with times=N
+        default_times = (0 if self.until_rows is not None
+                         or self.point.endswith(".dead") else 1)
+        self.times = int(self.params.get("times", default_times))
         self.p = float(self.params.get("p", 1.0))
         self.filters = {k: v for k, v in self.params.items()
                         if k not in _RESERVED}

@@ -21,8 +21,10 @@ views of the slice.
 """
 from __future__ import annotations
 
+import errno
 import os
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -33,7 +35,70 @@ from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.conf import ConfEntry, register, _bool
 
 __all__ = ["BufferCatalog", "SpillPriority", "SpillableColumnarBatch",
-           "DeviceSemaphore", "run_with_spill_retry"]
+           "SpillCorruptionError", "DeviceSemaphore", "run_with_spill_retry"]
+
+#: spill-file integrity checksum: CRC32C when the C binding is present,
+#: zlib's CRC32 otherwise (same ladder as the TCP frame checksum in
+#: shuffle/tcp.py — the disk tier must carry its own integrity just
+#: like the DCN plane does)
+try:
+    import google_crc32c as _gcrc32c
+
+    _SPILL_CRC_NAME, _spill_crc = "crc32c", _gcrc32c.value
+except ImportError:  # pragma: no cover - env without the binding
+    _SPILL_CRC_NAME, _spill_crc = "crc32", zlib.crc32
+
+
+class SpillCorruptionError(RuntimeError):
+    """A spilled buffer's disk read-back failed its checksum (or its
+    storage was invalidated): the DATA is lost, not the operation.
+    Consumers that can recompute the buffer from lineage (the shuffle
+    store -> exec/recovery.py) translate this into MapOutputLostError;
+    everything else fails with a diagnosable error instead of silently
+    consuming flipped bytes."""
+
+
+def _sidecar(path: str) -> str:
+    return path + ".crc"
+
+
+def _write_sidecar(path: str, value: int, nbytes: int) -> None:
+    with open(_sidecar(path), "w") as f:
+        f.write(f"{_SPILL_CRC_NAME}:{value & 0xFFFFFFFF:08x}:{nbytes}")
+
+
+def _verify_sidecar(path: str, data) -> None:
+    """Check ``data`` (bytes-like) against the spill file's sidecar;
+    raises SpillCorruptionError on mismatch or a missing/garbled
+    sidecar — an unverifiable spill file is treated as lost, never
+    trusted."""
+    try:
+        with open(_sidecar(path)) as f:
+            algo, want_hex, want_len = f.read().strip().split(":")
+    except (OSError, ValueError) as e:
+        raise SpillCorruptionError(
+            f"spill file {path} has no readable checksum sidecar: "
+            f"{type(e).__name__}: {e}") from e
+    if algo != _SPILL_CRC_NAME:
+        raise SpillCorruptionError(
+            f"spill file {path} was checksummed with {algo!r} but this "
+            f"process verifies {_SPILL_CRC_NAME!r}")
+    got = _spill_crc(bytes(data)) & 0xFFFFFFFF
+    if int(want_len) != len(data) or got != int(want_hex, 16):
+        raise SpillCorruptionError(
+            f"spill file {path} failed its {algo} read-back check "
+            f"(wrote {want_hex}/{want_len}B, read {got:08x}/"
+            f"{len(data)}B): corrupted on disk")
+
+
+def _is_enospc(e: OSError) -> bool:
+    return e.errno in (errno.ENOSPC, errno.EDQUOT)
+
+
+class _SpillDiskFull(RuntimeError):
+    """Internal: the disk tier is full; the buffer stays where it is and
+    the spill pass returns what it already freed, letting the OOM
+    split-and-retry scope (memory/retry.py) absorb the pressure."""
 
 
 DEVICE_SPILL_LIMIT = register(ConfEntry(
@@ -51,6 +116,13 @@ MEMORY_DEBUG = register(ConfEntry(
     "are still registered at close (reference "
     "spark.rapids.memory.gpu.debug -> cudf MemoryCleaner, "
     "RapidsConf.scala:288).", conv=_bool))
+SPILL_DIR = register(ConfEntry(
+    "spark.rapids.memory.spill.dir", "",
+    "Directory for disk-tier spill files (one file per buffer plus a "
+    ".crc checksum sidecar). Empty = $TMPDIR/srt_spill_<pid>. Files "
+    "are fsynced before the catalog entry flips to tier=disk and "
+    "deleted on restore, invalidation, and catalog close (reference "
+    "spark.local.dir placement of RapidsDiskStore block files)."))
 
 
 class SpillPriority:
@@ -66,7 +138,7 @@ class _Entry:
     priority: int
     size: int
     refcount: int = 0
-    tier: str = "device"            # device | host | disk
+    tier: str = "device"            # device | host | disk | lost
     batch: ColumnBatch | None = None
     # host/disk tier state
     treedef: Any = None
@@ -114,7 +186,7 @@ class BufferCatalog:
             self._arena_obj = get_pinned_arena(
                 max(self._host_limit, pinned))
             self._arena_shared = True
-        self._spill_dir_base = spill_dir
+        self._spill_dir_base = spill_dir or SPILL_DIR.get(settings) or None
         self._spill_dir_made: str | None = None
         # deterministic fault plan (spark.rapids.test.faults): the
         # memory.oom point drives run_with_spill_retry exactly like a
@@ -129,7 +201,14 @@ class BufferCatalog:
                         # halved when spill freed nothing, and the HBM
                         # pressure high-watermark of registered batches
                         "oom_retries": 0, "oom_splits": 0,
-                        "device_bytes_peak": 0}
+                        "device_bytes_peak": 0,
+                        # disk-tier integrity + stage recovery
+                        # (exec/recovery.py bumps the recovery counters;
+                        # they live here because the catalog is the one
+                        # metrics sink the bench runner already exports)
+                        "spill_crc_failures": 0, "spill_enospc": 0,
+                        "stage_recomputes": 0, "map_outputs_recomputed": 0,
+                        "recovery_wall_s": 0.0}
 
     @property
     def _arena(self):
@@ -202,7 +281,14 @@ class BufferCatalog:
         for e in self._spillable_locked():
             if freed >= target:
                 break
-            self._spill_one_to_host_locked(e)
+            try:
+                self._spill_one_to_host_locked(e)
+            except _SpillDiskFull:
+                # disk tier is full: stop spilling and report what was
+                # freed so far (possibly 0) — the OOM retry scope then
+                # splits its input instead of the operator crashing on a
+                # write error (ENOSPC degrades into PR 2's retry path)
+                break
             freed += e.size
         return freed
 
@@ -240,8 +326,26 @@ class BufferCatalog:
                 flat = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
                 packed[m[3]:m[3] + m[2]] = flat
             path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
-            with open(path, "wb") as f:
-                f.write(packed.tobytes())
+            data = packed.tobytes()
+            try:
+                self._check_enospc_fault(e)
+                with open(path, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    # durable BEFORE the entry flips to tier=disk: a
+                    # torn page-cache write must not become the only
+                    # copy of the buffer
+                    os.fsync(f.fileno())
+                _write_sidecar(path, _spill_crc(data), len(data))
+            except OSError as ex:
+                if not _is_enospc(ex):
+                    raise
+                self.metrics["spill_enospc"] += 1
+                _unlink_quiet(path)
+                _unlink_quiet(_sidecar(path))
+                e.treedef = None
+                e.leaf_meta = None
+                raise _SpillDiskFull(str(ex)) from ex
             e.disk_path = path
             e.tier = "disk"
             self.metrics["bytes_spilled_to_disk"] += total
@@ -259,7 +363,27 @@ class BufferCatalog:
         e = cands[0]
         total = _align_total(e.leaf_meta)
         path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
-        self._arena.write_to_disk(e.arena_offset, total, path)
+        # checksum the arena slice (the source of truth) before it is
+        # freed; verified against the file on read-back
+        crc = _spill_crc(bytes(self._arena.view(e.arena_offset, total)))
+        try:
+            self._check_enospc_fault(e)
+            self._arena.write_to_disk(e.arena_offset, total, path)
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            _write_sidecar(path, crc, total)
+        except OSError as ex:
+            if not _is_enospc(ex):
+                raise
+            # full disk: the buffer stays on the host tier; callers see
+            # False ("nothing moved") and stop pushing
+            self.metrics["spill_enospc"] += 1
+            _unlink_quiet(path)
+            _unlink_quiet(_sidecar(path))
+            return False
         self._arena.free(e.arena_offset)
         e.arena_offset = None
         e.disk_path = path
@@ -271,16 +395,28 @@ class BufferCatalog:
     # -- unspill ---------------------------------------------------------
     def _unspill_locked(self, e: _Entry) -> None:
         import jax.numpy as jnp
+        if e.tier == "lost":
+            raise SpillCorruptionError(
+                f"buffer {e.buffer_id}: storage was lost to disk "
+                "corruption; only lineage recomputation can restore it")
         total = _align_total(e.leaf_meta)
         if e.tier == "disk" and e.arena_offset is None:
+            self._check_corrupt_fault(e)
             # oversized direct-to-disk buffers restore without the arena
             if total > self._arena.capacity:
                 with open(e.disk_path, "rb") as f:
-                    packed = np.frombuffer(f.read(), np.uint8)
+                    raw = f.read()
+                try:
+                    _verify_sidecar(e.disk_path, raw)
+                except SpillCorruptionError:
+                    self._mark_lost_locked(e)
+                    raise
+                packed = np.frombuffer(raw, np.uint8)
                 leaves = [jnp.asarray(np.frombuffer(
                     packed[rel:rel + nb].tobytes(), dtype=dtype
                 ).reshape(shape)) for dtype, shape, nb, rel in e.leaf_meta]
                 os.unlink(e.disk_path)
+                _unlink_quiet(_sidecar(e.disk_path))
                 e.disk_path = None
                 self._finish_unspill_locked(e, leaves)
                 return
@@ -291,10 +427,17 @@ class BufferCatalog:
                 off = self._arena.alloc(max(total, 1))
             try:
                 self._arena.read_from_disk(off, total, e.disk_path)
+                _verify_sidecar(e.disk_path,
+                                bytes(self._arena.view(off, total)))
+            except SpillCorruptionError:
+                self._arena.free(off)
+                self._mark_lost_locked(e)
+                raise
             except Exception:
                 self._arena.free(off)
                 raise
             os.unlink(e.disk_path)
+            _unlink_quiet(_sidecar(e.disk_path))
             e.disk_path = None
             e.arena_offset = off
             e.tier = "host"
@@ -318,16 +461,56 @@ class BufferCatalog:
         if self.device_used > self.device_limit:
             self._spill_device_locked(self.device_used - self.device_limit)
 
+    def _check_enospc_fault(self, e: _Entry) -> None:
+        """spill.disk.enospc injection point: make a spill-to-disk write
+        fail exactly like a full disk would."""
+        if self.faults is not None:
+            act = self.faults.check("spill.disk.enospc",
+                                    buffer_id=e.buffer_id,
+                                    priority=e.priority, size=e.size)
+            if act is not None:
+                raise OSError(errno.ENOSPC,
+                              "injected fault: no space left on device")
+
+    def _check_corrupt_fault(self, e: _Entry) -> None:
+        """spill.disk.corrupt injection point: flip one seeded byte of
+        the on-disk payload so the read-back checksum catches it — real
+        bit rot as the verifier sees it."""
+        if self.faults is not None and e.disk_path:
+            act = self.faults.check("spill.disk.corrupt",
+                                    buffer_id=e.buffer_id,
+                                    priority=e.priority, size=e.size)
+            if act is not None:
+                with open(e.disk_path, "r+b") as f:
+                    data = f.read()
+                    if data:
+                        i = act.rng.randrange(len(data))
+                        f.seek(i)
+                        f.write(bytes([data[i] ^ 0xFF]))
+
+    def _mark_lost_locked(self, e: _Entry) -> None:
+        """Corrupt read-back: drop the unverifiable storage and mark the
+        entry lost so every later acquire fails fast with
+        SpillCorruptionError instead of re-reading flipped bytes."""
+        self.metrics["spill_crc_failures"] += 1
+        if e.disk_path:
+            _unlink_quiet(e.disk_path)
+            _unlink_quiet(_sidecar(e.disk_path))
+        e.disk_path = None
+        e.arena_offset = None
+        e.batch = None
+        e.treedef = None
+        e.leaf_meta = None
+        e.tier = "lost"
+
     def _drop_storage_locked(self, e: _Entry) -> None:
         if e.tier == "device":
             self.device_used -= e.size
         elif e.tier == "host" and e.arena_offset is not None:
             self._arena.free(e.arena_offset)
         elif e.tier == "disk" and e.disk_path:
-            try:
-                os.unlink(e.disk_path)
-            except OSError:
-                pass
+            _unlink_quiet(e.disk_path)
+            _unlink_quiet(_sidecar(e.disk_path))
         e.batch = None
 
     # -- introspection ---------------------------------------------------
@@ -363,6 +546,13 @@ class BufferCatalog:
             self._arena_obj = None
 
 
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 def _align(n: int) -> int:
     return (n + 63) & ~63
 
@@ -396,13 +586,21 @@ class SpillableColumnarBatch:
         batch is no longer referenced (reference incRefCount/close
         contract) so the catalog cannot spill HBM still in use."""
         with self._lock:
-            assert not self._closed, "get() after close()"
+            if self._closed:
+                # a stage recovery invalidated this map output while a
+                # concurrent pull still held the handle: that pull's
+                # data is gone, which is loss, not a usage bug
+                raise SpillCorruptionError(
+                    f"buffer {self._id}: handle closed by a concurrent "
+                    "invalidation")
             b = self._catalog.acquire(self._id)
             self._pins += 1
             return b
 
     def unpin(self) -> None:
         with self._lock:
+            if self._closed:
+                return  # close() already released every pin
             assert self._pins > 0
             self._catalog.release(self._id)
             self._pins -= 1

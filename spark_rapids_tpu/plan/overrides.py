@@ -472,6 +472,7 @@ class TpuOverrides:
         self._insert_transitions(root)
         self._align_mesh_outputs(root)
         self._mark_shared_scans(root)
+        self._stamp_lineage(root)
         explain_mode = self.conf.explain
         if explain and explain_mode and explain_mode != "NONE":
             text = self.explain(root, only_fallback=(explain_mode
@@ -484,6 +485,26 @@ class TpuOverrides:
 
     def apply(self, root: PlannedNode) -> PlanNode:
         return self.prepare(root, explain=True)
+
+    def _stamp_lineage(self, root: PlannedNode) -> None:
+        """Stamp every exchange with the effective conf's fingerprint.
+        Stage recovery (exec/recovery.py) re-executes lost map
+        partitions from the exchange's recorded lineage, which is only
+        deterministic under the settings the original map ran with —
+        the stamp binds the two so a recompute under a drifted conf
+        fails loudly instead of producing a silently different
+        shuffle."""
+        from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+        from spark_rapids_tpu.exec.recovery import conf_fingerprint
+        fp = conf_fingerprint(self.conf)
+
+        def walk(node) -> None:
+            if isinstance(node, ShuffleExchangeExec):
+                node._conf_fp = fp
+            for c in node.children:
+                walk(c)
+
+        walk(root.exec_node)
 
     def _mark_shared_scans(self, root: PlannedNode) -> None:
         """Scans whose (files, columns, pushdown) fingerprint appears

@@ -13,9 +13,13 @@ plans.
 
 Fault tolerance: cross-process pulls go through shuffle/retry.py —
 resumable retrying fetches (exponential backoff + jitter, per-peer
-circuit breaker) over tcp.py's checksummed frame protocol; the
-deterministic fault-injection plan (spark.rapids.test.faults,
-spark_rapids_tpu/faults.py) exercises every failure path in-process.
+circuit breaker) over tcp.py's checksummed frame protocol; TERMINAL
+data loss (shuffle/errors.py MapOutputLostError) bypasses the retry
+ladder and drives lineage recomputation of exactly the lost map
+outputs (exec/recovery.py), with epoch-tagged writes so a straggler
+from a dead attempt is discarded.  The deterministic fault-injection
+plan (spark.rapids.test.faults, spark_rapids_tpu/faults.py) exercises
+every failure path in-process.
 """
 from __future__ import annotations
 
@@ -38,8 +42,14 @@ class ShuffleTransport(Protocol):
     """
 
     def write_partition(self, shuffle_id: "int | str", map_id: int, part_id: int,
-                        batch) -> None:
-        """Store one map-output batch for (shuffle, map, partition)."""
+                        batch, epoch: int | None = None) -> None:
+        """Store one map-output batch for (shuffle, map, partition).
+
+        ``epoch`` tags the write with a map-output attempt: None means
+        "the map task's current epoch" (the common case); stage
+        recovery passes the post-invalidation epoch so a straggling
+        write from the superseded attempt is discarded instead of
+        mixed into the recovered stream."""
         ...
 
     def fetch_partition(self, shuffle_id: "int | str", part_id: int,

@@ -1,0 +1,99 @@
+"""Shuffle failure taxonomy: transient vs. terminal loss.
+
+The three-layer fault-tolerance model (docs/tuning-guide.md "Fault
+tolerance") needs every layer to agree on WHAT failed before deciding
+WHO handles it:
+
+* ``ShuffleTransportError`` — the *connection* died (reset, stall past
+  the deadline, frame checksum mismatch).  The map output is still
+  intact at the peer; shuffle/retry.py reconnects and resumes
+  (layer 1, transient).
+* ``MapOutputLostError`` — the *data* died: a spilled map-output file
+  came back corrupt, a peer is terminally dead, or a store slot was
+  invalidated mid-fetch (stale epoch).  Retrying the fetch cannot
+  help; the exchange's stage-recovery layer (exec/recovery.py)
+  invalidates exactly the named ``(shuffle_id, map_id)`` outputs and
+  recomputes them from lineage (layer 3, terminal).  ``lost`` maps
+  each dead map id to the output EPOCH the reader observed, so a
+  concurrent recovery that already advanced the epoch is not redone.
+* ``StageRecoveryExhausted`` — recovery itself gave up: the per-stage
+  attempt budget (``spark.rapids.shuffle.recovery.maxStageAttempts``)
+  ran out while the same map outputs kept dying.
+
+Reference mapping (SURVEY §2.6): FetchFailedException carries
+(shuffleId, mapId) up to Spark's DAGScheduler, which resubmits the
+lost map stage — the lineage-recomputation model of RDDs (Zaharia et
+al., NSDI 2012).  This standalone engine has no DAGScheduler above it,
+so the classification lives here and the resubmission in
+exec/recovery.py.
+"""
+from __future__ import annotations
+
+__all__ = ["ShuffleFetchError", "ShuffleTransportError",
+           "MapOutputLostError", "StageRecoveryExhausted"]
+
+
+class ShuffleFetchError(RuntimeError):
+    """A peer reported a server-side failure while serving a fetch."""
+
+    #: True when retrying the same fetch cannot succeed (the data is
+    #: gone, not just this connection) — the retry ladder re-raises
+    #: instead of burning backoff attempts.
+    terminal: bool = False
+
+
+class ShuffleTransportError(ShuffleFetchError):
+    """The transport itself failed (reset, stall past the timeout,
+    desynced or corrupted frame) — always retryable: the map output is
+    still intact at the peer, only this connection's stream died."""
+
+
+class MapOutputLostError(ShuffleFetchError):
+    """Terminal loss of specific map outputs of one shuffle.
+
+    ``lost`` maps each dead ``map_id`` to the output epoch the reader
+    observed when the loss surfaced; stage recovery skips any map id
+    whose store epoch has already advanced past the observed one
+    (a concurrent pull recovered it first).
+    """
+
+    terminal = True
+
+    def __init__(self, shuffle_id, part_id: int, lost: dict,
+                 detail: str = ""):
+        self.shuffle_id = shuffle_id
+        self.part_id = part_id
+        self.lost = dict(lost)
+        ids = ", ".join(f"map {m} (epoch {e})"
+                        for m, e in sorted(self.lost.items()))
+        msg = (f"map output lost: shuffle {shuffle_id} part {part_id} "
+               f"[{ids}]")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+    @classmethod
+    def parse(cls, shuffle_id, part_id: int,
+              payload: dict) -> "MapOutputLostError":
+        """Rebuild from a wire payload (tcp.py MAP_OUTPUT_LOST error
+        frame): map ids arrive as JSON object keys, i.e. strings."""
+        lost = {int(k): int(v)
+                for k, v in (payload.get("lost") or {}).items()}
+        return cls(payload.get("shuffle_id", shuffle_id),
+                   int(payload.get("part_id", part_id)), lost,
+                   payload.get("detail", "reported by peer"))
+
+
+class StageRecoveryExhausted(RuntimeError):
+    """The per-stage recovery attempt budget ran out: the same shuffle
+    kept losing map outputs after ``maxStageAttempts`` recomputations."""
+
+    def __init__(self, shuffle_id, attempts: int, lost: dict):
+        self.shuffle_id = shuffle_id
+        self.attempts = attempts
+        self.lost = dict(lost)
+        super().__init__(
+            f"stage recovery exhausted for shuffle {shuffle_id}: map "
+            f"outputs {sorted(self.lost)} still lost after {attempts} "
+            f"recovery attempts "
+            f"(spark.rapids.shuffle.recovery.maxStageAttempts)")

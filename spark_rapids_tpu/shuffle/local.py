@@ -12,21 +12,49 @@ catalog.  Here:
   serializer.py) and compressed into host bytes — the
   GpuColumnarBatchSerializer + nvcomp path — and restored on fetch.
 
+Stage recovery (exec/recovery.py) makes the store LOSS-AWARE: every
+stored batch lives in a ``_Slot`` tagged with its producing map id and
+an output EPOCH.  ``invalidate_map_outputs`` marks a map task's slots
+lost IN PLACE — positions never shift, so the adaptive reader's
+``(part_id, lo, hi)`` slices and a resumed pull's batch index stay
+valid across a recovery — and bumps the map's epoch so a straggling
+write from the previous attempt is discarded instead of mixed in
+(the epoch-tagging analog of Spark's stage attempt ids on map status).
+Fetching a lost slot raises ``MapOutputLostError`` naming exactly the
+dead ``(shuffle_id, map_id)`` outputs; a spill file that fails its
+read-back checksum (memory/catalog.py SpillCorruptionError) is
+reclassified the same way — data loss drives recomputation, not a
+query abort.
+
 Multi-host planes (ICI collectives / DCN) implement the same SPI; the
 planner's mesh path (exec/mesh_exec.py) is the ICI plane.
 """
 from __future__ import annotations
 
 import threading
-from typing import Iterable
+from dataclasses import dataclass
+from typing import Any, Iterable
 
 from spark_rapids_tpu.conf import (SHUFFLE_COMPRESSION_CODEC,
                                    SHUFFLE_MAX_METADATA_SIZE, TpuConf)
 from spark_rapids_tpu.shuffle.compression import get_codec
+from spark_rapids_tpu.shuffle.errors import MapOutputLostError
 from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
                                                  serialize_batch)
 
 __all__ = ["LocalShuffleTransport"]
+
+
+@dataclass
+class _Slot:
+    """One map-output batch's position in a reduce partition's fetch
+    order.  ``item is None`` means the output was invalidated and not
+    yet recomputed; the slot keeps its position so resumed pulls and
+    AQE skew-split ranges stay aligned across recoveries."""
+    map_id: int
+    epoch: int
+    item: Any          # ("spillable", scb) | ("bytes", data, raw) | None
+    size: int
 
 
 class LocalShuffleTransport:
@@ -44,21 +72,26 @@ class LocalShuffleTransport:
         # registry per transport so nth/times counters span its lifetime.
         self.faults = FaultRegistry.from_conf(conf)
         self._lock = threading.Lock()
-        # (shuffle_id, part_id) -> list of stored items in map order
-        self._store: dict[tuple, list] = {}
+        # (shuffle_id, part_id) -> list of _Slot in map-batch order
+        self._store: dict[tuple, list[_Slot]] = {}
         self._sizes: dict[tuple, int] = {}
         self._batch_sizes: dict[tuple, list[int]] = {}
+        # (shuffle_id, map_id) -> current output epoch; a write tagged
+        # with an older epoch raced a recovery and is discarded
+        self._epochs: dict[tuple, int] = {}
         self.metrics = {"bytes_written": 0, "bytes_compressed": 0,
-                        "batches_written": 0}
+                        "batches_written": 0, "stale_writes_discarded": 0,
+                        "map_outputs_invalidated": 0}
 
     # -- SPI ------------------------------------------------------------
-    def write_partition(self, shuffle_id: "int | str", map_id: int, part_id: int,
-                        batch) -> None:
+    def write_partition(self, shuffle_id: "int | str", map_id: int,
+                        part_id: int, batch, epoch: int | None = None) -> None:
         if self.codec is None and self.ctx is not None:
             from spark_rapids_tpu.memory.catalog import (
                 SpillableColumnarBatch, SpillPriority)
             item = ("spillable", SpillableColumnarBatch(
                 batch, self.ctx.catalog, SpillPriority.SHUFFLE_OUTPUT))
+            size = batch.device_size_bytes()
         else:
             raw = serialize_batch(batch, self.max_metadata)
             self.metrics["bytes_written"] += len(raw)
@@ -68,17 +101,80 @@ class LocalShuffleTransport:
                 item = ("bytes", comp, len(raw))
             else:
                 item = ("bytes", raw, len(raw))
-        if item[0] == "spillable":
-            size = batch.device_size_bytes()
-        else:
             size = len(item[1])
+        stale = None
         with self._lock:
-            self._store.setdefault((shuffle_id, part_id), []).append(item)
-            self._sizes[(shuffle_id, part_id)] = \
-                self._sizes.get((shuffle_id, part_id), 0) + size
-            self._batch_sizes.setdefault((shuffle_id, part_id),
-                                         []).append(size)
+            current = self._epochs.get((shuffle_id, map_id), 0)
+            eff = current if epoch is None else epoch
+            if eff < current:
+                # a prior attempt's straggler landed after recovery
+                # already invalidated this map output: discard, never mix
+                # epochs within one partition stream
+                self.metrics["stale_writes_discarded"] += 1
+                stale = item
+            else:
+                slots = self._store.setdefault((shuffle_id, part_id), [])
+                refill = next((s for s in slots
+                               if s.map_id == map_id and s.item is None),
+                              None)
+                if refill is not None:
+                    refill.item = item
+                    refill.epoch = eff
+                    refill.size = size
+                    idx = slots.index(refill)
+                    self._batch_sizes[(shuffle_id, part_id)][idx] = size
+                else:
+                    slots.append(_Slot(map_id, eff, item, size))
+                    self._batch_sizes.setdefault((shuffle_id, part_id),
+                                                 []).append(size)
+                self._sizes[(shuffle_id, part_id)] = \
+                    self._sizes.get((shuffle_id, part_id), 0) + size
+        if stale is not None:
+            if stale[0] == "spillable":
+                stale[1].close()
+            return
         self.metrics["batches_written"] += 1
+
+    def map_epoch(self, shuffle_id: "int | str", map_id: int) -> int:
+        with self._lock:
+            return self._epochs.get((shuffle_id, map_id), 0)
+
+    def invalidate_map_outputs(self, shuffle_id: "int | str",
+                               map_ids: Iterable[int]) -> dict[int, int]:
+        """Mark every stored output of the given map tasks lost, bump
+        their epochs, and free their storage (including spill files, via
+        the catalog entry's close).  Returns map_id -> new epoch; writes
+        tagged with an older epoch are discarded from now on.  Slots
+        keep their positions so in-flight pulls and AQE ranges survive
+        the recovery."""
+        wanted = set(map_ids)
+        to_close = []
+        new_epochs: dict[int, int] = {}
+        with self._lock:
+            for m in wanted:
+                new_epochs[m] = self._epochs.get((shuffle_id, m), 0) + 1
+                self._epochs[(shuffle_id, m)] = new_epochs[m]
+            for (sid, pid), slots in self._store.items():
+                if sid != shuffle_id:
+                    continue
+                for s in slots:
+                    if s.map_id in wanted and s.item is not None:
+                        to_close.append(s.item)
+                        s.item = None
+                        # advance to the post-invalidation epoch: a pull
+                        # that later observes this still-empty slot must
+                        # report the CURRENT epoch, or recovery would
+                        # judge it already-recovered and never retry
+                        s.epoch = new_epochs[s.map_id]
+                        self._sizes[(sid, pid)] -= s.size
+                        self.metrics["map_outputs_invalidated"] += 1
+        # close OUTSIDE the transport lock: spillable close takes the
+        # catalog lock (and may unlink disk files); nesting the two
+        # orders would deadlock against spill paths fetching from us
+        for item in to_close:
+            if item[0] == "spillable":
+                item[1].close()
+        return new_epochs
 
     def partition_sizes(self, shuffle_id: "int | str") -> dict[int, int]:
         """Map-output statistics per reduce partition (reference
@@ -93,17 +189,55 @@ class LocalShuffleTransport:
         with self._lock:
             return list(self._batch_sizes.get((shuffle_id, part_id), ()))
 
+    def _slice_or_lost(self, shuffle_id, part_id, lo, hi) -> list[_Slot]:
+        """Snapshot the requested slot slice, raising MapOutputLostError
+        naming EVERY lost map output in it (recovery recomputes them all
+        in one stage attempt, not one per failed fetch)."""
+        self._check_fetch_fault(shuffle_id, part_id)
+        with self._lock:
+            slots = list(self._store.get((shuffle_id, part_id), ()))[lo:hi]
+            lost = {s.map_id: s.epoch for s in slots if s.item is None}
+        if self.faults is not None and slots:
+            act = self.faults.check("shuffle.peer.dead", shuffle=shuffle_id,
+                                    part=part_id)
+            if act is not None:
+                raise MapOutputLostError(
+                    shuffle_id, part_id,
+                    {s.map_id: s.epoch for s in slots},
+                    "injected fault: shuffle.peer.dead")
+        if lost:
+            raise MapOutputLostError(shuffle_id, part_id, lost,
+                                     "slot invalidated and not recomputed")
+        return slots
+
+    def _get_spillable(self, scb, slot: _Slot, shuffle_id, part_id):
+        """Materialize a spillable slot, reclassifying a corrupt spill
+        read-back (or a handle closed by a concurrent invalidation) as
+        terminal loss of that map output: the data is gone no matter how
+        often the fetch retries."""
+        from spark_rapids_tpu.memory.catalog import SpillCorruptionError
+        try:
+            return scb.get()
+        except SpillCorruptionError as e:
+            raise MapOutputLostError(
+                shuffle_id, part_id, {slot.map_id: slot.epoch},
+                f"spill read-back failed its checksum: {e}") from e
+
     def fetch_partition(self, shuffle_id: "int | str", part_id: int,
                         lo: int = 0, hi: int | None = None) -> Iterable:
         """Stream one reduce partition's batches, optionally only the
         map-batch slice [lo, hi) — the adaptive reader's skew-split
         groups fetch their own range without materializing the rest."""
-        self._check_fetch_fault(shuffle_id, part_id)
-        with self._lock:
-            items = list(self._store.get((shuffle_id, part_id), ()))
-        for item in items[lo:hi]:
+        for slot in self._slice_or_lost(shuffle_id, part_id, lo, hi):
+            # snapshot: a concurrent invalidation nulls slot.item in
+            # place, and we must not flip representations mid-iteration
+            item = slot.item
+            if item is None:
+                raise MapOutputLostError(
+                    shuffle_id, part_id, {slot.map_id: slot.epoch},
+                    "invalidated while the pull was in flight")
             if item[0] == "spillable":
-                b = item[1].get()
+                b = self._get_spillable(item[1], slot, shuffle_id, part_id)
                 try:
                     yield b
                 finally:
@@ -127,12 +261,14 @@ class LocalShuffleTransport:
         RapidsShuffleServer: acquire from catalog -> copy to bounce
         buffer -> send)."""
         import struct
-        self._check_fetch_fault(shuffle_id, part_id)
-        with self._lock:
-            items = list(self._store.get((shuffle_id, part_id), ()))
-        for item in items[lo:hi]:
+        for slot in self._slice_or_lost(shuffle_id, part_id, lo, hi):
+            item = slot.item
+            if item is None:
+                raise MapOutputLostError(
+                    shuffle_id, part_id, {slot.map_id: slot.epoch},
+                    "invalidated while the pull was in flight")
             if item[0] == "spillable":
-                b = item[1].get()
+                b = self._get_spillable(item[1], slot, shuffle_id, part_id)
                 try:
                     raw = serialize_batch(b, self.max_metadata)
                 finally:
@@ -164,7 +300,8 @@ class LocalShuffleTransport:
 
     def close(self) -> None:
         with self._lock:
-            items = [i for lst in self._store.values() for i in lst]
+            items = [s.item for lst in self._store.values() for s in lst
+                     if s.item is not None]
             self._store.clear()
         for item in items:
             if item[0] == "spillable":

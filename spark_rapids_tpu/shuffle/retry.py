@@ -25,6 +25,13 @@ scheduler above it, so the transport-level ladder lives HERE:
   instead of burning the full backoff ladder per partition against a
   dead host.  After ``circuitBreaker.resetSeconds`` one probe attempt
   is allowed through (half-open); success closes the breaker.
+* TERMINAL errors bypass the ladder entirely: a ``MapOutputLostError``
+  (the peer's data is gone, not its connection) re-raises immediately
+  so stage recovery (exec/recovery.py) can recompute the lost outputs
+  — retrying a fetch of destroyed data only delays that.  Conversely,
+  ladder exhaustion and an open breaker mark THEIR errors terminal
+  (``.terminal = True``): the transient machinery has given up, and
+  whatever sits above must not spin on them either.
 
 With no faults and a healthy peer the success path is exactly ONE
 ``fetch_remote`` call — the retry layer adds no round trips.
@@ -97,11 +104,15 @@ class PeerCircuitBreaker:
                 return
             age = time.monotonic() - self._opened_at
             if age < reset_seconds:
-                raise ShuffleFetchError(
+                err = ShuffleFetchError(
                     f"circuit breaker open for shuffle peer {self.peer}: "
                     f"{self.failures} consecutive fetch failures "
                     f"(last: {self.last_error}); next probe in "
                     f"{reset_seconds - age:.1f}s")
+                # the transient ladder has given up on this peer: callers
+                # above must recover (or fail), not re-enter the ladder
+                err.terminal = True
+                raise err
             # half-open: let this attempt probe the peer
 
     def record_failure(self, err: BaseException, threshold: int) -> None:
@@ -229,14 +240,22 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
             breaker.record_success()
             return
         except ShuffleFetchError as e:
+            if getattr(e, "terminal", False):
+                # the DATA is gone (MapOutputLostError names which map
+                # outputs), not the connection: reconnecting cannot help
+                # and must not count against this peer's breaker —
+                # surface straight to stage recovery
+                raise
             breaker.record_failure(e, threshold)
             failures = 1 if delivered > before else failures + 1
             if failures > max_retries:
-                raise ShuffleFetchError(
+                err = ShuffleFetchError(
                     f"fetch of shuffle {shuffle_id} part {part_id} from "
                     f"{peer}: giving up after {failures} consecutive "
                     f"failed attempts ({delivered} batches delivered, "
-                    f"resume offset {lo + delivered}): {e}") from e
+                    f"resume offset {lo + delivered}): {e}")
+                err.terminal = True
+                raise err from e
             _backoff_sleep(retry_wait, backoff, failures, rng)
 
 

@@ -53,11 +53,16 @@ from typing import Iterable
 
 from spark_rapids_tpu.conf import ConfEntry, register, parse_bytes, _bool
 from spark_rapids_tpu.shuffle.compression import get_codec
+# re-exported for backward compatibility: these historically lived here
+from spark_rapids_tpu.shuffle.errors import (MapOutputLostError,
+                                             ShuffleFetchError,
+                                             ShuffleTransportError)
 from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
 from spark_rapids_tpu.shuffle.serializer import deserialize_batch
 
 __all__ = ["TcpShuffleTransport", "TcpShuffleServer", "ShuffleFetchError",
-           "ShuffleTransportError", "fetch_remote", "remote_partition_sizes"]
+           "ShuffleTransportError", "MapOutputLostError", "fetch_remote",
+           "remote_partition_sizes"]
 
 TCP_PORT = register(ConfEntry(
     "spark.rapids.shuffle.tcp.port", 0,
@@ -132,14 +137,25 @@ def _max_frame(conf=None) -> int:
     return max(_MAX_FRAME_MIN, 2 * conf.batch_size_bytes)
 
 
-class ShuffleFetchError(RuntimeError):
-    """A peer reported a server-side failure while serving a fetch."""
+#: error-frame prefix carrying a structured terminal-loss payload: the
+#: server's store lost map outputs, and the client must surface WHICH
+#: ones so stage recovery can recompute exactly those (not retry)
+_LOST_MARKER = "MAP_OUTPUT_LOST "
 
 
-class ShuffleTransportError(ShuffleFetchError):
-    """The transport itself failed (reset, stall past the timeout,
-    desynced or corrupted frame) — always retryable: the map output is
-    still intact at the peer, only this connection's stream died."""
+def _raise_error_frame(body: bytes, shuffle_id, part_id: int) -> None:
+    """Decode a _TAG_ERROR payload into the right exception class: a
+    MAP_OUTPUT_LOST marker means terminal data loss at the peer (raise
+    MapOutputLostError with the lost map ids), anything else is a plain
+    server-side ShuffleFetchError."""
+    text = body.decode()
+    if text.startswith(_LOST_MARKER):
+        try:
+            payload = json.loads(text[len(_LOST_MARKER):])
+        except ValueError:
+            raise ShuffleFetchError(text) from None
+        raise MapOutputLostError.parse(shuffle_id, part_id, payload)
+    raise ShuffleFetchError(text)
 
 
 def _send_frame(sock: socket.socket, tag: bytes, payload: bytes = b"") -> None:
@@ -213,6 +229,17 @@ class TcpShuffleServer:
                         self._serve_one(conn, req)
                     except (ConnectionError, OSError):
                         return
+                    except MapOutputLostError as e:
+                        # terminal loss: ship the structured payload so
+                        # the reader's stage-recovery layer learns WHICH
+                        # map outputs died, not just that the fetch failed
+                        _send_frame(conn, _TAG_ERROR, (
+                            _LOST_MARKER + json.dumps(
+                                {"shuffle_id": e.shuffle_id,
+                                 "part_id": e.part_id,
+                                 "lost": {str(k): v
+                                          for k, v in e.lost.items()},
+                                 "detail": "reported by peer"})).encode())
                     except Exception as e:  # noqa: BLE001 - sent to peer
                         # store/codec failures must reach the client as a
                         # diagnosable error frame, not a connection reset
@@ -411,7 +438,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
             _send_frame(sock, _TAG_JSON, json.dumps(req).encode())
             tag, body = _recv_frame(sock)
             if tag == _TAG_ERROR:
-                raise ShuffleFetchError(body.decode())
+                _raise_error_frame(body, shuffle_id, part_id)
             if tag != _TAG_JSON:
                 raise ShuffleTransportError(f"bad fetch header tag {tag!r}")
             header = json.loads(body.decode())
@@ -429,7 +456,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                 if tag == _TAG_END:
                     return
                 if tag == _TAG_ERROR:
-                    raise ShuffleFetchError(frame.decode())
+                    _raise_error_frame(frame, shuffle_id, part_id)
                 recv_window += len(frame)
                 if recv_window >= window:
                     _send_frame(sock, _TAG_JSON, b"{}")

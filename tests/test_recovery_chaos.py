@@ -1,0 +1,121 @@
+"""Stage-recovery chaos suite: TPC-H under peer-death + spill-corruption
+storms.
+
+The ``shuffle.peer.dead`` fault makes reduce-side pulls observe terminal
+map-output loss (every map output in the requested slice, exactly as a
+dead peer would present), and ``spill.disk.corrupt`` flips one seeded
+byte of a spilled shuffle output so its CRC sidecar fails on read-back.
+Lineage-based stage recovery (exec/recovery.py) must invalidate exactly
+the lost outputs, recompute their producing partitions, and resume the
+pull — queries still return EXACT oracle results, with nonzero
+``stage_recomputes`` in the BufferCatalog metrics.  Reference intent:
+FetchFailed -> DAGScheduler map-stage resubmission keeps queries correct
+under executor loss; here the loss is seeded and conf-driven, CPU-only,
+no mocks.
+
+The generated sf0.01 tables are split into multiple parquet files so
+scans are multi-partition and the planner actually inserts shuffle
+exchanges (a single-file scan plans shuffle-free and would make this
+suite vacuous).
+"""
+import os
+
+import pytest
+
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+
+# peer death on every transport's first two pulls, plus one corrupted
+# spilled shuffle output (priority=0 = SHUFFLE_OUTPUT entries only)
+_STORM = ("shuffle.peer.dead:dead,times=2;"
+          "spill.disk.corrupt:corrupt,priority=0,times=2")
+_CHAOS_CONF = {
+    "spark.rapids.test.faults": _STORM,
+    # tiny device budget + host arena: shuffle outputs spill DIRECT to
+    # disk, so the corrupt-readback path is actually exercised
+    "spark.rapids.memory.tpu.spillStoreSize": 1 << 16,
+    "spark.rapids.memory.host.spillStorageSize": 4096,
+}
+
+_QUERIES = ["q3", "q12", "q18"]
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_recovery_chaos") / "sf001")
+    generate_tpch(d, sf=0.01)
+    _split_tables(d, ("lineitem", "orders", "customer"), parts=4)
+    return d
+
+
+def _split_tables(data_dir: str, tables, parts: int) -> None:
+    """Re-write each table as ``parts`` parquet files so its scan is
+    multi-partition and aggregations above it get shuffle exchanges."""
+    import pyarrow.parquet as pq
+    for table in tables:
+        path = os.path.join(data_dir, table, "part-0.parquet")
+        t = pq.read_table(path)
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(data_dir, table,
+                                        f"part-{i}.parquet"))
+
+
+@pytest.mark.parametrize("query", _QUERIES)
+def test_tpch_exact_under_loss_storm(data_dir, query):
+    r = run_benchmark(data_dir, 0.01, [query], verify=True,
+                      generate=False, suite="tpch",
+                      session_conf=_CHAOS_CONF)[0]
+    assert "error" not in r, r
+    assert r["ok"], r
+    cat = r["metrics"].get("BufferCatalog", {})
+    # the storm must actually have driven lineage recomputation
+    assert cat.get("stage_recomputes", 0) > 0, cat
+    assert cat.get("map_outputs_recomputed", 0) > 0, cat
+    assert cat.get("recovery_wall_s", 0) > 0, cat
+
+
+def test_corrupt_spill_readback_recovered(data_dir):
+    """q18 (largest shuffle volume of the trio) spills shuffle outputs
+    to disk under the tiny budgets; the corrupted read-back must be
+    detected by the CRC sidecar and recovered from lineage, not served
+    as silently wrong rows."""
+    r = run_benchmark(data_dir, 0.01, ["q18"], verify=True,
+                      generate=False, suite="tpch",
+                      session_conf=_CHAOS_CONF)[0]
+    assert "error" not in r and r["ok"], r
+    cat = r["metrics"].get("BufferCatalog", {})
+    assert cat.get("spill_crc_failures", 0) > 0, cat
+    assert cat.get("bytes_spilled_to_disk", 0) > 0, cat
+    assert cat.get("stage_recomputes", 0) > 0, cat
+
+
+def test_recovery_disabled_fails_fast(data_dir):
+    """Control: with recovery off the same storm fails the query with a
+    terminal error naming the lost map outputs — proving the exact
+    results above come from recomputation, not from the faults never
+    firing."""
+    conf = dict(_CHAOS_CONF)
+    conf["spark.rapids.test.faults"] = "shuffle.peer.dead:dead,times=2"
+    conf["spark.rapids.shuffle.recovery.enabled"] = "false"
+    r = run_benchmark(data_dir, 0.01, ["q3"], verify=False,
+                      generate=False, suite="tpch", session_conf=conf)[0]
+    assert not r["ok"]
+    assert "MapOutputLostError" in r["error"], r["error"]
+    assert "map output lost" in r["error"], r["error"]
+
+
+def test_persistent_death_exhausts_budget(data_dir):
+    """A peer that stays dead (times=0 -> the fault fires forever) must
+    exhaust the per-stage attempt budget and surface
+    StageRecoveryExhausted instead of recomputing unboundedly."""
+    conf = {
+        "spark.rapids.test.faults": "shuffle.peer.dead:dead,times=0",
+        "spark.rapids.shuffle.recovery.maxStageAttempts": 2,
+    }
+    r = run_benchmark(data_dir, 0.01, ["q3"], verify=False,
+                      generate=False, suite="tpch", session_conf=conf)[0]
+    assert not r["ok"]
+    assert "StageRecoveryExhausted" in r["error"], r["error"]
+    assert "2 recovery attempts" in r["error"], r["error"]

@@ -155,11 +155,12 @@ def test_fetch_partition_early_break_unpins():
                 np.arange(4, dtype=np.int32) + m, np.ones(4, bool),
                 T.IntegerType())], schema)
             t.write_partition(7, m, 0, host_to_device(hb))
-        items = t._store[(7, 0)]
+        slots = t._store[(7, 0)]
         for b in t.fetch_partition(7, 0):
             break  # abandon the generator after the first batch
-        assert all(it[1]._pins == 0 for it in items
-                   if it[0] == "spillable"), "pin leaked on early break"
+        assert all(s.item[1]._pins == 0 for s in slots
+                   if s.item is not None and s.item[0] == "spillable"), \
+            "pin leaked on early break"
         # sliced fetch serves exactly [lo, hi)
         got = [int(b.columns[0].data[0]) for b in t.fetch_partition(
             7, 0, 1, 3)]

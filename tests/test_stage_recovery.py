@@ -1,0 +1,365 @@
+"""Stage recovery units: epoch tagging, attempt budget, terminal
+classification, disk-tier integrity, and spill-file lifecycle.
+
+The chaos-level counterpart (TPC-H under peer-death and spill-corruption
+storms) lives in test_recovery_chaos.py; these tests pin the individual
+mechanisms: a stale write from a superseded map attempt is discarded, an
+exhausted attempt budget surfaces StageRecoveryExhausted, terminal
+errors bypass the transport retry ladder, a corrupt disk read-back is a
+LOSS (recoverable) rather than a crash, and spill files never outlive
+their entries.
+"""
+import glob
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.ops.kernels import DeviceColumn
+from spark_rapids_tpu.shuffle.errors import (MapOutputLostError,
+                                             ShuffleFetchError,
+                                             StageRecoveryExhausted)
+from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
+
+
+def _batch(values):
+    data = jnp.asarray(values, jnp.int64)
+    col = DeviceColumn(data, jnp.ones(data.shape, jnp.bool_), T.LongType())
+    return ColumnBatch([col], len(values), T.Schema(
+        [T.StructField("x", T.LongType(), True)]))
+
+
+def _rows(b):
+    import jax
+    return [int(v) for v in jax.device_get(b.columns[0].data)[:b.num_rows]]
+
+
+# ---------------------------------------------------------------------------
+# epoch-tagged map outputs (transport level)
+# ---------------------------------------------------------------------------
+
+def test_invalidate_then_recompute_roundtrip():
+    t = LocalShuffleTransport(TpuConf({}), ctx=None)
+    t.write_partition("s", 0, 0, _batch([1, 2]))
+    t.write_partition("s", 1, 0, _batch([3, 4]))
+    assert t.map_epoch("s", 0) == 0
+
+    new_epochs = t.invalidate_map_outputs("s", [0])
+    assert new_epochs == {0: 1}
+    assert t.map_epoch("s", 0) == 1
+    assert t.metrics["map_outputs_invalidated"] == 1
+    with pytest.raises(MapOutputLostError) as ei:
+        list(t.fetch_partition("s", 0))
+    assert ei.value.lost == {0: 1}
+    assert ei.value.terminal
+
+    # the recomputed write refills the SAME slot: fetch order is stable
+    t.write_partition("s", 0, 0, _batch([1, 2]), epoch=1)
+    out = [_rows(b) for b in t.fetch_partition("s", 0)]
+    assert out == [[1, 2], [3, 4]]
+    t.close()
+
+
+def test_stale_write_from_dead_attempt_discarded():
+    t = LocalShuffleTransport(TpuConf({}), ctx=None)
+    t.write_partition("s", 0, 0, _batch([1]))
+    t.invalidate_map_outputs("s", [0])
+    # a straggling write still tagged with the superseded epoch must
+    # not resurrect the slot
+    t.write_partition("s", 0, 0, _batch([9]), epoch=0)
+    assert t.metrics["stale_writes_discarded"] == 1
+    with pytest.raises(MapOutputLostError):
+        list(t.fetch_partition("s", 0))
+    t.write_partition("s", 0, 0, _batch([1]), epoch=1)
+    assert [_rows(b) for b in t.fetch_partition("s", 0)] == [[1]]
+    t.close()
+
+
+def test_lost_slice_names_every_map_in_range():
+    t = LocalShuffleTransport(TpuConf({}), ctx=None)
+    for m in range(3):
+        t.write_partition("s", m, 0, _batch([m]))
+    t.invalidate_map_outputs("s", [0, 2])
+    with pytest.raises(MapOutputLostError) as ei:
+        list(t.fetch_partition("s", 0))
+    assert sorted(ei.value.lost) == [0, 2]
+    # a sub-range that skips the lost slots still streams
+    assert [_rows(b) for b in t.fetch_partition("s", 0, lo=1, hi=2)] \
+        == [[1]]
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# terminal vs transient classification (retry ladder)
+# ---------------------------------------------------------------------------
+
+def test_map_output_lost_bypasses_retry_ladder(monkeypatch):
+    from spark_rapids_tpu.shuffle import retry as retry_mod
+    retry_mod.reset_circuit_breakers()
+    calls = []
+
+    def dead_fetch(*a, **k):
+        calls.append(1)
+        raise MapOutputLostError("s", 0, {0: 0})
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(retry_mod, "fetch_remote", dead_fetch)
+    with pytest.raises(MapOutputLostError):
+        list(retry_mod.fetch_remote_with_retry(
+            ("lost-peer", 1), "s", 0, max_retries=5, retry_wait=0.0))
+    # terminal: ONE attempt, no reconnects, no breaker wind-up
+    assert len(calls) == 1
+    assert retry_mod._breaker(("lost-peer", 1)).failures == 0
+
+
+def test_ladder_exhaustion_is_terminal(monkeypatch):
+    from spark_rapids_tpu.shuffle import retry as retry_mod
+    retry_mod.reset_circuit_breakers()
+
+    def flaky_fetch(*a, **k):
+        raise ShuffleFetchError("connection reset")
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(retry_mod, "fetch_remote", flaky_fetch)
+    with pytest.raises(ShuffleFetchError) as ei:
+        list(retry_mod.fetch_remote_with_retry(
+            ("flaky-peer", 1), "s", 0, max_retries=1, retry_wait=0.0))
+    assert ei.value.terminal
+
+
+# ---------------------------------------------------------------------------
+# lineage + budget (exec level)
+# ---------------------------------------------------------------------------
+
+_SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType(), True),
+    T.StructField("v", T.LongType(), True),
+])
+_DATA = {"k": [i % 13 for i in range(500)], "v": list(range(500))}
+
+
+def _session(extra=None):
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession(dict(extra or {}))
+
+
+def _agg_df(s, key="k"):
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import col
+    return s.from_pydict(_DATA, _SCHEMA, partitions=4) \
+        .group_by(key).agg(Sum(col("v")))
+
+
+def _run_device(df, conf):
+    from spark_rapids_tpu.exec.core import (ExecCtx, _rows_from_host,
+                                            device_to_host)
+    ov, meta = df._overridden(quiet=True)
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        rows = []
+        for b in meta.exec_node.execute(ctx):
+            rows.extend(_rows_from_host(device_to_host(b)))
+        metrics = dict(ctx.catalog.metrics)
+    return sorted(rows), metrics
+
+
+def _oracle(df, conf):
+    from spark_rapids_tpu.exec.core import collect_host
+    ov, meta = df._overridden(quiet=True)
+    return sorted(collect_host(meta.exec_node, conf))
+
+
+def test_peer_death_recovered_exact():
+    s = _session({"spark.rapids.test.faults":
+                  "shuffle.peer.dead:dead,times=1"})
+    df = _agg_df(s)
+    rows, m = _run_device(df, s.conf)
+    s0 = _session()
+    assert rows == _oracle(_agg_df(s0), s0.conf)
+    assert m["stage_recomputes"] >= 1
+    assert m["map_outputs_recomputed"] >= 1
+    assert m["recovery_wall_s"] > 0
+
+
+def test_recovery_disabled_fails_fast_naming_outputs():
+    s = _session({"spark.rapids.test.faults":
+                  "shuffle.peer.dead:dead,times=1",
+                  "spark.rapids.shuffle.recovery.enabled": "false"})
+    with pytest.raises(MapOutputLostError) as ei:
+        _run_device(_agg_df(s), s.conf)
+    assert "map output lost" in str(ei.value)
+    assert "map 0" in str(ei.value)
+
+
+def test_attempt_budget_exhaustion():
+    # a persistently dead peer (times=0 -> fires forever) must stop at
+    # the per-stage budget, not recompute unboundedly
+    s = _session({"spark.rapids.test.faults":
+                  "shuffle.peer.dead:dead,times=0",
+                  "spark.rapids.shuffle.recovery.maxStageAttempts": "2"})
+    with pytest.raises(StageRecoveryExhausted) as ei:
+        _run_device(_agg_df(s), s.conf)
+    assert "2 recovery attempts" in str(ei.value)
+    assert "maxStageAttempts" in str(ei.value)
+
+
+def test_conf_fingerprint_drift_rejected():
+    from spark_rapids_tpu.exec.recovery import (ShuffleLineage,
+                                                conf_fingerprint)
+
+    class _Ex:
+        shuffle_id = "s"
+        children = []
+
+    class _Ctx:
+        conf = TpuConf({"a": "1"})
+
+    lineage = ShuffleLineage(exchange=_Ex(), coalesced=False, num_parts=1,
+                             map_src={0: 0},
+                             conf_fp=conf_fingerprint(TpuConf({"a": "2"})))
+    with pytest.raises(RuntimeError, match="conf changed"):
+        lineage.recompute(_Ctx(), None, {0: 1})
+
+
+# ---------------------------------------------------------------------------
+# disk spill tier: CRC sidecars, corruption -> loss, ENOSPC, lifecycle
+# ---------------------------------------------------------------------------
+
+def _catalog(tmp_path, faults="", host_limit=4096):
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    conf = TpuConf({"spark.rapids.test.faults": faults} if faults else {})
+    return BufferCatalog(host_limit=host_limit, spill_dir=str(tmp_path),
+                         conf=conf)
+
+
+def test_crc_sidecar_written_and_verified(tmp_path):
+    from spark_rapids_tpu.memory.catalog import (SpillPriority,
+                                                 SpillableColumnarBatch)
+    cat = _catalog(tmp_path)
+    scb = SpillableColumnarBatch(_batch(list(range(1024))), cat,
+                                 SpillPriority.SHUFFLE_OUTPUT)
+    cat.spill_device(1 << 30)
+    e = cat._entries[scb._id]
+    assert e.tier == "disk"
+    sidecar = e.disk_path + ".crc"
+    assert os.path.exists(sidecar)
+    algo, hexval, length = open(sidecar).read().split(":")
+    assert algo in ("crc32c", "crc32")
+    assert int(length) == os.path.getsize(e.disk_path)
+    got = scb.get()
+    assert _rows(got) == list(range(1024))
+    scb.unpin()
+    scb.close()
+    cat.close()
+
+
+def test_corrupt_readback_is_loss_not_crash(tmp_path):
+    from spark_rapids_tpu.memory.catalog import (SpillCorruptionError,
+                                                 SpillPriority,
+                                                 SpillableColumnarBatch)
+    cat = _catalog(tmp_path,
+                   faults="spill.disk.corrupt:corrupt,priority=0,times=1")
+    scb = SpillableColumnarBatch(_batch(list(range(1024))), cat,
+                                 SpillPriority.SHUFFLE_OUTPUT)
+    cat.spill_device(1 << 30)
+    e = cat._entries[scb._id]
+    assert e.tier == "disk"
+    with pytest.raises(SpillCorruptionError):
+        scb.get()
+    assert e.tier == "lost"
+    assert cat.metrics["spill_crc_failures"] == 1
+    # the unverifiable file and its sidecar are gone; a later read of
+    # the lost tier keeps failing deterministically
+    assert not _spill_files(tmp_path)
+    with pytest.raises(SpillCorruptionError):
+        scb.get()
+    cat.close()
+
+
+def test_enospc_degrades_into_oom_scope(tmp_path):
+    from spark_rapids_tpu.memory.catalog import (SpillPriority,
+                                                 SpillableColumnarBatch)
+    cat = _catalog(tmp_path,
+                   faults="spill.disk.enospc:enospc,times=1")
+    scb = SpillableColumnarBatch(_batch(list(range(1024))), cat,
+                                 SpillPriority.SHUFFLE_OUTPUT)
+    # a full disk must NOT raise out of spill: it returns what it freed
+    # so the OOM-retry ladder (split-and-retry) takes over
+    freed = cat.spill_device(1 << 30)
+    assert freed == 0
+    assert cat.metrics["spill_enospc"] == 1
+    assert cat._entries[scb._id].tier == "device"
+    assert not _spill_files(tmp_path)
+    # the batch is still intact and servable from its device tier
+    assert _rows(scb.get()) == list(range(1024))
+    scb.unpin()
+    cat.close()
+
+
+def _spill_files(tmp_path):
+    return [p for p in glob.glob(os.path.join(str(tmp_path), "**", "*"),
+                                 recursive=True) if os.path.isfile(p)]
+
+
+def test_invalidation_deletes_spilled_files(tmp_path):
+    from spark_rapids_tpu.exec.core import ExecCtx
+    from spark_rapids_tpu.shuffle import make_transport
+    # host arena too small for the batch -> the spill goes direct to disk
+    conf = TpuConf({"spark.rapids.memory.spill.dir": str(tmp_path),
+                    "spark.rapids.memory.host.spillStorageSize": 4096})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = make_transport(conf, ctx)
+        t.write_partition("s", 0, 0, _batch(list(range(1024))))
+        ctx.catalog.spill_device(1 << 30)
+        assert _spill_files(tmp_path)
+        t.invalidate_map_outputs("s", [0])
+        assert not _spill_files(tmp_path)
+        t.close()
+
+
+def test_spill_dir_clean_after_ctx_close(tmp_path):
+    """Leak check: nothing in the spill dir survives ExecCtx close, even
+    with outputs spilled to disk mid-query."""
+    from spark_rapids_tpu.exec.core import ExecCtx
+    from spark_rapids_tpu.shuffle import make_transport
+    conf = TpuConf({"spark.rapids.memory.spill.dir": str(tmp_path),
+                    "spark.rapids.memory.host.spillStorageSize": 4096})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = make_transport(conf, ctx)
+        for m in range(4):
+            t.write_partition("s", m, 0, _batch(list(range(1024))))
+        ctx.catalog.spill_device(1 << 30)
+        assert _spill_files(tmp_path)
+        t.close()
+    assert not _spill_files(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# mesh path: lost device slice -> single-device recompute
+# ---------------------------------------------------------------------------
+
+def test_mesh_slice_lost_falls_back_single_device():
+    from spark_rapids_tpu.exec.mesh_exec import MeshAggregateExec
+
+    s = _session({"spark.rapids.tpu.mesh.deviceCount": 8,
+                  "spark.rapids.test.faults":
+                  "mesh.slice.lost:lost,op=meshagg,times=1"})
+    df = _agg_df(s)
+    ov, meta = df._overridden(quiet=True)
+    assert any(isinstance(n, MeshAggregateExec)
+               for n in _walk(meta.exec_node)), \
+        "plan must lower to the mesh for this test to mean anything"
+    rows, m = _run_device(df, s.conf)
+    s0 = _session()
+    assert rows == _oracle(_agg_df(s0), s0.conf)
+    # the lost slice was recovered by the single-device recompute
+    assert m["stage_recomputes"] >= 1
+    assert m["recovery_wall_s"] > 0
+
+
+def _walk(node):
+    yield node
+    for c in getattr(node, "children", []):
+        yield from _walk(c)
