@@ -48,6 +48,65 @@ assert FaultRegistry.from_conf(TpuConf({})) is None, \
 assert FaultRegistry.from_conf(None) is None
 print("fault registry inert without spark.rapids.test.faults: ok")
 PY
+  echo "-- observability gate: traced TPC-H run + schema validation --"
+  # a TPC-H query with tracing + metrics on must export a trace and a
+  # metrics snapshot that validate against the checked-in schema
+  # (ci/obs_schema.json), with every event under ONE query/trace id
+  JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, sys, tempfile
+sys.path.insert(0, "scripts")
+from validate_obs import validate, load_schema
+d = tempfile.mkdtemp()
+trace_dir = os.path.join(d, "traces")
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+data = os.path.join(d, "tpch")
+generate_tpch(data, sf=0.01)
+r = run_benchmark(data, 0.01, ["q6"], generate=False, suite="tpch",
+                  session_conf={
+                      "spark.rapids.obs.trace.enabled": "true",
+                      "spark.rapids.obs.trace.dir": trace_dir})[0]
+assert r.get("ok") and "error" not in r, r
+traces = sorted(os.listdir(trace_dir))
+assert traces, "no trace exported"
+for t in traces:
+    doc = json.load(open(os.path.join(trace_dir, t)))
+    errs = validate(doc, load_schema("trace"))
+    assert not errs, errs[:5]
+    ids = {e["args"]["query_id"] for e in doc["traceEvents"]}
+    assert len(ids) == 1, ids
+obs = r["observability"]
+assert obs["query_id"] and obs["trace_id"] and obs["plan_analyzed"]
+# the unified metrics snapshot validates too
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.core import ExecCtx
+from spark_rapids_tpu.obs.registry import query_metrics_snapshot
+with ExecCtx(backend="host", conf=TpuConf({})) as ctx:
+    errs = validate(query_metrics_snapshot(ctx), load_schema("metrics"))
+assert not errs, errs[:5]
+print(f"observability gate: {len(traces)} trace(s) schema-valid")
+PY
+  # disabled-path import discipline: with tracing off, the per-batch hot
+  # path must never import the tracer or diagnostics modules (their cost
+  # is provably zero, not just "small"); obs.registry is stdlib-only and
+  # allowed
+  JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col
+s = TpuSession({})
+schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+df = s.from_pydict({"k": [i % 5 for i in range(200)],
+                    "v": list(range(200))}, schema, partitions=2)
+assert len(df.group_by("k").agg(Sum(col("v"))).collect()) == 5
+for mod in ("spark_rapids_tpu.obs.trace", "spark_rapids_tpu.obs.diag"):
+    assert mod not in sys.modules, \
+        f"{mod} imported on the tracing-disabled path"
+print("disabled path imports no tracer/diagnostics: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

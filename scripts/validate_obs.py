@@ -1,0 +1,94 @@
+"""Validate observability JSON artifacts against ci/obs_schema.json.
+
+Hand-rolled validator for the dependency-free subset of JSON Schema the
+checked-in schema uses (type / required / properties / items / enum) —
+the CI image carries no jsonschema package, and the gate must not grow a
+dependency just to check its own output.
+
+Usage:
+    python scripts/validate_obs.py <trace|metrics|bundle> <file.json> ...
+
+Exit 0 when every file validates; 1 with a path-qualified error line per
+violation otherwise.  Also importable: ``validate(instance, schema)``
+returns a list of error strings.
+"""
+import json
+import os
+import sys
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    # bool is an int subclass in Python; excluded explicitly below
+    "integer": int,
+    "number": (int, float),
+}
+
+
+def validate(instance, schema: dict, path: str = "$") -> list[str]:
+    """Errors (empty = valid) for ``instance`` against the schema subset."""
+    errs: list[str] = []
+    t = schema.get("type")
+    if t is not None:
+        py = _TYPES.get(t)
+        ok = isinstance(instance, py)
+        if t in ("integer", "number") and isinstance(instance, bool):
+            ok = False
+        if not ok:
+            errs.append(f"{path}: expected {t}, "
+                        f"got {type(instance).__name__}")
+            return errs  # child checks would only cascade
+    if "enum" in schema and instance not in schema["enum"]:
+        errs.append(f"{path}: {instance!r} not in {schema['enum']}")
+    if isinstance(instance, dict):
+        for key in schema.get("required", ()):
+            if key not in instance:
+                errs.append(f"{path}: missing required key {key!r}")
+        for key, sub in schema.get("properties", {}).items():
+            if key in instance:
+                errs.extend(validate(instance[key], sub, f"{path}.{key}"))
+    if isinstance(instance, list) and "items" in schema:
+        for i, item in enumerate(instance):
+            errs.extend(validate(item, schema["items"], f"{path}[{i}]"))
+    return errs
+
+
+def load_schema(kind: str) -> dict:
+    schema_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ci", "obs_schema.json")
+    with open(schema_path) as f:
+        schemas = json.load(f)
+    if kind not in schemas or kind.startswith("_"):
+        raise SystemExit(f"unknown schema kind {kind!r}; "
+                         f"want one of {[k for k in schemas if not k.startswith('_')]}")
+    return schemas[kind]
+
+
+def validate_file(kind: str, path: str) -> list[str]:
+    with open(path) as f:
+        return validate(json.load(f), load_schema(kind))
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    kind, files = argv[0], argv[1:]
+    bad = 0
+    for p in files:
+        errs = validate_file(kind, p)
+        if errs:
+            bad += 1
+            for e in errs[:20]:
+                print(f"{p}: {e}")
+            if len(errs) > 20:
+                print(f"{p}: ... {len(errs) - 20} more")
+        else:
+            print(f"{p}: ok ({kind})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

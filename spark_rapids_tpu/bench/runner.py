@@ -20,7 +20,8 @@ import time
 __all__ = ["run_benchmark"]
 
 
-def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None):
+def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None,
+                  obs_out: dict | None = None):
     from spark_rapids_tpu.exec.core import (ExecCtx, collect_device,
                                             collect_host, device_to_host,
                                             _rows_from_host)
@@ -34,6 +35,8 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None):
     # metrics-capturing run (reference BenchUtils JSON reports include
     # per-exec SQL metrics, docs/benchmarks.md:149-163)
     with ExecCtx(backend=backend, conf=df._s.conf) as ctx:
+        from spark_rapids_tpu.obs.registry import get_registry
+        before = get_registry().snapshot() if obs_out is not None else None
         out = []
         for b in plan.execute(ctx):
             hb = device_to_host(b) if backend == "device" else b
@@ -49,6 +52,16 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None):
             # device_bytes_peak) live on the BufferCatalog, not on any
             # one exec — report them alongside the per-exec metrics
             metrics_out["BufferCatalog"] = dict(cat.metrics)
+        if obs_out is not None:
+            # full observability record: registry counter MOVEMENT over
+            # this run (the process registry is cumulative), ids tying
+            # the report to any exported trace, and the analyzed plan
+            from spark_rapids_tpu.plan.overrides import explain_analyze
+            obs_out["query_id"] = ctx.query_id
+            obs_out["trace_id"] = ctx.trace_id
+            obs_out["registry"] = get_registry().delta(before)
+            obs_out["plan_analyzed"] = explain_analyze(
+                plan, ctx).splitlines()
         return out
 
 
@@ -181,13 +194,16 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
             df = build_query(name, session, data_dir)
             plan = _plan_of(df)
             metrics: dict = {}
+            obs: dict = {}
             for it in range(max(1, iterations)):
                 t0 = time.perf_counter()
                 # last iteration captures per-operator metrics + plan
                 # (reference BenchmarkRunner JSON reports)
+                last = it == iterations - 1
                 rows = _collect_rows(
                     df, "device", plan,
-                    metrics_out=metrics if it == iterations - 1 else None)
+                    metrics_out=metrics if last else None,
+                    obs_out=obs if last else None)
                 times.append(time.perf_counter() - t0)
             times.sort()
             rec["device_s"] = round(times[len(times) // 2], 4)
@@ -195,6 +211,7 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
             rec["rows"] = len(rows)
             rec["plan"] = plan.tree_string().strip().splitlines()
             rec["metrics"] = metrics
+            rec["observability"] = obs
             if verify:
                 t0 = time.perf_counter()
                 oracle = _collect_rows(df, "host", plan)

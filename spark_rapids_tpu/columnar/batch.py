@@ -204,7 +204,8 @@ class _PackBuilder:
         arrays = _packed_unpack_cached(spec)(dev_bufs)
         cols = [DeviceColumn(d, v, f.data_type, ln)
                 for f, (d, v, ln) in zip(schema, arrays[0])]
-        return ColumnBatch(cols, arrays[1], schema)
+        return ColumnBatch(cols, arrays[1], schema,
+                           known_rows=int(num_rows))
 
 
 @_functools.lru_cache(maxsize=1024)
@@ -294,15 +295,26 @@ def round_capacity(n: int) -> int:
 
 @jax.tree_util.register_pytree_node_class
 class ColumnBatch:
-    """An immutable device batch: tuple of DeviceColumn + device num_rows."""
+    """An immutable device batch: tuple of DeviceColumn + device num_rows.
 
-    __slots__ = ("columns", "num_rows", "schema")
+    ``known_rows`` is an OPTIONAL host-side int mirror of ``num_rows``,
+    set where the count is already on host (the pack builder, shuffle
+    map-writers, OOM split halves) — metrics/tracing read it without a
+    D2H sync.  It is metadata only: deliberately excluded from both the
+    pytree leaves (it must not be traced) and the aux treedef (a static
+    per-count treedef would retrigger jit compilation per row count), so
+    batches that cross a jit boundary correctly come back with
+    known_rows=None (their count is whatever the program computed).
+    """
+
+    __slots__ = ("columns", "num_rows", "schema", "known_rows")
 
     def __init__(self, columns: Sequence[DeviceColumn], num_rows: jax.Array,
-                 schema: T.Schema):
+                 schema: T.Schema, known_rows: int | None = None):
         self.columns = tuple(columns)
         self.num_rows = num_rows
         self.schema = schema
+        self.known_rows = known_rows
 
     def tree_flatten(self):
         return (self.columns, self.num_rows), (self.schema,)
@@ -332,11 +344,15 @@ class ColumnBatch:
 
     def with_columns(self, columns: Sequence[DeviceColumn],
                      schema: T.Schema) -> "ColumnBatch":
-        return ColumnBatch(columns, self.num_rows, schema)
+        return ColumnBatch(columns, self.num_rows, schema,
+                           known_rows=self.known_rows)
 
     def host_num_rows(self) -> int:
-        """Materialize the row count on host (sync point)."""
-        return int(jax.device_get(self.num_rows))
+        """Materialize the row count on host (sync point); cached into
+        ``known_rows`` so a later metrics read is free."""
+        if self.known_rows is None:
+            self.known_rows = int(jax.device_get(self.num_rows))
+        return self.known_rows
 
     # ------------------------------------------------------------------
     # Arrow interop

@@ -223,19 +223,83 @@ class ExecCtx:
         with self._lock:
             return self.lineage.get(shuffle_id)
 
+    # -- observability (spark_rapids_tpu/obs) ------------------------------
+    @property
+    def query_id(self) -> str:
+        """Stable per-execution id (16 hex chars), minted lazily and
+        shared with the tracer and diagnostic bundles."""
+        with self._lock:
+            qid = self.cache.get("query_id")
+            if qid is None:
+                import uuid
+                qid = self.cache["query_id"] = uuid.uuid4().hex[:16]
+            return qid
+
+    @property
+    def trace_id(self) -> str:
+        t = self.tracer
+        return t.trace_id if t is not None else self.query_id
+
+    @property
+    def tracer(self):
+        """Per-query span tracer, or None when tracing is off.  The
+        disabled check reads the RAW conf string so the default path
+        never imports the obs package (ci/premerge.sh asserts
+        spark_rapids_tpu.obs.trace stays out of sys.modules)."""
+        with self._lock:
+            if "tracer" in self.cache:
+                return self.cache["tracer"]
+        raw = self.conf.settings.get("spark.rapids.obs.trace.enabled")
+        t = None
+        if raw is not None and str(raw).lower() in ("true", "1", "yes"):
+            from spark_rapids_tpu.obs.trace import TRACE_MAX_EVENTS, Tracer
+            t = Tracer(query_id=self.query_id,
+                       max_events=self.conf.get(TRACE_MAX_EVENTS))
+        with self._lock:
+            return self.cache.setdefault("tracer", t)
+
+    def trace_span(self, name: str, cat: str = "query", *,
+                   parent_id=None, **args):
+        """Context manager opening a span (yields it for annotate());
+        a no-op nullcontext (yielding None) when tracing is off."""
+        t = self.tracer
+        if t is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return t.span(name, cat, parent_id=parent_id, **args)
+
+    def trace_event(self, name: str, cat: str = "query", *,
+                    parent_id=None, **args) -> None:
+        t = self.tracer
+        if t is not None:
+            t.event(name, cat, parent_id=parent_id, **args)
+
     def close(self) -> None:
         """End-of-execution cleanup: close shuffle transports, then the
-        BufferCatalog (spilled disk files, host arena) if created."""
+        BufferCatalog (spilled disk files, host arena) if created; last,
+        export the query trace when a trace dir is configured."""
         from spark_rapids_tpu.shuffle import ShuffleTransport
         with self._lock:
             tkeys = [k for k, v in self.cache.items()
                      if isinstance(v, ShuffleTransport)]
             transports = [self.cache.pop(k) for k in tkeys]
             catalog = self.cache.pop("catalog", None)
+            tracer = self.cache.get("tracer")
         for t in transports:
             t.close()
         if catalog is not None:
             catalog.close()
+        if tracer is not None:
+            try:
+                from spark_rapids_tpu.obs.trace import TRACE_DIR
+                d = self.conf.get(TRACE_DIR)
+                if d:
+                    import os
+                    os.makedirs(d, exist_ok=True)
+                    tracer.export(os.path.join(
+                        d, f"trace_{tracer.query_id}.json"))
+            except Exception:
+                pass
 
     def __enter__(self):
         return self
@@ -296,8 +360,14 @@ class PlanNode:
         wires GpuMetricNames into every GpuExec (GpuExec.scala:27-56);
         here the base class does it so operators cannot forget.
         totalTime is inclusive of children, as in the reference.
-        numOutputRows is recorded on the host backend only: reading a
-        device batch's row count would force a D2H sync per batch."""
+        numOutputRows: on the host backend always; on the device backend
+        only when the batch already carries a host-side count
+        (ColumnBatch.known_rows — set by the pack builder, shuffle
+        writers and OOM splitters) — reading num_rows off a device batch
+        would force a D2H sync per batch, so unknown counts stay
+        unrecorded rather than paid for.  When a tracer is active, one
+        summary span per (operator, partition) is recorded on
+        exhaustion."""
         super().__init_subclass__(**kw)
         impl = cls.__dict__.get("partition_iter")
         if impl is None:
@@ -310,19 +380,38 @@ class PlanNode:
             import jax.profiler as _prof
             m = ctx.metrics_for(self)
             label = type(self).__name__
+            tracer = ctx.tracer
             it = _impl(self, ctx, pid)
+            first_t0 = None
+            batches = 0
+            rows = 0
             while True:
                 t0 = time.perf_counter()
+                if first_t0 is None:
+                    first_t0 = t0
                 try:
                     with _prof.TraceAnnotation(label):
                         batch = next(it)
                 except StopIteration:
-                    return
+                    break
                 m.add("totalTime", time.perf_counter() - t0)
                 m.add("numOutputBatches", 1)
+                batches += 1
                 if not ctx.is_device:
                     m.add("numOutputRows", batch.num_rows)
+                    rows += batch.num_rows
+                else:
+                    kr = getattr(batch, "known_rows", None)
+                    if kr is not None:
+                        m.add("numOutputRows", kr)
+                        rows += kr
                 yield batch
+            if tracer is not None and first_t0 is not None:
+                # dur is wall clock first-pull -> exhaustion (includes
+                # consumer suspension; the active time is in totalTime)
+                tracer.complete(label, "operator", first_t0,
+                                time.perf_counter(), node=label,
+                                partition=pid, batches=batches, rows=rows)
 
         timed_partition_iter.__wrapped__ = impl
         cls.partition_iter = timed_partition_iter
@@ -400,8 +489,40 @@ class PlanNode:
         backend partitions run concurrently on a worker pool (reference:
         Spark's task scheduler running doExecuteColumnar RDD
         partitions).  Metrics/trace ranges are recorded per operator by
-        the auto-instrumented partition_iter (see __init_subclass__)."""
-        yield from drain_partitions(ctx, self)
+        the auto-instrumented partition_iter (see __init_subclass__).
+
+        The FIRST execute() on a ctx is the query root: it opens the
+        query span and is the failure-diagnostics chokepoint — a query
+        that dies here emits a bounded diagnostic bundle when
+        spark.rapids.obs.diagnostics.dir is set (obs/diag.py). Both
+        checks read raw conf strings so the disabled path never imports
+        the obs package."""
+        with ctx._lock:
+            root = not ctx.cache.get("query_root_claimed")
+            if root:
+                ctx.cache["query_root_claimed"] = True
+        if not root:
+            yield from drain_partitions(ctx, self)
+            return
+        try:
+            with ctx.trace_span("query", "query",
+                                root=type(self).__name__,
+                                backend=ctx.backend):
+                yield from drain_partitions(ctx, self)
+        except GeneratorExit:
+            raise
+        except Exception as e:
+            out_dir = ctx.conf.settings.get(
+                "spark.rapids.obs.diagnostics.dir")
+            emit = False
+            if out_dir:
+                with ctx._lock:
+                    emit = not ctx.cache.get("diag_emitted")
+                    ctx.cache["diag_emitted"] = True
+            if emit:
+                from spark_rapids_tpu.obs.diag import maybe_emit_bundle
+                maybe_emit_bundle(ctx, self, e, str(out_dir))
+            raise
 
     # -- plan introspection ------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:
@@ -445,21 +566,31 @@ def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
     workers = min(ctx.task_concurrency, n) if ctx.is_device else 1
     if workers <= 1 or n <= 1:
         for pid in range(n):
-            for b in node.partition_iter(ctx, pid):
-                yield pid, b
+            with ctx.trace_span("partition", "partition",
+                                node=type(node).__name__, partition=pid):
+                for b in node.partition_iter(ctx, pid):
+                    yield pid, b
         return
 
     import concurrent.futures as cf
     from spark_rapids_tpu.memory.catalog import (SpillableColumnarBatch,
                                                  SpillPriority)
     catalog = ctx.catalog
+    tracer = ctx.tracer
+    # worker threads have empty span stacks; parent their partition spans
+    # onto whatever span is open on the draining thread (query/stage)
+    drain_parent = tracer.current_span_id() if tracer is not None else None
 
     def drain(pid: int):
         # chip occupancy is bounded inside ctx.dispatch, not here: holding
         # the semaphore across a next() that may itself drain partitions
         # (join build sides, nested exchanges) would deadlock
-        return [SpillableColumnarBatch(b, catalog, SpillPriority.READ_SHUFFLE)
-                for b in node.partition_iter(ctx, pid)]
+        with ctx.trace_span("partition", "partition",
+                            parent_id=drain_parent,
+                            node=type(node).__name__, partition=pid):
+            return [SpillableColumnarBatch(b, catalog,
+                                           SpillPriority.READ_SHUFFLE)
+                    for b in node.partition_iter(ctx, pid)]
 
     with cf.ThreadPoolExecutor(max_workers=workers,
                                thread_name_prefix="tpu-task") as pool:

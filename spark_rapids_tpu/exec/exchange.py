@@ -216,47 +216,13 @@ class ShuffleExchangeExec(PlanNode):
         terminal fetch failure (exec/recovery.py; reference:
         MapOutputTracker lineage driving DAGScheduler stage
         resubmission)."""
-        from spark_rapids_tpu.exec.core import (drain_partitions,
-                                                drain_partitions_indexed)
+        from spark_rapids_tpu.exec.core import drain_partitions
         child = self.children[0]
         if ctx.is_device:
-            from spark_rapids_tpu.exec.recovery import ShuffleLineage
-            from spark_rapids_tpu.shuffle import make_transport
-            indexed = list(drain_partitions_indexed(ctx, child))
-            map_src = {bi: cpid for bi, (cpid, _) in enumerate(indexed)}
-            batches = [b for _, b in indexed]
-            self.partitioning.prepare(batches, True)
-            n = self.partitioning.num_partitions
-            transport = make_transport(ctx.conf, ctx)
-            # Map-side tiny-input coalescing: when the whole map side is
-            # below the advisory partition size, splitting it n ways
-            # only buys n slice programs + n downstream per-partition
-            # chains of dispatch latency.  Putting EVERYTHING in
-            # partition 0 is correct for every partitioning (all rows of
-            # any key land in one partition) — the map-side counterpart
-            # of the reader's AQE small-partition coalescing
-            # (GpuCustomShuffleReaderExec; Spark's AQE does this on the
-            # read side only because its map side is fixed at plan time).
-            # It is an ADAPTIVE rewrite, so it obeys the same gates as
-            # the read side: off when spark.sql.adaptive.enabled is
-            # false, and off when an allow_coalesce=False reader
-            # consumes this exchange — explicit repartition(n) promises
-            # n non-degenerate partitions (Spark's REPARTITION_BY_NUM
-            # contract).
-            coalesce_ok = (ADAPTIVE_ENABLED.get(ctx.conf.settings)
-                           and not getattr(self, "_no_map_coalesce",
-                                           False))
-            coalesced = False
-            if coalesce_ok and n > 1 and len(batches) >= 1:
-                total_bytes = sum(b.device_size_bytes() for b in batches)
-                coalesced = total_bytes <= ADVISORY_PARTITION_BYTES.get(
-                    ctx.conf.settings)
-            for bi, b in enumerate(batches):
-                self._write_map_batch(ctx, transport, bi, b, coalesced, n)
-            ctx.register_lineage(self.shuffle_id, ShuffleLineage(
-                exchange=self, coalesced=coalesced, num_parts=n,
-                map_src=map_src, conf_fp=getattr(self, "_conf_fp", None)))
-            return transport
+            with ctx.trace_span("stage.map", "stage",
+                                shuffle=str(self.shuffle_id)[:12],
+                                node=self.node_desc()):
+                return self._do_shuffle_device(ctx, child)
         batches = list(drain_partitions(ctx, child))
         self.partitioning.prepare(batches, False)
         n = self.partitioning.num_partitions
@@ -270,6 +236,46 @@ class ShuffleExchangeExec(PlanNode):
                 if piece.num_rows:
                     out[p].append(piece)
         return out
+
+    def _do_shuffle_device(self, ctx: ExecCtx, child: PlanNode):
+        from spark_rapids_tpu.exec.core import drain_partitions_indexed
+        from spark_rapids_tpu.exec.recovery import ShuffleLineage
+        from spark_rapids_tpu.shuffle import make_transport
+        indexed = list(drain_partitions_indexed(ctx, child))
+        map_src = {bi: cpid for bi, (cpid, _) in enumerate(indexed)}
+        batches = [b for _, b in indexed]
+        self.partitioning.prepare(batches, True)
+        n = self.partitioning.num_partitions
+        transport = make_transport(ctx.conf, ctx)
+        # Map-side tiny-input coalescing: when the whole map side is
+        # below the advisory partition size, splitting it n ways
+        # only buys n slice programs + n downstream per-partition
+        # chains of dispatch latency.  Putting EVERYTHING in
+        # partition 0 is correct for every partitioning (all rows of
+        # any key land in one partition) — the map-side counterpart
+        # of the reader's AQE small-partition coalescing
+        # (GpuCustomShuffleReaderExec; Spark's AQE does this on the
+        # read side only because its map side is fixed at plan time).
+        # It is an ADAPTIVE rewrite, so it obeys the same gates as
+        # the read side: off when spark.sql.adaptive.enabled is
+        # false, and off when an allow_coalesce=False reader
+        # consumes this exchange — explicit repartition(n) promises
+        # n non-degenerate partitions (Spark's REPARTITION_BY_NUM
+        # contract).
+        coalesce_ok = (ADAPTIVE_ENABLED.get(ctx.conf.settings)
+                       and not getattr(self, "_no_map_coalesce",
+                                       False))
+        coalesced = False
+        if coalesce_ok and n > 1 and len(batches) >= 1:
+            total_bytes = sum(b.device_size_bytes() for b in batches)
+            coalesced = total_bytes <= ADVISORY_PARTITION_BYTES.get(
+                ctx.conf.settings)
+        for bi, b in enumerate(batches):
+            self._write_map_batch(ctx, transport, bi, b, coalesced, n)
+        ctx.register_lineage(self.shuffle_id, ShuffleLineage(
+            exchange=self, coalesced=coalesced, num_parts=n,
+            map_src=map_src, conf_fp=getattr(self, "_conf_fp", None)))
+        return transport
 
     def _write_map_batch(self, ctx: ExecCtx, transport, bi: int, b,
                          coalesced: bool, n: int,
@@ -293,6 +299,14 @@ class ShuffleExchangeExec(PlanNode):
             piece = ctx.dispatch(
                 _jit_slice_part, sb, starts_d, counts_d,
                 dk.device_scalar(p), round_capacity(int(counts[p])))
+            # counts already crossed to host for the skip check above:
+            # record the exact row count on the piece so downstream
+            # numOutputRows never needs a fresh D2H sync (jit dispatch
+            # strips known_rows at the trace boundary)
+            piece.known_rows = int(counts[p])
+            ctx.trace_event("shuffle.map_write", "shuffle", map=bi,
+                            part=p, rows=int(counts[p]),
+                            epoch=epoch if epoch is not None else 0)
             transport.write_partition(self.shuffle_id, bi, p, piece,
                                       epoch=epoch)
 
@@ -309,7 +323,12 @@ class ShuffleExchangeExec(PlanNode):
         shuffled = self._shuffled(ctx)
         if ctx.is_device:
             from spark_rapids_tpu.exec.recovery import recovering_fetch
-            yield from recovering_fetch(ctx, self, shuffled, pid, lo, hi)
+            with ctx.trace_span("shuffle.fetch", "shuffle",
+                                shuffle=str(self.shuffle_id)[:12],
+                                partition=pid, lo=lo,
+                                hi=hi if hi is not None else -1):
+                yield from recovering_fetch(ctx, self, shuffled, pid,
+                                            lo, hi)
         else:
             yield from shuffled[pid][lo:hi]
 
@@ -509,9 +528,15 @@ class RemoteShuffleReaderExec(PlanNode):
         from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
         faults = ctx.cached(("fault_registry",),
                             lambda: FaultRegistry.from_conf(ctx.conf))
+        # propagate the originating query's trace across the wire so the
+        # serving peer's "shuffle.serve" event parents onto THIS span —
+        # one trace covers the fetch, its retries, and any recovery
+        tracer = ctx.tracer
+        trace = tracer.trace_header() if tracer is not None else None
         yield from fetch_remote_with_retry(self.address, self.shuffle_id,
                                            pid, device=ctx.is_device,
-                                           conf=ctx.conf, faults=faults)
+                                           conf=ctx.conf, faults=faults,
+                                           tracer=tracer, trace=trace)
 
     def node_desc(self) -> str:
         return (f"RemoteShuffleReaderExec[{self.address[0]}:"

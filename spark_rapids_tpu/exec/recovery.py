@@ -165,10 +165,11 @@ def recovering_fetch(ctx, exchange, transport, pid: int, lo: int,
                 yield b
             return
         except MapOutputLostError as err:
-            _recover(ctx, transport, err)
+            _recover(ctx, transport, err, exchange=exchange)
 
 
-def _recover(ctx, transport, err: MapOutputLostError) -> None:
+def _recover(ctx, transport, err: MapOutputLostError,
+             exchange=None) -> None:
     """Handle one observed loss: invalidate + recompute the lost map
     outputs, or raise when recovery is disabled, has no lineage, or the
     stage's attempt budget ran out."""
@@ -195,12 +196,31 @@ def _recover(ctx, transport, err: MapOutputLostError) -> None:
                                          still_lost) from err
         state.attempts[err.shuffle_id] = used + 1
         t0 = time.perf_counter()
-        new_epochs = transport.invalidate_map_outputs(err.shuffle_id,
-                                                      still_lost)
-        done = lineage.recompute(ctx, transport, new_epochs)
+        # the recovery span parents every map-rewrite event emitted by
+        # _write_map_batch during the recompute (same thread), so a
+        # trace distinguishes recovered outputs from the original stage
+        with ctx.trace_span("stage.recovery", "recovery",
+                            shuffle=str(err.shuffle_id),
+                            attempt=used + 1,
+                            lost_maps=sorted(still_lost)) as sp:
+            new_epochs = transport.invalidate_map_outputs(err.shuffle_id,
+                                                          still_lost)
+            done = lineage.recompute(ctx, transport, new_epochs)
+            if sp is not None:
+                sp.annotate(recomputed=done)
+        wall = time.perf_counter() - t0
         m = ctx.catalog.metrics
         m["stage_recomputes"] = m.get("stage_recomputes", 0) + 1
         m["map_outputs_recomputed"] = \
             m.get("map_outputs_recomputed", 0) + done
-        m["recovery_wall_s"] = \
-            m.get("recovery_wall_s", 0.0) + (time.perf_counter() - t0)
+        m["recovery_wall_s"] = m.get("recovery_wall_s", 0.0) + wall
+        # also attribute recovery to the exchange NODE so EXPLAIN
+        # ANALYZE shows nonzero recovery metrics on the affected plan
+        # node, not just a global counter
+        node = exchange if exchange is not None \
+            else getattr(lineage, "exchange", None)
+        if node is not None and ctx.metrics_enabled:
+            nm = ctx.metrics_for(node)
+            nm.add("stageRecoveries", 1)
+            nm.add("mapOutputsRecomputed", done)
+            nm.add("recoveryTime", wall)

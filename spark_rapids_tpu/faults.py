@@ -216,6 +216,13 @@ class FaultRegistry:
                     continue
                 if rule._try_fire(ctx):
                     self.log.append((point, rule.action, dict(ctx)))
+                    # chaos runs assert injection actually fired via the
+                    # process metrics registry (obs.registry is stdlib-
+                    # only; this class only exists when faults are on)
+                    from spark_rapids_tpu.obs.registry import get_registry
+                    reg = get_registry()
+                    reg.inc("faults.injected")
+                    reg.inc(f"faults.injected.{point}")
                     return FaultAction(rule)
         return None
 

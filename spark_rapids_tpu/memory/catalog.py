@@ -209,6 +209,24 @@ class BufferCatalog:
                         "spill_crc_failures": 0, "spill_enospc": 0,
                         "stage_recomputes": 0, "map_outputs_recomputed": 0,
                         "recovery_wall_s": 0.0}
+        # surface catalog counters in the process metrics registry as
+        # pull gauges (weakref-bound; dropped again in close())
+        from spark_rapids_tpu.obs.registry import get_registry
+        self._reg_source = get_registry().register_object_source(
+            f"catalog.{id(self):x}", self)
+
+    def tier_occupancy(self) -> dict:
+        """Buffers/bytes currently registered per spill tier — the
+        at-a-glance memory picture diagnostics bundles carry."""
+        occ: dict[str, dict] = {}
+        with self._lock:
+            for e in self._entries.values():
+                t = occ.setdefault(e.tier, {"buffers": 0, "bytes": 0})
+                t["buffers"] += 1
+                t["bytes"] += e.size
+            occ["_totals"] = {"device_used": self.device_used,
+                              "device_limit": self.device_limit}
+        return occ
 
     @property
     def _arena(self):
@@ -525,6 +543,8 @@ class BufferCatalog:
         spark.rapids.memory.gpu.debug (RapidsConf.scala:288): a buffer
         alive at executor teardown means some operator failed to
         release it."""
+        from spark_rapids_tpu.obs.registry import get_registry
+        get_registry().unregister_source(self._reg_source)
         with self._lock:
             if self._debug and self._entries:
                 leaks = [f"id={i} tier={e.tier} size={e.size} "

@@ -101,6 +101,11 @@ def split_half(batch: ColumnBatch) -> list[ColumnBatch]:
     hi = _slice_rows_jit(batch, dk.device_scalar(h),
                          dk.device_scalar(n - h),
                          round_capacity(max(n - h, 1)))
+    # the jit boundary strips known_rows; the halves' counts are host
+    # facts here, so restore them (metrics then never double-count a
+    # split: each half reports its own exact rows)
+    lo.known_rows = h
+    hi.known_rows = n - h
     return [lo, hi]
 
 

@@ -1,0 +1,146 @@
+"""Failure diagnostics: bounded bundles emitted when a query dies.
+
+After three robustness PRs the engine survives peer death, OOM storms,
+and spill corruption — but when the budgets are finally exhausted
+(``StageRecoveryExhausted``, ``SplitAndRetryOOM``) the operator gets a
+bare traceback and must rerun the chaos to learn anything. This module
+captures what the process already knows at the moment of failure into
+one JSON artifact: the analyzed plan (EXPLAIN ANALYZE view with whatever
+metrics accrued before death), the unified metrics snapshot, the last N
+span events, the active fault-injection spec + its fired log, and the
+buffer catalog's tier occupancy. Every field is bounded so a bundle is
+kilobytes, not a heap dump.
+
+Only imported from the failure path (and never on query success), so it
+may import freely; ``maybe_emit_bundle`` itself must NEVER raise — a
+broken diagnostic must not mask the real error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+
+from ..conf import ConfEntry, register
+
+DIAG_DIR = register(ConfEntry(
+    "spark.rapids.obs.diagnostics.dir", "",
+    "When set, a query failure emits a bounded diagnostic bundle "
+    "(diag_<query_id>_<unix-ms>.json: annotated plan, metrics snapshot, "
+    "last span events, fault config + fired log, catalog tier occupancy) "
+    "into this directory. Empty (default): no bundle, no overhead."))
+DIAG_MAX_SPAN_EVENTS = register(ConfEntry(
+    "spark.rapids.obs.diagnostics.maxSpanEvents", 256,
+    "How many trailing span events a diagnostic bundle carries.",
+    conv=int))
+
+_MAX_MSG = 4096       # error message / traceback cap, chars
+_MAX_FAULT_LOG = 64   # fired-fault audit entries carried
+
+
+def _catalog_view(ctx) -> dict:
+    # read the catalog out of the stage cache only if one was actually
+    # built — a failure before first spill should not construct one now
+    cache = getattr(ctx, "cache", None)
+    cat = cache.get("catalog") if isinstance(cache, dict) else None
+    if cat is None:
+        return {}
+    view = {}
+    try:
+        view["metrics"] = dict(cat.metrics)
+    except Exception:
+        pass
+    try:
+        view["tier_occupancy"] = cat.tier_occupancy()
+    except Exception:
+        pass
+    return view
+
+
+def _fault_view(ctx) -> dict:
+    spec = None
+    try:
+        spec = ctx.conf.settings.get("spark.rapids.test.faults")
+    except Exception:
+        pass
+    # fault registries hang off transports / readers parked in the stage
+    # cache; any of them carries the same audit log shape
+    fired = []
+    cache = getattr(ctx, "cache", None)
+    if isinstance(cache, dict):
+        for v in list(cache.values()):
+            reg = v if hasattr(v, "log") and hasattr(v, "check") \
+                else getattr(v, "faults", None)
+            if reg is None or not hasattr(reg, "log"):
+                continue
+            try:
+                fired = [dict(e) if isinstance(e, dict) else str(e)
+                         for e in list(reg.log)[-_MAX_FAULT_LOG:]]
+            except Exception:
+                fired = []
+            if fired:
+                break
+    return {"spec": spec, "fired": fired}
+
+
+def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
+    """Write ``diag_<query_id>_<unix-ms>.json`` into ``out_dir``.
+
+    Returns the path written, or None. Never raises.
+    """
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        query_id = getattr(ctx, "query_id", None) or "unknown"
+        tracer = getattr(ctx, "tracer", None)
+        try:
+            max_ev = int(ctx.conf.get(DIAG_MAX_SPAN_EVENTS))
+        except Exception:
+            max_ev = 256
+
+        bundle: dict = {
+            "kind": "spark_rapids_tpu.diagnostic_bundle",
+            "version": 1,
+            "query_id": query_id,
+            "trace_id": getattr(tracer, "trace_id", None) or query_id,
+            "emitted_unix_s": time.time(),
+            "error": {
+                "type": type(error).__name__,
+                "message": str(error)[:_MAX_MSG],
+                "traceback": "".join(traceback.format_exception(
+                    type(error), error, error.__traceback__))[-_MAX_MSG:],
+            },
+        }
+
+        try:
+            from ..plan.overrides import explain_analyze
+            bundle["plan_analyzed"] = explain_analyze(plan, ctx).splitlines() \
+                if plan is not None else []
+        except Exception:
+            bundle["plan_analyzed"] = []
+
+        try:
+            from .registry import query_metrics_snapshot
+            bundle["metrics"] = query_metrics_snapshot(ctx)
+        except Exception:
+            bundle["metrics"] = {}
+
+        bundle["span_events"] = (tracer.events_snapshot(last=max_ev)
+                                 if tracer is not None else [])
+        bundle["faults"] = _fault_view(ctx)
+        bundle["catalog"] = _catalog_view(ctx)
+        try:
+            bundle["conf"] = {k: v for k, v in ctx.conf.settings.items()
+                              if str(k).startswith("spark.")}
+        except Exception:
+            bundle["conf"] = {}
+
+        path = os.path.join(
+            out_dir, f"diag_{query_id}_{int(time.time() * 1000)}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None

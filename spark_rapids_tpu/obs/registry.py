@@ -1,0 +1,150 @@
+"""Process-wide metrics registry: one place where operator ``Metrics``,
+``BufferCatalog`` counters, and shuffle-plane counters meet.
+
+The reference plugin threads a standard metric set (GpuMetricNames)
+through every GpuExec and lets Spark's accumulator machinery aggregate
+and expose it; this engine has no driver/UI, so the registry plays that
+role: monotonically increasing **counters** (``inc``), point-in-time
+**gauges** (``set_gauge``), and pull-style **sources** (callables
+returning flat dicts — the existing per-object metrics dicts on
+catalogs/transports register themselves here without copying code).
+
+Snapshot/delta semantics let the bench runner report per-query counter
+movement, and ``to_prometheus`` renders the standard text exposition so
+a scrape endpoint is one ``open().write()`` away.
+
+Dependency discipline: this module imports nothing from the engine (only
+stdlib), so hot modules (shuffle/retry.py, faults.py) may import it at
+module level without creating cycles or dragging jax into light paths.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import weakref
+
+_SAN = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + pull sources."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._sources: dict[str, object] = {}
+
+    # -- write side --------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def register_source(self, name: str, fn) -> None:
+        """``fn() -> dict[str, number]``; folded into snapshots under
+        ``<name>.<key>``. A source raising or returning junk is dropped
+        from that snapshot, never propagated — observability must not
+        fail the query."""
+        with self._lock:
+            self._sources[name] = fn
+
+    def unregister_source(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def register_object_source(self, name: str, obj, attr: str = "metrics"):
+        """Register ``obj.<attr>`` (a plain dict) as a source via weakref
+        so the registry never keeps a catalog/transport alive."""
+        ref = weakref.ref(obj)
+
+        def _pull(_ref=ref, _attr=attr):
+            o = _ref()
+            if o is None:
+                return {}
+            d = getattr(o, _attr, None)
+            return dict(d) if isinstance(d, dict) else {}
+
+        self.register_source(name, _pull)
+        return name
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            sources = list(self._sources.items())
+        for name, fn in sources:
+            try:
+                vals = fn()
+            except Exception:
+                continue
+            if not isinstance(vals, dict):
+                continue
+            for k, v in vals.items():
+                if isinstance(v, (int, float)):
+                    gauges[f"{name}.{k}"] = v
+        return {"counters": counters, "gauges": gauges}
+
+    def delta(self, prev: dict) -> dict:
+        """Counter movement since ``prev`` (a prior ``snapshot()``);
+        gauges are point-in-time and reported as-is."""
+        cur = self.snapshot()
+        before = prev.get("counters", {}) if prev else {}
+        moved = {}
+        for k, v in cur["counters"].items():
+            d = v - before.get(k, 0)
+            if d:
+                moved[k] = d
+        return {"counters": moved, "gauges": cur["gauges"]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def to_prometheus(self, prefix: str = "srt_") -> str:
+        """Standard Prometheus text exposition (version 0.0.4)."""
+        snap = self.snapshot()
+        lines = []
+        for kind, bucket in (("counter", snap["counters"]),
+                             ("gauge", snap["gauges"])):
+            for name in sorted(bucket):
+                metric = prefix + _SAN.sub("_", name)
+                lines.append(f"# TYPE {metric} {kind}")
+                v = bucket[name]
+                lines.append(f"{metric} {v:g}" if isinstance(v, float)
+                             else f"{metric} {v}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Test hook: drop all counters/gauges/sources."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._sources.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry singleton."""
+    return _REGISTRY
+
+
+def query_metrics_snapshot(ctx) -> dict:
+    """Unified per-query view: operator Metrics aggregated by operator
+    class, plus the registry snapshot. Used by EXPLAIN ANALYZE footers,
+    diagnostics bundles, and the bench runner."""
+    ops: dict[str, dict] = {}
+    for key, m in getattr(ctx, "metrics", {}).items():
+        name = key.split("@")[0]
+        agg = ops.setdefault(name, {})
+        for k, v in m.values.items():
+            agg[k] = agg.get(k, 0) + v
+    return {"operators": ops, "registry": _REGISTRY.snapshot()}

@@ -746,3 +746,64 @@ class TpuOverrides:
             if sub:
                 lines.append(sub)
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE: post-execution plan annotation
+# ---------------------------------------------------------------------------
+
+#: metrics shown inline on every node that recorded them, in this order
+_CORE_METRICS = ("totalTime", "numOutputBatches", "numOutputRows")
+
+
+def _fmt_metric(name: str, v: float) -> str:
+    if name.endswith(("Time", "_s")) or isinstance(v, float) and v != int(v):
+        return f"{name}={v:.3f}s" if name.endswith(("Time", "_s")) \
+            else f"{name}={v:.3f}"
+    return f"{name}={int(v)}"
+
+
+def explain_analyze(plan, ctx) -> str:
+    """Render the EXECUTED plan tree annotated with runtime metrics —
+    the EXPLAIN ANALYZE counterpart of :meth:`TpuOverrides.explain`
+    (reference: GpuExec metrics surfaced in the Spark SQL UI per node).
+
+    ``plan`` is the exec-tree root (a PlanNode); metrics come from the
+    ExecCtx the plan ran under, keyed by node identity, so repeated
+    EXPLAIN ANALYZE calls over one execution are stable.  Nodes carry
+    ``[time=.. batches=.. rows=..]`` plus any extra recorded metrics
+    (spills, retries, stage recoveries) sorted by name; a footer gives
+    the query/trace ids and the process-wide counters so shuffle and
+    memory activity not attributable to a single node is still
+    visible."""
+    lines: list[str] = []
+
+    def walk(node, indent: int) -> None:
+        key = f"{type(node).__name__}@{id(node):x}"
+        m = ctx.metrics.get(key)
+        line = "  " * indent + f"* {node.node_desc()}"
+        if m is not None and m.values:
+            parts = [_fmt_metric(k, m.values[k]) for k in _CORE_METRICS
+                     if k in m.values]
+            parts += [_fmt_metric(k, v) for k, v in sorted(m.values.items())
+                      if k not in _CORE_METRICS]
+            line += "  [" + ", ".join(parts) + "]"
+        lines.append(line)
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    lines.append("")
+    lines.append(f"query_id={ctx.query_id} trace_id={ctx.trace_id}")
+    cat = ctx.cache.get("catalog")
+    if cat is not None and getattr(cat, "metrics", None):
+        parts = [_fmt_metric(k, v) for k, v in sorted(cat.metrics.items())
+                 if isinstance(v, (int, float))]
+        if parts:
+            lines.append("catalog: " + ", ".join(parts))
+    from spark_rapids_tpu.obs.registry import get_registry
+    counters = get_registry().snapshot()["counters"]
+    if counters:
+        parts = [_fmt_metric(k, v) for k, v in sorted(counters.items())]
+        lines.append("counters: " + ", ".join(parts))
+    return "\n".join(lines)

@@ -349,6 +349,18 @@ class DataFrame:
         ov, meta = self._overridden(quiet=True)
         return ov.explain(meta)
 
+    def explain_analyze(self) -> str:
+        """EXECUTE the query and render the plan annotated with runtime
+        metrics: per-node time/batches/rows plus spill, retry, and
+        recovery counters recorded during the run (EXPLAIN ANALYZE; the
+        reference surfaces the same GpuExec metrics in the SQL UI)."""
+        from spark_rapids_tpu.plan.overrides import explain_analyze
+        ov, meta = self._overridden(quiet=True)
+        with ExecCtx(backend=meta.backend, conf=self._s.conf) as ctx:
+            for _ in meta.exec_node.execute(ctx):
+                pass
+            return explain_analyze(meta.exec_node, ctx)
+
     def write_parquet(self, path: str, partition_by=None, **kw):
         """Directory write (Spark protocol).  ``partition_by`` enables
         hive-style dynamic-partition output; returns WriteStats."""

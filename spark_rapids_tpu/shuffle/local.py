@@ -82,6 +82,11 @@ class LocalShuffleTransport:
         self.metrics = {"bytes_written": 0, "bytes_compressed": 0,
                         "batches_written": 0, "stale_writes_discarded": 0,
                         "map_outputs_invalidated": 0}
+        # surface transport counters in the process metrics registry as
+        # pull gauges (weakref-bound; dropped again in close())
+        from spark_rapids_tpu.obs.registry import get_registry
+        self._reg_source = get_registry().register_object_source(
+            f"shuffle.transport.{id(self):x}", self)
 
     # -- SPI ------------------------------------------------------------
     def write_partition(self, shuffle_id: "int | str", map_id: int,
@@ -299,6 +304,8 @@ class LocalShuffleTransport:
                     f"(shuffle={shuffle_id} part={part_id})")
 
     def close(self) -> None:
+        from spark_rapids_tpu.obs.registry import get_registry
+        get_registry().unregister_source(self._reg_source)
         with self._lock:
             items = [s.item for lst in self._store.values() for s in lst
                      if s.item is not None]

@@ -44,6 +44,8 @@ import time
 from typing import Iterator
 
 from spark_rapids_tpu.conf import ConfEntry, register
+# obs.registry is dependency-free (stdlib only) — safe at module level
+from spark_rapids_tpu.obs.registry import get_registry
 from spark_rapids_tpu.shuffle.tcp import (TCP_CHECKSUM, TCP_INFLIGHT_LIMIT,
                                           TCP_TIMEOUT, ShuffleFetchError,
                                           _max_frame, fetch_remote,
@@ -120,7 +122,16 @@ class PeerCircuitBreaker:
             self.failures += 1
             self.last_error = f"{type(err).__name__}: {err}"
             if self.failures >= threshold:
+                if self._opened_at is None:
+                    # closed -> open transition only (a failed half-open
+                    # probe re-arms the cooldown without recounting)
+                    get_registry().inc("shuffle.breaker.opens")
                 self._opened_at = time.monotonic()
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
 
     def record_success(self) -> None:
         with self._lock:
@@ -146,6 +157,27 @@ def reset_circuit_breakers() -> None:
     change where old addresses are known stale)."""
     with _BREAKERS_LOCK:
         _BREAKERS.clear()
+
+
+def _peer_label(peer) -> str:
+    return ":".join(str(x) for x in peer) if isinstance(peer, tuple) \
+        else str(peer)
+
+
+def _breaker_gauges() -> dict:
+    """Registry source: per-peer breaker state, visible to snapshots as
+    shuffle.breaker.<host:port>.{failures,open} gauges."""
+    with _BREAKERS_LOCK:
+        breakers = list(_BREAKERS.values())
+    out = {}
+    for b in breakers:
+        p = _peer_label(b.peer)
+        out[f"{p}.failures"] = b.failures
+        out[f"{p}.open"] = int(b.is_open)
+    return out
+
+
+get_registry().register_source("shuffle.breaker", _breaker_gauges)
 
 
 def _settings(conf) -> dict:
@@ -200,10 +232,17 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                             checksum: bool | None = None,
                             max_retries: int | None = None,
                             retry_wait: float | None = None,
-                            backoff: float | None = None) -> Iterator:
+                            backoff: float | None = None,
+                            tracer=None, trace: dict | None = None) -> Iterator:
     """Stream one reduce partition's batches, surviving transport
     failures: on a retryable error, reconnect with exponential backoff
-    + jitter and resume at the last fully-delivered batch offset."""
+    + jitter and resume at the last fully-delivered batch offset.
+
+    ``trace`` is an optional propagation header (query_id/trace_id/
+    span_id) carried in the fetch request so the SERVING side attributes
+    its work to the originating query; ``tracer`` records retry events
+    locally. Attempt/retry counts land in the process metrics registry
+    either way."""
     s = _settings(conf)
     max_retries = TCP_MAX_RETRIES.get(s) if max_retries is None \
         else int(max_retries)
@@ -222,11 +261,15 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
     reset_s = TCP_BREAKER_RESET.get(s)
     peer = tuple(address)
     breaker = _breaker(peer)
+    reg = get_registry()
+    plabel = _peer_label(peer)
     rng = random.Random(f"fetch:{peer}:{shuffle_id}:{part_id}")
     delivered = 0     # batches fully yielded downstream, across attempts
     failures = 0      # consecutive failed attempts with NO new batches
     while True:
         breaker.before_attempt(reset_s)
+        reg.inc("shuffle.fetch.attempts")
+        reg.inc(f"shuffle.peer.{plabel}.fetch_attempts")
         before = delivered
         try:
             for batch in fetch_remote(peer, shuffle_id, part_id,
@@ -234,7 +277,8 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                                       device=device,
                                       inflight_limit=inflight_limit,
                                       max_frame=max_frame, timeout=timeout,
-                                      checksum=checksum, faults=faults):
+                                      checksum=checksum, faults=faults,
+                                      trace=trace):
                 yield batch
                 delivered += 1
             breaker.record_success()
@@ -245,9 +289,21 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                 # outputs), not the connection: reconnecting cannot help
                 # and must not count against this peer's breaker —
                 # surface straight to stage recovery
+                if tracer is not None:
+                    tracer.event("shuffle.fetch.terminal", "shuffle",
+                                 peer=plabel, part=part_id,
+                                 delivered=delivered, error=str(e)[:256])
                 raise
             breaker.record_failure(e, threshold)
+            reg.inc("shuffle.fetch.retries")
+            reg.inc(f"shuffle.peer.{plabel}.fetch_failures")
             failures = 1 if delivered > before else failures + 1
+            if tracer is not None:
+                tracer.event("shuffle.fetch.retry", "shuffle",
+                             peer=plabel, part=part_id, attempt=failures,
+                             delivered=delivered,
+                             resume_at=lo + delivered,
+                             error=str(e)[:256])
             if failures > max_retries:
                 err = ShuffleFetchError(
                     f"fetch of shuffle {shuffle_id} part {part_id} from "

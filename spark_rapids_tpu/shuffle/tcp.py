@@ -49,9 +49,12 @@ import struct
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Iterable
 
 from spark_rapids_tpu.conf import ConfEntry, register, parse_bytes, _bool
+# obs.registry is dependency-free (stdlib only) — safe at module level
+from spark_rapids_tpu.obs.registry import get_registry
 from spark_rapids_tpu.shuffle.compression import get_codec
 # re-exported for backward compatibility: these historically lived here
 from spark_rapids_tpu.shuffle.errors import (MapOutputLostError,
@@ -195,7 +198,13 @@ class TcpShuffleServer:
         self._faults = getattr(store, "faults", None)
         self.metrics = {"meta_requests": 0, "fetch_requests": 0,
                         "data_frames_sent": 0, "bytes_sent": 0,
-                        "faults_injected": 0}
+                        "faults_injected": 0, "traced_fetches": 0}
+        # propagated trace headers from peers' fetch requests (bounded):
+        # the serving side's record that remote work belonged to a given
+        # originating query_id/trace_id
+        self.trace_log: deque = deque(maxlen=256)
+        self._reg_source = get_registry().register_object_source(
+            f"shuffle.server.{id(self):x}", self)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind, port))
@@ -264,6 +273,33 @@ class TcpShuffleServer:
                         f"unknown op {req.get('op')!r}".encode())
             return
         self.metrics["fetch_requests"] += 1
+        # trace propagation: a new peer carries its query's ids in the
+        # request; record them, emit a serve event re-parented onto the
+        # propagated span when this process has a live tracer, and echo
+        # the header back.  An old peer sends no "trace" key and is
+        # served exactly as before.
+        tr = req.get("trace") or None
+        if isinstance(tr, dict):
+            self.metrics["traced_fetches"] += 1
+            self.trace_log.append({
+                "query_id": tr.get("query_id"),
+                "trace_id": tr.get("trace_id"),
+                "span_id": tr.get("span_id"),
+                "shuffle_id": req["shuffle_id"], "part_id": req["part_id"],
+                "lo": req.get("lo", 0), "hi": req.get("hi")})
+            try:
+                ctx = getattr(self._store, "ctx", None)
+                tracer = ctx.tracer if ctx is not None else None
+            except Exception:
+                tracer = None
+            if tracer is not None:
+                tracer.event("shuffle.serve", "shuffle",
+                             parent_id=tr.get("span_id"),
+                             origin_query_id=tr.get("query_id"),
+                             origin_trace_id=tr.get("trace_id"),
+                             shuffle=str(req["shuffle_id"]),
+                             part=req["part_id"],
+                             lo=req.get("lo", 0), hi=req.get("hi"))
         window = int(req.get("window") or TCP_INFLIGHT_LIMIT.default)
         # checksum negotiation: the client advertises the algorithms it
         # can verify; pick the first this server also knows and echo it
@@ -276,6 +312,8 @@ class TcpShuffleServer:
         header = {"codec": self._store.codec_name}
         if crc_name is not None:
             header["crc"] = crc_name
+        if isinstance(tr, dict):
+            header["trace"] = tr
         crc_fn = _CRC_ALGOS.get(crc_name)
         _send_frame(conn, _TAG_JSON, json.dumps(header).encode())
         sent_window = 0
@@ -322,6 +360,7 @@ class TcpShuffleServer:
 
     def close(self) -> None:
         self._closed.set()
+        get_registry().unregister_source(self._reg_source)
         try:
             self._sock.close()
         except OSError:
@@ -356,9 +395,13 @@ class TcpShuffleTransport(LocalShuffleTransport):
         from the conf (reference: the transport owns its inflight
         throttle and its failure policy, not the call site)."""
         from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+        ctx = getattr(self, "ctx", None)
+        tracer = ctx.tracer if ctx is not None else None
+        trace = tracer.trace_header() if tracer is not None else None
         return fetch_remote_with_retry(address, shuffle_id, part_id,
                                        lo=lo, hi=hi, device=device,
-                                       conf=self.conf, faults=self.faults)
+                                       conf=self.conf, faults=self.faults,
+                                       tracer=tracer, trace=trace)
 
     def close(self) -> None:
         self._server.close()
@@ -415,7 +458,8 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                  inflight_limit: int | None = None,
                  max_frame: int = _MAX_FRAME_MIN,
                  timeout: float | None = None,
-                 checksum: bool = True, faults=None) -> Iterable:
+                 checksum: bool = True, faults=None,
+                 trace: dict | None = None) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
     (reference RapidsShuffleClient.scala: TransferRequest -> bounce
     buffers -> reassembled device buffers).  The wire codec and frame
@@ -427,6 +471,8 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
     wedging or poisoning the reduce task."""
     window = int(inflight_limit or TCP_INFLIGHT_LIMIT.default)
     tmo = _resolve_timeout(timeout)
+    peer_label = ":".join(str(x) for x in tuple(address))
+    bytes_fetched = 0
     try:
         _check_connect_fault(faults, tuple(address))
         with socket.create_connection(tuple(address), timeout=tmo) as sock:
@@ -435,6 +481,11 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                    "window": window}
             if checksum:
                 req["crc"] = list(_CRC_ALGOS)
+            if trace:
+                # propagation header: the serving side attributes this
+                # stream to the originating query_id/trace_id (absent
+                # for old callers — same interop pattern as "crc")
+                req["trace"] = trace
             _send_frame(sock, _TAG_JSON, json.dumps(req).encode())
             tag, body = _recv_frame(sock)
             if tag == _TAG_ERROR:
@@ -457,6 +508,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                     return
                 if tag == _TAG_ERROR:
                     _raise_error_frame(frame, shuffle_id, part_id)
+                bytes_fetched += len(frame)
                 recv_window += len(frame)
                 if recv_window >= window:
                     _send_frame(sock, _TAG_JSON, b"{}")
@@ -470,6 +522,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                     frame = frame[_CRC.size:]
                     got = crc_fn(frame) & 0xFFFFFFFF
                     if got != want:
+                        get_registry().inc("shuffle.fetch.checksum_failures")
                         raise ShuffleTransportError(
                             f"frame {index} of shuffle {shuffle_id} part "
                             f"{part_id} from {address} failed its "
@@ -496,3 +549,10 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
         raise ShuffleTransportError(
             f"fetch of shuffle {shuffle_id} part {part_id} from "
             f"{address} failed: {type(e).__name__}: {e}") from e
+    finally:
+        # flushed once per stream (attempt), whatever way it ends, so
+        # per-peer byte movement is visible even for failed attempts
+        if bytes_fetched:
+            get_registry().inc(f"shuffle.peer.{peer_label}.bytes_fetched",
+                               bytes_fetched)
+            get_registry().inc("shuffle.fetch.bytes", bytes_fetched)

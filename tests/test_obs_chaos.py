@@ -1,0 +1,133 @@
+"""Observability under chaos: ONE trace tells the whole failure story.
+
+Acceptance-level companion to test_recovery_chaos.py: a TPC-H query run
+under a peer-death + spill-corruption storm with tracing enabled must
+produce a single exported trace in which the original map stage, the
+reduce-side fetches, and the lineage recompute all share one
+query_id/trace_id — and EXPLAIN ANALYZE must show the nonzero
+spill/recovery metrics on the affected exchange node, not just global
+counters.  The bench runner's JSON report carries the same story
+(registry counter movement + analyzed plan) for offline runs.
+"""
+import json
+import os
+
+import pytest
+
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.obs.registry import get_registry
+
+_STORM = ("shuffle.peer.dead:dead,times=2;"
+          "spill.disk.corrupt:corrupt,priority=0,times=2")
+
+
+def _chaos_conf(trace_dir: str) -> dict:
+    return {
+        "spark.rapids.test.faults": _STORM,
+        # tiny budgets: shuffle outputs spill to disk so the corrupt
+        # read-back path actually runs (same as test_recovery_chaos)
+        "spark.rapids.memory.tpu.spillStoreSize": 1 << 16,
+        "spark.rapids.memory.host.spillStorageSize": 4096,
+        "spark.rapids.obs.trace.enabled": "true",
+        "spark.rapids.obs.trace.dir": trace_dir,
+    }
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_obs_chaos") / "sf001")
+    generate_tpch(d, sf=0.01)
+    _split_tables(d, ("lineitem", "orders", "customer"), parts=4)
+    return d
+
+
+def _split_tables(data_dir: str, tables, parts: int) -> None:
+    import pyarrow.parquet as pq
+    for table in tables:
+        path = os.path.join(data_dir, table, "part-0.parquet")
+        t = pq.read_table(path)
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(data_dir, table,
+                                        f"part-{i}.parquet"))
+
+
+def test_chaos_run_single_trace_and_annotated_plan(data_dir, tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    before = get_registry().snapshot()
+    r = run_benchmark(data_dir, 0.01, ["q3"], verify=True,
+                      generate=False, suite="tpch",
+                      session_conf=_chaos_conf(trace_dir))[0]
+    assert "error" not in r, r
+    assert r["ok"], r
+
+    # --- the storm actually fired and was recovered -------------------
+    cat = r["metrics"].get("BufferCatalog", {})
+    assert cat.get("stage_recomputes", 0) > 0, cat
+    d = get_registry().delta(before)["counters"]
+    assert d.get("faults.injected", 0) > 0, d
+
+    # --- one trace, one query/trace id across the whole story ---------
+    # (verify also runs a host pass; pick the trace holding the chaos)
+    traces = [json.load(open(os.path.join(trace_dir, f)))
+              for f in os.listdir(trace_dir) if f.startswith("trace_")]
+    assert traces
+    chaos = [t for t in traces
+             if any(e["name"] == "stage.recovery"
+                    for e in t["traceEvents"])]
+    assert len(chaos) >= 1, "no trace captured the recovery"
+    evs = chaos[-1]["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"query", "stage.map", "shuffle.fetch",
+            "stage.recovery"} <= names, names
+    assert len({e["args"]["query_id"] for e in evs}) == 1
+    assert len({e["args"]["trace_id"] for e in evs}) == 1
+    # the exported file is named for the same query the events carry
+    qid = evs[0]["args"]["query_id"]
+    assert chaos[-1]["otherData"]["query_id"] == qid
+
+    # recomputed map writes hang off the recovery span, not stage.map
+    rec_ids = {e["args"]["span_id"] for e in evs
+               if e["name"] == "stage.recovery"}
+    writes = [e for e in evs if e["name"] == "shuffle.map_write"]
+    if writes:  # tiny-input coalescing may skip per-piece writes
+        assert any(e["args"]["parent_id"] in rec_ids for e in writes)
+
+    # --- EXPLAIN ANALYZE shows recovery on the affected node ----------
+    plan_txt = "\n".join(r["observability"]["plan_analyzed"])
+    assert "stageRecoveries=" in plan_txt, plan_txt
+    line = next(ln for ln in r["observability"]["plan_analyzed"]
+                if "stageRecoveries=" in ln)
+    assert "ShuffleExchangeExec" in line, line
+
+    # --- bench report carries the full observability record -----------
+    obs = r["observability"]
+    assert obs["query_id"] and obs["trace_id"]
+    assert obs["registry"]["counters"].get("faults.injected", 0) > 0
+    # report ids match an exported trace
+    assert any(t["otherData"]["query_id"] == obs["query_id"]
+               for t in traces)
+
+
+def test_failed_chaos_query_emits_bundle(data_dir, tmp_path):
+    """When the storm outlasts the recovery budget the run fails AND
+    leaves a diagnostic bundle naming the exhaustion."""
+    diag_dir = str(tmp_path / "diag")
+    conf = _chaos_conf(str(tmp_path / "traces"))
+    conf["spark.rapids.test.faults"] = "shuffle.peer.dead:dead,times=0"
+    conf["spark.rapids.shuffle.recovery.maxStageAttempts"] = "1"
+    conf["spark.rapids.obs.diagnostics.dir"] = diag_dir
+    r = run_benchmark(data_dir, 0.01, ["q3"], verify=False,
+                      generate=False, suite="tpch",
+                      session_conf=conf)[0]
+    assert not r["ok"]
+    assert "StageRecoveryExhausted" in r["error"], r["error"]
+    bundles = os.listdir(diag_dir)
+    assert len(bundles) == 1, bundles
+    doc = json.load(open(os.path.join(diag_dir, bundles[0])))
+    assert doc["error"]["type"] == "StageRecoveryExhausted"
+    assert doc["span_events"]
+    assert doc["faults"]["fired"]
+    assert any("ShuffleExchangeExec" in ln for ln in doc["plan_analyzed"])

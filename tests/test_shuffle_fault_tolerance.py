@@ -288,7 +288,8 @@ def test_no_faults_no_extra_round_trips():
                                         "data_frames_sent": 5,
                                         "bytes_sent":
                                             t.server_metrics["bytes_sent"],
-                                        "faults_injected": 0}
+                                        "faults_injected": 0,
+                                        "traced_fetches": 0}
         finally:
             t.close()
 
