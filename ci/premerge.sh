@@ -107,6 +107,84 @@ for mod in ("spark_rapids_tpu.obs.trace", "spark_rapids_tpu.obs.diag"):
         f"{mod} imported on the tracing-disabled path"
 print("disabled path imports no tracer/diagnostics: ok")
 PY
+  echo "-- query lifecycle gate: admission + cancel + deadline + shutdown --"
+  # four concurrent queries through one session bounded to 2 admitted:
+  # one is cancelled mid-flight (QueryCancelled), one carries a tiny
+  # deadline (QueryDeadlineExceeded), the other two must return EXACT
+  # results; after shutdown the session rejects new work and no
+  # tpu-task / tpu-shuffle-srv threads are left alive
+  JAX_PLATFORMS=cpu python - <<'PY'
+import threading
+import time
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.lifecycle import (QueryCancelled,
+                                             QueryDeadlineExceeded,
+                                             QueryRejected)
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+s = TpuSession({"spark.rapids.sql.admission.maxConcurrentQueries": 2,
+                "spark.rapids.sql.admission.maxQueuedQueries": 8})
+schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+small = s.from_pydict({"k": [i % 7 for i in range(4000)],
+                       "v": list(range(4000))}, schema, partitions=4) \
+    .group_by("k").agg(Sum(col("v")))
+big = s.from_pydict({"k": [i % 97 for i in range(400000)],
+                     "v": list(range(400000))}, schema, partitions=8) \
+    .group_by("k").agg(Sum(col("v")))
+expected = sorted(small.collect())
+
+results = {}
+def run(name, df, timeout=None):
+    try:
+        results[name] = ("ok", df.collect(timeout=timeout))
+    except BaseException as e:
+        results[name] = ("err", e)
+
+before = get_registry().snapshot()
+threads = [threading.Thread(target=run, args=("victim", big))]
+threads[0].start()
+deadline = time.monotonic() + 30.0
+while not s.active_queries() and time.monotonic() < deadline:
+    time.sleep(0.002)
+victim_qid, = s.active_queries()
+for name, df, tmo in (("deadline", small, 0.0005),
+                      ("exact1", small, None), ("exact2", small, None)):
+    t = threading.Thread(target=run, args=(name, df, tmo))
+    t.start()
+    threads.append(t)
+assert s.cancel(victim_qid), "victim finished before the cancel landed"
+for t in threads:
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "query did not unwind in time"
+
+kind, val = results["victim"]
+assert kind == "err" and isinstance(val, QueryCancelled), results["victim"]
+kind, val = results["deadline"]
+assert kind == "err" and isinstance(val, QueryDeadlineExceeded), \
+    results["deadline"]
+for name in ("exact1", "exact2"):
+    kind, val = results[name]
+    assert kind == "ok" and sorted(val) == expected, (name, kind)
+moved = get_registry().delta(before)["counters"]
+assert moved.get("queries_cancelled") == 1, moved
+assert moved.get("queries_deadline_exceeded") == 1, moved
+
+s.shutdown(drain=True, timeout=60.0)
+try:
+    small.collect()
+    raise SystemExit("collect after shutdown must raise QueryRejected")
+except QueryRejected:
+    pass
+leaked = [t.name for t in threading.enumerate()
+          if t.name.startswith(("tpu-task", "tpu-shuffle-srv"))]
+assert not leaked, f"leaked engine threads after shutdown: {leaked}"
+print("lifecycle gate: cancel/deadline/exact x2 + clean shutdown: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -156,7 +156,11 @@ class ExecCtx:
         with self._lock:
             if "catalog" not in self.cache:
                 from spark_rapids_tpu.memory.catalog import BufferCatalog
-                self.cache["catalog"] = BufferCatalog(conf=self.conf)
+                cat = BufferCatalog(conf=self.conf)
+                # spill I/O is a cooperative cancellation point: a
+                # cancelled query must stop pushing bytes to disk
+                cat.lifecycle = self.lifecycle
+                self.cache["catalog"] = cat
             return self.cache["catalog"]
 
     @property
@@ -168,12 +172,41 @@ class ExecCtx:
                     self.task_concurrency)
             return self.cache["semaphore"]
 
+    # -- query lifecycle (exec/lifecycle.py) -------------------------------
+    @property
+    def lifecycle(self):
+        """Per-query lifecycle handle (cancel event + deadline), minted
+        lazily alongside the query id.  Direct ExecCtx users get one
+        that is already RUNNING; TpuSession pre-populates the cache
+        with an ADMITTED handle it controls."""
+        lc = self.cache.get("lifecycle")
+        if lc is not None:
+            return lc
+        with self._lock:
+            lc = self.cache.get("lifecycle")
+            if lc is None:
+                from spark_rapids_tpu.exec.lifecycle import QueryLifecycle
+                lc = QueryLifecycle.from_conf(self.query_id, self.conf)
+                lc.start()
+                self.cache["lifecycle"] = lc
+            return lc
+
+    def check_cancel(self) -> None:
+        """Cooperative cancellation point: raises the terminal
+        QueryCancelled/QueryDeadlineExceeded once the query is
+        cancelled or past its deadline (reference: tasks polling
+        TaskContext.isInterrupted inside long loops)."""
+        self.lifecycle.check()
+
     def dispatch(self, fn, *args, **kwargs):
         """Run a heavy device program under (a) the DeviceSemaphore
         bounding chip occupancy (reference GpuSemaphore.acquireIfNecessary
         — acquired at the dispatch chokepoint, never while blocking on
         other tasks, so nested partition drains cannot deadlock) and
-        (b) the OOM-spill-retry hook (DeviceMemoryEventHandler loop)."""
+        (b) the OOM-spill-retry hook (DeviceMemoryEventHandler loop).
+        Every dispatch is a cancellation point: a cancelled query stops
+        before it can occupy the chip again."""
+        self.check_cancel()
         if not self.is_device:
             return fn(*args, **kwargs)
         from spark_rapids_tpu.memory.catalog import run_with_spill_retry
@@ -192,6 +225,7 @@ class ExecCtx:
         outputs would break semantics.  ``pairs=True`` returns
         ``(piece, output)`` tuples so callers can retain the processed
         pieces for a later :meth:`retry_sync` redo."""
+        self.check_cancel()
         if not self.is_device:
             r = fn(batch)
             return [(batch, r)] if pairs else [r]
@@ -209,6 +243,7 @@ class ExecCtx:
         poisoned dispatches from retained inputs, and sync again — the
         async-backend OOMs that used to surface outside every retry
         loop are recovered here."""
+        self.check_cancel()
         if not self.is_device:
             return sync_fn()
         from spark_rapids_tpu.memory import retry as _retry
@@ -334,6 +369,10 @@ class ExecCtx:
         with self._lock:
             if key in self.cache:
                 return self.cache[key]
+        # the owner failed; when the query was cancelled or timed out the
+        # owner's failure IS the cancellation — surface that, not a
+        # secondary "another task" error
+        self.check_cancel()
         raise RuntimeError(f"stage materialization failed for {key!r} "
                            "in another task")
 
@@ -512,6 +551,19 @@ class PlanNode:
         except GeneratorExit:
             raise
         except Exception as e:
+            # a cancelled/deadline-exceeded query closes its trace with
+            # the terminal lifecycle state so the timeline shows WHY the
+            # query span ended early (and the diag bundle below carries
+            # the same state for post-mortems)
+            if getattr(e, "terminal", False):
+                lc = ctx.cache.get("lifecycle")
+                if lc is not None and lc.state in ("CANCELLED",
+                                                   "DEADLINE_EXCEEDED"):
+                    t = ctx.tracer
+                    if t is not None:
+                        t.set_query_state(lc.state)
+                        t.event("query.lifecycle", "query",
+                                state=lc.state)
             out_dir = ctx.conf.settings.get(
                 "spark.rapids.obs.diagnostics.dir")
             emit = False
@@ -563,12 +615,14 @@ def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
     were lost).  Same worker pool, same spillable parking, same
     partition-ordered delivery."""
     n = node.num_partitions(ctx)
+    lc = ctx.lifecycle
     workers = min(ctx.task_concurrency, n) if ctx.is_device else 1
     if workers <= 1 or n <= 1:
         for pid in range(n):
             with ctx.trace_span("partition", "partition",
                                 node=type(node).__name__, partition=pid):
                 for b in node.partition_iter(ctx, pid):
+                    lc.check()
                     yield pid, b
         return
 
@@ -580,17 +634,41 @@ def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
     # worker threads have empty span stacks; parent their partition spans
     # onto whatever span is open on the draining thread (query/stage)
     drain_parent = tracer.current_span_id() if tracer is not None else None
+    # early consumer exit (LIMIT satisfied, error, cancel): the finally
+    # block raises this flag and in-flight workers stop at their NEXT
+    # batch boundary instead of draining every partition to completion
+    stop = threading.Event()
 
     def drain(pid: int):
         # chip occupancy is bounded inside ctx.dispatch, not here: holding
         # the semaphore across a next() that may itself drain partitions
         # (join build sides, nested exchanges) would deadlock
+        out: list = []
         with ctx.trace_span("partition", "partition",
                             parent_id=drain_parent,
                             node=type(node).__name__, partition=pid):
-            return [SpillableColumnarBatch(b, catalog,
-                                           SpillPriority.READ_SHUFFLE)
-                    for b in node.partition_iter(ctx, pid)]
+            it = node.partition_iter(ctx, pid)
+            try:
+                while not stop.is_set():
+                    lc.check()
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    out.append(SpillableColumnarBatch(
+                        b, catalog, SpillPriority.READ_SHUFFLE))
+            except BaseException:
+                # the batches already parked would otherwise sit in the
+                # catalog until ctx.close(); the post-cancel invariant
+                # is "parked spillable batches closed"
+                for sb in out:
+                    sb.close()
+                raise
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+        return out
 
     with cf.ThreadPoolExecutor(max_workers=workers,
                                thread_name_prefix="tpu-task") as pool:
@@ -598,11 +676,15 @@ def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
         try:
             for pid, fut in enumerate(futures):
                 for sb in fut.result():
+                    lc.check()
                     yield pid, sb.get()
                     sb.close()
         finally:
-            # early consumer exit / error: release every still-registered
-            # buffer (close is idempotent; unconsumed = leaked otherwise)
+            # early consumer exit / error: stop in-flight workers at
+            # their next batch boundary, then release every
+            # still-registered buffer (close is idempotent; unconsumed
+            # = leaked otherwise)
+            stop.set()
             for fut in futures:
                 if fut.cancel():
                     continue
@@ -622,21 +704,27 @@ def _rows_from_host(b: HostBatch) -> list[tuple]:
     return list(zip(*cols)) if cols else [()] * b.num_rows
 
 
-def collect_host(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
-    """Run on the CPU oracle; rows as python tuples."""
-    with ExecCtx(backend="host", conf=conf or TpuConf({})) as ctx:
+def collect_host(plan: PlanNode, conf: TpuConf | None = None,
+                 ctx: ExecCtx | None = None) -> list[tuple]:
+    """Run on the CPU oracle; rows as python tuples.  ``ctx`` lets the
+    session pass a context pre-bound to its lifecycle handle (so
+    cancel/deadline reach the run); the ctx is closed here either
+    way."""
+    with (ctx or ExecCtx(backend="host", conf=conf or TpuConf({}))) as ctx:
         out: list[tuple] = []
         for b in plan.execute(ctx):
             out.extend(_rows_from_host(b))
         return out
 
 
-def collect_device(plan: PlanNode, conf: TpuConf | None = None) -> list[tuple]:
+def collect_device(plan: PlanNode, conf: TpuConf | None = None,
+                   ctx: ExecCtx | None = None) -> list[tuple]:
     """Run on the TPU path; rows as python tuples (D2H at the end only).
     With spark.rapids.tpu.profile.dir set, the whole execution records an
-    xprof trace (reference: nsight timelines over NVTX ranges)."""
+    xprof trace (reference: nsight timelines over NVTX ranges).  ``ctx``
+    lets the session pass a context pre-bound to its lifecycle handle."""
     import contextlib
-    with ExecCtx(backend="device", conf=conf or TpuConf({})) as ctx:
+    with (ctx or ExecCtx(backend="device", conf=conf or TpuConf({}))) as ctx:
         profile_dir = ctx.conf.get(PROFILE_DIR)
         prof = contextlib.nullcontext()
         if profile_dir:

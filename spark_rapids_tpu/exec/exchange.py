@@ -536,7 +536,8 @@ class RemoteShuffleReaderExec(PlanNode):
         yield from fetch_remote_with_retry(self.address, self.shuffle_id,
                                            pid, device=ctx.is_device,
                                            conf=ctx.conf, faults=faults,
-                                           tracer=tracer, trace=trace)
+                                           tracer=tracer, trace=trace,
+                                           lifecycle=ctx.lifecycle)
 
     def node_desc(self) -> str:
         return (f"RemoteShuffleReaderExec[{self.address[0]}:"

@@ -1,0 +1,395 @@
+"""Query lifecycle control plane: deadlines, cooperative cancellation,
+admission control.
+
+Reference mapping: the plugin leans on Spark's task-kill machinery —
+``TaskContext.isInterrupted`` polled inside long loops, and
+GpuSemaphore releasing the device when a task is killed
+(GpuSemaphore.scala:74-126) — so one runaway task cannot wedge the GPU
+for the queries queued behind it.  This standalone engine has no Spark
+scheduler to inherit that from, so the equivalent plane lives here:
+
+* :class:`QueryLifecycle` — a per-query handle minted in ``ExecCtx``
+  alongside the query id.  It carries a cancellation
+  ``threading.Event`` plus a monotonic deadline
+  (``spark.rapids.sql.queryTimeout`` or ``collect(timeout=...)``) and
+  moves through ``ADMITTED -> RUNNING -> {FINISHED, FAILED, CANCELLED,
+  DEADLINE_EXCEEDED}``.  Cancellation is **cooperative**: the engine
+  calls :meth:`QueryLifecycle.check` at its chokepoints (every
+  ``ctx.dispatch``/``dispatch_retry`` entry, every drain batch
+  boundary, the shuffle retry ladder's backoff waits, the recovery
+  recompute loop, spill I/O, the pandas-UDF slot queue) and the first
+  check after a cancel/deadline raises a **terminal** error.
+
+* :class:`QueryCancelled` / :class:`QueryDeadlineExceeded` — terminal
+  taxonomy in the ``shuffle/errors.py`` style: ``terminal = True`` is
+  a class attribute so every retry ladder (OOM split-and-retry in
+  memory/retry.py, the shuffle fetch ladder, stage recovery) can
+  refuse to swallow them with one ``getattr(ex, "terminal", False)``
+  check and no import.
+
+* :class:`AdmissionController` — session-level FIFO admission bounding
+  concurrent queries (``spark.rapids.sql.admission.*``).  Beyond the
+  queue bound (or queue wait timeout, or after shutdown began) new
+  queries are load-shed with :class:`QueryRejected` instead of piling
+  onto the DeviceSemaphore and worker pool.
+
+Post-cancel invariants (asserted by tests/test_lifecycle.py): the
+DeviceSemaphore is back at full capacity, the spill directory is
+empty, parked spillable batches are closed, and the peer's server
+sessions for the dead query are dropped — cancellation unwinds through
+the same ``finally`` blocks as success, it never leaks by design.
+
+Dependency discipline: stdlib + conf + obs.registry only, so hot
+modules may import this at module level without dragging jax in.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.obs.registry import get_registry
+
+__all__ = [
+    "QueryLifecycle", "AdmissionController", "QueryLifecycleError",
+    "QueryCancelled", "QueryDeadlineExceeded", "QueryRejected",
+    "ADMITTED", "RUNNING", "FINISHED", "FAILED", "CANCELLED",
+    "DEADLINE_EXCEEDED",
+]
+
+QUERY_TIMEOUT = register(ConfEntry(
+    "spark.rapids.sql.queryTimeout", 0.0,
+    "Per-query deadline in seconds (0 disables). Measured on the "
+    "monotonic clock from query start; once exceeded, the next "
+    "cooperative cancellation point (dispatch entry, drain batch "
+    "boundary, shuffle backoff wait, spill I/O, UDF slot acquire) "
+    "raises the terminal QueryDeadlineExceeded and the query unwinds, "
+    "releasing the device semaphore and spill files on the way out. "
+    "DataFrame.collect(timeout=...) overrides it per call (the "
+    "tighter of the two wins).", conv=float))
+ADMISSION_MAX_CONCURRENT = register(ConfEntry(
+    "spark.rapids.sql.admission.maxConcurrentQueries", 0,
+    "Queries allowed to run concurrently per session (0 = unbounded). "
+    "Excess queries wait FIFO in the admission queue instead of piling "
+    "onto the device semaphore and drain worker pool; size it near the "
+    "device concurrency (spark.rapids.sql.concurrentDeviceTasks) so "
+    "admitted queries actually progress (reference: GpuSemaphore "
+    "bounding concurrent tasks on the GPU).", conv=int))
+ADMISSION_MAX_QUEUED = register(ConfEntry(
+    "spark.rapids.sql.admission.maxQueuedQueries", 16,
+    "Queries allowed to WAIT for admission beyond the concurrent "
+    "bound. Arrivals past this are load-shed immediately with "
+    "QueryRejected — under sustained overload a bounded queue keeps "
+    "latency finite instead of growing it without limit.", conv=int))
+ADMISSION_QUEUE_TIMEOUT = register(ConfEntry(
+    "spark.rapids.sql.admission.queueTimeoutSeconds", 30.0,
+    "Longest a query may wait in the admission queue before it is "
+    "rejected with QueryRejected (0 = wait forever). Keeps a wedged "
+    "run from silently stalling everything queued behind it.",
+    conv=float))
+
+# -- states ----------------------------------------------------------------
+
+ADMITTED = "ADMITTED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+DEADLINE_EXCEEDED = "DEADLINE_EXCEEDED"
+
+#: states a query never leaves
+TERMINAL_STATES = frozenset({FINISHED, FAILED, CANCELLED,
+                             DEADLINE_EXCEEDED})
+
+
+# -- terminal taxonomy (shuffle/errors.py style) ---------------------------
+
+class QueryLifecycleError(RuntimeError):
+    """Base of the lifecycle taxonomy.  ``terminal`` mirrors the
+    shuffle/errors.py convention: retry ladders check
+    ``getattr(ex, "terminal", False)`` and re-raise instead of
+    retrying — a cancelled query must not be split, backed off, or
+    lineage-recomputed back to life."""
+
+    terminal: bool = True
+
+    def __init__(self, query_id: str, msg: str):
+        super().__init__(msg)
+        self.query_id = query_id
+
+
+class QueryCancelled(QueryLifecycleError):
+    """The query was cancelled (session.cancel / cancel_all / early
+    consumer exit) and a cooperative checkpoint observed it."""
+
+    def __init__(self, query_id: str, reason: str = "cancelled"):
+        super().__init__(query_id,
+                         f"query {query_id} cancelled: {reason}")
+        self.reason = reason
+
+
+class QueryDeadlineExceeded(QueryLifecycleError):
+    """The query ran past its deadline (spark.rapids.sql.queryTimeout
+    or collect(timeout=...))."""
+
+    def __init__(self, query_id: str, timeout: float):
+        super().__init__(query_id,
+                         f"query {query_id} exceeded its deadline "
+                         f"({timeout:g}s)")
+        self.timeout = timeout
+
+
+class QueryRejected(QueryLifecycleError):
+    """Load-shed at admission: the session is shutting down, the
+    admission queue is full, or the queue wait timed out.  The query
+    never started, so there is nothing to unwind."""
+
+
+# -- per-query handle ------------------------------------------------------
+
+class QueryLifecycle:
+    """State machine + cancellation event + monotonic deadline for one
+    query.  Thread-safe: the session cancels from its thread while
+    drain workers call :meth:`check` from theirs.
+
+    The cancellation event is the single broadcast channel: ``cancel``
+    and a tripped deadline both set it, so every blocked
+    ``event.wait(pause)`` (shuffle backoff, UDF slot poll) wakes
+    promptly and the next :meth:`check` raises the terminal error.
+    """
+
+    def __init__(self, query_id: str, timeout: "float | None" = None):
+        self.query_id = query_id
+        self.timeout = timeout if timeout and timeout > 0 else None
+        self.cancel_event = threading.Event()
+        self._lock = threading.Lock()
+        self._state = ADMITTED
+        self._started_at: "float | None" = None
+        self._deadline: "float | None" = None
+        self._cancel_reason = "cancelled"
+
+    @classmethod
+    def from_conf(cls, query_id: str, conf,
+                  timeout: "float | None" = None) -> "QueryLifecycle":
+        """Effective deadline = the tighter of the conf queryTimeout
+        and the per-call ``timeout``."""
+        settings = getattr(conf, "settings", None) or {}
+        conf_tmo = QUERY_TIMEOUT.get(settings)
+        cands = [t for t in (conf_tmo, timeout) if t and t > 0]
+        return cls(query_id, timeout=min(cands) if cands else None)
+
+    # -- transitions -------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def start(self) -> None:
+        """ADMITTED -> RUNNING; the deadline clock starts here, not at
+        admission, so queue wait does not eat the query's budget."""
+        with self._lock:
+            if self._state == ADMITTED:
+                self._state = RUNNING
+                self._started_at = time.monotonic()
+                if self.timeout is not None:
+                    self._deadline = self._started_at + self.timeout
+
+    def finish(self) -> bool:
+        """RUNNING -> FINISHED (no-op once terminal)."""
+        with self._lock:
+            if self._state in TERMINAL_STATES:
+                return False
+            self._state = FINISHED
+            return True
+
+    def fail(self) -> bool:
+        """RUNNING -> FAILED on a non-lifecycle error (no-op once
+        terminal)."""
+        with self._lock:
+            if self._state in TERMINAL_STATES:
+                return False
+            self._state = FAILED
+            return True
+
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Request cooperative cancellation.  Idempotent: only the
+        first call transitions (and counts queries_cancelled); a query
+        already finished/failed/deadline-exceeded is left alone and
+        ``False`` is returned."""
+        with self._lock:
+            if self._state in TERMINAL_STATES:
+                return False
+            self._state = CANCELLED
+            self._cancel_reason = reason
+        self.cancel_event.set()
+        get_registry().inc("queries_cancelled")
+        return True
+
+    def _expire(self) -> bool:
+        with self._lock:
+            if self._state in TERMINAL_STATES:
+                return False
+            self._state = DEADLINE_EXCEEDED
+        self.cancel_event.set()
+        get_registry().inc("queries_deadline_exceeded")
+        return True
+
+    # -- cooperative checkpoints -------------------------------------------
+
+    def remaining(self) -> "float | None":
+        """Seconds to the deadline (None when no deadline; never
+        negative)."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def check(self) -> None:
+        """The cancellation point.  Raises :class:`QueryCancelled` or
+        :class:`QueryDeadlineExceeded` once the query is cancelled or
+        past its deadline; otherwise returns immediately.  Cheap on
+        the happy path (one Event read + one clock read)."""
+        if not self.cancel_event.is_set():
+            if self._deadline is None or \
+                    time.monotonic() < self._deadline:
+                return
+            self._expire()
+        state = self._state
+        if state == DEADLINE_EXCEEDED:
+            raise QueryDeadlineExceeded(self.query_id,
+                                        self.timeout or 0.0)
+        raise QueryCancelled(self.query_id, self._cancel_reason)
+
+    def wait(self, seconds: float) -> None:
+        """Interruptible sleep: waits up to ``seconds`` (capped at the
+        time left to the deadline) on the cancel event, then
+        :meth:`check`.  Replaces ``time.sleep`` in retry backoff so a
+        cancel or deadline aborts the ladder mid-pause instead of
+        after it."""
+        self.check()
+        rem = self.remaining()
+        pause = seconds if rem is None else min(seconds, rem)
+        if pause > 0:
+            self.cancel_event.wait(pause)
+        self.check()
+
+
+# -- session-level admission -----------------------------------------------
+
+class AdmissionController:
+    """FIFO admission: at most ``max_concurrent`` queries run, at most
+    ``max_queued`` wait, the rest are load-shed with
+    :class:`QueryRejected`.  A single condition variable guards both
+    counters; FIFO order is enforced by a token deque — a waiter only
+    proceeds when its token reaches the head, so a late arrival can
+    never overtake a query that queued first."""
+
+    def __init__(self, max_concurrent: int = 0, max_queued: int = 16,
+                 queue_timeout: float = 30.0):
+        self.max_concurrent = max_concurrent
+        self.max_queued = max_queued
+        self.queue_timeout = queue_timeout
+        self._cond = threading.Condition()
+        self._active = 0
+        self._queue: deque = deque()
+        self._shutdown = False
+
+    @classmethod
+    def from_conf(cls, conf) -> "AdmissionController":
+        settings = getattr(conf, "settings", None) or {}
+        return cls(
+            max_concurrent=ADMISSION_MAX_CONCURRENT.get(settings),
+            max_queued=ADMISSION_MAX_QUEUED.get(settings),
+            queue_timeout=ADMISSION_QUEUE_TIMEOUT.get(settings))
+
+    @property
+    def active(self) -> int:
+        with self._cond:
+            return self._active
+
+    @property
+    def queued(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def shutting_down(self) -> bool:
+        return self._shutdown
+
+    def admit(self, query_id: str = "?",
+              timeout: "float | None" = None) -> None:
+        """Block until admitted (FIFO).  Raises :class:`QueryRejected`
+        when the session is shutting down, the wait queue is full, or
+        the queue wait exceeds ``timeout`` (default: the
+        queueTimeoutSeconds conf; 0 waits forever)."""
+        reg = get_registry()
+        tmo = self.queue_timeout if timeout is None else timeout
+        token = object()
+        with self._cond:
+            if self._shutdown:
+                reg.inc("queries_rejected")
+                raise QueryRejected(
+                    query_id, f"query {query_id} rejected: session is "
+                    "shutting down")
+            if self.max_concurrent <= 0:
+                self._active += 1
+                reg.inc("queries_admitted")
+                return
+            if self._active < self.max_concurrent and not self._queue:
+                self._active += 1
+                reg.inc("queries_admitted")
+                return
+            if len(self._queue) >= self.max_queued:
+                reg.inc("queries_rejected")
+                raise QueryRejected(
+                    query_id, f"query {query_id} rejected: admission "
+                    f"queue full ({len(self._queue)} >= "
+                    f"maxQueuedQueries={self.max_queued})")
+            self._queue.append(token)
+            deadline = time.monotonic() + tmo if tmo and tmo > 0 \
+                else None
+            try:
+                while True:
+                    if self._shutdown:
+                        raise QueryRejected(
+                            query_id, f"query {query_id} rejected: "
+                            "session is shutting down")
+                    if self._queue[0] is token and \
+                            self._active < self.max_concurrent:
+                        self._queue.popleft()
+                        self._active += 1
+                        reg.inc("queries_admitted")
+                        return
+                    rem = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if rem is not None and rem <= 0:
+                        raise QueryRejected(
+                            query_id, f"query {query_id} rejected: "
+                            f"waited {tmo:g}s in the admission queue "
+                            "(queueTimeoutSeconds)")
+                    self._cond.wait(rem)
+            except QueryRejected:
+                reg.inc("queries_rejected")
+                try:
+                    self._queue.remove(token)
+                except ValueError:
+                    pass
+                # the head token may have changed: wake the queue
+                self._cond.notify_all()
+                raise
+
+    def release(self) -> None:
+        """One admitted query finished (success, failure, or cancel):
+        free its slot and wake the queue head."""
+        with self._cond:
+            if self._active > 0:
+                self._active -= 1
+            self._cond.notify_all()
+
+    def begin_shutdown(self) -> None:
+        """Stop admitting: every queued waiter and every future
+        ``admit`` raises :class:`QueryRejected`.  Already-admitted
+        queries are unaffected (the session drains or cancels them)."""
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()

@@ -51,17 +51,28 @@ _slot_tls = threading.local()
 
 
 @contextmanager
-def _udf_slot(sem: threading.BoundedSemaphore):
+def _udf_slot(sem: threading.BoundedSemaphore, lifecycle=None):
     """Per-thread REENTRANT semaphore hold: a chain of streaming pandas
     execs in one thread (map_in_pandas over map_in_pandas) pulls child
     batches while the downstream UDF slot is held — counting each level
     against the semaphore would self-deadlock once the chain is longer
     than the permit count, so the whole chain consumes ONE worker slot
     (the reference's semaphore also counts python WORKERS, not plan
-    depth — PythonWorkerSemaphore.scala:42-100)."""
+    depth — PythonWorkerSemaphore.scala:42-100).
+
+    ``lifecycle`` (the query's exec/lifecycle.py handle) makes the
+    acquire a cancellation point: a cancelled query never queues new
+    UDF evaluations behind the concurrentPythonWorkers semaphore, and
+    one already waiting wakes at the next poll instead of after the
+    UDF ahead of it finishes."""
     depth = getattr(_slot_tls, "depth", 0)
     if depth == 0:
-        sem.acquire()
+        if lifecycle is None:
+            sem.acquire()
+        else:
+            lifecycle.check()
+            while not sem.acquire(timeout=0.05):
+                lifecycle.check()
     _slot_tls.depth = depth + 1
     try:
         yield
@@ -179,7 +190,7 @@ class ArrowEvalPythonExec(PlanNode):
         sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
         cols = list(hb.columns)
         for name, u in self._udfs:
-            with _udf_slot(sem):
+            with _udf_slot(sem, ctx.lifecycle):
                 result = u.fn(*self._series_inputs(hb, u))
             r = pd.Series(result)
             if len(r) != hb.num_rows:
@@ -304,7 +315,7 @@ class MapInPandasExec(PlanNode):
             # slot held only around the UDF body (runs inside next()
             # for generator UDFs); reentrant so chained pandas execs in
             # one thread consume a single worker slot
-            with _udf_slot(sem):
+            with _udf_slot(sem, ctx.lifecycle):
                 try:
                     out = next(it)
                 except StopIteration:
@@ -352,7 +363,7 @@ class FlatMapGroupsInPandasExec(PlanNode):
             return
         sem = _py_semaphore(ctx.conf.get(CONCURRENT_PYTHON))
         for _, g in _group_frames(pdf, self._keys):
-            with _udf_slot(sem):
+            with _udf_slot(sem, ctx.lifecycle):
                 out = self._fn(g.reset_index(drop=True))
             hb = _from_pandas(out, self._schema, "apply_in_pandas")
             if hb.num_rows:
@@ -479,7 +490,7 @@ class AggregateInPandasExec(PlanNode):
             for k, kv in zip(self._keys, key):
                 rows[k].append(None if pd.isna(kv) else kv)
             for (name, u), cols in zip(self._udfs, in_names):
-                with _udf_slot(sem):
+                with _udf_slot(sem, ctx.lifecycle):
                     r = u.fn(*[g[c] for c in cols])
                 rows[name].append(None if r is None or
                                   (np.isscalar(r) and pd.isna(r)) else r)
@@ -557,7 +568,7 @@ class FlatMapCoGroupsInPandasExec(PlanNode):
             # mutations into later calls (review finding)
             lg = lgroups.get(k)
             rg = rgroups.get(k)
-            with _udf_slot(sem):
+            with _udf_slot(sem, ctx.lifecycle):
                 out = self._fn(lg if lg is not None else lempty.copy(),
                                rg if rg is not None else rempty.copy())
             hb = _from_pandas(out, self._schema, "cogroup apply_in_pandas")
@@ -774,7 +785,7 @@ class WindowInPandasExec(PlanNode):
                 series = [s.iloc[s0:s1].reset_index(drop=True)
                           for s in in_series[ui]]
                 vals = out_vals[ui]
-                with _udf_slot(sem):
+                with _udf_slot(sem, ctx.lifecycle):
                     for i in range(gn):
                         r = u.fn(*[s.iloc[lo[i]:hi[i]] for s in series])
                         vals[s0 + i] = None if r is None or (

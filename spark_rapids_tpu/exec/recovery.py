@@ -119,6 +119,9 @@ class ShuffleLineage:
         impl = getattr(impl, "__wrapped__", impl)
         done = 0
         for cpid in sorted({self.map_src[bi] for bi in wanted}):
+            # recompute re-drains whole child partitions; check between
+            # them so a cancel mid-recovery stops at the next boundary
+            ctx.check_cancel()
             flat = flat_by_cpid[cpid]
             for k, b in enumerate(impl(child, ctx, cpid)):
                 if k >= len(flat):
@@ -158,6 +161,10 @@ def recovering_fetch(ctx, exchange, transport, pid: int, lo: int,
     the resumed stream never mixes attempts)."""
     delivered = 0
     while True:
+        # cancellation point: a cancelled query must not start another
+        # recovery round (only MapOutputLostError re-enters the loop;
+        # the terminal lifecycle errors propagate straight out)
+        ctx.check_cancel()
         try:
             for b in transport.fetch_partition(
                     exchange.shuffle_id, pid, lo + delivered, hi):
@@ -173,6 +180,7 @@ def _recover(ctx, transport, err: MapOutputLostError,
     """Handle one observed loss: invalidate + recompute the lost map
     outputs, or raise when recovery is disabled, has no lineage, or the
     stage's attempt budget ran out."""
+    ctx.check_cancel()
     settings = ctx.conf.settings
     if not RECOVERY_ENABLED.get(settings):
         raise err

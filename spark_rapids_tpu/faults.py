@@ -29,6 +29,17 @@ Injection points wired today (site -> actions it interprets):
     store.fetch         local shuffle store reads (ctx: shuffle, part).
                         Action ``error`` raises from the store — over
                         TCP it reaches the client as an error frame.
+    shuffle.peer.hang   accepted-then-stalled peer: checked at the TOP
+                        of the server's fetch handling (ctx: shuffle,
+                        part).  Any action name works (use ``hang``);
+                        the server holds the connection open sending
+                        nothing — no header, no error frame — for
+                        ``seconds`` (default 3600, interrupted by
+                        server close), so the CLIENT's
+                        spark.rapids.shuffle.socketTimeout is what
+                        breaks the wedge as a retryable
+                        ShuffleFetchError.  Default ``times=1``: the
+                        retry's reconnect succeeds.
     shuffle.peer.dead   terminal peer death, checked on every store /
                         remote fetch (ctx: shuffle, part).  Any action
                         name works (use ``dead``); once triggered the

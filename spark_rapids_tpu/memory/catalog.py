@@ -193,6 +193,10 @@ class BufferCatalog:
         # real XLA RESOURCE_EXHAUSTED; None when unset (inert)
         from spark_rapids_tpu.faults import FaultRegistry
         self.faults = FaultRegistry.from_conf(settings)
+        # query lifecycle handle (exec/lifecycle.py), bound by ExecCtx:
+        # spill I/O checks it so a cancelled query stops pushing bytes
+        # between tiers instead of finishing a multi-buffer spill sweep
+        self.lifecycle = None
         self.metrics = {"device_spills": 0, "host_spills": 0,
                         "bytes_spilled_to_host": 0,
                         "bytes_spilled_to_disk": 0,
@@ -310,7 +314,17 @@ class BufferCatalog:
             freed += e.size
         return freed
 
+    def _check_cancel(self) -> None:
+        """Cooperative cancellation point at spill-I/O entry: checked
+        BEFORE any tier state mutates, so an abort here leaves the
+        entry where it was (still consistent) and the query unwinds
+        without half-moved buffers."""
+        lc = self.lifecycle
+        if lc is not None:
+            lc.check()
+
     def _spill_one_to_host_locked(self, e: _Entry) -> None:
+        self._check_cancel()
         leaves, treedef = jax.tree_util.tree_flatten(e.batch)
         host = jax.device_get(leaves)
         metas, total = [], 0
@@ -373,6 +387,7 @@ class BufferCatalog:
 
     def _spill_host_one_locked(self) -> bool:
         """Move one host-tier buffer to disk; False if none exist."""
+        self._check_cancel()
         cands = sorted((e for e in self._entries.values()
                         if e.tier == "host" and e.refcount == 0),
                        key=lambda e: e.priority)
@@ -413,6 +428,7 @@ class BufferCatalog:
     # -- unspill ---------------------------------------------------------
     def _unspill_locked(self, e: _Entry) -> None:
         import jax.numpy as jnp
+        self._check_cancel()
         if e.tier == "lost":
             raise SpillCorruptionError(
                 f"buffer {e.buffer_id}: storage was lost to disk "

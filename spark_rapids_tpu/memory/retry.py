@@ -75,7 +75,13 @@ _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory")
 
 
 def is_oom(ex: BaseException) -> bool:
-    """True when ``ex`` is an HBM exhaustion (real XLA or injected)."""
+    """True when ``ex`` is an HBM exhaustion (real XLA or injected).
+    Terminal errors (QueryCancelled / QueryDeadlineExceeded /
+    MapOutputLostError carry ``terminal = True``) are never OOMs, no
+    matter what their message says — a cancelled query must not be
+    split-and-retried back to life."""
+    if getattr(ex, "terminal", False):
+        return False
     msg = str(ex)
     return any(m in msg for m in _OOM_MARKERS)
 

@@ -84,6 +84,23 @@ def _fault_view(ctx) -> dict:
     return {"spec": spec, "fired": fired}
 
 
+def _lifecycle_view(ctx) -> dict:
+    """Query lifecycle state at emission time (exec/lifecycle.py): a
+    deadline-exceeded bundle shows DEADLINE_EXCEEDED with the timeout
+    that tripped, a cancel shows CANCELLED, so the first line of
+    triage — 'did it die or was it killed?' — is in the bundle."""
+    try:
+        lc = ctx.cache.get("lifecycle")
+        if lc is None:
+            return {}
+        return {"state": lc.state,
+                "timeout_s": lc.timeout,
+                "deadline_remaining_s": lc.remaining(),
+                "cancel_requested": lc.cancel_event.is_set()}
+    except Exception:
+        return {}
+
+
 def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
     """Write ``diag_<query_id>_<unix-ms>.json`` into ``out_dir``.
 
@@ -129,6 +146,7 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
                                  if tracer is not None else [])
         bundle["faults"] = _fault_view(ctx)
         bundle["catalog"] = _catalog_view(ctx)
+        bundle["lifecycle"] = _lifecycle_view(ctx)
         try:
             bundle["conf"] = {k: v for k, v in ctx.conf.settings.items()
                               if str(k).startswith("spark.")}

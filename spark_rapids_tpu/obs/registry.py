@@ -16,6 +16,14 @@ a scrape endpoint is one ``open().write()`` away.
 Dependency discipline: this module imports nothing from the engine (only
 stdlib), so hot modules (shuffle/retry.py, faults.py) may import it at
 module level without creating cycles or dragging jax into light paths.
+
+Well-known counter families (beyond per-object sources):
+``shuffle.fetch.*`` (retry ladder), ``faults.injected[.point]``
+(injection sites), and the query lifecycle plane's
+``queries_admitted`` / ``queries_rejected`` / ``queries_cancelled`` /
+``queries_deadline_exceeded`` (exec/lifecycle.py — incremented exactly
+once per query at the admission decision or the first terminal
+transition, so a delta over a run counts QUERIES, not checkpoints).
 """
 from __future__ import annotations
 

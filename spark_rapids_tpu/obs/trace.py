@@ -93,6 +93,10 @@ class Tracer:
         self._origin = time.perf_counter()
         self._wall_origin = time.time()
         self.pid = os.getpid()
+        # terminal lifecycle state (CANCELLED / DEADLINE_EXCEEDED / ...)
+        # stamped by the query root when the run ends abnormally; carried
+        # in the export header so a trace says WHY it ends early
+        self.query_state: str | None = None
 
     # -- internals ---------------------------------------------------------
 
@@ -176,6 +180,10 @@ class Tracer:
                        **args}}
         self._push(ev)
 
+    def set_query_state(self, state: str) -> None:
+        """Record the query's terminal lifecycle state (exec/lifecycle)."""
+        self.query_state = state
+
     # -- export ------------------------------------------------------------
 
     def events_snapshot(self, last: int | None = None) -> list[dict]:
@@ -197,6 +205,8 @@ class Tracer:
                 "events_dropped": self._dropped,
             },
         }
+        if self.query_state is not None:
+            doc["otherData"]["query_state"] = self.query_state
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)

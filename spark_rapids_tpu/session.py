@@ -26,12 +26,158 @@ __all__ = ["TpuSession", "DataFrame"]
 
 class TpuSession:
     """Session: conf + data sources (reference: SparkSession + the
-    plugin's RapidsConf snapshot, Plugin.scala:116)."""
+    plugin's RapidsConf snapshot, Plugin.scala:116).
+
+    The session is also the query lifecycle control plane
+    (exec/lifecycle.py): every ``collect`` runs through FIFO admission
+    (``spark.rapids.sql.admission.*``), is registered under its
+    query_id while in flight so :meth:`cancel` / :meth:`cancel_all`
+    reach it, and carries a deadline from
+    ``spark.rapids.sql.queryTimeout`` or ``collect(timeout=...)``.
+    :meth:`shutdown` stops admission and drains (or cancels) what is
+    left — the analog of SparkContext.stop over the plugin's
+    task-kill machinery."""
 
     def __init__(self, conf: dict | TpuConf | None = None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf or {})
         from spark_rapids_tpu.runtime import ensure_runtime
         ensure_runtime(self.conf)
+        import threading
+        self._lc_cond = threading.Condition()
+        self._live: dict = {}        # query_id -> QueryLifecycle
+        self._admission = None       # built lazily from the live conf
+
+    # -- query lifecycle (exec/lifecycle.py) ---------------------------
+    def _admission_controller(self):
+        with self._lc_cond:
+            if self._admission is None:
+                from spark_rapids_tpu.exec.lifecycle import \
+                    AdmissionController
+                self._admission = AdmissionController.from_conf(self.conf)
+            return self._admission
+
+    def active_queries(self) -> list[str]:
+        """query_ids currently admitted and running."""
+        with self._lc_cond:
+            return sorted(self._live)
+
+    def cancel(self, query_id: str) -> bool:
+        """Request cooperative cancellation of one in-flight query.
+        Returns True when the request transitioned it to CANCELLED
+        (False: unknown id or already terminal).  The run itself
+        unwinds at its next cancellation point, raising
+        QueryCancelled from ``collect``."""
+        with self._lc_cond:
+            lc = self._live.get(query_id)
+        return lc.cancel("session.cancel") if lc is not None else False
+
+    def cancel_all(self) -> int:
+        """Cancel every in-flight query; returns how many transitioned."""
+        with self._lc_cond:
+            lcs = list(self._live.values())
+        return sum(1 for lc in lcs if lc.cancel("session.cancel_all"))
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float | None = None) -> None:
+        """Graceful session shutdown: stop admission (new queries get
+        QueryRejected), then ``drain=True`` waits for in-flight queries
+        to finish — cancelling whatever is still running once
+        ``timeout`` (seconds, None = wait forever) expires — while
+        ``drain=False`` cancels them immediately.  Each query's unwind
+        closes its own ExecCtx: shuffle TCP servers stop, catalogs
+        close (spill files unlinked), the DeviceSemaphore is released
+        in full."""
+        self._admission_controller().begin_shutdown()
+        if not drain:
+            self.cancel_all()
+            timeout = None
+        if not self._wait_idle(timeout):
+            # drain window expired: cancel the stragglers, then give
+            # their cooperative checkpoints a bounded grace to unwind
+            self.cancel_all()
+            self._wait_idle(10.0)
+
+    def _wait_idle(self, timeout: float | None) -> bool:
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._lc_cond:
+            while self._live:
+                rem = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._lc_cond.wait(rem if rem is not None else 1.0)
+        return True
+
+    def _run_query(self, node, backend: str,
+                   timeout: float | None = None) -> list[tuple]:
+        """Admission -> lifecycle registration -> execution -> cleanup
+        for one collect.  The ExecCtx cache is pre-seeded with the
+        lifecycle handle (and its query_id) so every cancellation
+        point down the stack observes the session's cancel/deadline."""
+        import uuid
+        from spark_rapids_tpu.exec.lifecycle import (QueryLifecycle,
+                                                     QueryLifecycleError)
+        admission = self._admission_controller()
+        query_id = uuid.uuid4().hex[:16]
+        admission.admit(query_id)
+        lc = QueryLifecycle.from_conf(query_id, self.conf,
+                                      timeout=timeout)
+        with self._lc_cond:
+            self._live[query_id] = lc
+        try:
+            lc.start()
+            try:
+                out = self._execute_collect(node, backend, query_id, lc)
+            except QueryLifecycleError:
+                raise
+            except BaseException:
+                if not lc.fail():
+                    # already terminal: the cancel/deadline unwound
+                    # concurrent workers in arbitrary order and a
+                    # secondary error won the race to surface — raise
+                    # the lifecycle error (the real cause), chaining
+                    # the loser as context
+                    lc.check()
+                raise
+            lc.finish()
+            return out
+        finally:
+            with self._lc_cond:
+                self._live.pop(query_id, None)
+                self._lc_cond.notify_all()
+            admission.release()
+
+    def _execute_collect(self, node, backend: str, query_id: str, lc):
+        def make_ctx(be: str) -> ExecCtx:
+            ctx = ExecCtx(backend=be, conf=self.conf)
+            ctx.cache["query_id"] = query_id
+            ctx.cache["lifecycle"] = lc
+            return ctx
+
+        if backend != "device":
+            return collect_host(node, self.conf, ctx=make_ctx("host"))
+        from spark_rapids_tpu.conf import FALLBACK_ON_DEVICE_ERROR
+        if not self.conf.get(FALLBACK_ON_DEVICE_ERROR):
+            return collect_device(node, self.conf, ctx=make_ctx("device"))
+        try:
+            return collect_device(node, self.conf, ctx=make_ctx("device"))
+        except Exception as e:  # noqa: BLE001 - opt-in resilience path
+            # a cancelled/deadline-exceeded (or otherwise terminal)
+            # query must NOT be resurrected on the host engine
+            if getattr(e, "terminal", False):
+                raise
+            # opt-in runtime resilience beyond the reference (which only
+            # falls back at PLAN time): rerun the whole query on the
+            # host oracle with a loud warning. Off by default — masking
+            # device bugs silently would defeat differential testing.
+            import warnings
+            warnings.warn(
+                f"device execution failed ({type(e).__name__}: {e}); "
+                "re-running on the host engine per "
+                "spark.rapids.sql.fallbackOnDeviceError", RuntimeWarning)
+            return collect_host(node, self.conf, ctx=make_ctx("host"))
 
     # -- sources -------------------------------------------------------
     def read_parquet(self, path, columns=None, **kw) -> "DataFrame":
@@ -288,26 +434,21 @@ class DataFrame:
             self._plan))
 
     # -- actions -------------------------------------------------------
-    def collect(self) -> list[tuple]:
+    def collect(self, timeout: float | None = None) -> list[tuple]:
+        """Run the query and return every row as a python tuple.
+
+        ``timeout`` (seconds) sets a per-call deadline, combined with
+        ``spark.rapids.sql.queryTimeout`` (the tighter wins): past it,
+        the run unwinds at its next cancellation point and raises
+        QueryDeadlineExceeded.  The run is registered with the session
+        while in flight, so ``session.cancel(query_id)`` /
+        ``cancel_all()`` raise QueryCancelled from here, and admission
+        control (``spark.rapids.sql.admission.*``) may make this call
+        wait its turn or raise QueryRejected under overload."""
         ov, meta = self._overridden()
-        if meta.backend != "device":
-            return collect_host(meta.exec_node, self._s.conf)
-        from spark_rapids_tpu.conf import FALLBACK_ON_DEVICE_ERROR
-        if not self._s.conf.get(FALLBACK_ON_DEVICE_ERROR):
-            return collect_device(meta.exec_node, self._s.conf)
-        try:
-            return collect_device(meta.exec_node, self._s.conf)
-        except Exception as e:  # noqa: BLE001 - opt-in resilience path
-            # opt-in runtime resilience beyond the reference (which only
-            # falls back at PLAN time): rerun the whole query on the
-            # host oracle with a loud warning. Off by default — masking
-            # device bugs silently would defeat differential testing.
-            import warnings
-            warnings.warn(
-                f"device execution failed ({type(e).__name__}: {e}); "
-                "re-running on the host engine per "
-                "spark.rapids.sql.fallbackOnDeviceError", RuntimeWarning)
-            return collect_host(meta.exec_node, self._s.conf)
+        backend = "device" if meta.backend == "device" else "host"
+        return self._s._run_query(meta.exec_node, backend,
+                                  timeout=timeout)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -20,6 +20,13 @@ WHO handles it:
   attempt budget (``spark.rapids.shuffle.recovery.maxStageAttempts``)
   ran out while the same map outputs kept dying.
 
+The query lifecycle plane (exec/lifecycle.py) extends the same
+``terminal`` convention: ``QueryCancelled`` / ``QueryDeadlineExceeded``
+carry ``terminal = True`` as a class attribute, so every ladder here —
+and the OOM retry scopes in memory/retry.py — refuses to swallow them
+with the one ``getattr(ex, "terminal", False)`` check it already does,
+no lifecycle import required.
+
 Reference mapping (SURVEY §2.6): FetchFailedException carries
 (shuffleId, mapId) up to Spark's DAGScheduler, which resubmits the
 lost map stage — the lineage-recomputation model of RDDs (Zaharia et

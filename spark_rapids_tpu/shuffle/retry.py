@@ -189,10 +189,12 @@ def remote_partition_sizes_with_retry(address, shuffle_id: "int | str",
                                       max_retries: int | None = None,
                                       retry_wait: float | None = None,
                                       backoff: float | None = None,
-                                      faults=None) -> tuple[dict, dict]:
+                                      faults=None,
+                                      lifecycle=None) -> tuple[dict, dict]:
     """Metadata plane with the same retry ladder + circuit breaker as
     the data plane."""
     s = _settings(conf)
+    sock_timeout = _sock_timeout(s)
     max_retries = TCP_MAX_RETRIES.get(s) if max_retries is None \
         else int(max_retries)
     retry_wait = TCP_RETRY_WAIT.get(s) if retry_wait is None \
@@ -207,9 +209,12 @@ def remote_partition_sizes_with_retry(address, shuffle_id: "int | str",
     rng = random.Random(f"meta:{peer}:{shuffle_id}")
     attempt = 0
     while True:
+        if lifecycle is not None:
+            lifecycle.check()
         breaker.before_attempt(reset_s)
         try:
             out = remote_partition_sizes(peer, shuffle_id, timeout=timeout,
+                                         sock_timeout=sock_timeout,
                                          faults=faults)
             breaker.record_success()
             return out
@@ -220,7 +225,7 @@ def remote_partition_sizes_with_retry(address, shuffle_id: "int | str",
                 raise ShuffleFetchError(
                     f"metadata fetch of shuffle {shuffle_id} from {peer}: "
                     f"giving up after {attempt} attempts: {e}") from e
-            _backoff_sleep(retry_wait, backoff, attempt, rng)
+            _backoff_sleep(retry_wait, backoff, attempt, rng, lifecycle)
 
 
 def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
@@ -233,7 +238,8 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                             max_retries: int | None = None,
                             retry_wait: float | None = None,
                             backoff: float | None = None,
-                            tracer=None, trace: dict | None = None) -> Iterator:
+                            tracer=None, trace: dict | None = None,
+                            lifecycle=None) -> Iterator:
     """Stream one reduce partition's batches, surviving transport
     failures: on a retryable error, reconnect with exponential backoff
     + jitter and resume at the last fully-delivered batch offset.
@@ -242,8 +248,14 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
     span_id) carried in the fetch request so the SERVING side attributes
     its work to the originating query; ``tracer`` records retry events
     locally. Attempt/retry counts land in the process metrics registry
-    either way."""
+    either way.
+
+    ``lifecycle`` (exec/lifecycle.py QueryLifecycle) makes the ladder
+    cancellable: checked before every attempt, and backoff pauses wait
+    on the cancel event instead of sleeping — a cancel or deadline
+    aborts the ladder mid-pause with the terminal lifecycle error."""
     s = _settings(conf)
+    sock_timeout = _sock_timeout(s)
     max_retries = TCP_MAX_RETRIES.get(s) if max_retries is None \
         else int(max_retries)
     retry_wait = TCP_RETRY_WAIT.get(s) if retry_wait is None \
@@ -267,6 +279,8 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
     delivered = 0     # batches fully yielded downstream, across attempts
     failures = 0      # consecutive failed attempts with NO new batches
     while True:
+        if lifecycle is not None:
+            lifecycle.check()
         breaker.before_attempt(reset_s)
         reg.inc("shuffle.fetch.attempts")
         reg.inc(f"shuffle.peer.{plabel}.fetch_attempts")
@@ -277,6 +291,7 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                                       device=device,
                                       inflight_limit=inflight_limit,
                                       max_frame=max_frame, timeout=timeout,
+                                      sock_timeout=sock_timeout,
                                       checksum=checksum, faults=faults,
                                       trace=trace):
                 yield batch
@@ -312,13 +327,28 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                     f"resume offset {lo + delivered}): {e}")
                 err.terminal = True
                 raise err from e
-            _backoff_sleep(retry_wait, backoff, failures, rng)
+            _backoff_sleep(retry_wait, backoff, failures, rng, lifecycle)
+
+
+def _sock_timeout(settings: dict) -> "float | None":
+    """Resolve the per-read data-socket timeout for this fetch: the
+    dedicated socketTimeout conf, falling back to the overall
+    tcp.timeoutSeconds when unset (0)."""
+    from spark_rapids_tpu.shuffle.tcp import SOCKET_TIMEOUT
+    st = SOCKET_TIMEOUT.get(settings)
+    return st if st and st > 0 else None
 
 
 def _backoff_sleep(base: float, mult: float, attempt: int,
-                   rng: random.Random) -> None:
+                   rng: random.Random, lifecycle=None) -> None:
     """attempt-th (1-based) backoff: base * mult^(attempt-1), jittered
-    to [0.5x, 1.5x) from the caller's deterministically-seeded PRNG."""
+    to [0.5x, 1.5x) from the caller's deterministically-seeded PRNG.
+    With a ``lifecycle``, the pause waits on the cancel event instead
+    of sleeping, so cancel/deadline interrupts it immediately."""
     pause = base * (mult ** (attempt - 1)) * (0.5 + rng.random())
-    if pause > 0:
+    if pause <= 0:
+        return
+    if lifecycle is not None:
+        lifecycle.wait(pause)
+    else:
         time.sleep(pause)

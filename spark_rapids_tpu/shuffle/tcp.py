@@ -96,6 +96,16 @@ TCP_TIMEOUT = register(ConfEntry(
     "(reference: fetch timeout via spark.network.timeout, "
     "GpuShuffleEnv.scala:60-62, propagated through "
     "RapidsShuffleIterator).", conv=float))
+SOCKET_TIMEOUT = register(ConfEntry(
+    "spark.rapids.shuffle.socketTimeout", 0.0,
+    "Per-read/write timeout in seconds on established shuffle data "
+    "connections, applied on BOTH ends: the client's fetch socket and "
+    "the server's accepted connections. A peer that accepts and then "
+    "stalls mid-stream surfaces as a retryable ShuffleFetchError after "
+    "this long instead of holding the connection (and a serve thread) "
+    "until tcp.timeoutSeconds. 0 inherits tcp.timeoutSeconds. Set it "
+    "well below the backoff ladder's total budget so a hung peer "
+    "converts into retries the circuit breaker can count.", conv=float))
 TCP_CHECKSUM = register(ConfEntry(
     "spark.rapids.shuffle.tcp.checksumEnabled", True,
     "Per-data-frame integrity checksum (CRC32C when the C binding is "
@@ -205,6 +215,13 @@ class TcpShuffleServer:
         self.trace_log: deque = deque(maxlen=256)
         self._reg_source = get_registry().register_object_source(
             f"shuffle.server.{id(self):x}", self)
+        # read/write timeout for accepted connections: a client that
+        # connects and then wedges must not pin a serve thread forever
+        settings = getattr(getattr(store, "conf", None), "settings", {})
+        st = SOCKET_TIMEOUT.get(settings)
+        if not st or st <= 0:
+            st = TCP_TIMEOUT.get(settings)
+        self._sock_timeout = st if st and st > 0 else None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind, port))
@@ -222,6 +239,9 @@ class TcpShuffleServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # a timed-out read raises TimeoutError (an OSError), which
+            # the _serve handlers already treat as "drop the connection"
+            conn.settimeout(self._sock_timeout)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -273,6 +293,19 @@ class TcpShuffleServer:
                         f"unknown op {req.get('op')!r}".encode())
             return
         self.metrics["fetch_requests"] += 1
+        if self._faults is not None:
+            act = self._faults.check("shuffle.peer.hang",
+                                     shuffle=req["shuffle_id"],
+                                     part=req["part_id"])
+            if act is not None:
+                # accepted-then-stalled peer: hold the connection open
+                # sending NOTHING (no header, no error frame) until the
+                # client's socketTimeout trips or this server closes —
+                # the exact wedge satellite 1's data-socket timeout
+                # exists to convert into a retryable ShuffleFetchError
+                self.metrics["faults_injected"] += 1
+                self._closed.wait(act.param("seconds", 3600.0))
+                return
         # trace propagation: a new peer carries its query's ids in the
         # request; record them, emit a serve event re-parented onto the
         # propagated span when this process has a live tracer, and echo
@@ -398,10 +431,12 @@ class TcpShuffleTransport(LocalShuffleTransport):
         ctx = getattr(self, "ctx", None)
         tracer = ctx.tracer if ctx is not None else None
         trace = tracer.trace_header() if tracer is not None else None
+        lifecycle = ctx.lifecycle if ctx is not None else None
         return fetch_remote_with_retry(address, shuffle_id, part_id,
                                        lo=lo, hi=hi, device=device,
                                        conf=self.conf, faults=self.faults,
-                                       tracer=tracer, trace=trace)
+                                       tracer=tracer, trace=trace,
+                                       lifecycle=lifecycle)
 
     def close(self) -> None:
         self._server.close()
@@ -425,23 +460,27 @@ def _check_connect_fault(faults, address) -> None:
 
 def remote_partition_sizes(address, shuffle_id: "int | str",
                            timeout: float | None = None,
+                           sock_timeout: float | None = None,
                            faults=None) -> tuple[dict, dict]:
     """Metadata plane: (partition_sizes, batch_sizes) from a peer
     (reference MetadataRequest/Response flatbuffer RPC).  A wedged peer
-    raises ShuffleFetchError after ``timeout`` seconds; a reset or
-    mid-frame close is wrapped with the same context instead of leaking
-    a raw ConnectionError to the reduce task."""
+    raises ShuffleFetchError after ``timeout`` seconds (``sock_timeout``
+    tightens the per-read deadline once connected — the socketTimeout
+    conf); a reset or mid-frame close is wrapped with the same context
+    instead of leaking a raw ConnectionError to the reduce task."""
     tmo = _resolve_timeout(timeout)
     try:
         _check_connect_fault(faults, tuple(address))
         with socket.create_connection(tuple(address), timeout=tmo) as sock:
+            if sock_timeout is not None and sock_timeout > 0:
+                sock.settimeout(sock_timeout)
             _send_frame(sock, _TAG_JSON, json.dumps(
                 {"op": "meta", "shuffle_id": shuffle_id}).encode())
             tag, body = _recv_frame(sock)
     except TimeoutError as e:
         raise ShuffleTransportError(
             f"metadata fetch of shuffle {shuffle_id} from {address} "
-            f"stalled past {tmo}s") from e
+            f"stalled past its read deadline") from e
     except (ConnectionError, OSError) as e:
         raise ShuffleTransportError(
             f"metadata fetch of shuffle {shuffle_id} from {address} "
@@ -458,6 +497,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                  inflight_limit: int | None = None,
                  max_frame: int = _MAX_FRAME_MIN,
                  timeout: float | None = None,
+                 sock_timeout: float | None = None,
                  checksum: bool = True, faults=None,
                  trace: dict | None = None) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
@@ -476,6 +516,11 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
     try:
         _check_connect_fault(faults, tuple(address))
         with socket.create_connection(tuple(address), timeout=tmo) as sock:
+            if sock_timeout is not None and sock_timeout > 0:
+                # tighter per-read deadline on the established data
+                # connection (spark.rapids.shuffle.socketTimeout): an
+                # accepted-then-stalled peer fails fast and retryably
+                sock.settimeout(sock_timeout)
             req = {"op": "fetch", "shuffle_id": shuffle_id,
                    "part_id": part_id, "lo": lo, "hi": hi,
                    "window": window}
@@ -544,7 +589,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
     except TimeoutError as e:
         raise ShuffleTransportError(
             f"fetch of shuffle {shuffle_id} part {part_id} from "
-            f"{address} stalled past {tmo}s") from e
+            f"{address} stalled past its read deadline") from e
     except (ConnectionError, OSError) as e:
         raise ShuffleTransportError(
             f"fetch of shuffle {shuffle_id} part {part_id} from "

@@ -1,0 +1,601 @@
+"""Query lifecycle control plane suite: deadlines, cooperative
+cancellation, admission control, graceful shutdown.
+
+The invariant under test is the one the reference gets from Spark's
+task-kill machinery (TaskContext.isInterrupted + GpuSemaphore releasing
+the device for killed tasks): a cancelled or deadline-exceeded query
+unwinds through the SAME finally blocks as a successful one, so nothing
+leaks — the DeviceSemaphore returns to full capacity, spilled files are
+unlinked, parked spillable batches are closed, and the terminal
+QueryCancelled / QueryDeadlineExceeded is never swallowed by the OOM
+split-and-retry scope, the shuffle fetch ladder, or stage recovery.
+
+The integration half cancels TPC-H q3 mid-flight under the PR-1/PR-3
+chaos storm (peer death + spilled-output corruption + tiny budgets), so
+cancellation lands while retries, recovery and spill I/O are all in
+motion — the worst case for a leak, not the best.
+"""
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.lifecycle import (ADMITTED, CANCELLED,
+                                             DEADLINE_EXCEEDED, FINISHED,
+                                             RUNNING, AdmissionController,
+                                             QueryCancelled,
+                                             QueryDeadlineExceeded,
+                                             QueryLifecycle, QueryRejected)
+from spark_rapids_tpu.obs.registry import get_registry
+
+
+def _counter_delta(before: dict, name: str) -> float:
+    return get_registry().delta(before)["counters"].get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# QueryLifecycle state machine
+# ---------------------------------------------------------------------------
+
+def test_state_machine_happy_path():
+    lc = QueryLifecycle("q1")
+    assert lc.state == ADMITTED
+    lc.start()
+    assert lc.state == RUNNING
+    lc.check()  # no deadline, not cancelled: no-op
+    assert lc.finish()
+    assert lc.state == FINISHED
+    # terminal is sticky: neither fail nor cancel moves it
+    assert not lc.fail()
+    assert not lc.cancel()
+    assert lc.state == FINISHED
+
+
+def test_cancel_idempotent_counts_once():
+    before = get_registry().snapshot()
+    lc = QueryLifecycle("q2")
+    lc.start()
+    assert lc.cancel("test")
+    assert not lc.cancel("again")
+    assert not lc.cancel("and again")
+    assert lc.state == CANCELLED
+    assert lc.cancel_event.is_set()
+    assert _counter_delta(before, "queries_cancelled") == 1
+    with pytest.raises(QueryCancelled, match="test"):
+        lc.check()
+
+
+def test_deadline_expires_at_check():
+    before = get_registry().snapshot()
+    lc = QueryLifecycle("q3", timeout=0.02)
+    lc.start()
+    time.sleep(0.05)
+    with pytest.raises(QueryDeadlineExceeded):
+        lc.check()
+    assert lc.state == DEADLINE_EXCEEDED
+    assert lc.cancel_event.is_set()
+    # a cancel after expiry is a no-op and must not double-count
+    assert not lc.cancel()
+    assert _counter_delta(before, "queries_deadline_exceeded") == 1
+    assert _counter_delta(before, "queries_cancelled") == 0
+
+
+def test_deadline_clock_starts_at_start_not_admission():
+    lc = QueryLifecycle("q4", timeout=5.0)
+    assert lc.remaining() is None      # not started: no deadline yet
+    lc.start()
+    rem = lc.remaining()
+    assert rem is not None and 4.0 < rem <= 5.0
+
+
+def test_from_conf_tighter_of_conf_and_call():
+    conf = TpuConf({"spark.rapids.sql.queryTimeout": 5.0})
+    assert QueryLifecycle.from_conf("a", conf).timeout == 5.0
+    assert QueryLifecycle.from_conf("b", conf, timeout=1.0).timeout == 1.0
+    assert QueryLifecycle.from_conf("c", conf, timeout=9.0).timeout == 5.0
+    assert QueryLifecycle.from_conf("d", TpuConf({})).timeout is None
+
+
+def test_wait_interrupted_by_cancel():
+    lc = QueryLifecycle("q5")
+    lc.start()
+    t = threading.Timer(0.15, lc.cancel)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(QueryCancelled):
+        lc.wait(30.0)
+    assert time.monotonic() - t0 < 5.0   # woke at the cancel, not 30s
+    t.join()
+
+
+def test_wait_capped_by_deadline():
+    lc = QueryLifecycle("q6", timeout=0.1)
+    lc.start()
+    t0 = time.monotonic()
+    with pytest.raises(QueryDeadlineExceeded):
+        lc.wait(30.0)
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# terminal taxonomy vs the retry ladders
+# ---------------------------------------------------------------------------
+
+def test_is_oom_refuses_terminal_errors():
+    from spark_rapids_tpu.memory.retry import is_oom
+    # message LOOKS like an OOM; terminal=True must win
+    e = QueryCancelled("q", "RESOURCE_EXHAUSTED: not really")
+    assert not is_oom(e)
+    assert not is_oom(QueryDeadlineExceeded("q", 1.0))
+    assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: real"))
+
+
+def test_with_retry_does_not_swallow_cancel():
+    from spark_rapids_tpu.memory.retry import with_retry
+
+    calls = []
+
+    def fn(_b):
+        calls.append(1)
+        raise QueryCancelled("q", "RESOURCE_EXHAUSTED: disguised")
+
+    class _Cat:
+        pass
+
+    with pytest.raises(QueryCancelled):
+        with_retry(fn, _Cat(), object())
+    assert len(calls) == 1   # no second attempt, no split
+
+
+def test_dispatch_entry_is_a_cancellation_point():
+    from spark_rapids_tpu.exec.core import ExecCtx
+    with ExecCtx(backend="device", conf=TpuConf({})) as ctx:
+        ctx.lifecycle.cancel("test")
+        with pytest.raises(QueryCancelled):
+            ctx.check_cancel()
+        with pytest.raises(QueryCancelled):
+            ctx.dispatch(lambda: 1)
+
+
+def test_udf_slot_acquire_is_a_cancellation_point():
+    from spark_rapids_tpu.exec.python_exec import _udf_slot
+    sem = threading.BoundedSemaphore(1)
+    lc = QueryLifecycle("qudf")
+    lc.start()
+    assert sem.acquire()   # saturate: the slot is unavailable
+    errs = []
+
+    def worker():
+        try:
+            with _udf_slot(sem, lc):
+                pass
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            errs.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.15)       # worker is polling for the slot
+    lc.cancel("test")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert errs and isinstance(errs[0], QueryCancelled)
+    sem.release()
+    # the cancelled waiter must NOT have consumed the permit
+    assert sem.acquire(blocking=False)
+    sem.release()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_unbounded_by_default():
+    ac = AdmissionController(max_concurrent=0)
+    for i in range(32):
+        ac.admit(f"q{i}")
+    assert ac.active == 32
+
+
+def test_admission_queue_overflow_rejected():
+    before = get_registry().snapshot()
+    ac = AdmissionController(max_concurrent=1, max_queued=1,
+                             queue_timeout=30.0)
+    ac.admit("holder")
+
+    queued = threading.Thread(target=ac.admit, args=("waiter",))
+    queued.start()
+    deadline = time.monotonic() + 5.0
+    while ac.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert ac.queued == 1
+
+    with pytest.raises(QueryRejected, match="queue full"):
+        ac.admit("overflow")
+    assert _counter_delta(before, "queries_rejected") == 1
+
+    ac.release()           # holder done -> waiter admitted
+    queued.join(timeout=5.0)
+    assert not queued.is_alive()
+    assert ac.active == 1 and ac.queued == 0
+    assert _counter_delta(before, "queries_admitted") == 2
+
+
+def test_admission_is_fifo():
+    ac = AdmissionController(max_concurrent=1, max_queued=8,
+                             queue_timeout=30.0)
+    ac.admit("holder")
+    order: list = []
+
+    def wait_in(name):
+        ac.admit(name)
+        order.append(name)
+
+    threads = []
+    for i in range(3):
+        t = threading.Thread(target=wait_in, args=(f"w{i}",))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5.0
+        while ac.queued < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert ac.queued == i + 1   # arrival order is pinned
+
+    for i in range(3):
+        ac.release()
+        deadline = time.monotonic() + 5.0
+        while len(order) < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert order == ["w0", "w1", "w2"]
+
+
+def test_admission_queue_timeout_rejects():
+    ac = AdmissionController(max_concurrent=1, max_queued=4,
+                             queue_timeout=0.15)
+    ac.admit("holder")
+    t0 = time.monotonic()
+    with pytest.raises(QueryRejected, match="queueTimeoutSeconds"):
+        ac.admit("late")
+    assert 0.1 <= time.monotonic() - t0 < 5.0
+    assert ac.queued == 0   # the timed-out token was removed
+
+
+def test_admission_shutdown_rejects_new_and_queued():
+    ac = AdmissionController(max_concurrent=1, max_queued=4,
+                             queue_timeout=30.0)
+    ac.admit("holder")
+    errs = []
+
+    def waiter():
+        try:
+            ac.admit("queued")
+        except QueryRejected as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while ac.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    ac.begin_shutdown()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert errs and "shutting down" in str(errs[0])
+    with pytest.raises(QueryRejected, match="shutting down"):
+        ac.admit("new")
+    # already-admitted queries are unaffected
+    assert ac.active == 1
+
+
+# ---------------------------------------------------------------------------
+# early consumer exit stops drain workers (exec/core.py stop flag)
+# ---------------------------------------------------------------------------
+
+class _FakeBatch:
+    def device_size_bytes(self) -> int:
+        return 64
+
+
+def test_early_consumer_exit_stops_drain_workers():
+    from spark_rapids_tpu.exec.core import (ExecCtx, PlanNode,
+                                            drain_partitions_indexed)
+
+    full = 40          # batches a slow partition would produce if drained
+    step = 0.1         # seconds per slow batch
+    counts = [0, 0, 0, 0]
+
+    class SlowNode(PlanNode):
+        def __init__(self):
+            super().__init__(())
+
+        def num_partitions(self, ctx):
+            return 4
+
+        def partition_iter(self, ctx, pid):
+            if pid == 0:
+                yield _FakeBatch()
+                return
+            for _ in range(full):
+                time.sleep(step)
+                counts[pid] += 1
+                yield _FakeBatch()
+
+    conf = TpuConf({"spark.rapids.sql.concurrentTpuTasks": 4,
+                    "spark.rapids.sql.metrics.enabled": "false"})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        it = drain_partitions_indexed(ctx, SlowNode())
+        t0 = time.monotonic()
+        pid, first = next(it)
+        assert pid == 0 and isinstance(first, _FakeBatch)
+        it.close()     # LIMIT satisfied / consumer gone
+        elapsed = time.monotonic() - t0
+        # without the stop flag the close would block for the FULL drain
+        # of three slow partitions (~4s each); with it, workers stop at
+        # their next batch boundary
+        assert elapsed < full * step / 2, elapsed
+        assert max(counts[1:]) < full, counts
+        # every parked spillable batch was closed on the way out
+        assert not ctx.cache["catalog"]._entries
+
+
+# ---------------------------------------------------------------------------
+# shuffle retry ladder: deadline aborts mid-backoff
+# ---------------------------------------------------------------------------
+
+def test_deadline_aborts_shuffle_backoff_mid_pause():
+    from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+    # a port nothing listens on: every connect fails fast (refused),
+    # so elapsed time is dominated by the backoff pause
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    before = get_registry().snapshot()
+    lc = QueryLifecycle("qdl", timeout=0.3)
+    lc.start()
+    retry_wait = 2.0
+    t0 = time.monotonic()
+    with pytest.raises(QueryDeadlineExceeded):
+        list(fetch_remote_with_retry(("127.0.0.1", port), "s1", 0,
+                                     device=False, timeout=1.0,
+                                     retry_wait=retry_wait, backoff=1.0,
+                                     max_retries=8, lifecycle=lc))
+    elapsed = time.monotonic() - t0
+    # the deadline fired DURING the first backoff pause: abort well
+    # under one full (jittered up to 1.5x) backoff step, not after it
+    assert elapsed < 2 * retry_wait, elapsed
+    assert _counter_delta(before, "queries_deadline_exceeded") == 1
+
+
+# ---------------------------------------------------------------------------
+# session integration: cancel / deadline / shutdown on real TPC-H plans
+# ---------------------------------------------------------------------------
+
+# same storm as tests/test_recovery_chaos.py: peer death + corrupted
+# spilled shuffle output + tiny budgets, so cancellation lands while
+# retries, recovery and spill I/O are all active
+_STORM = ("shuffle.peer.dead:dead,times=4;"
+          "spill.disk.corrupt:corrupt,priority=0,times=2")
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    d = str(tmp_path_factory.mktemp("tpch_lifecycle") / "sf001")
+    generate_tpch(d, sf=0.01)
+    _split_tables(d, ("lineitem", "orders", "customer"), parts=4)
+    return d
+
+
+def _split_tables(data_dir: str, tables, parts: int) -> None:
+    """Re-write each table as ``parts`` parquet files so scans are
+    multi-partition and the plans actually contain shuffle exchanges."""
+    import pyarrow.parquet as pq
+    for table in tables:
+        path = os.path.join(data_dir, table, "part-0.parquet")
+        t = pq.read_table(path)
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(data_dir, table,
+                                        f"part-{i}.parquet"))
+
+
+def test_cancel_mid_query_under_storm(data_dir, tmp_path, monkeypatch):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.memory import catalog as cat_mod
+    from spark_rapids_tpu.session import TpuSession
+
+    spill_dir = tmp_path / "spill"
+    spill_dir.mkdir()
+
+    # capture every DeviceSemaphore minted during the run so the
+    # post-cancel capacity invariant can be asserted after ctx close
+    sems = []
+    orig_init = cat_mod.DeviceSemaphore.__init__
+
+    def capture_init(self, concurrency):
+        orig_init(self, concurrency)
+        sems.append(self)
+
+    monkeypatch.setattr(cat_mod.DeviceSemaphore, "__init__", capture_init)
+
+    session = TpuSession({
+        "spark.rapids.test.faults": _STORM,
+        "spark.rapids.memory.tpu.spillStoreSize": 1 << 16,
+        "spark.rapids.memory.host.spillStorageSize": 4096,
+        "spark.rapids.memory.spill.dir": str(spill_dir),
+    })
+    df = build_tpch_query("q3", session, data_dir)
+    outcome: list = []
+
+    def run():
+        try:
+            outcome.append(("ok", df.collect()))
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            outcome.append(("err", e))
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while not session.active_queries() and t.is_alive() \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    qids = session.active_queries()
+    assert qids, "query never became active"
+    time.sleep(0.3)        # let it get into the storm
+    before = get_registry().snapshot()
+    cancelled = session.cancel(qids[0])
+    if not cancelled:
+        t.join(timeout=60.0)
+        pytest.skip("query finished before the cancel landed")
+
+    t.join(timeout=60.0)   # bounded unwind, not a full run
+    assert not t.is_alive(), "cancelled query did not unwind in time"
+    kind, val = outcome[0]
+    assert kind == "err" and isinstance(val, QueryCancelled), outcome
+    # exactly one queries_cancelled no matter how many checkpoints fired;
+    # post-run cancels are no-ops (the query is no longer live)
+    assert not session.cancel(qids[0])
+    assert session.cancel_all() == 0
+    assert _counter_delta(before, "queries_cancelled") == 1
+    # the unwind released the device in full and unlinked every spill file
+    assert sems, "no DeviceSemaphore was ever minted"
+    for sem in sems:
+        assert sem._sem._value == sem.concurrency
+    leftover = [os.path.join(r, f)
+                for r, _d, fs in os.walk(spill_dir) for f in fs]
+    assert not leftover, leftover
+    assert session.active_queries() == []
+
+
+def test_query_timeout_conf_enforced(data_dir):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.session import TpuSession
+    session = TpuSession({"spark.rapids.sql.queryTimeout": 0.001})
+    df = build_tpch_query("q6", session, data_dir)
+    with pytest.raises(QueryDeadlineExceeded):
+        df.collect()
+    assert session.active_queries() == []
+
+
+def test_hang_fault_broken_by_socket_timeout():
+    """A peer that accepts the fetch then sends nothing (the
+    ``shuffle.peer.hang`` fault) must be broken by the client's
+    ``socketTimeout`` read deadline and retried to an EXACT result —
+    not wedge the fetch for the full tcp.timeoutSeconds (120s)."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exec.core import (ExecCtx, device_to_host,
+                                            host_to_device)
+    from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+    from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+    schema = T.Schema([T.StructField("x", T.IntegerType())])
+    conf = TpuConf({
+        "spark.rapids.test.faults":
+            "shuffle.peer.hang:hang,times=1,seconds=30",
+        "spark.rapids.shuffle.socketTimeout": 0.5,
+    })
+    before = get_registry().snapshot()
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            oracle = []
+            for m in range(4):
+                vals = [m, m + 100]
+                hb = HostBatch([HostColumn(np.asarray(vals, np.int32),
+                                           np.ones(2, bool),
+                                           T.IntegerType())], schema)
+                t.write_partition(1, m, 0, host_to_device(hb))
+                oracle += vals
+            t0 = time.monotonic()
+            got = []
+            for b in fetch_remote_with_retry(t.address, 1, 0, conf=conf):
+                got.extend(device_to_host(b).columns[0].to_list())
+            elapsed = time.monotonic() - t0
+            assert sorted(got) == sorted(oracle)
+            # the stall really happened (>= the 0.5s read deadline) and
+            # was broken by socketTimeout, nowhere near the hang window
+            assert 0.4 <= elapsed < 15.0, elapsed
+            assert _counter_delta(before, "shuffle.fetch.retries") >= 1
+            assert t.server_metrics["faults_injected"] >= 1
+        finally:
+            t.close()
+
+
+def test_shutdown_drain_finishes_inflight_then_rejects(data_dir):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.session import TpuSession
+    expected = build_tpch_query(
+        "q6", TpuSession({}), data_dir).collect()
+
+    session = TpuSession({})
+    df = build_tpch_query("q6", session, data_dir)
+    outcome: list = []
+
+    def run():
+        try:
+            outcome.append(("ok", df.collect()))
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            outcome.append(("err", e))
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while not session.active_queries() and t.is_alive() \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    session.shutdown(drain=True, timeout=120.0)
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    kind, val = outcome[0]
+    assert kind == "ok", outcome
+    assert val == expected          # drained to the EXACT result
+    with pytest.raises(QueryRejected, match="shutting down"):
+        df.collect()
+    assert session.active_queries() == []
+
+
+def test_shutdown_no_drain_cancels_inflight(data_dir):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.session import TpuSession
+    session = TpuSession({
+        "spark.rapids.test.faults": _STORM,
+        "spark.rapids.memory.tpu.spillStoreSize": 1 << 16,
+        "spark.rapids.memory.host.spillStorageSize": 4096,
+    })
+    df = build_tpch_query("q3", session, data_dir)
+    outcome: list = []
+
+    def run():
+        try:
+            outcome.append(("ok", df.collect()))
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            outcome.append(("err", e))
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while not session.active_queries() and t.is_alive() \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    time.sleep(0.2)
+    session.shutdown(drain=False)
+    t.join(timeout=60.0)
+    assert not t.is_alive()
+    kind, val = outcome[0]
+    # either the cancel landed (the common case) or the query won the
+    # race and finished; both leave the session idle and closed to
+    # new work
+    assert kind == "ok" or isinstance(val, QueryCancelled), outcome
+    assert session.active_queries() == []
+    with pytest.raises(QueryRejected):
+        df.collect()
