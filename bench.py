@@ -203,6 +203,32 @@ def _child(sf: float, platform: str) -> None:
     if r.get("ok") and r.get("rows", 0) <= 0:
         r["ok"] = False
         r["error"] = "query produced 0 rows"
+    # scenario-diversity rider (ROADMAP): one string-heavy and one
+    # high-skew query alongside q6, so fusion/compile wins aren't
+    # measured on arithmetic-only plans.  TPC-H q13 is LIKE-dominated
+    # (o_comment scan) and q18 concentrates on heavy-order keys.
+    # Small SFs only, and never fatal to the rung: the q6 ladder metric
+    # stays the gate, the scenarios ride along in the artifact.
+    if r.get("ok") and sf <= 1:
+        scenarios = []
+        try:
+            srs = run_benchmark(
+                os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
+                ["q13", "q18"], iterations=1, verify=True, suite="tpch")
+            for sr in srs:
+                scenarios.append({
+                    "suite": "tpch", "query": sr.get("query"),
+                    "kind": ("string_heavy" if sr.get("query") == "q13"
+                             else "high_skew"),
+                    "ok": bool(sr.get("ok")) and not sr.get("error"),
+                    "speedup": sr.get("speedup"),
+                    "device_s": sr.get("device_s"),
+                    "oracle_s": sr.get("oracle_s"),
+                    "rows": sr.get("rows"),
+                })
+        except Exception as e:  # pragma: no cover - rider must not gate
+            scenarios.append({"error": str(e)[:300]})
+        r["scenarios"] = scenarios
     print(_REPORT_PREFIX + json.dumps(r))
     sys.stdout.flush()
     # a wedged PJRT teardown must not eat the already-printed report
@@ -224,7 +250,7 @@ def _ladder(platform: str, deadline: float, reserve: float, rungs: list):
         r = _run_rung(sf, platform, budget)
         rung = {"sf": sf, "backend": platform,
                 "ok": bool(r.get("ok")) and not r.get("error")}
-        for k in ("speedup", "device_s", "oracle_s", "rows"):
+        for k in ("speedup", "device_s", "oracle_s", "rows", "scenarios"):
             if k in r:
                 rung[k] = r[k]
         if r.get("error"):
@@ -287,6 +313,8 @@ def main() -> None:
         extra.update({"device_s": r.get("device_s"),
                       "oracle_s": r.get("oracle_s"),
                       "rows": r.get("rows")})
+        if r.get("scenarios"):
+            extra["scenarios"] = r["scenarios"]
         _emit(r.get("speedup", 0.0), sf, backend, error=err, extra=extra)
         sys.exit(0)
     _emit(0.0, LADDER[0], backend, error=err or "no rung completed",

@@ -185,6 +185,55 @@ leaked = [t.name for t in threading.enumerate()
 assert not leaked, f"leaked engine threads after shutdown: {leaked}"
 print("lifecycle gate: cancel/deadline/exact x2 + clean shutdown: ok")
 PY
+  echo "-- fusion + compile-cache gate: warm reruns compile NOTHING --"
+  # the same query run twice in one process must be pure cache reuse
+  # (compile_count delta 0 on the second run — the whole point of the
+  # process-wide compile cache), and fusion.enabled=false must restore
+  # the exact unfused plan shape
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, tempfile
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+
+def classes(query, conf):
+    s = TpuSession(dict(conf))
+    df = build_tpch_query(query, s, d)
+    ov, meta = df._overridden(quiet=True)
+    acc = []
+    def walk(n):
+        acc.append(type(n).__name__)
+        for c in n.children:
+            walk(c)
+    walk(meta.exec_node)
+    return acc, sorted(df.collect(), key=str)
+
+# 1) warm rerun: a FRESH session over the same q6 must record ZERO new
+# compiles and zero program-cache misses — only hits
+classes("q6", {})
+before = get_registry().snapshot()
+_, rows = classes("q6", {})
+moved = get_registry().delta(before)["counters"]
+assert rows, "q6 returned no rows"
+assert moved.get("compile_count", 0) == 0, f"second run compiled: {moved}"
+assert moved.get("fusion_cache_misses", 0) == 0, moved
+assert moved.get("fusion_cache_hits", 0) >= 1, moved
+
+# 2) shape reversibility: q3 fuses its filter/project chain; disabling
+# fusion restores the per-operator plan with identical results
+fused, frows = classes("q3", {})
+plain, prows = classes("q3", {"spark.rapids.sql.fusion.enabled": "false"})
+assert "FusedStageExec" in fused, fused
+assert "FusedStageExec" not in plain, plain
+assert all(c in plain for c in fused if c != "FusedStageExec"), (fused, plain)
+assert frows == prows, "fused vs unfused rows diverge on q3"
+print("fusion gate: warm rerun compiles 0, shape reversible: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

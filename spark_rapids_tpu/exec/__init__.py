@@ -17,8 +17,10 @@ from spark_rapids_tpu.exec.exchange import (BroadcastExchangeExec,
                                             ShuffleExchangeExec)
 from spark_rapids_tpu.exec.sortexec import (CoalesceBatchesExec, SortExec,
                                             resolve_orders)
+from spark_rapids_tpu.exec.fused import FusedStageExec
 
 __all__ = [
+    "FusedStageExec",
     "CoalesceGoal", "ExecCtx", "PlanNode", "RequireSingleBatch", "TargetSize",
     "collect", "collect_device", "collect_host", "device_to_host",
     "host_to_device",

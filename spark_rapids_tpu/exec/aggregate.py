@@ -315,9 +315,19 @@ class HashAggregateExec(PlanNode):
                 return ColumnBatch(cols, run.num_rows, self._output_schema)
 
             import jax
+            from spark_rapids_tpu.exec import compile_cache as cc
+            key = cc.fragment_key(
+                "agg", presorted, len(key_idx), tuple(self._pre_exprs),
+                self._pre_schema, self._update_specs, self._merge_specs,
+                self._buffer_schema, tuple(self._final_exprs),
+                self._output_schema)
             # single atomic publication: concurrent partition workers must
-            # never observe a partially-initialized triple
-            self._jits = (jax.jit(update), jax.jit(merge), jax.jit(final))
+            # never observe a partially-initialized triple (the cached
+            # value is the complete immutable triple)
+            self._jits = cc.get_or_build(key, lambda: (
+                cc.instrument(jax.jit(update)),
+                cc.instrument(jax.jit(merge)),
+                cc.instrument(jax.jit(final))))
         return self._jits
 
     # pending partial buffers merge once their summed capacity crosses

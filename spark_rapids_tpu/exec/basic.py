@@ -137,15 +137,20 @@ class ProjectExec(PlanNode):
 
     def _jit_fn(self):
         # one program per batch shape: whole-projection jit (the eager
-        # per-op path costs a dispatch round trip per op on a remote TPU)
+        # per-op path costs a dispatch round trip per op on a remote TPU),
+        # shared process-wide so identical projections across plans and
+        # queries reuse one compiled program (exec/compile_cache.py)
         if not hasattr(self, "_project_jit"):
-            import jax
+            from spark_rapids_tpu.exec import compile_cache as cc
 
             def project(b):
                 cols = [eval_device(e, b) for e in self._bound]
                 return ColumnBatch(cols, b.num_rows, self._schema)
 
-            self._project_jit = jax.jit(project)
+            self._project_jit = cc.shared_jit(
+                cc.fragment_key("project", tuple(self._bound), self._schema,
+                                self.children[0].output_schema),
+                project)
         return self._project_jit
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
@@ -277,14 +282,17 @@ class FilterExec(PlanNode):
 
     def _jit_fn(self):
         if not hasattr(self, "_filter_jit"):
-            import jax
+            from spark_rapids_tpu.exec import compile_cache as cc
 
             def filt(b):
                 c = eval_device(self._cond, b)
                 keep = c.data & c.validity  # null -> drop (SQL WHERE)
                 return dk.compact(b, keep)
 
-            self._filter_jit = jax.jit(filt)
+            self._filter_jit = cc.shared_jit(
+                cc.fragment_key("filter", self._cond,
+                                self.children[0].output_schema),
+                filt)
         return self._filter_jit
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:

@@ -1,0 +1,331 @@
+"""Process-wide compiled-kernel cache: jitted programs keyed on a
+canonical plan-fragment fingerprint and shared across exec-node
+instances, plans, queries, and sessions.
+
+The reference's hot loop never compiles: every kernel is a pre-built
+libcudf entry point (SURVEY §3.3).  The XLA analog used to re-``jax.jit``
+per exec-node INSTANCE (``basic.py`` ``_project_jit``, ``joins.py``
+``_cond_jit`` …), so two queries over the same plan fragment — or one
+query re-run — paid tracing again because the wrapper died with the
+plan.  Here the wrapper itself is process-wide: identical fragments
+resolve to ONE shared jit callable, and jax's own executable cache keys
+the compiled artifacts per (shape, dtype) signature underneath it.
+Batch capacities are pow2-bucketed at the producers (and re-normalized
+at fused-stage entry), so shape polymorphism cannot fragment that
+inner cache.
+
+Key design: the python-level key is the *program* (canonicalized
+expression trees + schemas + static closure state), NOT the capacity
+bucket — one wrapper serves every bucket, and the (capacity, dtype)
+signature selects the executable inside jax.  ``SharedJit`` tracks the
+signatures it has seen so ``compile_count`` / ``compile_wall_s`` move
+exactly when a new executable is built, which makes "a second run of
+the same query compiles nothing" a testable invariant (ci/premerge.sh).
+
+Counters (MetricsRegistry): ``fusion_cache_hits`` / ``fusion_cache_misses``
+move per fragment-key lookup; ``compile_count`` / ``compile_wall_s`` per
+first invocation of a new input signature (trace + compile + first run).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import types as _pytypes
+from collections import OrderedDict
+from functools import partial as _partial
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import bool_conf, conf, int_conf
+from spark_rapids_tpu.obs.registry import get_registry
+
+__all__ = ["fragment_key", "fingerprint", "get_or_build", "shared_jit",
+           "instrument", "SharedJit", "cache_info", "reset_cache",
+           "FUSION_ENABLED", "FUSION_MIN_OPS", "FUSION_DONATE",
+           "COMPILE_CACHE_DIR"]
+
+FUSION_ENABLED = bool_conf(
+    "spark.rapids.sql.fusion.enabled", True,
+    "Collapse adjacent filter/project pipelines into single FusedStageExec "
+    "nodes whose body is ONE jitted program — one dispatch and one kernel "
+    "launch per batch instead of one per operator (the whole-stage-codegen "
+    "analog; reference GpuTransitionOverrides, PAPER.md §L3). Disable to "
+    "restore the per-operator plan shape.")
+
+FUSION_MIN_OPS = int_conf(
+    "spark.rapids.sql.fusion.minOperators", 2,
+    "Minimum number of adjacent fusible operators before a FusedStageExec "
+    "replaces the run; below it the per-operator nodes are kept.")
+
+FUSION_DONATE = bool_conf(
+    "spark.rapids.sql.fusion.donateInputs", True,
+    "Donate input buffers to the fused jit region (jax donate_argnums) so "
+    "XLA may reuse them for outputs — halves peak HBM per fused batch. "
+    "Only applied when the stage's input is provably exclusive: the "
+    "planner disables donation per stage when any producer below it is "
+    "consumed by multiple parents (a CTE scanned once, joined twice) or "
+    "shares a parked scan materialization, since donating a shared batch "
+    "deletes its buffers under the sibling consumer. Tradeoff: a donated "
+    "batch cannot be re-dispatched, so a REAL device OOM inside a fused "
+    "stage cannot replay/split that batch and surfaces an actionable "
+    "error instead; set false to trade buffer reuse for full "
+    "split-and-retry coverage (docs/tuning-guide.md).")
+
+COMPILE_CACHE_DIR = conf(
+    "spark.rapids.sql.compile.cacheDir", "",
+    "When set, force the persistent XLA compilation cache ON rooted at "
+    "this directory (overriding spark.rapids.tpu.compilationCache.* "
+    "including its XLA:CPU auto-off), so cold sessions start warm: a "
+    "fragment compiled by ANY past process on this machine loads from "
+    "disk instead of recompiling. Empty (default) defers to the "
+    "spark.rapids.tpu.compilationCache.enabled mode.")
+
+COMPILE_CACHE_MAX_ENTRIES = int_conf(
+    "spark.rapids.sql.compile.cacheMaxEntries", 1024,
+    "Upper bound on distinct plan fragments kept in the process-wide "
+    "compile cache; least-recently-used entries (and their jax "
+    "executables) are dropped past it.", internal=True)
+
+
+# ---------------------------------------------------------------------------
+# Canonical fingerprints
+# ---------------------------------------------------------------------------
+
+_MAX_DEPTH = 64
+
+#: attribute values whose equality the recursion cannot prove
+#: (callables, modules): poisoned with a process-unique serial, NOT
+#: ``id()`` — a dead object's id can be reused by a NEW object, and an
+#: id-based key would then falsely HIT the old entry.  The serial makes
+#: such fingerprints unique per call: sharing is lost (the per-instance
+#: ``hasattr`` guards still amortize the cost), correctness is not.
+_OPAQUE = (_pytypes.FunctionType, _pytypes.MethodType,
+           _pytypes.BuiltinFunctionType, _pytypes.ModuleType, _partial)
+
+_SERIAL_LOCK = threading.Lock()
+_SERIAL = 0
+
+
+def _next_serial() -> int:
+    global _SERIAL
+    with _SERIAL_LOCK:
+        _SERIAL += 1
+        return _SERIAL
+
+
+def _fp(v, out: list, seen: set, depth: int) -> None:
+    if depth > _MAX_DEPTH:
+        out.append(f"<deep:#{_next_serial()}>")
+        return
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        out.append(repr(v))
+        out.append(";")
+        return
+    if isinstance(v, T.DataType):
+        # DataType reprs are structural (ArrayType includes its element)
+        out.append(f"dt<{v!r}>;")
+        return
+    if isinstance(v, T.StructField):
+        out.append(f"sf<{v.name}:")
+        _fp(v.data_type, out, seen, depth + 1)
+        out.append(f"{v.nullable}>;")
+        return
+    if isinstance(v, T.Schema):
+        out.append("schema[")
+        for f in v.fields:
+            _fp(f, out, seen, depth + 1)
+        out.append("];")
+        return
+    if isinstance(v, (list, tuple)):
+        out.append("[" if isinstance(v, list) else "(")
+        for x in v:
+            _fp(x, out, seen, depth + 1)
+        out.append("];" if isinstance(v, list) else ");")
+        return
+    if isinstance(v, dict):
+        out.append("{")
+        for k in sorted(v, key=repr):
+            out.append(f"{k!r}=")
+            _fp(v[k], out, seen, depth + 1)
+        out.append("};")
+        return
+    if isinstance(v, _OPAQUE) or callable(v) and not hasattr(v, "children"):
+        out.append(f"<opaque:{type(v).__name__}:#{_next_serial()}>;")
+        return
+    if id(v) in seen:
+        out.append("<cycle>;")
+        return
+    seen.add(id(v))
+    try:
+        # generic object (Expression, resolved sort order, agg spec …):
+        # class identity + every attribute, with expression children
+        # LAST so tree shape is unambiguous.  Attributes the recursion
+        # cannot canonicalize fall back to identity above — safety
+        # (never share a program whose state we cannot prove equal)
+        # over sharing.
+        try:
+            d = vars(v)
+        except TypeError:
+            out.append(f"<slots:{type(v).__name__}:#{_next_serial()}>;")
+            return
+        out.append(type(v).__name__)
+        out.append("{")
+        children = d.get("children", ())
+        for k in sorted(d):
+            if k == "children":
+                continue
+            out.append(f"{k}=")
+            _fp(d[k], out, seen, depth + 1)
+        out.append("}(")
+        for c in children:
+            _fp(c, out, seen, depth + 1)
+        out.append(");")
+    finally:
+        seen.discard(id(v))
+
+
+def fingerprint(*parts) -> str:
+    """Canonical structural serialization of expressions / schemas /
+    static closure state.  Unlike ``repr``, this captures non-child
+    attributes (a LIKE pattern, a Cast target type, a resolved sort
+    direction), every node's bound dtype, and poisons the result with a
+    unique serial — never a lossy summary — for state it cannot prove
+    canonical."""
+    out: list = []
+    _fp(list(parts), out, set(), 0)
+    return "".join(out)
+
+
+def fragment_key(kind: str, *parts) -> str:
+    """Cache key for one plan fragment's program: a ``kind`` tag plus the
+    md5 of the canonical fingerprint of everything the traced closure
+    captures."""
+    digest = hashlib.md5(fingerprint(*parts).encode()).hexdigest()
+    return f"{kind}:{digest}"
+
+
+# ---------------------------------------------------------------------------
+# Shared jit wrappers + compile accounting
+# ---------------------------------------------------------------------------
+
+class SharedJit:
+    """A process-wide jit callable with per-signature compile accounting.
+
+    jax compiles one executable per abstract input signature inside the
+    wrapper; this class mirrors that bookkeeping at the python level so
+    the first call for a NEW (shapes, dtypes, tree) signature — the one
+    that traces and compiles — moves ``compile_count`` and is timed into
+    ``compile_wall_s``.  Signatures already seen dispatch with no extra
+    accounting beyond one set lookup."""
+
+    __slots__ = ("fn", "_sigs", "_lock")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._sigs: set = set()
+        self._lock = threading.Lock()
+
+    def signature_count(self) -> int:
+        return len(self._sigs)
+
+    @staticmethod
+    def _signature(args):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = (treedef, tuple(
+            (l.shape, str(l.dtype)) if hasattr(l, "shape") else l
+            for l in leaves))
+        hash(sig)  # unhashable static leaf -> fall back to uncounted
+        return sig
+
+    def __call__(self, *args):
+        try:
+            sig = self._signature(args)
+        except Exception:
+            return self.fn(*args)
+        with self._lock:
+            new = sig not in self._sigs
+            if new:
+                self._sigs.add(sig)
+        if not new:
+            return self.fn(*args)
+        t0 = time.perf_counter()
+        try:
+            return self.fn(*args)
+        finally:
+            reg = get_registry()
+            reg.inc("compile_count")
+            reg.inc("compile_wall_s", time.perf_counter() - t0)
+
+
+def instrument(fn) -> SharedJit:
+    """Wrap an already-jitted callable with compile accounting."""
+    return SharedJit(fn)
+
+
+# ---------------------------------------------------------------------------
+# The process-wide cache
+# ---------------------------------------------------------------------------
+
+_CACHE: "OrderedDict[str, object]" = OrderedDict()
+_LOCK = threading.Lock()
+
+
+def get_or_build(key: str, builder, *, max_entries: int | None = None):
+    """Return the process-wide entry for ``key``, building it once.
+
+    ``builder()`` runs OUTSIDE the cache lock (it may construct several
+    jit wrappers); a concurrent duplicate build is discarded in favor of
+    the first published entry, so callers always share one object per
+    key.  ``fusion_cache_hits`` / ``fusion_cache_misses`` move per
+    lookup."""
+    reg = get_registry()
+    with _LOCK:
+        got = _CACHE.get(key)
+        if got is not None:
+            _CACHE.move_to_end(key)
+            reg.inc("fusion_cache_hits")
+            return got
+    val = builder()
+    bound = max_entries if max_entries is not None \
+        else COMPILE_CACHE_MAX_ENTRIES.default
+    with _LOCK:
+        got = _CACHE.get(key)
+        if got is not None:
+            reg.inc("fusion_cache_hits")
+            return got
+        reg.inc("fusion_cache_misses")
+        _CACHE[key] = val
+        while len(_CACHE) > max(bound, 1):
+            _CACHE.popitem(last=False)
+    return val
+
+
+def shared_jit(key: str, fn, **jit_kwargs) -> SharedJit:
+    """``get_or_build`` specialization for the common one-function case:
+    jit ``fn`` (with ``jit_kwargs``, e.g. ``donate_argnums``) behind the
+    process-wide key and wrap it with compile accounting."""
+    def build():
+        import jax
+        return SharedJit(jax.jit(fn, **jit_kwargs))
+    return get_or_build(key, build)
+
+
+def cache_info() -> dict:
+    """Test/diagnostic hook: entry count + per-entry signature counts."""
+    with _LOCK:
+        entries = list(_CACHE.items())
+    return {
+        "entries": len(entries),
+        "keys": [k for k, _ in entries],
+        "signatures": {k: v.signature_count() for k, v in entries
+                       if isinstance(v, SharedJit)},
+    }
+
+
+def reset_cache() -> None:
+    """Test hook: drop every cached program (jax's own caches are
+    untouched — they key on the jitted function object, which dies with
+    the entry)."""
+    with _LOCK:
+        _CACHE.clear()

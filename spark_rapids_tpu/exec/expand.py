@@ -59,13 +59,16 @@ class ExpandExec(PlanNode):
         # GpuExpandExec emits per projection) so peak device memory is one
         # output batch, not len(projections) of them — a 4-key cube has 16
         if not hasattr(self, "_expand_jits"):
-            import jax
+            from spark_rapids_tpu.exec import compile_cache as cc
 
             def make(proj):
                 def one(b):
                     cols = [eval_device(e, b) for e in proj]
                     return ColumnBatch(cols, b.num_rows, self._schema)
-                return jax.jit(one)
+                return cc.shared_jit(
+                    cc.fragment_key("expand", tuple(proj), self._schema,
+                                    self.children[0].output_schema),
+                    one)
 
             self._expand_jits = [make(p) for p in self._bound]
         return self._expand_jits

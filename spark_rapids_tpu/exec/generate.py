@@ -297,7 +297,10 @@ class GenerateExec(PlanNode):
 
     def _eval_jit(self):
         if not hasattr(self, "_gen_jit"):
-            self._gen_jit = jax.jit(lambda b: eval_device(self._gen_bound, b))
+            from spark_rapids_tpu.exec import compile_cache as cc
+            self._gen_jit = cc.shared_jit(
+                cc.fragment_key("generate", self._gen_bound),
+                lambda b: eval_device(self._gen_bound, b))
         return self._gen_jit
 
     def _host_generate(self, b: HostBatch) -> HostBatch:

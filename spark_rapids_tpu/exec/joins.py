@@ -365,10 +365,13 @@ class JoinExec(PlanNode):
 
     def _condition_jit(self):
         if not hasattr(self, "_cond_jit"):
+            from spark_rapids_tpu.exec import compile_cache as cc
+
             def filt(out):
                 c = eval_device(self._cond_b, out)
                 return dk.compact(out, c.data & c.validity)
-            self._cond_jit = jax.jit(filt)
+            self._cond_jit = cc.shared_jit(
+                cc.fragment_key("join_cond", self._cond_b), filt)
         return self._cond_jit
 
     def _unmatched_right_jit(self):
@@ -403,7 +406,10 @@ class JoinExec(PlanNode):
                 return ColumnBatch(null_cols + list(rc.columns),
                                    rc.num_rows, self._schema)
 
-            self._unmatched_jit = jax.jit(fn)
+            from spark_rapids_tpu.exec import compile_cache as cc
+            self._unmatched_jit = cc.shared_jit(
+                cc.fragment_key("join_unmatched", left_fields, right_schema,
+                                self._schema), fn)
         return self._unmatched_jit
 
     def _run_host(self, ctx: ExecCtx, lb: HostBatch, rb: HostBatch):

@@ -37,7 +37,8 @@ from spark_rapids_tpu.expr.core import Expression, bind, eval_device
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops.segmented import sorted_group_by
 from spark_rapids_tpu.parallel.mesh import (local_view, make_mesh, restack,
-                                            shard_batches, unshard_batch)
+                                            shard_batches, shard_map,
+                                            unshard_batch)
 from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
                                                     exchange_local,
                                                     partition_ids_for_keys)
@@ -308,7 +309,7 @@ class MeshAggregateExec(_MeshOutputMixin, PlanNode):
                     out.schema))
             return restack(out)
 
-        fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P(axis),
+        fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P(axis),
                                    out_specs=P(axis)))
         self._jitted[key] = fn
         return fn
@@ -420,7 +421,7 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
             dev = jnp.where(pid < n, pid % p, p)  # padding -> p (dropped)
             return restack(exchange_local(b, dev, p, axis))
 
-        fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P(axis),
+        fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P(axis),
                                    out_specs=P(axis)))
         self._jitted[key] = fn
         return fn

@@ -195,6 +195,21 @@ def _recover(ctx, transport, err: MapOutputLostError,
         # this reader observed are still lost
         still_lost = {m: e for m, e in err.lost.items()
                       if transport.map_epoch(err.shuffle_id, m) <= e}
+        if still_lost and getattr(err, "observed_empty", False):
+            # an empty slot can be OBSERVED between a recovery's
+            # invalidation and its rewrite — at the same epoch the
+            # rewrite carries, so the epoch test above cannot rule it
+            # out.  We hold the shuffle's recovery lock, so any prior
+            # recovery has fully written back: a present output means
+            # this reader's loss was already repaired.  Re-invalidating
+            # it would cascade (each round nulls the slots again and
+            # reopens the same window for another concurrent reader)
+            # until the attempt budget exhausts on a healthy shuffle.
+            present = getattr(transport, "map_output_present", None)
+            if present is not None:
+                still_lost = {
+                    m: e for m, e in still_lost.items()
+                    if not present(err.shuffle_id, err.part_id, m)}
         if not still_lost:
             return
         budget = RECOVERY_MAX_ATTEMPTS.get(settings)

@@ -90,8 +90,10 @@ class SortExec(PlanNode):
 
     def _jit_fn(self):
         if not hasattr(self, "_sort_jit"):
-            import jax
-            self._sort_jit = jax.jit(
+            from spark_rapids_tpu.exec import compile_cache as cc
+            self._sort_jit = cc.shared_jit(
+                cc.fragment_key("sort", tuple(self._orders),
+                                self.children[0].output_schema),
                 lambda b: sort_batch(b, self._orders))
         return self._sort_jit
 

@@ -235,7 +235,13 @@ class WindowExec(PlanNode):
                              (sb, cb, mnb, mxb, rb) in zip(a, b))
 
         if not hasattr(self, "_gs_jits"):
-            self._gs_jits = (jax.jit(update), jax.jit(merge))
+            from spark_rapids_tpu.exec import compile_cache as cc
+            # update folds exactly `inputs`; merge captures nothing —
+            # the pair is one process-wide entry keyed on the inputs
+            self._gs_jits = cc.get_or_build(
+                cc.fragment_key("window_gs_update", tuple(inputs)),
+                lambda: (cc.instrument(jax.jit(update)),
+                         cc.instrument(jax.jit(merge))))
         upd_jit, merge_jit = self._gs_jits[:2]
 
         child = self.children[0]
@@ -285,7 +291,11 @@ class WindowExec(PlanNode):
             return ColumnBatch(cols, b.num_rows, self._schema)
 
         if len(self._gs_jits) == 2:
-            self._gs_jits = self._gs_jits + (jax.jit(append),)
+            from spark_rapids_tpu.exec import compile_cache as cc
+            self._gs_jits = self._gs_jits + (cc.shared_jit(
+                cc.fragment_key("window_gs_append", tuple(self._wexprs),
+                                tuple(self._out_dtypes), self._schema),
+                append),)
         app_jit = self._gs_jits[2]
         for sb in parked:
             b = sb.get()

@@ -23,7 +23,11 @@ Well-known counter families (beyond per-object sources):
 ``queries_admitted`` / ``queries_rejected`` / ``queries_cancelled`` /
 ``queries_deadline_exceeded`` (exec/lifecycle.py — incremented exactly
 once per query at the admission decision or the first terminal
-transition, so a delta over a run counts QUERIES, not checkpoints).
+transition, so a delta over a run counts QUERIES, not checkpoints); and
+the compile plane's ``compile_count`` / ``compile_wall_s`` (one move per
+NEW jit input signature — a zero delta across a repeated query proves
+pure cache reuse) plus ``fusion_cache_hits`` / ``fusion_cache_misses``
+(process-wide program-cache lookups, exec/compile_cache.py).
 """
 from __future__ import annotations
 

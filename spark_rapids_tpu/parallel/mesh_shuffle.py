@@ -38,7 +38,7 @@ from spark_rapids_tpu.expr.core import EvalCtx, Val
 from spark_rapids_tpu.expr.hashing import murmur3_val, DEFAULT_SEED
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops.segmented import AggSpec, sorted_group_by
-from spark_rapids_tpu.parallel.mesh import local_view, restack
+from spark_rapids_tpu.parallel.mesh import local_view, restack, shard_map
 
 __all__ = [
     "partition_ids_for_keys", "make_hash_exchange",
@@ -164,7 +164,7 @@ def make_hash_exchange(mesh: Mesh, schema: T.Schema,
         part = partition_ids_for_keys(b, key_indices, num_parts)
         return restack(exchange_local(b, part, num_parts, axis_name))
 
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=P(axis_name),
+    mapped = shard_map(step, mesh=mesh, in_specs=P(axis_name),
                            out_specs=P(axis_name))
     return jax.jit(mapped)
 
@@ -225,6 +225,6 @@ def make_distributed_groupby(mesh: Mesh, schema: T.Schema,
             out = canonicalize(out)
         return restack(out)
 
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=P(axis_name),
+    mapped = shard_map(step, mesh=mesh, in_specs=P(axis_name),
                            out_specs=P(axis_name))
     return jax.jit(mapped)

@@ -481,7 +481,94 @@ class TpuOverrides:
                 print(text)
         if self.conf.test_enabled:
             self._assert_on_tpu(root)
+        self._fuse_stages(root)
         return root.exec_node
+
+    def _fuse_stages(self, root: PlannedNode) -> None:
+        """Collapse runs of adjacent elementwise operators into
+        ``FusedStageExec`` nodes — one jit region and one dispatch per
+        batch instead of one per operator (exec/fused.py; the
+        whole-stage-codegen analog, PAPER.md §L3).
+
+        Runs LAST, on the realized exec tree only: transitions,
+        coalesces, and exchanges are already placed, so a fusible run
+        can never cross a backend switch or a pipeline breaker — any
+        non-fusible node simply terminates the run.  The meta tree is
+        left untouched (conversion EXPLAIN shows per-operator nodes;
+        EXPLAIN ANALYZE shows the fused stages with what they
+        replaced)."""
+        from spark_rapids_tpu.exec.compile_cache import (FUSION_ENABLED,
+                                                         FUSION_MIN_OPS)
+        if not self.conf.get(FUSION_ENABLED):
+            return
+        from spark_rapids_tpu.exec.fused import FusedStageExec, fusible
+        min_ops = max(2, self.conf.get(FUSION_MIN_OPS))
+        done: dict[int, PlanNode] = {}
+
+        def walk(node: PlanNode) -> PlanNode:
+            got = done.get(id(node))
+            if got is not None:
+                return got
+            if fusible(node):
+                run = [node]  # outermost-first
+                cur = node.children[0]
+                while fusible(cur):
+                    run.append(cur)
+                    cur = cur.children[0]
+                if len(run) >= min_ops:
+                    below = walk(cur)
+                    ops = list(reversed(run))  # innermost-first
+                    if below is not cur:
+                        ops[0].children = (below,)
+                    fused = FusedStageExec(ops)
+                    done[id(node)] = fused
+                    return fused
+            new_children = tuple(walk(c) for c in node.children)
+            if any(a is not b for a, b in zip(new_children, node.children)):
+                node.children = new_children
+            done[id(node)] = node
+            return node
+
+        root.exec_node = walk(root.exec_node)
+
+        # Donation safety: a fused stage may only donate its input batch
+        # when that batch is provably exclusive.  Two producers break
+        # exclusivity: a plan-shared subtree (CTE scanned once, joined
+        # twice — TPC-DS q1) yields the same batch objects to every
+        # parent, and a shared-output scan (io/scan.py share_output:
+        # several scan NODES over one table share one parked
+        # materialization — TPC-DS q49) aliases device buffers across
+        # plan-distinct nodes.  Pass-through nodes can forward either
+        # upward unchanged, so any such producer anywhere BELOW the
+        # stage disables donation (conservative: a materializing node
+        # in between would make it safe again, but proving that per
+        # node type is not worth a deleted-buffer crash).
+        parent_counts: dict[int, int] = {}
+        nodes: dict[int, PlanNode] = {}
+
+        def count(node: PlanNode) -> None:
+            if id(node) in nodes:
+                return
+            nodes[id(node)] = node
+            for c in node.children:
+                parent_counts[id(c)] = parent_counts.get(id(c), 0) + 1
+                count(c)
+
+        count(root.exec_node)
+
+        def exclusive(node: PlanNode, seen: set) -> bool:
+            if id(node) in seen:
+                return True
+            seen.add(id(node))
+            if parent_counts.get(id(node), 0) > 1 or \
+                    getattr(node, "share_output", False):
+                return False
+            return all(exclusive(c, seen) for c in node.children)
+
+        for node in nodes.values():
+            if isinstance(node, FusedStageExec) and \
+                    not exclusive(node.children[0], set()):
+                node.donate_ok = False
 
     def apply(self, root: PlannedNode) -> PlanNode:
         return self.prepare(root, explain=True)

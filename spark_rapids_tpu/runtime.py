@@ -185,6 +185,13 @@ def ensure_runtime(conf=None) -> None:
     # platform, which the cache fingerprint below depends on
     from spark_rapids_tpu.device import initialize_device
     initialize_device(conf)
+    from spark_rapids_tpu.exec.compile_cache import COMPILE_CACHE_DIR
+    sql_dir = COMPILE_CACHE_DIR.get(settings)
+    if sql_dir:
+        # explicit opt-in wins over the auto heuristic: naming a
+        # directory means the operator wants warm starts even on XLA:CPU
+        enable_compilation_cache(sql_dir)
+        return
     mode = COMPILATION_CACHE_ENABLED.get(settings)
     if mode == "auto":
         try:

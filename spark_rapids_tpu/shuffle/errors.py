@@ -62,15 +62,24 @@ class MapOutputLostError(ShuffleFetchError):
     observed when the loss surfaced; stage recovery skips any map id
     whose store epoch has already advanced past the observed one
     (a concurrent pull recovered it first).
+
+    ``observed_empty`` distinguishes the two ways a loss is observed:
+    True means the reader found an invalidated slot with no data (the
+    output may already be mid-recompute by another thread — recovery
+    re-checks presence before re-invalidating); False means data was
+    present but is terminally gone (dead peer, corrupt spill read-back)
+    and must be recomputed regardless of what the store holds now.
     """
 
     terminal = True
+    observed_empty = False
 
     def __init__(self, shuffle_id, part_id: int, lost: dict,
-                 detail: str = ""):
+                 detail: str = "", observed_empty: bool = False):
         self.shuffle_id = shuffle_id
         self.part_id = part_id
         self.lost = dict(lost)
+        self.observed_empty = observed_empty
         ids = ", ".join(f"map {m} (epoch {e})"
                         for m, e in sorted(self.lost.items()))
         msg = (f"map output lost: shuffle {shuffle_id} part {part_id} "
@@ -88,7 +97,9 @@ class MapOutputLostError(ShuffleFetchError):
                 for k, v in (payload.get("lost") or {}).items()}
         return cls(payload.get("shuffle_id", shuffle_id),
                    int(payload.get("part_id", part_id)), lost,
-                   payload.get("detail", "reported by peer"))
+                   payload.get("detail", "reported by peer"),
+                   observed_empty=bool(payload.get("observed_empty",
+                                                   False)))
 
 
 class StageRecoveryExhausted(RuntimeError):

@@ -144,6 +144,18 @@ class LocalShuffleTransport:
         with self._lock:
             return self._epochs.get((shuffle_id, map_id), 0)
 
+    def map_output_present(self, shuffle_id: "int | str", part_id: int,
+                           map_id: int) -> bool:
+        """True when this reduce partition currently holds a live output
+        of the given map task.  Recovery re-checks this for empty-slot
+        observations: a reader can catch a slot between invalidation and
+        the recovering thread's rewrite — at the very epoch the rewrite
+        will carry, so epoch ordering alone cannot tell "mid-recompute"
+        from "still lost"."""
+        with self._lock:
+            return any(s.map_id == map_id and s.item is not None
+                       for s in self._store.get((shuffle_id, part_id), ()))
+
     def invalidate_map_outputs(self, shuffle_id: "int | str",
                                map_ids: Iterable[int]) -> dict[int, int]:
         """Mark every stored output of the given map tasks lost, bump
@@ -212,7 +224,8 @@ class LocalShuffleTransport:
                     "injected fault: shuffle.peer.dead")
         if lost:
             raise MapOutputLostError(shuffle_id, part_id, lost,
-                                     "slot invalidated and not recomputed")
+                                     "slot invalidated and not recomputed",
+                                     observed_empty=True)
         return slots
 
     def _get_spillable(self, scb, slot: _Slot, shuffle_id, part_id):
@@ -240,7 +253,8 @@ class LocalShuffleTransport:
             if item is None:
                 raise MapOutputLostError(
                     shuffle_id, part_id, {slot.map_id: slot.epoch},
-                    "invalidated while the pull was in flight")
+                    "invalidated while the pull was in flight",
+                    observed_empty=True)
             if item[0] == "spillable":
                 b = self._get_spillable(item[1], slot, shuffle_id, part_id)
                 try:
@@ -271,7 +285,8 @@ class LocalShuffleTransport:
             if item is None:
                 raise MapOutputLostError(
                     shuffle_id, part_id, {slot.map_id: slot.epoch},
-                    "invalidated while the pull was in flight")
+                    "invalidated while the pull was in flight",
+                    observed_empty=True)
             if item[0] == "spillable":
                 b = self._get_spillable(item[1], slot, shuffle_id, part_id)
                 try:

@@ -268,7 +268,9 @@ class TcpShuffleServer:
                                  "part_id": e.part_id,
                                  "lost": {str(k): v
                                           for k, v in e.lost.items()},
-                                 "detail": "reported by peer"})).encode())
+                                 "detail": "reported by peer",
+                                 "observed_empty":
+                                     e.observed_empty})).encode())
                     except Exception as e:  # noqa: BLE001 - sent to peer
                         # store/codec failures must reach the client as a
                         # diagnosable error frame, not a connection reset

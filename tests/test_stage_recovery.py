@@ -205,6 +205,56 @@ def test_attempt_budget_exhaustion():
     assert "maxStageAttempts" in str(ei.value)
 
 
+def test_mid_recovery_observation_does_not_cascade():
+    """A reader that catches a slot between invalidation and the
+    recovering thread's rewrite observes an EMPTY slot at the very
+    epoch the rewrite carries — epoch ordering alone cannot tell that
+    apart from a genuine loss.  The presence re-check must classify it
+    as already repaired, or each such observation re-invalidates a
+    healthy shuffle and the rounds cascade until the budget exhausts."""
+    from spark_rapids_tpu.exec.recovery import _recover
+
+    t = LocalShuffleTransport(TpuConf({}), ctx=None)
+    t.write_partition("s", 0, 0, _batch([1, 2]))
+    new_epochs = t.invalidate_map_outputs("s", [0])
+    # mid-window observation: slot empty, already at the new epoch
+    with pytest.raises(MapOutputLostError) as ei:
+        list(t.fetch_partition("s", 0))
+    assert ei.value.observed_empty
+    assert ei.value.lost == {0: new_epochs[0]}
+    # the concurrent recovery completes its rewrite
+    t.write_partition("s", 0, 0, _batch([1, 2]), epoch=new_epochs[0])
+    assert t.map_output_present("s", 0, 0)
+
+    class _Ctx:
+        # budget 0: any attempt _recover tries to start raises
+        # StageRecoveryExhausted, so a clean return proves the
+        # presence re-check classified the outputs as repaired
+        conf = TpuConf({"spark.rapids.shuffle.recovery"
+                        ".maxStageAttempts": "0"})
+
+        def check_cancel(self):
+            pass
+
+        def lineage_for(self, sid):
+            return object()
+
+        def cached(self, key, factory):
+            return factory()
+
+    _recover(_Ctx(), t, ei.value)
+    assert t.map_epoch("s", 0) == new_epochs[0]  # NOT re-invalidated
+    assert [_rows(b) for b in t.fetch_partition("s", 0)] == [[1, 2]]
+    # a loss observed with the data still present (dead peer) is NOT
+    # skippable by presence: it must reach the budget check
+    dead = MapOutputLostError("s", 0, {0: t.map_epoch("s", 0)},
+                              "injected fault: shuffle.peer.dead")
+    assert not dead.observed_empty
+    with pytest.raises(StageRecoveryExhausted):
+        _recover(_Ctx(), t, dead)
+    t.close()
+
+
 def test_conf_fingerprint_drift_rejected():
     from spark_rapids_tpu.exec.recovery import (ShuffleLineage,
                                                 conf_fingerprint)
