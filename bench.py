@@ -1,7 +1,15 @@
 """Benchmark: TPC-DS q6 (BASELINE configs[0]) device vs CPU oracle.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints one JSON line per metric:
+  {"metric": "tpcds_q6_sf..._speedup_vs_cpu_oracle", "value": N, ...}
+  {"metric": "tpch_multichip_scaling_sf...", "value": N, "ladder": [...]}
+
+The second line is the pod-scale device-count ladder: TPC-H q6 and q3
+at 1/2/4/8 mesh devices (spark.rapids.tpu.mesh.deviceCount), wall time
+and scaling efficiency t1/(n*tn) per rung.  Setting
+SPARK_RAPIDS_BENCH_MESH_DEVICES=N additionally runs the PRIMARY q6
+ladder itself over an N-device mesh, so a multichip harness run stops
+reporting healthy-but-idle devices.
 
 Runs a scale-factor ladder (SF0.1 smoke -> SF1 -> SF10) of TPC-DS q6
 through the real engine (parquet scan -> joins -> filter -> group-by ->
@@ -49,6 +57,33 @@ DATA_DIR = os.environ.get("BENCH_DATA_DIR",
 # a 0-row "device == oracle" comparison verifies nothing (round-2 verdict)
 LADDER = [sf for sf in (0.1, 1.0, 10.0) if sf <= MAX_SF] or [0.1]
 
+# pod-scale knob: when set (>1) every bench rung runs the engine over an
+# n-device mesh (spark.rapids.tpu.mesh.deviceCount=n), so a multichip
+# harness run stops reporting healthy-but-IDLE devices — the devices it
+# probes are the devices the measured plan executes on
+MESH_DEVICES = int(os.environ.get("SPARK_RAPIDS_BENCH_MESH_DEVICES", "0")
+                   or "0")
+# device-count scaling ladder (MULTICHIP metric): q6 + q3 at 1/2/4/8
+# devices, wall time and scaling efficiency per rung
+MULTICHIP_QUERIES = ("q6", "q3")
+MULTICHIP_LADDER = tuple(
+    int(x) for x in os.environ.get("BENCH_MULTICHIP_LADDER",
+                                   "1,2,4,8").split(",") if x.strip())
+MULTICHIP_SF = float(os.environ.get("BENCH_MULTICHIP_SF", "0.1"))
+MULTICHIP_TIMEOUT_S = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S",
+                                           "420"))
+
+
+def _mesh_env(n_devices: int) -> dict:
+    """Child env forcing n virtual host devices (idempotent append)."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count=" not in flags:
+        env["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={n_devices}").strip()
+    return env
+
 
 def _emit(value: float, sf: float, backend: str, error: str | None = None,
           extra: dict | None = None):
@@ -72,7 +107,8 @@ def _emit(value: float, sf: float, backend: str, error: str | None = None,
 _REPORT_PREFIX = "BENCH_REPORT:"
 
 
-def _probe_backend(platform: str, timeout_s: float) -> tuple[bool, str]:
+def _probe_backend(platform: str, timeout_s: float,
+                   env: dict | None = None) -> tuple[bool, str]:
     """Cheaply check the backend can initialize at all.
 
     Runs ``jax.devices()`` plus one tiny device computation in a killable
@@ -97,7 +133,9 @@ def _probe_backend(platform: str, timeout_s: float) -> tuple[bool, str]:
         "print('PROBE_OK', ds[0].platform, len(ds), flush=True)\n"
         "os._exit(0)\n"
     )
-    rc, out, errout = _run_killable([sys.executable, "-c", code], timeout_s)
+    kw = {"env": env} if env else {}
+    rc, out, errout = _run_killable([sys.executable, "-c", code], timeout_s,
+                                    **kw)
     out = (out or "") + (errout or "")
     if rc is None:
         # even in the kill path, scan the drained output: the watchdog
@@ -153,9 +191,13 @@ def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
     or {"error": ...}."""
     cmd = [sys.executable, os.path.abspath(__file__),
            "--child", str(sf), platform]
+    kw = {}
+    if MESH_DEVICES > 1 and platform == "cpu":
+        # the mesh needs the virtual devices to exist before jax inits
+        kw["env"] = _mesh_env(MESH_DEVICES)
     rc, out, errout = _run_killable(
         cmd, timeout_s,
-        cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+        cwd=os.path.dirname(os.path.abspath(__file__)) or None, **kw)
     if rc is None:
         return {"error": f"rung sf{sf:g}/{platform} killed after "
                          f"{timeout_s:.0f}s (backend hang)"}
@@ -193,13 +235,32 @@ def _child(sf: float, platform: str) -> None:
                       f"'{backend}'"}), flush=True)
         os._exit(1)
     from spark_rapids_tpu.bench.runner import run_benchmark
+    # pod-scale: when SPARK_RAPIDS_BENCH_MESH_DEVICES is set the rung's
+    # plan runs sharded over the mesh — but only if that many devices
+    # actually exist; a silent 1-device "mesh" run would mislabel the
+    # metric, so the shortfall is recorded instead
+    session_conf = None
+    mesh_note = None
+    if MESH_DEVICES > 1:
+        have = len(jax.devices())
+        if have >= MESH_DEVICES:
+            session_conf = {"spark.rapids.tpu.mesh.deviceCount":
+                            MESH_DEVICES}
+        else:
+            mesh_note = (f"requested mesh x{MESH_DEVICES} but only "
+                         f"{have} devices; ran single-device")
     # 3 iterations at every SF: the median discards the one-time
     # executable-cache load that dominates iteration 0, at the cost of
     # ~2 extra warm runs — the per-rung subprocess budget (not an
     # iteration count) is what bounds a slow backend here
     reports = run_benchmark(os.path.join(DATA_DIR, f"sf{sf:g}"), sf, ["q6"],
-                            iterations=3, verify=True)
+                            iterations=3, verify=True,
+                            session_conf=session_conf)
     r = reports[0]
+    if session_conf is not None:
+        r["mesh_devices"] = MESH_DEVICES
+    if mesh_note:
+        r["mesh_note"] = mesh_note
     if r.get("ok") and r.get("rows", 0) <= 0:
         r["ok"] = False
         r["error"] = "query produced 0 rows"
@@ -214,7 +275,8 @@ def _child(sf: float, platform: str) -> None:
         try:
             srs = run_benchmark(
                 os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
-                ["q13", "q18"], iterations=1, verify=True, suite="tpch")
+                ["q13", "q18"], iterations=1, verify=True, suite="tpch",
+                session_conf=session_conf)
             for sr in srs:
                 scenarios.append({
                     "suite": "tpch", "query": sr.get("query"),
@@ -233,6 +295,142 @@ def _child(sf: float, platform: str) -> None:
     sys.stdout.flush()
     # a wedged PJRT teardown must not eat the already-printed report
     os._exit(0)
+
+
+def _mchild(n_devices: int, platform: str) -> None:
+    """One MULTICHIP rung: q6 + q3 (TPC-H) on an n-device mesh.
+
+    Prints a BENCH_REPORT line with per-query wall times.  The parent
+    forces ``--xla_force_host_platform_device_count`` in this child's
+    env for the virtual-CPU ladder, so jax must not initialize before
+    that takes effect (it already has: env is set pre-spawn)."""
+    import jax
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    have = len(jax.devices())
+    if have < n_devices:
+        print(_REPORT_PREFIX + json.dumps(
+            {"ok": False, "error": f"need {n_devices} devices, have {have}"}),
+            flush=True)
+        os._exit(1)
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    conf = ({"spark.rapids.tpu.mesh.deviceCount": n_devices}
+            if n_devices > 1 else None)
+    sf = MULTICHIP_SF
+    reports = run_benchmark(
+        os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
+        list(MULTICHIP_QUERIES), iterations=3, verify=True, suite="tpch",
+        session_conf=conf)
+    out = {"ok": True, "devices": n_devices, "queries": {}}
+    for r in reports:
+        q = r.get("query")
+        qr = {"ok": bool(r.get("ok")) and not r.get("error"),
+              "wall_s": r.get("device_s"), "rows": r.get("rows")}
+        if r.get("error"):
+            qr["error"] = str(r["error"])[:300]
+        out["queries"][q] = qr
+        out["ok"] = out["ok"] and qr["ok"]
+    print(_REPORT_PREFIX + json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _emit_multichip(rungs: list, backend: str, error: str | None) -> None:
+    """Second metric line: the MULTICHIP device-count scaling ladder.
+
+    value = q3 scaling speedup t(1)/t(n) at the largest completed rung;
+    every rung carries its wall times and efficiency t1/(n*tn) so the
+    artifact shows the whole curve, not one point."""
+    base = {}     # query -> t(1)
+    for r in rungs:
+        if r.get("devices") == 1 and r.get("ok"):
+            for q, qr in r.get("queries", {}).items():
+                if qr.get("ok") and qr.get("wall_s"):
+                    base[q] = qr["wall_s"]
+    value = 0.0
+    top = 0
+    for r in rungs:
+        n = r.get("devices", 0)
+        for q, qr in r.get("queries", {}).items():
+            t = qr.get("wall_s")
+            if qr.get("ok") and t and q in base:
+                qr["speedup_vs_1dev"] = round(base[q] / t, 3)
+                qr["efficiency"] = round(base[q] / (n * t), 3)
+        q3 = r.get("queries", {}).get("q3", {})
+        if r.get("ok") and n > top and "speedup_vs_1dev" in q3:
+            top, value = n, q3["speedup_vs_1dev"]
+    rec = {
+        "metric": f"tpch_multichip_scaling_sf{MULTICHIP_SF:g}_{backend}",
+        "value": round(float(value), 3),
+        "unit": "x",
+        "devices": top,
+        "queries": list(MULTICHIP_QUERIES),
+        "ladder": rungs,
+    }
+    if error:
+        rec["error"] = str(error)[:500]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _multichip(deadline: float, tpu_probe_detail: str) -> None:
+    """Climb the device-count ladder and emit the MULTICHIP metric line.
+
+    Real multi-device TPU hardware is used when the probe saw >=2
+    devices; otherwise the ladder runs on virtual CPU devices (honestly
+    labeled ``cpu_virtual``) — scaling SHAPE is still meaningful there
+    because the per-device programs and collectives are identical."""
+    m = None
+    for tok in tpu_probe_detail.split():
+        if tok.startswith("x") and tok[1:].isdigit():
+            m = int(tok[1:])
+    max_n = max(MULTICHIP_LADDER)
+    if m is not None and m >= 2:
+        platform, backend = "tpu", "tpu"
+        env = None
+    else:
+        platform, backend = "cpu", "cpu_virtual"
+        env = _mesh_env(max_n)
+    rungs: list[dict] = []
+    err = None
+    for n in MULTICHIP_LADDER:
+        budget = min(MULTICHIP_TIMEOUT_S, deadline - time.monotonic())
+        if budget < 45:
+            err = (err or "") + f" (no budget for x{n})"
+            break
+        if platform == "tpu" and n > (m or 1):
+            rungs.append({"devices": n, "ok": False,
+                          "error": f"only {m} tpu devices"})
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--mchild", str(n), platform]
+        kw = {"env": env} if env else {}
+        rc, out, errout = _run_killable(
+            cmd, budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or None, **kw)
+        r = {"error": f"rung x{n} killed after {budget:.0f}s"} \
+            if rc is None else None
+        if r is None:
+            for line in reversed(out.splitlines()):
+                line = line.strip()
+                if line.startswith(_REPORT_PREFIX):
+                    try:
+                        r = json.loads(line[len(_REPORT_PREFIX):])
+                    except json.JSONDecodeError:
+                        pass
+                    break
+            if r is None:
+                tail = (errout or "")[-300:].replace("\n", " | ")
+                r = {"error": f"rung x{n} rc={rc} no report; {tail}"}
+        r.setdefault("devices", n)
+        r.setdefault("ok", False)
+        rungs.append(r)
+        if not r["ok"]:
+            err = r.get("error") or f"x{n} failed"
+    _emit_multichip(rungs, backend, err)
 
 
 def _ladder(platform: str, deadline: float, reserve: float, rungs: list):
@@ -286,6 +484,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child(float(sys.argv[2]), sys.argv[3])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--mchild":
+        _mchild(int(sys.argv[2]), sys.argv[3])
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--prewarm":
         _prewarm(float(sys.argv[2]) if len(sys.argv) > 2 else 0.1)
         return
@@ -295,6 +496,12 @@ def main() -> None:
     reserve = min(FALLBACK_RESERVE_S, TOTAL_TIMEOUT_S / 3.0)
     rungs: list[dict] = []
     probe_ok, probe_detail = _probe_backend("tpu", PROBE_TIMEOUT_S)
+    if MESH_DEVICES > 1 and not probe_ok:
+        # the mesh ladder will run on virtual CPU devices: record the
+        # device width it will ACTUALLY use (xN), not the dead tunnel's
+        mok, mdetail = _probe_backend("cpu", PROBE_TIMEOUT_S,
+                                      env=_mesh_env(MESH_DEVICES))
+        probe_detail += f" ; mesh cpu probe: {mdetail}"
     if probe_ok:
         best, err = _ladder("tpu", deadline, reserve, rungs)
     else:
@@ -308,6 +515,9 @@ def main() -> None:
         backend = "cpu_fallback"
         err = f"tpu ladder failed: {tpu_err}" + (f" ; {err}" if err else "")
     extra = {"ladder": rungs, "tpu_probe": probe_detail}
+    if MESH_DEVICES > 1:
+        extra["mesh_devices"] = MESH_DEVICES
+    rc = 0
     if best is not None:
         sf, r = best
         extra.update({"device_s": r.get("device_s"),
@@ -316,10 +526,19 @@ def main() -> None:
         if r.get("scenarios"):
             extra["scenarios"] = r["scenarios"]
         _emit(r.get("speedup", 0.0), sf, backend, error=err, extra=extra)
-        sys.exit(0)
-    _emit(0.0, LADDER[0], backend, error=err or "no rung completed",
-          extra=extra)
-    sys.exit(1)
+    else:
+        _emit(0.0, LADDER[0], backend, error=err or "no rung completed",
+              extra=extra)
+        rc = 1
+    # second metric line: the pod-scale device-count ladder (q6 + q3 at
+    # 1/2/4/8 devices).  Runs after the primary metric so a wedged mesh
+    # rung can never eat the gate number.
+    mc_deadline = time.monotonic() + MULTICHIP_TIMEOUT_S
+    try:
+        _multichip(mc_deadline, probe_detail)
+    except Exception as e:  # pragma: no cover - rider must not gate
+        _emit_multichip([], "none", f"multichip ladder crashed: {e}")
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

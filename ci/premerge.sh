@@ -234,6 +234,59 @@ assert all(c in plain for c in fused if c != "FusedStageExec"), (fused, plain)
 assert frows == prows, "fused vs unfused rows diverge on q3"
 print("fusion gate: warm rerun compiles 0, shape reversible: ok")
 PY
+  echo "-- pod-scale mesh gate: regions exact, warm, and reversible --"
+  # q6 + q3 over an 8-device mesh must return EXACTLY the single-chip
+  # rows; a warm rerun at the SAME mesh shape must compile nothing (the
+  # region/mesh programs are keyed by mesh shape in the process-wide
+  # compile cache); and mesh.deviceCount=0 must restore the exact
+  # single-chip plan shape
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import os, tempfile
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+MESH = {"spark.rapids.tpu.mesh.deviceCount": 8}
+
+def classes(query, conf):
+    s = TpuSession(dict(conf))
+    df = build_tpch_query(query, s, d)
+    ov, meta = df._overridden(quiet=True)
+    acc = []
+    def walk(n):
+        acc.append(type(n).__name__)
+        for c in n.children:
+            walk(c)
+    walk(meta.exec_node)
+    return acc, sorted(df.collect(), key=str)
+
+# 1) mesh-vs-single exact equality on q6 and q3
+for q in ("q6", "q3"):
+    mnames, mrows = classes(q, MESH)
+    _, prows = classes(q, {})
+    assert mrows == prows, f"{q}: mesh-8 rows != single-chip rows"
+    assert any(n.startswith("Mesh") for n in mnames), (q, mnames)
+
+# 2) warm rerun at the FIXED mesh shape compiles nothing
+before = get_registry().snapshot()
+_, rows = classes("q3", MESH)
+moved = get_registry().delta(before)["counters"]
+assert rows, "q3 returned no rows"
+assert moved.get("compile_count", 0) == 0, f"warm mesh rerun compiled: {moved}"
+
+# 3) deviceCount=0 restores the exact single-chip plan shape
+zero, zrows = classes("q3", {"spark.rapids.tpu.mesh.deviceCount": 0})
+plain, prows = classes("q3", {})
+assert zero == plain, (zero, plain)
+assert zrows == prows
+assert not any(n.startswith("Mesh") for n in zero), zero
+print("mesh gate: q6/q3 exact, warm rerun compiles 0, deviceCount=0 reversible: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

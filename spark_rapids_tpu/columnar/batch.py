@@ -269,7 +269,12 @@ def _packed_unpack_cached(spec):
                 out_cols.append((data, validity, lens))
         return tuple(out_cols), nr
 
-    return jax.jit(unpack)
+    # through the shared-jit wrapper: the io scan worker compiles NEW
+    # unpack programs mid-query, which must serialize against every
+    # other engine compile/dispatch on CPU (compile_cache guard); bound
+    # lazily — columnar/ sits below exec/
+    from spark_rapids_tpu.exec.compile_cache import instrument
+    return instrument(jax.jit(unpack))
 
 # Arrow<->device conversions are serialized AND pyarrow's internal pool
 # is pinned to one thread (runtime.pin_arrow_threads): pyarrow compute

@@ -212,6 +212,28 @@ MESH_DEVICE_COUNT = int_conf(
     "SURVEY.md §5.8) instead of the in-process exchange. 0 disables. "
     "(ref: the UCX transport enable, RapidsConf.scala:652)")
 
+MESH_REGIONS_ENABLED = bool_conf(
+    "spark.rapids.tpu.mesh.regions.enabled", True,
+    "Form mesh REGIONS: a contiguous elementwise pipeline "
+    "(filter/project/fused stage) feeding a mesh collective operator "
+    "(aggregate, exchange, sort) runs INSIDE the per-device shard_map "
+    "program — batches are sharded once at the region leaves and stay "
+    "device-resident through the whole pipeline, with host/device-0 "
+    "transitions only at region boundaries. Disable to run each mesh "
+    "operator as an isolated island (the pre-region plan shape).")
+
+MESH_SEND_CAPACITY = int_conf(
+    "spark.rapids.tpu.mesh.exchange.sendCapacityRows", 0,
+    "Per-target row capacity C of the [P, C] all-to-all send buffers in "
+    "mesh exchanges. 0 (default) sizes C to the full shard capacity — "
+    "the static worst case where every row targets one device, which "
+    "can never overflow but costs P x shard bytes of send-buffer HBM "
+    "per device. A smaller C bounds that memory; if a skewed key "
+    "distribution overflows it, the exchange detects the overflow "
+    "in-program (no silent truncation), counts mesh_send_overflows, "
+    "and degrades into a retry at worst-case capacity — the mesh "
+    "analog of the OOM split-and-retry ladder (memory/retry.py).")
+
 MESH_JOIN_BUILD_THRESHOLD = bytes_conf(
     "spark.rapids.tpu.mesh.join.buildThresholdBytes", 128 << 20,
     "Mesh joins replicate the build side to every device while it fits "

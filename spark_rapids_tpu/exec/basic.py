@@ -17,6 +17,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.core import (ExecCtx, PlanNode, host_to_device)
+from spark_rapids_tpu.exec.compile_cache import guarded_jit as _guarded_jit
 from spark_rapids_tpu.expr.core import (Alias, Expression, bind, eval_device,
                                         eval_host, output_name)
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
@@ -27,14 +28,14 @@ __all__ = ["LocalScanExec", "ProjectExec", "FilterExec", "RangeExec",
            "UnionExec", "LocalLimitExec", "GlobalLimitExec"]
 
 
-@_partial(_jax.jit, static_argnames=("cap",))
+@_guarded_jit(static_argnames=("cap",))
 def _jit_miid(mask, cap: int, base):
     import jax.numpy as jnp
     data = jnp.where(mask, base + jnp.arange(cap, dtype=jnp.int64), 0)
     return DeviceColumn(data, mask, T.LongType())
 
 
-@_partial(_jax.jit, static_argnames=("cap",))
+@_guarded_jit(static_argnames=("cap",))
 def _jit_spid(mask, cap: int, pid):
     import jax.numpy as jnp
     data = jnp.where(mask, pid.astype(jnp.int32), 0)

@@ -28,9 +28,11 @@ first invocation of a new input signature (trace + compile + first run).
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 import time
+import weakref
 import types as _pytypes
 from collections import OrderedDict
 from functools import partial as _partial
@@ -41,6 +43,7 @@ from spark_rapids_tpu.obs.registry import get_registry
 
 __all__ = ["fragment_key", "fingerprint", "get_or_build", "shared_jit",
            "instrument", "SharedJit", "cache_info", "reset_cache",
+           "mesh_key_part",
            "FUSION_ENABLED", "FUSION_MIN_OPS", "FUSION_DONATE",
            "COMPILE_CACHE_DIR"]
 
@@ -85,6 +88,17 @@ COMPILE_CACHE_MAX_ENTRIES = int_conf(
     "Upper bound on distinct plan fragments kept in the process-wide "
     "compile cache; least-recently-used entries (and their jax "
     "executables) are dropped past it.", internal=True)
+
+COMPILE_CACHE_MAP_PRESSURE = int_conf(
+    "spark.rapids.sql.compile.mapPressureLimit", 0,
+    "Purge every cached executable when the process's memory-mapping "
+    "count reaches this value at a compile event.  Each XLA:CPU "
+    "executable pins ~10 mappings for the life of the process, so a "
+    "long-lived engine eventually hits the kernel's vm.max_map_count "
+    "and the NEXT compile dies with an unexplained SIGSEGV/SIGABRT "
+    "inside backend_compile.  0 (default) = auto: 70% of "
+    "/proc/sys/vm/max_map_count, disabled where /proc is absent.",
+    internal=True)
 
 
 # ---------------------------------------------------------------------------
@@ -196,6 +210,21 @@ def fingerprint(*parts) -> str:
     return "".join(out)
 
 
+def mesh_key_part(mesh, axis_name: str) -> tuple:
+    """The mesh component of a fragment key: a ``shard_map`` program is
+    specialized to its mesh SHAPE (the all-to-all degree is baked into
+    every buffer shape) and to the participating device set (the
+    executable is lowered against those devices' memories), so a mesh-2
+    and a mesh-4 lowering of the same fragment must MISS each other,
+    and both must miss the single-chip program (which has no mesh part
+    at all).  ``mesh`` may be a ``jax.sharding.Mesh`` or a plain device
+    count."""
+    if isinstance(mesh, int):
+        return ("mesh", mesh, axis_name)
+    devs = tuple(int(d.id) for d in mesh.devices.flat)
+    return ("mesh", len(devs), axis_name, devs)
+
+
 def fragment_key(kind: str, *parts) -> str:
     """Cache key for one plan fragment's program: a ``kind`` tag plus the
     md5 of the canonical fingerprint of everything the traced closure
@@ -208,6 +237,157 @@ def fragment_key(kind: str, *parts) -> str:
 # Shared jit wrappers + compile accounting
 # ---------------------------------------------------------------------------
 
+# XLA's CPU backend is not reliably safe against backend_compile running
+# *concurrently* with other compiles OR with executions on sibling
+# python threads (drain threads segfault inside the LLVM JIT while a
+# peer dispatches) — observed as rare full-suite SIGSEGVs on single-host
+# CPU runs.  On the CPU backend every SharedJit call therefore passes a
+# process-wide readers-writer lock: warm dispatches share it, while a
+# first-signature call — the one that traces + compiles — holds it
+# exclusively.  Both sides are re-entrant for the lock-holding thread
+# (jit-of-jit tracing re-enters wrappers).  Non-CPU backends take no
+# lock at all.
+
+class _CompileRWLock:
+    """Many concurrent executors, one exclusive compiler."""
+
+    __slots__ = ("_cond", "_readers", "_writer", "_depth")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer: int | None = None
+        self._depth = 0
+
+    @contextlib.contextmanager
+    def reading(self):
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:
+                counted = False  # already exclusive; pass through
+            else:
+                while self._writer is not None:
+                    self._cond.wait()
+                self._readers += 1
+                counted = True
+        try:
+            yield
+        finally:
+            if counted:
+                with self._cond:
+                    self._readers -= 1
+                    if not self._readers:
+                        self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def writing(self):
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:
+                self._depth += 1
+            else:
+                while self._writer is not None or self._readers:
+                    self._cond.wait()
+                self._writer = me
+                self._depth = 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._depth -= 1
+                if not self._depth:
+                    self._writer = None
+                    self._cond.notify_all()
+
+
+_COMPILE_RW = _CompileRWLock()
+_NULL_GUARD = contextlib.nullcontext()
+_SERIALIZE_COMPILES: bool | None = None
+
+
+def _cpu_backend() -> bool:
+    global _SERIALIZE_COMPILES
+    if _SERIALIZE_COMPILES is None:
+        try:
+            import jax
+            _SERIALIZE_COMPILES = jax.default_backend() == "cpu"
+        except Exception:
+            _SERIALIZE_COMPILES = False
+        if _SERIALIZE_COMPILES:
+            from spark_rapids_tpu.runtime import sync_cpu_dispatch
+            sync_cpu_dispatch()  # locks can't see the async native pool
+    return _SERIALIZE_COMPILES
+
+
+def compile_guard():
+    """Exclusive guard to hold while a call WILL trace + compile."""
+    return _COMPILE_RW.writing() if _cpu_backend() else _NULL_GUARD
+
+
+def dispatch_guard():
+    """Shared guard to hold while dispatching an already-built program."""
+    return _COMPILE_RW.reading() if _cpu_backend() else _NULL_GUARD
+
+
+# ---------------------------------------------------------------------------
+# Mapping-pressure valve
+# ---------------------------------------------------------------------------
+
+_ALL_SHARED: "weakref.WeakSet" = weakref.WeakSet()
+_MAP_LIMIT: int | None = None
+
+
+def _map_pressure_limit() -> int:
+    global _MAP_LIMIT
+    if _MAP_LIMIT is None:
+        lim = COMPILE_CACHE_MAP_PRESSURE.default
+        if not lim:
+            try:
+                with open("/proc/sys/vm/max_map_count") as f:
+                    lim = int(f.read()) * 7 // 10
+            except (OSError, ValueError):
+                lim = 0
+        _MAP_LIMIT = lim
+    return _MAP_LIMIT
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return f.read().count(b"\n")
+    except OSError:
+        return 0
+
+
+def purge_compiled() -> None:
+    """Drop every compiled executable the process holds.
+
+    Clears the fragment cache, every SharedJit's signature bookkeeping,
+    and jax's own executable caches, then collects — executables only
+    release their code-page mappings once the last reference dies.
+    Callers must already hold the exclusive compile guard (or be
+    otherwise single-threaded): live plans keep their wrapper objects
+    and simply recompile on next dispatch."""
+    import gc
+    import jax
+    with _LOCK:
+        _CACHE.clear()
+    for sj in list(_ALL_SHARED):
+        with sj._lock:
+            sj._sigs.clear()
+    jax.clear_caches()
+    gc.collect()
+    get_registry().inc("compile_cache_purges")
+
+
+def _purge_if_pressured() -> bool:
+    lim = _map_pressure_limit()
+    if not lim or _map_count() < lim:
+        return False
+    purge_compiled()
+    return True
+
+
 class SharedJit:
     """A process-wide jit callable with per-signature compile accounting.
 
@@ -218,40 +398,47 @@ class SharedJit:
     ``compile_wall_s``.  Signatures already seen dispatch with no extra
     accounting beyond one set lookup."""
 
-    __slots__ = ("fn", "_sigs", "_lock")
+    __slots__ = ("fn", "_sigs", "_lock", "__weakref__")
 
     def __init__(self, fn):
         self.fn = fn
         self._sigs: set = set()
         self._lock = threading.Lock()
+        _ALL_SHARED.add(self)
 
     def signature_count(self) -> int:
         return len(self._sigs)
 
     @staticmethod
-    def _signature(args):
+    def _signature(args, kwargs):
         import jax
-        leaves, treedef = jax.tree_util.tree_flatten(args)
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         sig = (treedef, tuple(
             (l.shape, str(l.dtype)) if hasattr(l, "shape") else l
             for l in leaves))
         hash(sig)  # unhashable static leaf -> fall back to uncounted
         return sig
 
-    def __call__(self, *args):
+    def __call__(self, *args, **kwargs):
         try:
-            sig = self._signature(args)
+            sig = self._signature(args, kwargs)
         except Exception:
-            return self.fn(*args)
+            with dispatch_guard():
+                return self.fn(*args, **kwargs)
         with self._lock:
             new = sig not in self._sigs
             if new:
                 self._sigs.add(sig)
         if not new:
-            return self.fn(*args)
+            with dispatch_guard():
+                return self.fn(*args, **kwargs)
         t0 = time.perf_counter()
         try:
-            return self.fn(*args)
+            with compile_guard():
+                if _purge_if_pressured():
+                    with self._lock:
+                        self._sigs.add(sig)  # purge cleared it
+                return self.fn(*args, **kwargs)
         finally:
             reg = get_registry()
             reg.inc("compile_count")
@@ -261,6 +448,22 @@ class SharedJit:
 def instrument(fn) -> SharedJit:
     """Wrap an already-jitted callable with compile accounting."""
     return SharedJit(fn)
+
+
+def guarded_jit(**jit_kwargs):
+    """``jax.jit`` + the SharedJit wrapper, as a decorator.
+
+    Module-level kernels (`@guarded_jit(static_argnames=...)`) get the
+    same compile accounting as fragment-keyed programs AND pass the
+    process-wide compile/dispatch guard, so on the CPU backend no raw
+    kernel can compile concurrently with another engine compile or
+    dispatch (the XLA-build crash class documented above).  jax already
+    requires static args to be hashable, so the signature bookkeeping
+    mirrors jax's own executable cache exactly."""
+    def wrap(fn):
+        import jax
+        return SharedJit(jax.jit(fn, **jit_kwargs))
+    return wrap
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,11 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.conf import ConfEntry, TpuConf, register
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.runtime import widen_thread_stacks
+
+# worker threads created from here on (drain pools, shuffle servers) get
+# deep stacks — XLA:CPU compiles overflow the 8 MiB default (runtime.py)
+widen_thread_stacks()
 
 __all__ = [
     "ExecCtx", "PlanNode", "CoalesceGoal", "TargetSize", "RequireSingleBatch",

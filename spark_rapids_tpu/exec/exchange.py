@@ -28,6 +28,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.compile_cache import guarded_jit
 from spark_rapids_tpu.exec.partitioning import Partitioning
 from spark_rapids_tpu.host.batch import HostBatch
 from spark_rapids_tpu.ops import host_kernels as hk
@@ -57,7 +58,7 @@ SKEWED_PARTITION_THRESHOLD = register(ConfEntry(
     "split).", conv=int))
 
 
-@partial(jax.jit, static_argnames=("num_parts",))
+@guarded_jit(static_argnames=("num_parts",))
 def _jit_group_by_part(batch: ColumnBatch, ids: jax.Array, num_parts: int):
     """Sort rows by partition id; return (sorted_batch, counts[num_parts]).
 
@@ -78,7 +79,7 @@ def _jit_group_by_part(batch: ColumnBatch, ids: jax.Array, num_parts: int):
     return ColumnBatch(cols, batch.num_rows, batch.schema), counts, starts
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
+@guarded_jit(static_argnames=("out_cap",))
 def _jit_slice_part(sorted_batch: ColumnBatch, starts, counts, p,
                     out_cap: int):
     """Copy partition ``p``'s rows [starts[p], starts[p]+counts[p]) into

@@ -48,7 +48,7 @@ from spark_rapids_tpu.host.batch import HostBatch
 from spark_rapids_tpu.ops import host_kernels as hk
 from spark_rapids_tpu.ops import kernels as dk
 
-__all__ = ["FusedStageExec", "fusible"]
+__all__ = ["FusedStageExec", "fusible", "stage_body", "stage_key_parts"]
 
 # donation is best-effort by design: a dtype-changing projection leaves
 # some input buffers unreusable and jax warns per compile — expected here
@@ -69,6 +69,36 @@ def fusible(node: PlanNode) -> bool:
 def _is_donated_reuse_error(e: BaseException) -> bool:
     msg = str(e).lower()
     return "donat" in msg or "deleted" in msg
+
+
+def stage_body(ops):
+    """The single traced body chaining ``ops`` (innermost-first) — ONE
+    program whether jitted standalone per batch (FusedStageExec) or
+    spliced into a mesh region's per-device shard_map program
+    (exec/mesh_region.py), where the same filter/projection chain runs
+    shard-resident with no extra dispatch."""
+    def body(b):
+        for op in ops:
+            if type(op) is FilterExec:
+                c = eval_device(op._cond, b)
+                b = dk.compact(b, c.data & c.validity)
+            else:
+                cols = [eval_device(e, b) for e in op._bound]
+                b = ColumnBatch(cols, b.num_rows, op._schema)
+        return b
+    return body
+
+
+def stage_key_parts(ops) -> list:
+    """Fragment-key material for a filter/project chain: what
+    ``stage_body``'s trace closes over, per member."""
+    parts = []
+    for op in ops:
+        if type(op) is FilterExec:
+            parts.append(("filter", op._cond))
+        else:
+            parts.append(("project", tuple(op._bound), op._schema))
+    return parts
 
 
 class FusedStageExec(PlanNode):
@@ -113,13 +143,7 @@ class FusedStageExec(PlanNode):
 
     def _stage_key(self, donate: bool) -> str:
         from spark_rapids_tpu.exec import compile_cache as cc
-        parts = []
-        for op in self._ops:
-            if type(op) is FilterExec:
-                parts.append(("filter", op._cond))
-            else:
-                parts.append(("project", tuple(op._bound), op._schema))
-        return cc.fragment_key("fused_stage", parts,
+        return cc.fragment_key("fused_stage", stage_key_parts(self._ops),
                                self.children[0].output_schema, donate)
 
     def _jit_fn(self, donate: bool):
@@ -127,21 +151,9 @@ class FusedStageExec(PlanNode):
             self._fused_jits = {}
         if donate not in self._fused_jits:
             from spark_rapids_tpu.exec import compile_cache as cc
-            ops = self._ops
-
-            def body(b):
-                for op in ops:
-                    if type(op) is FilterExec:
-                        c = eval_device(op._cond, b)
-                        b = dk.compact(b, c.data & c.validity)
-                    else:
-                        cols = [eval_device(e, b) for e in op._bound]
-                        b = ColumnBatch(cols, b.num_rows, op._schema)
-                return b
-
             kw = {"donate_argnums": 0} if donate else {}
             self._fused_jits[donate] = cc.shared_jit(
-                self._stage_key(donate), body, **kw)
+                self._stage_key(donate), stage_body(self._ops), **kw)
         return self._fused_jits[donate]
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:

@@ -26,6 +26,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.compile_cache import guarded_jit
 from spark_rapids_tpu.expr.core import Expression, bind, eval_device, \
     eval_host
 from spark_rapids_tpu.host.batch import HostBatch, HostColumn
@@ -85,7 +86,7 @@ class Explode(Expression):
         return f"Explode({self.children[0]!r})"
 
 
-@partial(jax.jit, static_argnames=("out_cap", "pos_col", "outer"))
+@guarded_jit(static_argnames=("out_cap", "pos_col", "outer"))
 def _jit_generate_array(batch: ColumnBatch, col: DeviceColumn,
                         out_cap: int, pos_col: bool, outer: bool):
     """Explode an array column: one output row per element, child
@@ -133,7 +134,7 @@ def _jit_generate_array(batch: ColumnBatch, col: DeviceColumn,
     return out_cols, total
 
 
-@partial(jax.jit, static_argnames=())
+@guarded_jit(static_argnames=())
 def _jit_counts(col: DeviceColumn, real: jax.Array, delim: int):
     """Per-row piece counts (0 for null/padding rows) + total."""
     w = col.max_len
@@ -144,7 +145,7 @@ def _jit_counts(col: DeviceColumn, real: jax.Array, delim: int):
     return counts, jnp.sum(counts, dtype=jnp.int64)
 
 
-@partial(jax.jit, static_argnames=("out_cap", "pos_col", "outer"))
+@guarded_jit(static_argnames=("out_cap", "pos_col", "outer"))
 def _jit_generate(batch: ColumnBatch, col: DeviceColumn, counts, delim: int,
                   out_cap: int, pos_col: bool, outer: bool):
     """Build the generated batch: child columns gathered per output row +

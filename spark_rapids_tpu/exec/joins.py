@@ -25,6 +25,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.compile_cache import guarded_jit
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.expr.core import (BoundReference, Expression, bind,
                                         eval_device, eval_host)
@@ -39,7 +40,7 @@ from spark_rapids_tpu.ops.join import (JOIN_TYPES, build_prepare_fast,
 __all__ = ["JoinExec", "CrossJoinExec"]
 
 
-@partial(jax.jit, static_argnames=("lkeys", "rkeys", "join_type"))
+@guarded_jit(static_argnames=("lkeys", "rkeys", "join_type"))
 def _jit_probe(lb, rb, lkeys, rkeys, join_type):
     """Heavy rank-path phase (all sorts): compiled once per capacity pair."""
     probe_arrays, total = join_probe(lb, rb, lkeys, rkeys, join_type)
@@ -49,18 +50,18 @@ def _jit_probe(lb, rb, lkeys, rkeys, join_type):
     return probe_arrays, total
 
 
-@partial(jax.jit, static_argnames=("rkey",))
+@guarded_jit(static_argnames=("rkey",))
 def _jit_build_prep(rb, rkey):
     return build_prepare_fast(rb, rkey)
 
 
-@partial(jax.jit, static_argnames=("lkey", "join_type"))
+@guarded_jit(static_argnames=("lkey", "join_type"))
 def _jit_probe_fast(lb, prep, lkey, join_type):
     probe_arrays, total = probe_fast(lb, lkey, *prep, join_type)
     return probe_arrays[:-1], total  # drop the None placeholder
 
 
-@partial(jax.jit, static_argnames=("cl", "join_type", "out_cap",
+@guarded_jit(static_argnames=("cl", "join_type", "out_cap",
                                    "include_right", "schema",
                                    "track_matched"))
 def _jit_gather(lb, rb, probe_arrays, cl, join_type, out_cap, include_right,

@@ -36,15 +36,39 @@ from spark_rapids_tpu.exec.joins import JoinExec
 from spark_rapids_tpu.expr.core import Expression, bind, eval_device
 from spark_rapids_tpu.ops import kernels as dk
 from spark_rapids_tpu.ops.segmented import sorted_group_by
+from spark_rapids_tpu.obs.registry import get_registry
 from spark_rapids_tpu.parallel.mesh import (local_view, make_mesh, restack,
                                             shard_batches, shard_map,
-                                            unshard_batch)
+                                            split_shards)
 from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
                                                     exchange_local,
+                                                    exchange_local_checked,
                                                     partition_ids_for_keys)
 
-__all__ = ["DeviceSliceLost", "MeshAggregateExec", "MeshExchangeExec",
-           "MeshJoinExec", "mesh_for"]
+__all__ = ["DeviceSliceLost", "MeshSendOverflow", "MeshAggregateExec",
+           "MeshExchangeExec", "MeshJoinExec", "mesh_for"]
+
+
+def _committed_device(b: ColumnBatch):
+    """The single device ``b`` is committed to, or None (uncommitted
+    batches live wherever the default device put them)."""
+    if b.columns and getattr(b.columns[0].data, "committed", False):
+        devs = b.columns[0].data.devices()
+        if len(devs) == 1:
+            return next(iter(devs))
+    return None
+
+
+def _note_a2a_bytes(stacked) -> None:
+    """Static worst-case accounting for one collective launch: in an
+    all-to-all every input row crosses the interconnect at most once, so
+    the stacked program input's total byte size bounds the traffic.
+    Incremented host-side at launch (a counter inside the jitted program
+    is not expressible), so the counter moves per collective, not per
+    byte actually routed off-device."""
+    n = sum(getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(stacked))
+    get_registry().inc("mesh_all_to_all_bytes", float(n))
 
 
 class _MeshOutputMixin:
@@ -53,7 +77,14 @@ class _MeshOutputMixin:
     own jitted programs — per-batch join probes, window kernels), it
     sets ``align_output`` and the exec moves each yielded batch to the
     default device at the mesh->single-device boundary (review finding:
-    patching individual consumers is whack-a-mole)."""
+    patching individual consumers is whack-a-mole).
+
+    Every batch that actually MOVES across devices here increments
+    ``mesh_gather_fallbacks`` — the counter that tells you the plan fell
+    off the mesh (docs/tuning-guide.md "Pod-scale execution"): a fully
+    region-resident pipeline reads 0 because region members exchange
+    inside one program and the boundary batches are consumed
+    device-aware (place_shards affinity)."""
 
     align_output: bool = False
 
@@ -64,8 +95,13 @@ class _MeshOutputMixin:
         target = jax.devices()[0]
         for b in it:
             # host-backend batches (oracle path) carry no placement
-            yield jax.device_put(b, target) \
-                if isinstance(b, ColumnBatch) else b
+            if not isinstance(b, ColumnBatch):
+                yield b
+                continue
+            src = _committed_device(b)
+            if src is not None and src != target:
+                get_registry().inc("mesh_gather_fallbacks")
+            yield jax.device_put(b, target)
 
 
 class DeviceSliceLost(RuntimeError):
@@ -73,6 +109,15 @@ class DeviceSliceLost(RuntimeError):
     ``mesh.slice.lost`` fault, or an XLA/PJRT device-loss status): the
     on-mesh outputs are unrecoverable, but the child lineage is intact
     so the exec can recompute single-device."""
+
+
+class MeshSendOverflow(RuntimeError):
+    """A bounded [P, C] all-to-all send buffer
+    (spark.rapids.tpu.mesh.exchange.sendCapacityRows) could not carry a
+    skewed destination's rows.  Never silent: the overflow flag comes
+    back from the program and the exchange retries at worst-case
+    capacity (the mesh analog of PR 2's detect-then-split-and-retry —
+    here the 'split' is the other direction: give the buffer room)."""
 
 
 # status fragments PJRT/XLA surface when a participating device (or the
@@ -190,12 +235,26 @@ def drain_cached(ctx: ExecCtx, node: PlanNode) -> list:
 
 
 def concat_or_empty(batches, schema: T.Schema) -> ColumnBatch:
-    """One device batch from a drained list (empty-schema fallback)."""
+    """One device batch from a drained list (empty-schema fallback).
+
+    Region-era inputs may be committed to DIFFERENT mesh devices
+    (split_shards keeps boundary batches device-resident); a concat
+    must see them on one device, so mixed placements are aligned to the
+    first committed device before concatenation — this is a build-side
+    materialization (replicated to every device right after), not a
+    gather fallback."""
     if not batches:
         from spark_rapids_tpu.exec.core import host_to_device
         from spark_rapids_tpu.host.batch import HostBatch
         return host_to_device(HostBatch.empty(schema))
-    return dk.concat_batches(batches) if len(batches) > 1 else batches[0]
+    if len(batches) == 1:
+        return batches[0]
+    devs = {repr(_committed_device(b)) for b in batches}
+    if len(devs) > 1:
+        target = _committed_device(batches[0]) or jax.devices()[0]
+        batches = [b if _committed_device(b) == target
+                   else jax.device_put(b, target) for b in batches]
+    return dk.concat_batches(batches)
 
 
 def _empty_shard(schema: T.Schema, cap: int, widths) -> ColumnBatch:
@@ -272,18 +331,17 @@ class MeshAggregateExec(_MeshOutputMixin, PlanNode):
                                  self.children[0], mode="complete")
 
     # -- distributed program -------------------------------------------
-    def _program(self, mesh):
-        key = id(mesh)
-        if key in self._jitted:
-            return self._jitted[key]
-        from jax.sharding import PartitionSpec as P
+    def _local_step(self):
+        """The per-device body (local view in, local view out) — the
+        unit a MeshRegionExec splices into ITS shard_map program so a
+        whole pipeline compiles as one per-device executable."""
         L = self._layout
         key_idx = list(range(len(L._group_bound)))
         p = self.mesh_size
         axis = self.axis_name
+        out_schema = self._output_schema
 
-        def step(stacked: ColumnBatch) -> ColumnBatch:
-            b = local_view(stacked)
+        def step(b: ColumnBatch) -> ColumnBatch:
             cols = [eval_device(e, b) for e in L._pre_exprs]
             pre = ColumnBatch(cols, b.num_rows, L._pre_schema)
             part_out = _relabel_d(
@@ -300,23 +358,65 @@ class MeshAggregateExec(_MeshOutputMixin, PlanNode):
                 sorted_group_by(ex, key_idx, L._merge_specs),
                 L._buffer_schema)
             out_cols = [eval_device(e, merged) for e in L._final_exprs]
-            out = ColumnBatch(out_cols, merged.num_rows,
-                              self._output_schema)
+            out = ColumnBatch(out_cols, merged.num_rows, out_schema)
             if not key_idx:
+                # grand-aggregate finalization stays ON-device: device 0
+                # carries the merged row, every other shard suppresses
+                # its identity row — no host hop before the final value
                 on0 = jax.lax.axis_index(axis) == 0
                 out = canonicalize(ColumnBatch(
                     out.columns, jnp.where(on0, out.num_rows, 0),
                     out.schema))
-            return restack(out)
+            return out
 
-        fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P(axis),
-                                   out_specs=P(axis)))
-        self._jitted[key] = fn
+        return step
+
+    def _step_key_parts(self) -> tuple:
+        """Fragment-key material for the local step (mesh part added by
+        the program builder — a region key composes these per member)."""
+        L = self._layout
+        return ("mesh_agg", tuple(L._pre_exprs), L._pre_schema,
+                tuple(L._update_specs), tuple(L._merge_specs),
+                tuple(L._final_exprs), self._output_schema,
+                len(L._group_bound), self.mesh_size)
+
+    def _program(self, mesh):
+        memo = id(mesh)
+        if memo in self._jitted:
+            return self._jitted[memo]
+        from jax.sharding import PartitionSpec as P
+
+        from spark_rapids_tpu.exec import compile_cache as cc
+        axis = self.axis_name
+        step = self._local_step()
+        key = cc.fragment_key(*self._step_key_parts(),
+                              cc.mesh_key_part(mesh, axis))
+
+        def build():
+            def prog(stacked: ColumnBatch) -> ColumnBatch:
+                return restack(step(local_view(stacked)))
+            return cc.instrument(jax.jit(shard_map(
+                prog, mesh=mesh, in_specs=P(axis), out_specs=P(axis))))
+
+        fn = cc.get_or_build(key, build)
+        self._jitted[memo] = fn
         return fn
 
+    def _outputs_cache_key(self, ctx: ExecCtx) -> tuple:
+        return ("meshagg", id(self), ctx.backend)
+
     def _outputs(self, ctx: ExecCtx):
-        return ctx.cached(("meshagg", id(self), ctx.backend),
+        return ctx.cached(self._outputs_cache_key(ctx),
                           lambda: self._compute_outputs(ctx))
+
+    def _fallback_outputs(self, ctx: ExecCtx):
+        """Single-device recompute: the complete-mode aggregation is the
+        mesh program's lineage (same layout contract), re-run on the
+        default device — also the degenerate path when the mesh never
+        existed or the child produced nothing."""
+        out = [list(self._complete_exec().partition_iter(ctx, 0))]
+        out += [[] for _ in range(self.mesh_size - 1)]
+        return out
 
     def _compute_outputs(self, ctx: ExecCtx):
         from spark_rapids_tpu.exec.core import drain_partitions
@@ -328,17 +428,13 @@ class MeshAggregateExec(_MeshOutputMixin, PlanNode):
                 _check_slice_fault(ctx, "meshagg", mesh)
                 shards = place_shards(batches, self.mesh_size)
                 stacked = shard_batches(shards, mesh, self.axis_name)
+                _note_a2a_bytes(stacked)
                 result = self._program(mesh)(stacked)
-                return [[b] for b in unshard_batch(result)]
+                return [[b] for b in split_shards(result)]
             except Exception as err:
                 _reraise_unless_slice_lost(err)
                 t0 = time.perf_counter()
-        # single-device recompute: the complete-mode aggregation is the
-        # mesh program's lineage (same layout contract), re-run on the
-        # default device — also the degenerate path when the mesh never
-        # existed or the child produced nothing
-        out = [list(self._complete_exec().partition_iter(ctx, 0))]
-        out += [[] for _ in range(self.mesh_size - 1)]
+        out = self._fallback_outputs(ctx)
         if t0 is not None:
             _note_slice_recovery(ctx, time.perf_counter() - t0)
         return out
@@ -405,25 +501,50 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
             kidx.append(len(cols) - 1)
         return ColumnBatch(cols, b.num_rows, T.Schema(fields)), kidx
 
-    def _program(self, mesh):
-        key = id(mesh)
-        if key in self._jitted:
-            return self._jitted[key]
-        from jax.sharding import PartitionSpec as P
+    def _local_step(self, send_capacity: int | None = None):
+        """Per-device body returning ``(batch, overflow)`` — the region
+        splices this into its own shard_map program; overflow is
+        statically False at worst-case capacity (send_capacity=None)."""
         p = self.mesh_size
         n = self._num_parts
         axis = self.axis_name
 
-        def step(stacked: ColumnBatch) -> ColumnBatch:
-            b = local_view(stacked)
+        def step(b: ColumnBatch):
             aug, kidx = self._augment(b)
             pid = partition_ids_for_keys(aug, kidx, n)
             dev = jnp.where(pid < n, pid % p, p)  # padding -> p (dropped)
-            return restack(exchange_local(b, dev, p, axis))
+            return exchange_local_checked(b, dev, p, axis,
+                                          send_capacity=send_capacity)
 
-        fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P(axis),
-                                   out_specs=P(axis)))
-        self._jitted[key] = fn
+        return step
+
+    def _step_key_parts(self, send_capacity: int | None = None) -> tuple:
+        return ("mesh_exchange", tuple(self._bound),
+                self.children[0].output_schema, self._num_parts,
+                send_capacity, self.mesh_size)
+
+    def _program(self, mesh, send_capacity: int | None = None):
+        memo = (id(mesh), send_capacity)
+        if memo in self._jitted:
+            return self._jitted[memo]
+        from jax.sharding import PartitionSpec as P
+
+        from spark_rapids_tpu.exec import compile_cache as cc
+        axis = self.axis_name
+        step = self._local_step(send_capacity)
+        key = cc.fragment_key(*self._step_key_parts(send_capacity),
+                              cc.mesh_key_part(mesh, axis))
+
+        def build():
+            def prog(stacked: ColumnBatch):
+                out, overflow = step(local_view(stacked))
+                return restack(out), restack(overflow)
+            return cc.instrument(jax.jit(shard_map(
+                prog, mesh=mesh, in_specs=P(axis),
+                out_specs=(P(axis), P(axis)))))
+
+        fn = cc.get_or_build(key, build)
+        self._jitted[memo] = fn
         return fn
 
     def _pick_jit(self):
@@ -438,18 +559,43 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
                 ids = partition_ids_for_keys(aug, kidx, n)
                 return dk.compact(b, ids == pid)
 
-            self._pick = jax.jit(pick)
+            from spark_rapids_tpu.exec import compile_cache as cc
+            self._pick = cc.instrument(jax.jit(pick))
         return self._pick
 
+    def _outputs_cache_key(self, ctx: ExecCtx) -> tuple:
+        return ("meshex", id(self), ctx.backend)
+
     def _outputs(self, ctx: ExecCtx):
-        return ctx.cached(("meshex", id(self), ctx.backend),
+        return ctx.cached(self._outputs_cache_key(ctx),
                           lambda: self._compute_outputs(ctx))
+
+    def _fallback_outputs(self, ctx: ExecCtx):
+        """Single-device recompute from lineage: the in-process exchange
+        over the same child and keys — also the degenerate path when
+        the mesh never existed or the child produced nothing."""
+        he = self._host_exchange()
+        return ("host", [list(he.partition_iter(ctx, pid))
+                         for pid in range(self._num_parts)])
+
+    def _run_exchange(self, ctx: ExecCtx, mesh, stacked):
+        """Launch the exchange program; a bounded send buffer that
+        overflowed under key skew retries ONCE at worst-case capacity
+        (counted, never truncated — the mesh analog of split-and-retry)."""
+        import numpy as np
+
+        from spark_rapids_tpu.conf import MESH_SEND_CAPACITY
+        send_cap = ctx.conf.get(MESH_SEND_CAPACITY) or None
+        result, flags = self._program(mesh, send_cap)(stacked)
+        if send_cap is not None and bool(
+                np.asarray(jax.device_get(flags)).any()):
+            get_registry().inc("mesh_send_overflows")
+            result, _ = self._program(mesh, None)(stacked)
+        return result
 
     def _compute_outputs(self, ctx: ExecCtx):
         if not ctx.is_device:
-            he = self._host_exchange()
-            return ("host", [list(he.partition_iter(ctx, pid))
-                             for pid in range(self._num_parts)])
+            return self._fallback_outputs(ctx)
         # drain_cached, not drain_partitions: in partitioned mesh-join
         # mode _use_partitioned already drained this subtree for its size
         # probe — share that materialization instead of executing twice
@@ -461,17 +607,13 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
                 _check_slice_fault(ctx, "meshex", mesh)
                 shards = place_shards(batches, self.mesh_size)
                 stacked = shard_batches(shards, mesh, self.axis_name)
-                result = self._program(mesh)(stacked)
-                return ("mesh", unshard_batch(result))
+                _note_a2a_bytes(stacked)
+                result = self._run_exchange(ctx, mesh, stacked)
+                return ("mesh", split_shards(result))
             except Exception as err:
                 _reraise_unless_slice_lost(err)
                 t0 = time.perf_counter()
-        # single-device recompute from lineage: the in-process exchange
-        # over the same child and keys — also the degenerate path when
-        # the mesh never existed or the child produced nothing
-        he = self._host_exchange()
-        out = ("host", [list(he.partition_iter(ctx, pid))
-                        for pid in range(self._num_parts)])
+        out = self._fallback_outputs(ctx)
         if t0 is not None:
             _note_slice_recovery(ctx, time.perf_counter() - t0)
         return out

@@ -1,0 +1,397 @@
+"""Mesh regions: whole pipelines as ONE per-device program, plus the
+mesh-distributed sort.
+
+A mesh *island* (exec/mesh_exec.py) runs one collective operator per
+``shard_map`` program: the planner shards the operator's input, runs the
+program, and splits the output back into per-device batches.  Between
+two islands every batch used to take a host/device-0 round trip — the
+exact gather the pod-scale plan shape must avoid.
+
+A mesh *region* extends the island downward: the contiguous elementwise
+pipeline feeding a collective operator (filter / project / fused stage —
+the same absorbable set as whole-stage fusion, exec/fused.py) is spliced
+INTO the per-device program, so batches are sharded once at the region's
+leaves, flow shard-resident through the member pipeline and the
+collective, and cross the device boundary only at the region's output —
+one compiled executable per (pipeline, collective, mesh shape).
+
+:class:`MeshSortExec` completes the operator set: a global sort (or
+TopN) as a broadcast sort inside ``shard_map`` — all-gather the shard
+rows over ICI, sort the gathered batch per device, and keep each
+device's contiguous slice of the total order (reference: GpuSortExec's
+total-order contract; the reference reaches distributed order via a
+range exchange + per-partition sort, here the gather IS the exchange).
+Device order equals global order, so a downstream limit or collect
+reads partitions in order with zero cross-device traffic; with
+``limit=n`` only device 0 keeps the first n rows (TopN), which a
+``GlobalLimitExec`` above passes through untouched.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.exec.fused import (FusedStageExec, stage_body,
+                                         stage_key_parts)
+from spark_rapids_tpu.exec.mesh_exec import (MeshAggregateExec,
+                                             MeshExchangeExec,
+                                             _MeshOutputMixin,
+                                             _check_slice_fault,
+                                             _note_a2a_bytes,
+                                             _note_slice_recovery,
+                                             _reraise_unless_slice_lost,
+                                             mesh_for, place_shards)
+from spark_rapids_tpu.exec.sortexec import SortExec
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.ops.kernels import gather_columns
+from spark_rapids_tpu.ops.sort import sort_permutation
+from spark_rapids_tpu.parallel.mesh import (local_view, restack,
+                                            shard_batches, shard_map,
+                                            split_shards)
+
+__all__ = ["MeshSortExec", "MeshRegionExec"]
+
+
+class MeshSortExec(_MeshOutputMixin, PlanNode):
+    """Global sort / TopN over the mesh as one broadcast-sort program.
+
+    Per-device body: all-gather every shard's rows and counts, build the
+    segment-aware real-row mask (gathered segments are packed per shard,
+    not globally), run ONE stable multi-operand sort whose leading
+    padding-last flag simultaneously front-packs and orders, then keep
+    this device's slice of the total order — device i holds rows
+    [i*base + min(i, rem), ...), so partition order IS global order.
+    With ``limit`` device 0 keeps the first ``limit`` rows and every
+    other shard is empty.
+
+    Broadcast cost: every device holds all P*cap gathered rows during
+    the sort.  That is the TopN/ORDER-BY-tail shape TPC-H exercises
+    (q2/q3/q10: small post-aggregation row sets); a terabyte-scale sort
+    wants the range-exchange plan the in-process path already has.
+    """
+
+    def __init__(self, orders: Sequence, child: PlanNode, mesh_size: int,
+                 limit: int | None = None, axis_name: str = "data"):
+        from spark_rapids_tpu.exec.sortexec import resolve_orders
+        super().__init__([child])
+        self._orders = resolve_orders(orders, child.output_schema)
+        self.mesh_size = mesh_size
+        self.limit = limit
+        self.axis_name = axis_name
+        self._jitted = {}
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    @property
+    def output_ordering(self):
+        return [self.output_schema.names[o.child_index]
+                for o in self._orders]
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self.mesh_size if ctx.is_device else 1
+
+    # -- fallback ------------------------------------------------------
+    def _single_exec(self) -> SortExec:
+        # built lazily so tree-rewrite passes that replace the child are
+        # picked up; the limit (if any) is enforced by the
+        # GlobalLimitExec the planner keeps above this node
+        return SortExec(list(self._orders), self.children[0],
+                        global_sort=True)
+
+    # -- distributed program -------------------------------------------
+    def _local_step(self):
+        """Per-device body (local view in, local view out) — the unit a
+        MeshRegionExec splices into its shard_map program."""
+        p = self.mesh_size
+        axis = self.axis_name
+        orders = self._orders
+        limit = self.limit
+        schema = self.children[0].output_schema
+
+        def step(b: ColumnBatch) -> ColumnBatch:
+            cap = b.capacity
+            counts = jax.lax.all_gather(b.num_rows, axis)  # int32[P]
+            cols = []
+            for c in b.columns:
+                data = jax.lax.all_gather(c.data, axis, tiled=True)
+                val = jax.lax.all_gather(c.validity, axis, tiled=True)
+                if c.is_string:
+                    ln = jax.lax.all_gather(c.lengths, axis, tiled=True)
+                    cols.append(DeviceColumn(data, val, c.dtype, ln))
+                else:
+                    cols.append(DeviceColumn(data, val, c.dtype))
+            gcap = p * cap
+            idx = jnp.arange(gcap, dtype=jnp.int32)
+            # segment-aware real mask: rows are packed per gathered
+            # shard segment, not globally
+            real = (idx % cap) < counts[idx // cap]
+            total = jnp.sum(counts, dtype=jnp.int32)
+            gb = ColumnBatch(cols, total, schema)
+            perm = sort_permutation(gb, orders, real=real)
+            i = jax.lax.axis_index(axis)
+            if limit is None:
+                # contiguous slice of the total order per device; each
+                # count is <= cap because total <= p*cap
+                base = total // p
+                rem = total % p
+                start = i * base + jnp.minimum(i, rem)
+                cnt = base + (i < rem).astype(jnp.int32)
+                out_cap = cap
+            else:
+                out_cap = round_capacity(max(1, min(limit, gcap)))
+                start = jnp.int32(0)
+                cnt = jnp.where(i == 0,
+                                jnp.minimum(jnp.int32(limit), total),
+                                jnp.int32(0))
+            pick = jnp.clip(start + jnp.arange(out_cap, dtype=jnp.int32),
+                            0, gcap - 1)
+            out_cols = gather_columns(gb.columns, perm[pick], cnt)
+            return ColumnBatch(out_cols, cnt, schema)
+
+        return step
+
+    def _step_key_parts(self) -> tuple:
+        return ("mesh_sort", tuple(self._orders),
+                self.children[0].output_schema, self.limit, self.mesh_size)
+
+    def _program(self, mesh):
+        memo = id(mesh)
+        if memo in self._jitted:
+            return self._jitted[memo]
+        from jax.sharding import PartitionSpec as P
+
+        from spark_rapids_tpu.exec import compile_cache as cc
+        axis = self.axis_name
+        step = self._local_step()
+        key = cc.fragment_key(*self._step_key_parts(),
+                              cc.mesh_key_part(mesh, axis))
+
+        def build():
+            def prog(stacked: ColumnBatch) -> ColumnBatch:
+                return restack(step(local_view(stacked)))
+            return cc.instrument(jax.jit(shard_map(
+                prog, mesh=mesh, in_specs=P(axis), out_specs=P(axis))))
+
+        fn = cc.get_or_build(key, build)
+        self._jitted[memo] = fn
+        return fn
+
+    def _outputs_cache_key(self, ctx: ExecCtx) -> tuple:
+        return ("meshsort", id(self), ctx.backend)
+
+    def _outputs(self, ctx: ExecCtx):
+        return ctx.cached(self._outputs_cache_key(ctx),
+                          lambda: self._compute_outputs(ctx))
+
+    def _fallback_outputs(self, ctx: ExecCtx):
+        """Single-device recompute from lineage: the in-process global
+        sort over the same child — also the degenerate path when the
+        mesh never existed or the child produced nothing."""
+        out = [list(self._single_exec().partition_iter(ctx, 0))]
+        out += [[] for _ in range(self.mesh_size - 1)]
+        return out
+
+    def _compute_outputs(self, ctx: ExecCtx):
+        from spark_rapids_tpu.exec.core import drain_partitions
+        batches = list(drain_partitions(ctx, self.children[0]))
+        mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
+        t0 = None
+        if mesh is not None and batches:
+            try:
+                _check_slice_fault(ctx, "meshsort", mesh)
+                shards = place_shards(batches, self.mesh_size)
+                stacked = shard_batches(shards, mesh, self.axis_name)
+                _note_a2a_bytes(stacked)
+                result = self._program(mesh)(stacked)
+                return [[b] for b in split_shards(result)]
+            except Exception as err:
+                _reraise_unless_slice_lost(err)
+                t0 = time.perf_counter()
+        out = self._fallback_outputs(ctx)
+        if t0 is not None:
+            _note_slice_recovery(ctx, time.perf_counter() - t0)
+        return out
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        if not ctx.is_device:
+            yield from self._single_exec().partition_iter(ctx, pid)
+            return
+        yield from self._aligned(iter(self._outputs(ctx)[pid]))
+
+    def node_desc(self) -> str:
+        lim = f", limit={self.limit}" if self.limit is not None else ""
+        return f"MeshSortExec[mesh={self.mesh_size}, {self._orders}{lim}]"
+
+
+class MeshRegionExec(_MeshOutputMixin, PlanNode):
+    """A contiguous elementwise pipeline + its terminal collective
+    operator, compiled into ONE per-device ``shard_map`` program.
+
+    ``members`` is innermost-first (members[0] consumes the region
+    input); ``terminal`` is a MeshAggregateExec, MeshExchangeExec, or
+    MeshSortExec whose child is members[-1].  Like FusedStageExec, every
+    member and the terminal keep their ORIGINAL child links, so schema /
+    ordering delegation and — critically — lineage-based recovery walk
+    the unfused chain: on a lost mesh slice the terminal's own
+    single-device fallback re-executes the members as ordinary
+    per-batch operators.
+
+    Execution primes the terminal's per-execution output cache and then
+    delegates ``partition_iter`` to the terminal, so its partition
+    serving (exchange partition slicing, alignment, shrink) is reused
+    unchanged.
+    """
+
+    combines_batches = True
+
+    def __init__(self, terminal: PlanNode, members: Sequence[PlanNode]):
+        assert members, "a region needs at least one absorbed member"
+        super().__init__([members[0].children[0]])
+        self._terminal = terminal
+        self._members = tuple(members)
+        # elementary filter/project ops, fused stages unpacked: the
+        # region body and key compose per elementary op
+        flat = []
+        for m in self._members:
+            if isinstance(m, FusedStageExec):
+                flat.extend(m.fused_ops)
+            else:
+                flat.append(m)
+        self._flat = tuple(flat)
+        self.mesh_size = terminal.mesh_size
+        self.axis_name = terminal.axis_name
+        self._jitted = {}
+        # the member chain is the terminal's recovery lineage: after a
+        # lost slice the fallback replays it per batch, so a fused
+        # member must not have donated (deleted) its input buffers
+        for m in self._members:
+            if isinstance(m, FusedStageExec):
+                m.donate_ok = False
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._terminal.output_schema
+
+    @property
+    def output_ordering(self):
+        return self._terminal.output_ordering
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self._terminal.num_partitions(ctx)
+
+    @property
+    def region_ops(self) -> tuple:
+        return self._flat + (self._terminal,)
+
+    # -- program -------------------------------------------------------
+    def _is_exchange(self) -> bool:
+        return isinstance(self._terminal, MeshExchangeExec)
+
+    def _program(self, mesh, send_capacity: int | None = None):
+        memo = (id(mesh), send_capacity)
+        if memo in self._jitted:
+            return self._jitted[memo]
+        from jax.sharding import PartitionSpec as P
+
+        from spark_rapids_tpu.exec import compile_cache as cc
+        axis = self.axis_name
+        body = stage_body(self._flat)
+        if self._is_exchange():
+            tstep = self._terminal._local_step(send_capacity)
+            tparts = self._terminal._step_key_parts(send_capacity)
+        else:
+            tstep = self._terminal._local_step()
+            tparts = self._terminal._step_key_parts()
+        key = cc.fragment_key("mesh_region", stage_key_parts(self._flat),
+                              *tparts, self.children[0].output_schema,
+                              cc.mesh_key_part(mesh, axis))
+
+        def build():
+            if self._is_exchange():
+                def prog(stacked: ColumnBatch):
+                    out, overflow = tstep(body(local_view(stacked)))
+                    return restack(out), restack(overflow)
+                out_specs = (P(axis), P(axis))
+            else:
+                def prog(stacked: ColumnBatch) -> ColumnBatch:
+                    return restack(tstep(body(local_view(stacked))))
+                out_specs = P(axis)
+            return cc.instrument(jax.jit(shard_map(
+                prog, mesh=mesh, in_specs=P(axis), out_specs=out_specs)))
+
+        fn = cc.get_or_build(key, build)
+        self._jitted[memo] = fn
+        return fn
+
+    def _run_exchange(self, ctx: ExecCtx, mesh, stacked):
+        # mirror of MeshExchangeExec._run_exchange over the REGION
+        # program: a bounded send buffer that overflowed under key skew
+        # retries once at worst-case capacity (counted, never truncated)
+        import numpy as np
+
+        from spark_rapids_tpu.conf import MESH_SEND_CAPACITY
+        send_cap = ctx.conf.get(MESH_SEND_CAPACITY) or None
+        result, flags = self._program(mesh, send_cap)(stacked)
+        if send_cap is not None and bool(
+                np.asarray(jax.device_get(flags)).any()):
+            get_registry().inc("mesh_send_overflows")
+            result, _ = self._program(mesh, None)(stacked)
+        return result
+
+    # -- execution -----------------------------------------------------
+    def _ensure(self, ctx: ExecCtx) -> None:
+        ctx.cached(("mesh_region", id(self), ctx.backend),
+                   lambda: self._execute(ctx))
+
+    def _execute(self, ctx: ExecCtx) -> bool:
+        tkey = self._terminal._outputs_cache_key(ctx)
+        from spark_rapids_tpu.exec.core import drain_partitions
+        batches = list(drain_partitions(ctx, self.children[0]))
+        mesh = mesh_for(ctx, self.mesh_size, self.axis_name)
+        t0 = None
+        if mesh is not None and batches:
+            try:
+                _check_slice_fault(ctx, "meshregion", mesh)
+                shards = place_shards(batches, self.mesh_size)
+                stacked = shard_batches(shards, mesh, self.axis_name)
+                _note_a2a_bytes(stacked)
+                if self._is_exchange():
+                    result = self._run_exchange(ctx, mesh, stacked)
+                    ctx.cache[tkey] = ("mesh", split_shards(result))
+                else:
+                    result = self._program(mesh)(stacked)
+                    ctx.cache[tkey] = [[b] for b in split_shards(result)]
+                return True
+            except Exception as err:
+                _reraise_unless_slice_lost(err)
+                t0 = time.perf_counter()
+        # lost slice / no mesh / empty input: the terminal's own
+        # fallback recomputes through the intact member chain
+        ctx.cache[tkey] = self._terminal._fallback_outputs(ctx)
+        if t0 is not None:
+            _note_slice_recovery(ctx, time.perf_counter() - t0)
+        return True
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        if not ctx.is_device:
+            # host backend: the terminal's host path walks the original
+            # member chain as ordinary per-batch operators
+            yield from self._terminal.partition_iter(ctx, pid)
+            return
+        self._ensure(ctx)
+        yield from self._aligned(self._terminal.partition_iter(ctx, pid))
+
+    def node_desc(self) -> str:
+        inner = " -> ".join([op.node_desc() for op in self._members]
+                            + [self._terminal.node_desc()])
+        return (f"MeshRegionExec[mesh={self.mesh_size}, "
+                f"{len(self._flat) + 1} ops: {inner}]")

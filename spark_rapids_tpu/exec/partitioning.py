@@ -26,6 +26,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.compile_cache import guarded_jit
 from spark_rapids_tpu.expr.core import (Expression, bind, eval_device,
                                         eval_host)
 from spark_rapids_tpu.host.batch import HostBatch
@@ -313,7 +314,7 @@ def _host_keys_equal(c, i: int, j: int) -> bool:
     return a == b
 
 
-@partial(jax.jit, static_argnames=("orders",))
+@guarded_jit(static_argnames=("orders",))
 def _jit_sorted(batch: ColumnBatch, orders):
     from spark_rapids_tpu.ops.sort import sort_batch
     return sort_batch(batch, list(orders))

@@ -25,6 +25,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode, RequireSingleBatch
+from spark_rapids_tpu.exec.compile_cache import guarded_jit
 from spark_rapids_tpu.expr.core import (Expression, bind, eval_device,
                                         eval_host, output_name)
 from spark_rapids_tpu.expr import aggregates as A
@@ -503,7 +504,7 @@ def _objs_to_host(data, validity, dtype) -> HostColumn:
     return HostColumn(arr, validity, dtype)
 
 
-@partial(jax.jit, static_argnames=("orders", "part_idx", "order_idx",
+@guarded_jit(static_argnames=("orders", "part_idx", "order_idx",
                                    "input_idx", "wexprs", "nbase", "schema"))
 def _jit_window(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
                 wexprs, nbase: int, schema: T.Schema) -> ColumnBatch:

@@ -94,6 +94,21 @@ def _slice_rows_jit(batch: ColumnBatch, start, count, out_cap: int):
     return dk.take(batch, idx, jnp.asarray(count, jnp.int32))
 
 
+_SHARED_SLICE: dict = {}
+
+
+def _shared_slice():
+    """Split compiles a new executable per (shape, out_cap) right in the
+    middle of an OOM storm, concurrently with other drain threads'
+    compiles; route it through the shared-jit wrapper (which serializes
+    CPU compiles).  Bound lazily — memory/ sits below exec/."""
+    w = _SHARED_SLICE.get("slice")
+    if w is None:
+        from spark_rapids_tpu.exec.compile_cache import instrument
+        w = _SHARED_SLICE.setdefault("slice", instrument(_slice_rows_jit))
+    return w
+
+
 def split_half(batch: ColumnBatch) -> list[ColumnBatch]:
     """Split a front-packed batch into two row-contiguous halves, each
     at its own right-sized pow2 capacity (reference
@@ -102,11 +117,12 @@ def split_half(batch: ColumnBatch) -> list[ColumnBatch]:
     if n <= 1:
         raise SplitAndRetryOOM(f"cannot split a {n}-row batch further")
     h = (n + 1) // 2
-    lo = _slice_rows_jit(batch, dk.device_scalar(0), dk.device_scalar(h),
-                         round_capacity(h))
-    hi = _slice_rows_jit(batch, dk.device_scalar(h),
-                         dk.device_scalar(n - h),
-                         round_capacity(max(n - h, 1)))
+    slice_rows = _shared_slice()
+    lo = slice_rows(batch, dk.device_scalar(0), dk.device_scalar(h),
+                    round_capacity(h))
+    hi = slice_rows(batch, dk.device_scalar(h),
+                    dk.device_scalar(n - h),
+                    round_capacity(max(n - h, 1)))
     # the jit boundary strips known_rows; the halves' counts are host
     # facts here, so restore them (metrics then never double-count a
     # split: each half reports its own exact rows)

@@ -123,7 +123,26 @@ def shrink_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:
     """
     if batch.capacity <= cap:
         return batch
-    return _shrink_jit(batch, cap)
+    return _shared("shrink", _shrink_jit)(batch, cap)
+
+
+_SHARED_JITS: dict = {}
+
+
+def _shared(name: str, fn):
+    """Compile-accounted wrapper for a capacity-changing kernel.
+
+    These two kernels compile NEW executables mid-query (every distinct
+    capacity is a fresh signature, and spill/retry storms churn
+    capacities across drain threads), so they go through the shared-jit
+    wrapper, which serializes CPU compiles process-wide.  kernels sits
+    below exec/, hence the wrapper is bound lazily on first dispatch
+    instead of imported at module load."""
+    w = _SHARED_JITS.get(name)
+    if w is None:
+        from spark_rapids_tpu.exec.compile_cache import instrument
+        w = _SHARED_JITS.setdefault(name, instrument(fn))
+    return w
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -143,7 +162,7 @@ def pad_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:
     (cheap realloc; keeps compilation buckets canonical)."""
     if cap <= batch.capacity:
         return batch
-    return _pad_jit(batch, cap)
+    return _shared("pad", _pad_jit)(batch, cap)
 
 
 @partial(jax.jit, static_argnames=("cap",))

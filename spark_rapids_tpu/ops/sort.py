@@ -102,10 +102,18 @@ def encode_key_operands(col: DeviceColumn, ascending: bool = True) -> list[jax.A
     return [col.data] if ascending else [~col.data]
 
 
-def sort_permutation(batch: ColumnBatch, orders: list[SortOrder]) -> jax.Array:
-    """Return the permutation (int32[capacity]) that sorts the batch."""
+def sort_permutation(batch: ColumnBatch, orders: list[SortOrder],
+                     real: jax.Array | None = None) -> jax.Array:
+    """Return the permutation (int32[capacity]) that sorts the batch.
+
+    ``real`` overrides the front-packed ``row_mask()`` real-row
+    indicator — a mesh broadcast sort (exec/mesh_region.py) all-gathers
+    P shard segments whose rows are packed per SEGMENT, not globally,
+    so the caller supplies the segment-aware mask and the sort's
+    padding-last flag simultaneously front-packs and orders."""
     cap = batch.capacity
-    real = batch.row_mask()
+    if real is None:
+        real = batch.row_mask()
     operands: list[jax.Array] = [(~real).astype(jnp.uint8)]  # padding last
     for o in orders:
         col = batch.columns[o.child_index]

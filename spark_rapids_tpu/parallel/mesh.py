@@ -36,8 +36,8 @@ except AttributeError:  # pragma: no cover - version dependent
     from jax.experimental.shard_map import shard_map as _exp_shard_map
     shard_map = _partial(_exp_shard_map, check_rep=False)
 
-__all__ = ["make_mesh", "shard_batches", "unshard_batch", "local_view",
-           "stacked_spec", "shard_map"]
+__all__ = ["make_mesh", "shard_batches", "unshard_batch", "split_shards",
+           "local_view", "stacked_spec", "shard_map"]
 
 
 def make_mesh(n_devices: int | None = None, axis_name: str = "data",
@@ -96,6 +96,29 @@ def local_view(stacked: ColumnBatch) -> ColumnBatch:
 def restack(local: ColumnBatch) -> ColumnBatch:
     """Inside shard_map: re-add the leading device axis before returning."""
     return jax.tree_util.tree_map(lambda x: x[None], local)
+
+
+def split_shards(stacked: ColumnBatch) -> list[ColumnBatch]:
+    """Split a sharded batch into P per-device ColumnBatches WITHOUT a
+    host round trip: each shard's arrays stay committed to the mesh
+    device that produced them.  This is the region-boundary exit path —
+    ``unshard_batch`` (device_get + re-upload) implicitly funneled every
+    mesh output through the default device, re-serializing the
+    distributed pipeline at each island boundary.  Downstream per-batch
+    operators dispatch on the shard's own device; ``place_shards``
+    device affinity keeps re-sharded batches where they already live."""
+    leaves, treedef = jax.tree_util.tree_flatten(stacked)
+    per_dev: list[list] = []
+    for leaf in leaves:
+        shards = sorted(leaf.addressable_shards, key=lambda s: s.index[0].start)
+        # s.data has the leading extent-1 device axis; [0] squeezes it
+        # ON the shard's device (jax keeps slicing on the operand's
+        # device, and the result stays committed there)
+        per_dev.append([s.data[0] for s in shards])
+    p = len(per_dev[0]) if per_dev else 1
+    return [jax.tree_util.tree_unflatten(treedef,
+                                         [col[i] for col in per_dev])
+            for i in range(p)]
 
 
 def unshard_batch(stacked: ColumnBatch) -> list[ColumnBatch]:

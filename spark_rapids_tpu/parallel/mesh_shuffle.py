@@ -43,6 +43,7 @@ from spark_rapids_tpu.parallel.mesh import local_view, restack, shard_map
 __all__ = [
     "partition_ids_for_keys", "make_hash_exchange",
     "make_distributed_groupby", "MERGE_OPS",
+    "exchange_local", "exchange_local_checked", "canonicalize",
 ]
 
 
@@ -66,36 +67,48 @@ def partition_ids_for_keys(batch: ColumnBatch, key_indices: Sequence[int],
     return jnp.where(mask, pid, num_parts)
 
 
-def _bucketize(batch: ColumnBatch, part: jax.Array, num_parts: int):
-    """Split into [P, C] per-column send buffers + int32[P] counts."""
+def _bucketize(batch: ColumnBatch, part: jax.Array, num_parts: int,
+               send_capacity: int | None = None):
+    """Split into [P, C] per-column send buffers + int32[P] counts.
+
+    ``send_capacity`` bounds C below the full shard capacity (the
+    static worst case where every row targets one destination).  Rows
+    beyond a destination's C would scatter out of bounds — the caller
+    MUST check the returned counts against C (``exchange_local_checked``
+    surfaces an overflow flag) instead of letting ``mode="drop"``
+    silently truncate them."""
     cap = batch.capacity
     counts = jnp.sum(part[None, :] == jnp.arange(num_parts, dtype=jnp.int32)[:, None],
                      axis=1, dtype=jnp.int32)
+    C = cap if send_capacity is None else min(send_capacity, cap)
+    overflow = jnp.any(counts > C)
     order = jnp.argsort(part, stable=True)       # padding (P) sinks to end
     sorted_part = part[order]
     starts = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
     rank = jnp.arange(cap, dtype=jnp.int32) - \
         starts[jnp.clip(sorted_part, 0, num_parts - 1)]
-    dest = (sorted_part, rank)  # index (P, C); sorted_part==P drops
+    dest = (sorted_part, rank)  # index (P, C); sorted_part==P or rank>=C drops
 
     send_cols = []
     for c in batch.columns:
         data_s = c.data[order]
         val_s = c.validity[order]
         if c.is_string:
-            d = jnp.zeros((num_parts, cap, c.max_len), c.data.dtype
+            d = jnp.zeros((num_parts, C, c.max_len), c.data.dtype
                           ).at[dest].set(data_s, mode="drop")
-            ln = jnp.zeros((num_parts, cap), jnp.int32
+            ln = jnp.zeros((num_parts, C), jnp.int32
                            ).at[dest].set(c.lengths[order], mode="drop")
         else:
-            d = jnp.zeros((num_parts, cap), c.data.dtype
+            d = jnp.zeros((num_parts, C), c.data.dtype
                           ).at[dest].set(data_s, mode="drop")
             ln = None
-        v = jnp.zeros((num_parts, cap), jnp.bool_
+        v = jnp.zeros((num_parts, C), jnp.bool_
                       ).at[dest].set(val_s, mode="drop")
         send_cols.append((d, v, ln))
-    return send_cols, counts
+    # clamp so _repack's receive mask never counts rows the bounded
+    # buffer could not carry; the overflow flag is the loud signal
+    return send_cols, jnp.minimum(counts, C), overflow
 
 
 def _repack(schema: T.Schema, recv_cols, recv_counts: jax.Array,
@@ -128,14 +141,34 @@ def exchange_local(batch: ColumnBatch, part: jax.Array, num_parts: int,
     device).  The reference's analogs of these three phases are
     contiguousSplit -> UCX tag send/recv -> BufferReceiveState reassembly.
     """
-    send_cols, counts = _bucketize(batch, part, num_parts)
+    out, _ = exchange_local_checked(batch, part, num_parts, axis_name)
+    return out
+
+
+def exchange_local_checked(batch: ColumnBatch, part: jax.Array,
+                           num_parts: int, axis_name: str,
+                           send_capacity: int | None = None):
+    """``exchange_local`` with a bounded [P, C] send buffer and a loud
+    overflow signal.
+
+    Returns ``(batch, overflow)``: ``overflow`` is a device bool that is
+    True on any shard where one destination received more than C rows —
+    those rows did NOT travel, and the caller must retry at worst-case
+    capacity (mesh_exec.py degrades exactly like the OOM split-and-retry
+    ladder: detect, never truncate, re-run with room).  With
+    ``send_capacity=None`` C is the shard capacity and overflow is
+    statically impossible."""
+    send_cols, counts, overflow = _bucketize(batch, part, num_parts,
+                                             send_capacity)
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name,
                   split_axis=0, concat_axis=0, tiled=True)
     recv_counts = a2a(counts)
     recv_cols = [(a2a(d), a2a(v), a2a(ln) if ln is not None else None)
                  for (d, v, ln) in send_cols]
+    C = batch.capacity if send_capacity is None \
+        else min(send_capacity, batch.capacity)
     return _repack(batch.schema, recv_cols, recv_counts, num_parts,
-                   batch.capacity)
+                   C), overflow
 
 
 def canonicalize(batch: ColumnBatch) -> ColumnBatch:
@@ -166,7 +199,8 @@ def make_hash_exchange(mesh: Mesh, schema: T.Schema,
 
     mapped = shard_map(step, mesh=mesh, in_specs=P(axis_name),
                            out_specs=P(axis_name))
-    return jax.jit(mapped)
+    from spark_rapids_tpu.exec.compile_cache import instrument
+    return instrument(jax.jit(mapped))
 
 
 # Merge-side op per update op (reference: CudfAggregate mergeAggregate,
@@ -227,4 +261,5 @@ def make_distributed_groupby(mesh: Mesh, schema: T.Schema,
 
     mapped = shard_map(step, mesh=mesh, in_specs=P(axis_name),
                            out_specs=P(axis_name))
-    return jax.jit(mapped)
+    from spark_rapids_tpu.exec.compile_cache import instrument
+    return instrument(jax.jit(mapped))

@@ -104,10 +104,33 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         return PlannedNode(ex, exprs, metas)
     if isinstance(node, L.Sort):
         c = lower(node.child, conf)
-        ex = SortExec(node.orders, c.exec_node, global_sort=True)
+        orders = _mesh_sort_orders(node.orders, c.exec_node, conf)
+        if orders is not None:
+            from spark_rapids_tpu.exec.mesh_region import MeshSortExec
+            ex = MeshSortExec(orders, c.exec_node, conf.mesh_device_count)
+        else:
+            ex = SortExec(node.orders, c.exec_node, global_sort=True)
         return PlannedNode(ex, [], [c])
     if isinstance(node, L.Limit):
-        c = lower(node.child, conf)
+        if isinstance(node.child, L.Sort):
+            # ORDER BY + LIMIT under the mesh: distributed TopN — the
+            # broadcast sort keeps only the first n rows on device 0,
+            # and the GlobalLimitExec above drains partitions in order
+            # so the result passes through with no cross-device gather
+            sc = lower(node.child.child, conf)
+            orders = _mesh_sort_orders(node.child.orders, sc.exec_node,
+                                       conf)
+            if orders is not None:
+                from spark_rapids_tpu.exec.mesh_region import MeshSortExec
+                ms = MeshSortExec(orders, sc.exec_node,
+                                  conf.mesh_device_count, limit=node.n)
+                smeta = PlannedNode(ms, [], [sc])
+                return PlannedNode(GlobalLimitExec(node.n, ms), [],
+                                   [smeta])
+            c = PlannedNode(SortExec(node.child.orders, sc.exec_node,
+                                     global_sort=True), [], [sc])
+        else:
+            c = lower(node.child, conf)
         return PlannedNode(GlobalLimitExec(node.n, c.exec_node), [], [c])
     if isinstance(node, L.Union):
         cs = [lower(i, conf) for i in node.inputs]
@@ -217,6 +240,19 @@ def _cluster_on_keys(c: PlannedNode, keys: list, conf: TpuConf,
     part = HashPartitioning(list(keys), conf.shuffle_partitions)
     exch = ShuffleExchangeExec(part, c.exec_node)
     return PlannedNode(exch, list(keys), [c])
+
+
+def _mesh_sort_orders(orders, exec_node: PlanNode, conf: TpuConf):
+    """Resolved SortOrders when this sort can run as a mesh broadcast
+    sort, else None (non-column sort keys, array payloads, or no mesh
+    configured keep the in-process global sort)."""
+    if conf.mesh_device_count <= 1 or _schema_has_arrays(exec_node):
+        return None
+    from spark_rapids_tpu.exec.sortexec import resolve_orders
+    try:
+        return resolve_orders(orders, exec_node.output_schema)
+    except Exception:  # noqa: BLE001 - any unresolvable key falls back
+        return None
 
 
 def _schema_has_arrays(*nodes: PlanNode) -> bool:
@@ -482,6 +518,7 @@ class TpuOverrides:
         if self.conf.test_enabled:
             self._assert_on_tpu(root)
         self._fuse_stages(root)
+        self._form_mesh_regions(root)
         return root.exec_node
 
     def _fuse_stages(self, root: PlannedNode) -> None:
@@ -569,6 +606,67 @@ class TpuOverrides:
             if isinstance(node, FusedStageExec) and \
                     not exclusive(node.children[0], set()):
                 node.donate_ok = False
+
+    def _form_mesh_regions(self, root: PlannedNode) -> None:
+        """Grow each mesh collective (aggregate / exchange / sort)
+        downward into a MeshRegionExec absorbing the contiguous
+        elementwise pipeline below it — the absorbable set is exactly
+        whole-stage fusion's (filter / non-partition-aware project /
+        FusedStageExec), so this pass composes with ``_fuse_stages``:
+        an already-fused stage is spliced into the per-device program
+        as one body (exec/mesh_region.py).
+
+        Runs after fusion on the realized exec tree: transitions and
+        coalesces are placed, so an absorbable run can never cross a
+        backend switch.  Members keep their original child links
+        (lineage recovery and host fallback replay them per batch);
+        ``mesh_regions`` counts formed regions at plan time."""
+        from spark_rapids_tpu.conf import MESH_REGIONS_ENABLED
+        if self.conf.mesh_device_count <= 1 or \
+                not self.conf.get(MESH_REGIONS_ENABLED):
+            return
+        from spark_rapids_tpu.exec.fused import FusedStageExec, fusible
+        from spark_rapids_tpu.exec.mesh_exec import (MeshAggregateExec,
+                                                     MeshExchangeExec)
+        from spark_rapids_tpu.exec.mesh_region import (MeshRegionExec,
+                                                       MeshSortExec)
+        from spark_rapids_tpu.obs.registry import get_registry
+        terminals = (MeshAggregateExec, MeshExchangeExec, MeshSortExec)
+        done: dict[int, PlanNode] = {}
+
+        def absorbable(n: PlanNode) -> bool:
+            return fusible(n) or type(n) is FusedStageExec
+
+        def walk(node: PlanNode) -> PlanNode:
+            got = done.get(id(node))
+            if got is not None:
+                return got
+            if type(node) in terminals:
+                run = []  # outermost-first members below the terminal
+                cur = node.children[0]
+                while absorbable(cur):
+                    run.append(cur)
+                    cur = cur.children[0]
+                if run:
+                    below = walk(cur)
+                    members = list(reversed(run))  # innermost-first
+                    if below is not cur:
+                        members[0].children = (below,)
+                    region = MeshRegionExec(node, members)
+                    # the terminal now yields through the region, which
+                    # owns the mesh->single-device boundary
+                    region.align_output = node.align_output
+                    node.align_output = False
+                    get_registry().inc("mesh_regions")
+                    done[id(node)] = region
+                    return region
+            new_children = tuple(walk(c) for c in node.children)
+            if any(a is not b for a, b in zip(new_children, node.children)):
+                node.children = new_children
+            done[id(node)] = node
+            return node
+
+        root.exec_node = walk(root.exec_node)
 
     def apply(self, root: PlannedNode) -> PlanNode:
         return self.prepare(root, explain=True)

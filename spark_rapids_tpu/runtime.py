@@ -17,7 +17,8 @@ import os
 
 from spark_rapids_tpu.conf import ConfEntry, register, _bool
 
-__all__ = ["enable_compilation_cache", "ensure_runtime"]
+__all__ = ["enable_compilation_cache", "ensure_runtime",
+           "widen_thread_stacks"]
 
 def _cache_mode(v) -> str:
     s = str(v).strip().lower()
@@ -47,6 +48,56 @@ COMPILATION_CACHE_DIR = register(ConfEntry(
 _enabled_dir: str | None = None
 _arrow_pinned = False
 _pinned_arena = None
+_stacks_widened = False
+
+
+_cpu_sync_dispatch = False
+
+
+def sync_cpu_dispatch() -> None:
+    """Make XLA:CPU dispatch synchronous.
+
+    With async dispatch (jax's default) an execution keeps running on
+    the backend's internal thread pool after the python call returns;
+    a compile starting on another engine thread then overlaps it, and
+    this XLA build segfaults intermittently inside ``backend_compile``
+    under exactly that overlap (same family as the pyarrow pool races
+    pinned away in ``pin_arrow_threads``).  Synchronous dispatch closes
+    the window; the engine's drain pool supplies the parallelism
+    instead, so CPU throughput is unaffected.  Called once, when the
+    compile cache first observes the CPU backend.
+    """
+    global _cpu_sync_dispatch
+    if _cpu_sync_dispatch:
+        return
+    try:
+        import jax
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:
+        pass
+    _cpu_sync_dispatch = True
+
+
+def widen_thread_stacks(size: int = 64 * 1024 * 1024) -> None:
+    """Deepen the stack of engine-created worker threads.
+
+    XLA:CPU compilation (LLVM's recursive optimizer passes) can exceed
+    the default 8 MiB pthread stack when a drain worker hits a new
+    executable mid-query; the overflow lands as a bare SIGSEGV inside
+    ``backend_compile``.  The stack is virtual address space committed
+    lazily, so a deep reserve costs nothing.  ``threading.stack_size``
+    only applies to threads created AFTER the call, so this runs at
+    exec-layer import, before the first drain pool exists.
+    """
+    global _stacks_widened
+    if _stacks_widened:
+        return
+    try:
+        import threading
+        threading.stack_size(size)
+    except (ValueError, RuntimeError, OverflowError):
+        pass
+    _stacks_widened = True
 
 
 def get_pinned_arena(size: int):

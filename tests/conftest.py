@@ -24,9 +24,17 @@ except ImportError:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# sync dispatch: async executions on XLA's native pool racing a compile
+# on an engine thread segfault this XLA build (runtime.sync_cpu_dispatch)
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run")
 
 
 @pytest.fixture

@@ -1,0 +1,406 @@
+"""Pod-scale execution: mesh regions, distributed sort, and the
+multichip equality gate.
+
+The tentpole contract (ISSUE 7): a plan under
+``spark.rapids.tpu.mesh.deviceCount=N`` runs whole pipelines
+shard-resident — contiguous scan->filter->project->aggregate/exchange/
+sort pipelines compile into ONE per-device ``shard_map`` program
+(exec/mesh_region.py), batches cross the device boundary only at region
+edges, and results are EXACTLY the single-device plan's.  These tests
+pin that contract on the virtual 8-device CPU mesh:
+
+* TPC-H q1/q3/q6/q12/q13/q18 mesh-vs-single equality at deviceCount
+  2/4/8 (q13 string-heavy, q18 high-skew);
+* q3 under deviceCount=8 moves ZERO ``mesh_gather_fallbacks`` between
+  region members and renders MeshRegionExec + counters in EXPLAIN
+  ANALYZE;
+* compile-cache fragment keys are mesh-shape-aware (mesh-2 and mesh-4
+  never share an executable; single-chip keys carry no mesh part);
+* a killed mesh slice mid-query recovers to exact rows with exactly
+  one stage recompute;
+* a bounded [P, C] send buffer that overflows under key skew degrades
+  into a counted retry at worst-case capacity — never a truncation.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+MESH8 = {"spark.rapids.tpu.mesh.deviceCount": 8}
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType(), True),
+    T.StructField("g", T.StringType(), True),
+    T.StructField("v", T.LongType(), True),
+    T.StructField("f", T.DoubleType(), True),
+])
+
+
+def _data(rng, n=400, nkeys=17):
+    return {
+        "k": rng.integers(0, nkeys, n).astype(np.int32),
+        "g": np.array([f"g{int(x) % 5}" for x in rng.integers(0, 50, n)],
+                      dtype=object),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        "f": rng.normal(size=n),
+    }
+
+
+def _classes(node):
+    out = [type(node).__name__]
+    for c in node.children:
+        out.extend(_classes(c))
+    return out
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+def _executed_plan(df):
+    """The REALIZED exec tree (post fusion + region formation) — the
+    meta-tree explain() renders the pre-region operators."""
+    ov, meta = df._overridden(quiet=True)
+    return meta.exec_node
+
+
+# ---------------------------------------------------------------------------
+# TPC-H mesh-vs-single equality gate
+# ---------------------------------------------------------------------------
+
+# q1 (wide agg) and q13 (string-heavy) take minutes under the 8-way
+# virtual mesh on one physical CPU, so like the 2/4-device rungs they
+# run in the full (premerge) suite; the 8-device q3/q6/q12/q18 rungs
+# are the tier-1 gate
+GATE_QUERIES = (
+    pytest.param("q1", marks=pytest.mark.slow),
+    "q3", "q6", "q12",
+    pytest.param("q13", marks=pytest.mark.slow),
+    "q18",
+)
+DEVICE_COUNTS = (
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow),
+    8,
+)
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    d = str(tmp_path_factory.mktemp("tpch_mesh") / "sf001")
+    generate_tpch(d, sf=0.01)
+    return d
+
+
+@pytest.fixture(scope="module")
+def single_device_rows(tpch_dir):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    cache = {}
+
+    def get(query):
+        if query not in cache:
+            s = TpuSession({})
+            cache[query] = build_tpch_query(query, s, tpch_dir).collect()
+        return cache[query]
+    return get
+
+
+@pytest.mark.parametrize("devices", DEVICE_COUNTS)
+@pytest.mark.parametrize("query", GATE_QUERIES)
+def test_tpch_mesh_matches_single_device(tpch_dir, single_device_rows,
+                                         query, devices):
+    from spark_rapids_tpu.bench.runner import _rows_match
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    s = TpuSession({"spark.rapids.tpu.mesh.deviceCount": devices})
+    got = build_tpch_query(query, s, tpch_dir).collect()
+    want = single_device_rows(query)
+    assert len(got) == len(want), (query, devices, len(got), len(want))
+    assert _rows_match(got, want, strict=True), (query, devices)
+
+
+def test_q3_mesh8_zero_gather_fallbacks(tpch_dir, single_device_rows):
+    """Acceptance: full q3 under deviceCount=8 stays region-resident —
+    no batch is gathered to the default device between region members,
+    verified through the counter EXPLAIN ANALYZE surfaces."""
+    from spark_rapids_tpu.bench.runner import _rows_match
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.exec.core import (ExecCtx, _rows_from_host,
+                                            device_to_host)
+    from spark_rapids_tpu.plan.overrides import explain_analyze
+    s = TpuSession(MESH8)
+    df = build_tpch_query("q3", s, tpch_dir)
+    b0 = get_registry().snapshot()
+    plan = _executed_plan(df)
+    assert get_registry().delta(b0)["counters"].get("mesh_regions", 0) >= 1
+    assert "MeshRegionExec" in _classes(plan)
+    b1 = get_registry().snapshot()
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        rows = []
+        for b in plan.execute(ctx):
+            rows.extend(_rows_from_host(device_to_host(b)))
+        analyzed = explain_analyze(plan, ctx)
+    delta = get_registry().delta(b1)["counters"]
+    assert delta.get("mesh_gather_fallbacks", 0) == 0, delta
+    assert "MeshRegionExec" in analyzed
+    assert "counters:" in analyzed and "mesh_regions" in analyzed
+    assert _rows_match(rows, single_device_rows("q3"), strict=True)
+
+
+# ---------------------------------------------------------------------------
+# region formation + plan shape
+# ---------------------------------------------------------------------------
+
+def test_region_absorbs_filter_into_aggregate(rng):
+    s = TpuSession(MESH8)
+    df = s.from_pydict(_data(rng), SCHEMA, partitions=4) \
+        .where(col("v") > 0).group_by("k") \
+        .agg(Sum(col("v")).alias("sv"), CountStar().alias("n"))
+    plan = _executed_plan(df)
+    names = _classes(plan)
+    assert "MeshRegionExec" in names
+    # the filter is a region member, not a tree node above the scan
+    assert "FilterExec" not in names
+    plain = TpuSession({}).from_pydict(_data(rng), SCHEMA, partitions=4)
+    region = next(n for n in _walk(plan)
+                  if type(n).__name__ == "MeshRegionExec")
+    assert "MeshAggregateExec" in region.node_desc()
+
+
+def test_regions_disabled_keeps_island_shape_and_rows(rng):
+    data = _data(rng)
+    son = TpuSession(MESH8)
+    soff = TpuSession({**MESH8,
+                       "spark.rapids.tpu.mesh.regions.enabled": "false"})
+
+    def q(s):
+        return s.from_pydict(data, SCHEMA, partitions=4) \
+            .where(col("v") > 0).group_by("k") \
+            .agg(Sum(col("v")).alias("sv"))
+
+    assert "MeshRegionExec" in _classes(_executed_plan(q(son)))
+    off_names = _classes(_executed_plan(q(soff)))
+    assert "MeshRegionExec" not in off_names
+    assert sorted(q(son).collect()) == sorted(q(soff).collect())
+
+
+def test_mesh_devicecount_zero_restores_single_chip_plan(rng):
+    data = _data(rng)
+    plain = TpuSession({}).from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") > 0).group_by("k").agg(Sum(col("v")).alias("sv")) \
+        .order_by(("sv", False)).limit(5)
+    zero = TpuSession({"spark.rapids.tpu.mesh.deviceCount": 0}) \
+        .from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") > 0).group_by("k").agg(Sum(col("v")).alias("sv")) \
+        .order_by(("sv", False)).limit(5)
+    assert _classes(_executed_plan(plain)) == _classes(_executed_plan(zero))
+    assert plain.collect() == zero.collect()
+
+
+# ---------------------------------------------------------------------------
+# mesh sort / TopN
+# ---------------------------------------------------------------------------
+
+def test_mesh_sort_total_order_matches_plain(rng):
+    data = _data(rng)
+    sm, sp = TpuSession(MESH8), TpuSession({})
+    dfm = sm.from_pydict(data, SCHEMA, partitions=4) \
+        .order_by("v", ("k", False), "g")
+    dfp = sp.from_pydict(data, SCHEMA, partitions=4) \
+        .order_by("v", ("k", False), "g")
+    assert "MeshSortExec" in dfm.explain()
+    got, want = dfm.collect(), dfp.collect()
+    assert got == want and len(got) == 400
+
+
+@pytest.mark.parametrize("limit", [5, 64, 10_000])
+def test_mesh_topn_matches_plain(rng, limit):
+    """limit < rows, limit spanning shard boundaries, limit > rows."""
+    data = _data(rng)
+    sm, sp = TpuSession(MESH8), TpuSession({})
+
+    def q(s):
+        return s.from_pydict(data, SCHEMA, partitions=4) \
+            .where(col("v") > 0) \
+            .order_by(("v", False), "k").limit(limit)
+
+    assert "MeshSortExec" in q(sm).explain()
+    assert q(sm).collect() == q(sp).collect()
+
+
+def test_mesh_topn_output_no_gather(rng):
+    """TopN keeps its rows on device 0: serving the limit moves nothing
+    across devices."""
+    data = _data(rng)
+    s = TpuSession(MESH8)
+    df = s.from_pydict(data, SCHEMA, partitions=4) \
+        .order_by(("v", False)).limit(7)
+    b0 = get_registry().snapshot()
+    rows = df.collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert len(rows) == 7
+    assert delta.get("mesh_gather_fallbacks", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# compile cache: mesh-shape-aware fragment keys
+# ---------------------------------------------------------------------------
+
+def test_mesh_key_part_distinguishes_mesh_shapes():
+    from spark_rapids_tpu.exec import compile_cache as cc
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    assert cc.fragment_key("frag", ("x",), cc.mesh_key_part(2, "data")) != \
+        cc.fragment_key("frag", ("x",), cc.mesh_key_part(4, "data"))
+    m2, m4 = make_mesh(2), make_mesh(4)
+    assert cc.mesh_key_part(m2, "data") != cc.mesh_key_part(m4, "data")
+    assert cc.fragment_key("frag", cc.mesh_key_part(m2, "data")) != \
+        cc.fragment_key("frag", cc.mesh_key_part(m4, "data"))
+
+
+def test_single_chip_fragment_keys_carry_no_mesh_part(rng):
+    """The mesh key component lives ONLY in mesh program keys:
+    single-chip fused-stage keys are byte-stable across sessions and
+    mesh confs, so this PR cannot fragment the existing cache."""
+    from spark_rapids_tpu.exec.fused import FusedStageExec
+
+    def stage(s):
+        df = s.from_pydict(_data(rng), SCHEMA, partitions=2) \
+            .where(col("v") > 0).select(col("k"), (col("v") * 2).alias("w"))
+        plan = _executed_plan(df)
+        return next(n for n in _walk(plan)
+                    if isinstance(n, FusedStageExec))
+
+    k_plain = stage(TpuSession({}))._stage_key(True)
+    k_plain2 = stage(TpuSession({}))._stage_key(True)
+    assert k_plain == k_plain2
+
+
+def test_region_programs_cached_per_mesh_shape(rng):
+    """Warm rerun at a FIXED mesh shape compiles nothing; changing the
+    mesh shape misses (mesh-2 and mesh-4 must not share executables)."""
+    data = _data(rng)
+
+    def run(n):
+        s = TpuSession({"spark.rapids.tpu.mesh.deviceCount": n})
+        return s.from_pydict(data, SCHEMA, partitions=4) \
+            .where(col("v") > 0).group_by("k") \
+            .agg(Sum(col("v")).alias("sv")).collect()
+
+    base = run(4)                       # cold at mesh-4
+    b0 = get_registry().snapshot()
+    assert run(4) == base               # warm at mesh-4
+    warm = get_registry().delta(b0)["counters"]
+    assert warm.get("compile_count", 0) == 0, warm
+    b1 = get_registry().snapshot()
+    assert sorted(run(2)) == sorted(base)   # mesh-2: new mesh shape
+    cold2 = get_registry().delta(b1)["counters"]
+    assert cold2.get("compile_count", 0) >= 1, cold2
+
+
+# ---------------------------------------------------------------------------
+# chaos: lost mesh slice under a region
+# ---------------------------------------------------------------------------
+
+def test_region_slice_lost_recovers_exact_once(rng):
+    """Kill a mesh slice mid-query: rows must be EXACTLY the plain
+    plan's, recovered through exactly one region-level recompute."""
+    from spark_rapids_tpu.exec.core import (ExecCtx, _rows_from_host,
+                                            device_to_host)
+    data = _data(rng)
+    s = TpuSession({**MESH8,
+                    "spark.rapids.test.faults":
+                    "mesh.slice.lost:lost,op=meshregion,times=1"})
+    df = s.from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") > 0).group_by("k") \
+        .agg(Sum(col("v")).alias("sv"), CountStar().alias("n"))
+    plan = _executed_plan(df)
+    assert "MeshRegionExec" in _classes(plan)
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        rows = []
+        for b in plan.execute(ctx):
+            rows.extend(_rows_from_host(device_to_host(b)))
+        metrics = dict(ctx.catalog.metrics)
+    assert metrics.get("stage_recomputes", 0) == 1, metrics
+    assert metrics.get("recovery_wall_s", 0) > 0
+    plain = TpuSession({}).from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") > 0).group_by("k") \
+        .agg(Sum(col("v")).alias("sv"), CountStar().alias("n"))
+    assert sorted(rows) == sorted(plain.collect())
+
+
+# ---------------------------------------------------------------------------
+# bounded [P, C] send buffers: overflow degrades, never truncates
+# ---------------------------------------------------------------------------
+
+def _skewed(n=300):
+    # every row hashes to ONE destination: the worst case for a
+    # bounded per-target send buffer
+    return {
+        "k": np.full(n, 7, np.int32),
+        "g": np.array([f"s{i % 3}" for i in range(n)], dtype=object),
+        "v": np.arange(n, dtype=np.int64),
+        "f": np.linspace(0.0, 1.0, n),
+    }
+
+
+def test_send_capacity_overflow_degrades_into_retry():
+    data = _skewed()
+    s = TpuSession({**MESH8,
+                    "spark.rapids.tpu.mesh.exchange.sendCapacityRows": 4})
+    df = s.from_pydict(data, SCHEMA, partitions=4).repartition(8, "k")
+    b0 = get_registry().snapshot()
+    rows = df.collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert delta.get("mesh_send_overflows", 0) >= 1, delta
+    plain = TpuSession({}).from_pydict(data, SCHEMA, partitions=4).collect()
+    assert sorted(rows) == sorted(plain)
+
+
+def test_send_capacity_default_never_overflows(rng):
+    s = TpuSession(MESH8)
+    df = s.from_pydict(_skewed(), SCHEMA, partitions=4).repartition(8, "k")
+    b0 = get_registry().snapshot()
+    rows = df.collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert delta.get("mesh_send_overflows", 0) == 0, delta
+    assert len(rows) == 300
+
+
+# ---------------------------------------------------------------------------
+# split_shards: region boundary batches stay device-resident
+# ---------------------------------------------------------------------------
+
+def test_split_shards_keeps_batches_on_their_devices():
+    import jax
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.exec.core import ExecCtx, device_to_host
+    from spark_rapids_tpu.exec.mesh_exec import place_shards
+    from spark_rapids_tpu.parallel.mesh import (make_mesh, shard_batches,
+                                                split_shards)
+    data = {"k": list(range(64)), "s": [f"v{i % 7}" for i in range(64)]}
+    schema = T.Schema([T.StructField("k", T.LongType()),
+                       T.StructField("s", T.StringType())])
+    scan = LocalScanExec.from_pydict(data, schema, 1, 16)
+    with ExecCtx(backend="device") as ctx:
+        batches = list(scan.partition_iter(ctx, 0))
+    mesh = make_mesh(4)
+    shards = place_shards(batches, 4)
+    out = split_shards(shard_batches(shards, mesh))
+    assert len(out) == 4
+    devs = []
+    for b in out:
+        assert b.columns[0].data.committed
+        (d,) = b.columns[0].data.devices()
+        devs.append(d)
+    assert devs == list(mesh.devices.flat)
+    got = []
+    for b in out:
+        hb = device_to_host(b)
+        got.extend(zip(*[c.to_list() for c in hb.columns]))
+    assert sorted(got) == sorted(zip(data["k"], data["s"]))
