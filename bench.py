@@ -213,6 +213,42 @@ def _run_rung(sf: float, platform: str, timeout_s: float) -> dict:
                      f"with no report; stderr tail: {tail}"}
 
 
+def _scenario_pass(sf: float, session_conf, aqe: bool) -> list:
+    """One q13+q18 scenario sweep, static or adaptive.  The adaptive
+    pass records the per-query aqe_* counter movement from the runner's
+    observability block so the artifact shows what the re-optimizer
+    actually did (broadcast switches, coalesced/split partitions,
+    dynamic filters), not just the wall time."""
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    conf = dict(session_conf or {})
+    if aqe:
+        conf["spark.sql.adaptive.shuffledHashJoin.enabled"] = True
+    out = []
+    srs = run_benchmark(
+        os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
+        ["q13", "q18"], iterations=1, verify=True, suite="tpch",
+        session_conf=conf or None)
+    for sr in srs:
+        row = {
+            "suite": "tpch", "query": sr.get("query"),
+            "kind": ("string_heavy" if sr.get("query") == "q13"
+                     else "high_skew"),
+            "adaptive": aqe,
+            "ok": bool(sr.get("ok")) and not sr.get("error"),
+            "speedup": sr.get("speedup"),
+            "device_s": sr.get("device_s"),
+            "oracle_s": sr.get("oracle_s"),
+            "rows": sr.get("rows"),
+        }
+        if aqe:
+            counters = (sr.get("observability", {})
+                        .get("registry", {}).get("counters", {}))
+            row["aqe"] = {k: v for k, v in counters.items()
+                          if k.startswith("aqe_")}
+        out.append(row)
+    return out
+
+
 def _child(sf: float, platform: str) -> None:
     """Run one rung in-process and print its report as the last line."""
     import jax
@@ -273,21 +309,12 @@ def _child(sf: float, platform: str) -> None:
     if r.get("ok") and sf <= 1:
         scenarios = []
         try:
-            srs = run_benchmark(
-                os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
-                ["q13", "q18"], iterations=1, verify=True, suite="tpch",
-                session_conf=session_conf)
-            for sr in srs:
-                scenarios.append({
-                    "suite": "tpch", "query": sr.get("query"),
-                    "kind": ("string_heavy" if sr.get("query") == "q13"
-                             else "high_skew"),
-                    "ok": bool(sr.get("ok")) and not sr.get("error"),
-                    "speedup": sr.get("speedup"),
-                    "device_s": sr.get("device_s"),
-                    "oracle_s": sr.get("oracle_s"),
-                    "rows": sr.get("rows"),
-                })
+            scenarios += _scenario_pass(sf, session_conf, aqe=False)
+            # AQE on/off A-B on the same rungs: q13's string-heavy plan
+            # and q18's skewed orderkeys are exactly where the
+            # re-optimizer should move the aqe_* counters, and rows must
+            # stay identical to the static pass either way
+            scenarios += _scenario_pass(sf, session_conf, aqe=True)
         except Exception as e:  # pragma: no cover - rider must not gate
             scenarios.append({"error": str(e)[:300]})
         r["scenarios"] = scenarios

@@ -234,6 +234,68 @@ assert all(c in plain for c in fused if c != "FusedStageExec"), (fused, plain)
 assert frows == prows, "fused vs unfused rows diverge on q3"
 print("fusion gate: warm rerun compiles 0, shape reversible: ok")
 PY
+  echo "-- adaptive execution gate: broadcast switch, skew split, reversible --"
+  # three contracts on the runtime re-optimizer: a forced-small build
+  # side is rewritten to broadcast strategy EXACTLY once with rows
+  # identical to the static plan; a skewed AQE shuffle records skew
+  # splits with rows identical; and adaptive.enabled=false restores the
+  # byte-identical static plan shape
+  JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+AQE = {"spark.sql.adaptive.shuffledHashJoin.enabled": True}
+SB = T.Schema([T.StructField("k", T.LongType()),
+               T.StructField("v", T.DoubleType())])
+SS = T.Schema([T.StructField("k", T.LongType()),
+               T.StructField("w", T.DoubleType())])
+
+def q(s, n=600, nkeys=10, skew=0.0):
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, nkeys, n)
+    if skew:
+        keys = np.where(rng.random(n) < skew, 7, keys)
+    big = s.from_pydict({"k": [int(x) for x in keys],
+                         "v": [float(i) for i in range(n)]},
+                        SB, partitions=4, rows_per_batch=128)
+    small = s.from_pydict({"k": list(range(nkeys)),
+                           "w": [float(k) * 10 for k in range(nkeys)]}, SS)
+    return big.join(small, on="k", how="inner")
+
+# 1) forced-small build: exactly ONE broadcast switch, rows exact
+want = sorted(q(TpuSession({})).collect(), key=str)
+before = get_registry().snapshot()
+got = sorted(q(TpuSession(AQE)).collect(), key=str)
+moved = get_registry().delta(before)["counters"]
+assert got == want and got, "broadcast-switch rows diverge from static plan"
+assert moved.get("aqe_broadcast_switches", 0) == 1, moved
+
+# 2) skewed shuffle: >=1 skew split, rows exact
+skew_conf = dict(AQE)
+skew_conf.update({"spark.sql.adaptive.autoBroadcastJoinThreshold": 0,
+                  "spark.sql.adaptive.advisoryPartitionSizeInBytes": 4096,
+                  "spark.sql.adaptive.skewedPartitionThresholdInBytes": 16384})
+kw = dict(n=4000, nkeys=64, skew=0.9)
+want = sorted(q(TpuSession({}), **kw).collect(), key=str)
+before = get_registry().snapshot()
+got = sorted(q(TpuSession(skew_conf), **kw).collect(), key=str)
+moved = get_registry().delta(before)["counters"]
+assert got == want and got, "skew-split rows diverge from static plan"
+assert moved.get("aqe_skew_splits", 0) >= 1, moved
+
+# 3) adaptive.enabled=false restores the byte-identical static shape
+off = dict(AQE)
+off["spark.sql.adaptive.enabled"] = False
+_, m_off = q(TpuSession(off))._overridden(quiet=True)
+_, m_static = q(TpuSession({"spark.sql.adaptive.enabled": False})) \
+    ._overridden(quiet=True)
+assert m_off.exec_node.tree_string() == m_static.exec_node.tree_string()
+assert "StageBoundaryExec" not in m_off.exec_node.tree_string()
+print("adaptive gate: 1 broadcast switch, skew splits, off-switch reversible: ok")
+PY
   echo "-- pod-scale mesh gate: regions exact, warm, and reversible --"
   # q6 + q3 over an 8-device mesh must return EXACTLY the single-chip
   # rows; a warm rerun at the SAME mesh shape must compile nothing (the

@@ -128,9 +128,13 @@ def _fp_extra(n: PlanNode) -> str | None:
         # ReuseExchange would serve one consumer the other's data.
         return (f"{n.mode}:{n._update_specs!r}:{n._merge_specs!r}:"
                 f"{getattr(n, '_agg_offsets', None)!r}")
+    if isinstance(n, BroadcastExchangeExec):
+        return ""
+    from spark_rapids_tpu.exec.stage_boundary import StageBoundaryExec
     if isinstance(n, (ProjectExec, FilterExec, UnionExec, JoinExec,
                       CrossJoinExec, SortExec,
-                      ExpandExec, GenerateExec, BackendSwitchExec)):
+                      ExpandExec, GenerateExec, BackendSwitchExec,
+                      StageBoundaryExec)):
         # desc + bound_exprs + schema already carry their parameters
         return ""
     return None
@@ -405,6 +409,7 @@ class AdaptiveShuffleReaderExec(PlanNode):
         groups: list[list[tuple]] = []
         cur: list[tuple] = []
         cur_bytes = 0
+        n_splits = 0
 
         def flush():
             nonlocal cur, cur_bytes
@@ -419,6 +424,7 @@ class AdaptiveShuffleReaderExec(PlanNode):
                     and hasattr(shuffled, "batch_sizes")) else None
             if per_batch and len(per_batch) > 1:
                 flush()
+                before = len(groups)
                 lo, acc = 0, 0
                 for i, bsz in enumerate(per_batch):
                     if acc > 0 and acc + bsz > target:
@@ -426,6 +432,7 @@ class AdaptiveShuffleReaderExec(PlanNode):
                         lo, acc = i, 0
                     acc += bsz
                 groups.append([(pid, lo, None)])
+                n_splits += len(groups) - before - 1
                 continue
             if not self.allow_coalesce:
                 groups.append([(pid, 0, None)])
@@ -435,7 +442,20 @@ class AdaptiveShuffleReaderExec(PlanNode):
             cur.append((pid, 0, None))
             cur_bytes += sz
         flush()
-        return groups or identity
+        if not groups:
+            return identity
+        n_coalesced = sum(len(g) - 1 for g in groups)
+        if n_coalesced or n_splits:
+            from spark_rapids_tpu.obs.registry import get_registry
+            reg = get_registry()
+            if n_coalesced:
+                reg.inc("aqe_partitions_coalesced", n_coalesced)
+            if n_splits:
+                reg.inc("aqe_skew_splits", n_splits)
+            ctx.trace_event("aqe.replan", "aqe", node=self.node_desc(),
+                            partitions=n, groups=len(groups),
+                            coalesced=n_coalesced, skew_splits=n_splits)
+        return groups
 
     def num_partitions(self, ctx: ExecCtx) -> int:
         return len(self._groups(ctx))

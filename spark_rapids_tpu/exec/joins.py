@@ -37,7 +37,7 @@ from spark_rapids_tpu.ops.join import (JOIN_TYPES, build_prepare_fast,
                                        join_indices_from_probe, join_probe,
                                        matched_build_rows, probe_fast)
 
-__all__ = ["JoinExec", "CrossJoinExec"]
+__all__ = ["JoinExec", "CrossJoinExec", "BroadcastHashJoinExec"]
 
 
 @guarded_jit(static_argnames=("lkeys", "rkeys", "join_type"))
@@ -453,6 +453,41 @@ class JoinExec(PlanNode):
     def node_desc(self) -> str:
         jt = "right" if self._swapped else self.join_type
         return f"JoinExec[{jt}, keys={len(self._lkeys_b)}]"
+
+
+class BroadcastHashJoinExec(JoinExec):
+    """Broadcast-build equi-join: the build child is a single-partition
+    node (BroadcastExchangeExec) materialized whole, the stream side is
+    probed per batch with no shuffle (reference GpuBroadcastHashJoinExec).
+
+    Execution is exactly JoinExec's device/host paths — the build side's
+    ``_materialize`` drains one broadcast partition instead of a shuffled
+    exchange.  Exists as its own class so AQE's shuffle-join -> broadcast
+    switch is visible in EXPLAIN (ANALYZE) and so plan fingerprints stay
+    honest about the strategy that actually ran."""
+
+    @classmethod
+    def from_shuffled(cls, join: JoinExec, probe: PlanNode,
+                      build: PlanNode) -> "BroadcastHashJoinExec":
+        """Re-strategize an existing JoinExec around (probe, build)
+        children without re-binding: key expressions were bound against
+        the child SCHEMAS, which the new children preserve — so the
+        compile-cache fragment keys (join_cond/join_unmatched) and the
+        guarded-jit structural keys are byte-identical to the static
+        plan's, and a warm rerun of the re-planned query compiles
+        nothing."""
+        nj = object.__new__(cls)
+        nj.__dict__.update(join.__dict__)
+        # lazily-built jit wrappers close over the originating node; let
+        # the new node rebuild its own (same fragment keys -> cache hits)
+        nj.__dict__.pop("_cond_jit", None)
+        nj.__dict__.pop("_unmatched_jit", None)
+        nj.children = (probe, build)
+        return nj
+
+    def node_desc(self) -> str:
+        jt = "right" if self._swapped else self.join_type
+        return f"BroadcastHashJoinExec[{jt}, keys={len(self._lkeys_b)}]"
 
 
 class CrossJoinExec(JoinExec):

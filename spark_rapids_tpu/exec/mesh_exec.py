@@ -754,7 +754,17 @@ class MeshJoinExec(_MeshOutputMixin, JoinExec):
             nbytes = sum(getattr(x, "nbytes", 0)
                          for b in batches
                          for x in jax.tree_util.tree_leaves(b))
-            return nbytes > self.build_threshold_bytes
+            partitioned = nbytes > self.build_threshold_bytes
+            # the mesh analog of plan/adaptive.py's broadcast switch:
+            # record the measured-size strategy pick on the trace (no
+            # aqe_* counter — this is the static mesh join's built-in
+            # decision, not a stage-boundary re-plan)
+            ctx.trace_event(
+                "aqe.replan", "aqe", node=self.node_desc(),
+                build_bytes=int(nbytes),
+                threshold=int(self.build_threshold_bytes),
+                decision="partitioned" if partitioned else "replicated")
+            return partitioned
         return ctx.cached((id(self), "mesh_join_partitioned"), decide)
 
     def _partitioned_exchanges(self):

@@ -1,0 +1,72 @@
+"""StageBoundaryExec: the query-stage barrier that triggers adaptive
+re-planning.
+
+The planner (plan/overrides.py ``_insert_stage_boundaries``) wraps each
+join whose build side is an AQE-inserted shuffle in one of these.  At
+execution time, the FIRST pull on the boundary forces the build-side
+map stage to materialize, hands its actual statistics to
+``plan/adaptive.py``'s re-optimizer, and swaps in whatever node the
+re-optimizer returns — the original join, or a broadcast-strategy
+rewrite with the probe shuffle dropped and dynamic filters installed.
+Subsequent pulls (and EXPLAIN ANALYZE's post-execution tree walk) see
+the re-planned child: the rendered plan shows what actually ran.
+
+The decision is cached per (execution, backend): every output partition
+of one query execution sees one consistent plan, while a fresh
+execution re-decides from fresh statistics.  The host (oracle) backend
+resolves to the static child, so the differential oracle always checks
+the adaptive plan's rows against the un-replanned semantics.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+
+__all__ = ["StageBoundaryExec"]
+
+
+class StageBoundaryExec(PlanNode):
+    """Pass-through barrier above a re-plannable join (see module doc).
+
+    ``df_targets`` are the dynamic-filter candidates computed at
+    plan-prepare time (``plan.adaptive.dynamic_filter_targets``) —
+    resolved BEFORE stage fusion hides the probe-side scan inside a
+    fused region, and carried here for the runtime re-optimizer.
+    """
+
+    combines_batches = False
+
+    def __init__(self, child: PlanNode, df_targets=()):
+        super().__init__([child])
+        self.df_targets = tuple(df_targets)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def _resolved(self, ctx: ExecCtx) -> PlanNode:
+        if not ctx.is_device:
+            return self.children[0]
+        return ctx.cached(("aqe_stage", id(self), ctx.backend),
+                          lambda: self._replan(ctx))
+
+    def _replan(self, ctx: ExecCtx) -> PlanNode:
+        from spark_rapids_tpu.plan.adaptive import replan_stage
+        new = replan_stage(ctx, self)
+        if new is not self.children[0]:
+            # reparent so explain_analyze / tree renders walk the plan
+            # that actually executed
+            self.children = (new,)
+        return new
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        return self._resolved(ctx).num_partitions(ctx)
+
+    def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        yield from self._resolved(ctx).partition_iter(ctx, pid)
+
+    def node_desc(self) -> str:
+        return "StageBoundaryExec" + (
+            f"[df={len(self.df_targets)}]" if self.df_targets else "")

@@ -159,6 +159,11 @@ class FileScanExec(PlanNode):
             # supported ones (reference keeps a residual FilterExec above)
             raise ValueError(f"predicate not pushable: {pushdown!r}")
         self._string_width = string_width
+        #: AQE dynamic filters (plan/adaptive.py): (column, values, lo, hi)
+        #: tuples derived from a small materialized join build side and
+        #: pushed here before the probe stage launches (the DPP analog).
+        #: Applied at the arrow layer alongside the static pushdown.
+        self._runtime_filters: list[tuple] = []
         self._buckets_cache: dict[int, list[list[str]]] = {}
         #: stripes/row-groups skipped via statistics pruning (diagnostic)
         self.stripes_skipped = 0
@@ -215,11 +220,40 @@ class FileScanExec(PlanNode):
             self._buckets_cache[nparts] = buckets
         return self._buckets_cache[nparts][pid]
 
+    def add_runtime_filter(self, column: str, values=None, lo=None,
+                           hi=None) -> None:
+        """Install a join-key filter derived at runtime (AQE dynamic
+        filter): either an IN-set (``values``) or a min-max range
+        (``lo``/``hi``).  Only ever narrows the scan's output — rows it
+        removes are exactly rows the downstream join would drop — so it
+        is safe to install between stages of a running query."""
+        assert not self.share_output, \
+            "dynamic filters must not narrow a shared scan"
+        assert column in self._schema.names
+        self._runtime_filters.append(
+            (column, tuple(values) if values is not None else None, lo, hi))
+
+    def _arrow_filter(self):
+        """The combined arrow-level filter: static pushdown composed with
+        any runtime (AQE dynamic) filters."""
+        import pyarrow.dataset as ds
+        filt = _to_arrow_filter(self._pushdown) \
+            if self._pushdown is not None else None
+        for column, values, lo, hi in self._runtime_filters:
+            if values is not None:
+                f = ds.field(column).isin(list(values))
+            else:
+                f = (ds.field(column) >= ds.scalar(lo)) & \
+                    (ds.field(column) <= ds.scalar(hi))
+            filt = f if filt is None else (filt & f)
+        return filt
+
     def scan_fingerprint(self) -> tuple:
         """Structural identity: two scans with equal fingerprints read
         the same files, columns, and pushdown — identical output."""
         return (self.format_name, tuple(self._files),
                 tuple(self._schema.names), repr(self._pushdown),
+                tuple(self._runtime_filters),
                 self._string_width, self._requested_parts)
 
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
@@ -426,9 +460,8 @@ class ParquetScanExec(FileScanExec):
     def _read_file(self, path: str, batch_rows: int = 1 << 16):
         import pyarrow.dataset as ds
         dataset = ds.dataset(path, format="parquet")
-        filt = _to_arrow_filter(self._pushdown) if self._pushdown is not None \
-            else None
-        scanner = dataset.scanner(columns=self._schema.names, filter=filt,
+        scanner = dataset.scanner(columns=self._schema.names,
+                                  filter=self._arrow_filter(),
                                   batch_size=batch_rows)
         yield from scanner.to_batches()
 
@@ -452,8 +485,9 @@ class OrcScanExec(FileScanExec):
         from spark_rapids_tpu.io import orc_meta
         f = orc.ORCFile(path)
         cols = self._schema.names
-        filt = _to_arrow_filter(self._pushdown) if self._pushdown is not None \
-            else None
+        # stripe pruning stays keyed on the STATIC pushdown; runtime
+        # filters join at the residual row-level filter below
+        filt = self._arrow_filter()
         stats = None
         if self._pushdown is not None:
             # flattened-stats index: root struct is column 0, fields
@@ -539,8 +573,7 @@ class CsvScanExec(FileScanExec):
                           convert_options=copts)
         if self._columns:
             tbl = tbl.select(self._schema.names)
-        if self._pushdown is not None:
-            filt = _to_arrow_filter(self._pushdown)
-            if filt is not None:
-                tbl = tbl.filter(filt)
+        filt = self._arrow_filter()
+        if filt is not None:
+            tbl = tbl.filter(filt)
         yield from tbl.to_batches(max_chunksize=batch_rows)

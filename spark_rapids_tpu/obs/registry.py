@@ -27,7 +27,14 @@ transition, so a delta over a run counts QUERIES, not checkpoints); and
 the compile plane's ``compile_count`` / ``compile_wall_s`` (one move per
 NEW jit input signature — a zero delta across a repeated query proves
 pure cache reuse) plus ``fusion_cache_hits`` / ``fusion_cache_misses``
-(process-wide program-cache lookups, exec/compile_cache.py).
+(process-wide program-cache lookups, exec/compile_cache.py); and the
+adaptive-execution plane's ``aqe_broadcast_switches`` (shuffle-join ->
+broadcast-join rewrites, plan/adaptive.py) /
+``aqe_partitions_coalesced`` / ``aqe_skew_splits`` (reader-group
+regrouping from map-output sizes, exec/exchange.py) /
+``aqe_dynamic_filters`` (build-side IN-set/min-max filters pushed into
+probe scans) — each incremented at the decision site, so a per-query
+delta shows exactly what the re-optimizer did.
 """
 from __future__ import annotations
 

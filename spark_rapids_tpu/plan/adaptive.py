@@ -1,0 +1,245 @@
+"""Adaptive re-optimizer: rewrite the remainder of a running plan from
+materialized stage statistics.
+
+The reference plugin rides Spark AQE: at each query-stage boundary the
+re-optimized plan is re-walked by `GpuTransitionOverrides` and
+`GpuCustomShuffleReaderExec` regroups reduce partitions from actual
+map-output sizes (PAPER.md §L3, §2.10).  This module is the re-planning
+half for this engine: `exec/stage_boundary.py` marks the stage barrier
+above an AQE-inserted join exchange, and when that barrier is first
+pulled, :func:`replan_stage` materializes the build side (the map
+stage), reads its ACTUAL bytes/rows from the shuffle transport
+(`shuffle/local.py` partition_sizes/partition_rows), and rewrites the
+not-yet-started join stage:
+
+* **shuffle-join -> broadcast-join** when the built side landed under
+  ``spark.sql.adaptive.autoBroadcastJoinThreshold``: the build-side
+  ``ShuffleExchangeExec`` is wrapped in a ``BroadcastExchangeExec`` (the
+  broadcast drains the already-materialized map output, so lineage
+  recovery still covers it) and the ``JoinExec`` is re-strategized to
+  ``BroadcastHashJoinExec`` — dropping the probe-side shuffle entirely,
+  since a broadcast build no longer needs the probe co-partitioned.
+* **dynamic filter pushdown** (the DPP analog): a small build side's
+  distinct join-key values become an IN-set (or min-max range) filter
+  installed on the probe-side file scan, so the probe stage never
+  decodes rows the join would drop.
+
+Reader-side coalescing/skew-splitting from the same statistics lives in
+``exec/exchange.py`` ``AdaptiveShuffleReaderExec``; overrides lifts its
+split-only restriction for the exchanges this module inserts.
+
+Every decision is recorded under an ``aqe.replan`` span and counted in
+the metrics registry (``aqe_broadcast_switches`` /
+``aqe_partitions_coalesced`` / ``aqe_skew_splits`` /
+``aqe_dynamic_filters``), so EXPLAIN ANALYZE shows both the re-planned
+tree and the counters that produced it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import bool_conf, bytes_conf, int_conf
+
+__all__ = ["AUTO_BROADCAST_THRESHOLD", "AQE_SHUFFLED_JOIN",
+           "AQE_DYNAMIC_FILTER", "AQE_DYNAMIC_FILTER_MAX_KEYS",
+           "unwrap_exchange", "dynamic_filter_targets", "replan_stage"]
+
+AUTO_BROADCAST_THRESHOLD = bytes_conf(
+    "spark.sql.adaptive.autoBroadcastJoinThreshold", 10 << 20,
+    "A join build side whose MATERIALIZED map-output bytes land under "
+    "this threshold is switched from a shuffled join to a broadcast "
+    "join at the stage boundary (Spark AQE's "
+    "DemoteBroadcastHashJoin/OptimizeLocalShuffleReader counterpart, "
+    "decided from actual sizes instead of estimates).")
+AQE_SHUFFLED_JOIN = bool_conf(
+    "spark.sql.adaptive.shuffledHashJoin.enabled", False,
+    "Plan equi-joins as shuffled hash joins (hash-partition both sides) "
+    "with a stage boundary above the build exchange, letting the "
+    "adaptive re-optimizer pick the final strategy from materialized "
+    "sizes. Off by default: the engine's static join already streams "
+    "the probe side against a whole-build table, which single-process "
+    "benchmarks favor; enable where the build side is too large to "
+    "materialize unpartitioned, or to let AQE prove it small.")
+AQE_DYNAMIC_FILTER = bool_conf(
+    "spark.sql.adaptive.dynamicFilter.enabled", True,
+    "When a materialized join build side is small, push an IN-set / "
+    "min-max filter over the join keys into the probe-side file scan "
+    "(dynamic partition pruning analog). Only ever removes rows the "
+    "join would drop; applies to inner/semi joins on integer keys over "
+    "non-shared scans.")
+AQE_DYNAMIC_FILTER_MAX_KEYS = int_conf(
+    "spark.sql.adaptive.dynamicFilter.maxInSetSize", 4096,
+    "Max distinct build-side keys for an IN-set dynamic filter; above "
+    "this the filter degrades to a min-max range.")
+
+#: key dtypes a dynamic filter may be derived for: plain integers whose
+#: host values compare exactly against the arrow column (dates/
+#: timestamps/strings/floats are excluded — their arrow-level scalar
+#: comparison semantics differ from the raw stored representation)
+_FILTERABLE = (T.ByteType, T.ShortType, T.IntegerType, T.LongType)
+
+
+def unwrap_exchange(node):
+    """The ShuffleExchangeExec under a chain of adaptive readers /
+    batch coalescers, or None when the subtree is not exchange-rooted."""
+    from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.exec.sortexec import CoalesceBatchesExec
+    while isinstance(node, (AdaptiveShuffleReaderExec, CoalesceBatchesExec)):
+        node = node.children[0]
+    return node if isinstance(node, ShuffleExchangeExec) else None
+
+
+def dynamic_filter_targets(join) -> list[tuple]:
+    """``(key_idx, scan, column)`` triples: probe-side join keys that
+    resolve, through column-preserving operators, to a column of a file
+    scan this join consumes EXCLUSIVELY (``share_output`` scans serve
+    other plan branches, which a join-derived filter must never narrow).
+    Computed at plan-prepare time — before stage fusion hides the scan —
+    and carried on the stage boundary for the replanner."""
+    from spark_rapids_tpu.exec.basic import FilterExec, ProjectExec
+    from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.exec.sortexec import CoalesceBatchesExec
+    from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+    from spark_rapids_tpu.expr.core import BoundReference
+    from spark_rapids_tpu.io.scan import FileScanExec
+
+    out: list[tuple] = []
+    for ki, k in enumerate(join._lkeys_b):
+        if not isinstance(k, BoundReference) or \
+                not isinstance(k.dtype, _FILTERABLE):
+            continue
+        node, idx = join.children[0], k.index
+        while True:
+            if isinstance(node, (FilterExec, CoalesceBatchesExec,
+                                 AdaptiveShuffleReaderExec,
+                                 ShuffleExchangeExec, BackendSwitchExec)):
+                node = node.children[0]
+                continue
+            if isinstance(node, ProjectExec):
+                b = node._bound[idx]
+                if not isinstance(b, BoundReference):
+                    break
+                idx = b.index
+                node = node.children[0]
+                continue
+            break
+        if isinstance(node, FileScanExec) and not node.share_output and \
+                idx < len(node.output_schema.fields):
+            out.append((ki, node, node.output_schema.fields[idx].name))
+    return out
+
+
+def replan_stage(ctx, boundary):
+    """Materialize the stage under ``boundary``'s join build exchange
+    and re-plan the join from its actual statistics.  Returns the node
+    to execute in place of the static join (possibly the join itself).
+    Runs once per execution, on the device backend, at first pull of the
+    boundary — before any probe-side work starts."""
+    from spark_rapids_tpu.obs.registry import get_registry
+
+    join = boundary.children[0]
+    exchange = unwrap_exchange(join.children[1])
+    if exchange is None or not getattr(exchange, "_aqe_inserted", False):
+        return join
+    ctx.check_cancel()   # a cancelled query must not launch the map stage
+    new_join = join
+    with ctx.trace_span("aqe.replan", "aqe", node=join.node_desc()):
+        transport = exchange._shuffled(ctx)  # <- the stage barrier
+        has_stats = hasattr(transport, "partition_sizes")
+        sizes = transport.partition_sizes(exchange.shuffle_id) \
+            if has_stats else {}
+        rows = transport.partition_rows(exchange.shuffle_id) \
+            if hasattr(transport, "partition_rows") else {}
+        total = sum(sizes.values())
+        threshold = ctx.conf.get(AUTO_BROADCAST_THRESHOLD)
+        small = has_stats and total <= threshold
+        decisions = []
+        if small:
+            new_join = _broadcast_switch(join, exchange)
+            get_registry().inc("aqe_broadcast_switches")
+            decisions.append("broadcast")
+        if small and join.join_type in ("inner", "semi") and \
+                ctx.conf.get(AQE_DYNAMIC_FILTER):
+            decisions += _push_dynamic_filters(ctx, boundary, join, exchange)
+        ctx.trace_event("aqe.decision", "aqe", build_bytes=total,
+                        build_rows=sum(rows.values()), threshold=threshold,
+                        decisions=",".join(decisions) or "none")
+    return new_join
+
+
+def _broadcast_switch(join, exchange):
+    """Rewrite (probe-shuffle) JOIN (build-shuffle) into
+    probe BROADCAST-JOIN broadcast(build-map-output).  The broadcast
+    drains the exchange's already-written map partitions (through the
+    recovering fetch, so lineage recovery still applies), and the
+    probe's own AQE-inserted exchange — whose only purpose was
+    co-partitioning — is dropped."""
+    from spark_rapids_tpu.exec.exchange import BroadcastExchangeExec
+    from spark_rapids_tpu.exec.joins import BroadcastHashJoinExec
+    bcast = BroadcastExchangeExec(exchange)
+    probe = join.children[0]
+    pex = unwrap_exchange(probe)
+    if pex is not None and getattr(pex, "_aqe_inserted", False):
+        # AQE-inserted exchanges have exactly one consumer (this join),
+        # so no other operator depends on the probe's partitioning
+        probe = pex.children[0]
+    return BroadcastHashJoinExec.from_shuffled(join, probe, bcast)
+
+
+def _collect_build_key_values(ctx, exchange, key):
+    """All non-null build-side join-key values from the materialized map
+    output, as one numpy array (None when the dtype is not filterable).
+    Host-side evaluation over mirrored batches: zero device compilation,
+    so a dynamic filter never perturbs the compile cache."""
+    from spark_rapids_tpu.exec.core import device_to_host
+    from spark_rapids_tpu.expr.core import eval_host
+    if not isinstance(key.dtype, _FILTERABLE):
+        return None
+    npdt = key.dtype.np_dtype
+    out = []
+    for pid in range(exchange.num_partitions(ctx)):
+        for b in exchange.partition_iter(ctx, pid):
+            hb = device_to_host(b)
+            c = eval_host(key, hb)
+            data = np.asarray(c.data)
+            valid = np.asarray(c.validity, dtype=bool)
+            out.append(data[valid])
+    if not out:
+        return np.empty(0, npdt)
+    return np.concatenate(out)
+
+
+def _push_dynamic_filters(ctx, boundary, join, exchange) -> list[str]:
+    """Derive and install per-key filters on the probe-side scans listed
+    in ``boundary.df_targets``.  Returns decision strings for the replan
+    trace."""
+    from spark_rapids_tpu.obs.registry import get_registry
+    decisions: list[str] = []
+    max_keys = ctx.conf.get(AQE_DYNAMIC_FILTER_MAX_KEYS)
+    for ki, scan, col_name in boundary.df_targets:
+        vals = _collect_build_key_values(ctx, exchange, join._rkeys_b[ki])
+        if vals is None:
+            continue
+        distinct = np.unique(vals)
+        if distinct.size == 0:
+            # empty build side: an inner/semi join emits nothing — an
+            # impossible range skips the probe decode entirely
+            scan.add_runtime_filter(col_name, lo=1, hi=0)
+            kind = "empty"
+        elif distinct.size <= max_keys:
+            scan.add_runtime_filter(
+                col_name, values=[v.item() for v in distinct])
+            kind = f"in[{distinct.size}]"
+        else:
+            scan.add_runtime_filter(col_name, lo=distinct[0].item(),
+                                    hi=distinct[-1].item())
+            kind = "minmax"
+        get_registry().inc("aqe_dynamic_filters")
+        ctx.trace_event("aqe.dynamic_filter", "aqe", column=col_name,
+                        kind=kind, keys=int(distinct.size),
+                        scan=scan.node_desc())
+        decisions.append(f"filter:{col_name}")
+    return decisions

@@ -75,6 +75,9 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
     if isinstance(node, L.Join):
         lc = lower(node.left, conf)
         rc = lower(node.right, conf)
+        if node.how in ("inner", "left", "right", "semi", "anti"):
+            lc = _aqe_join_exchange(lc, node.left_on, conf)
+            rc = _aqe_join_exchange(rc, node.right_on, conf)
         lc, rc = (_aqe_join_reader(c, conf) for c in (lc, rc))
         if node.how == "cross":
             ex = CrossJoinExec(lc.exec_node, rc.exec_node, node.condition)
@@ -263,24 +266,50 @@ def _schema_has_arrays(*nodes: PlanNode) -> bool:
                for n in nodes for f in n.output_schema)
 
 
+def _aqe_join_exchange(c: PlannedNode, keys, conf: TpuConf) -> PlannedNode:
+    """Hash-exchange one join side on its join keys, marked
+    ``_aqe_inserted`` so the adaptive layer owns it: the stage-boundary
+    pass puts a re-plan barrier above the join, and the re-optimizer may
+    coalesce its reduce side, switch it to a broadcast, or drop the
+    probe copy entirely.  Gated on the shuffled-hash-join conf (the
+    engine's static join needs no co-partitioning) and skipped under
+    the mesh (joins ride MeshJoinExec there) or when the side already
+    exchanges on these keys (explicit repartition)."""
+    from spark_rapids_tpu.exec.exchange import (ADAPTIVE_ENABLED,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.plan.adaptive import AQE_SHUFFLED_JOIN
+    if not keys or not conf.get(AQE_SHUFFLED_JOIN) or \
+            not conf.get(ADAPTIVE_ENABLED) or conf.mesh_device_count > 1 \
+            or isinstance(c.exec_node, ShuffleExchangeExec):
+        return c
+    ex = ShuffleExchangeExec(
+        HashPartitioning(list(keys), conf.shuffle_partitions), c.exec_node)
+    ex._aqe_inserted = True
+    return PlannedNode(ex, list(keys), [c])
+
+
 def _aqe_join_reader(c: PlannedNode, conf: TpuConf) -> PlannedNode:
-    """Joins read shuffles through a SPLIT-ONLY adaptive reader (Spark's
+    """Joins read shuffles through an adaptive reader (Spark's
     OptimizeSkewedJoin scope): join sides have per-row semantics, so
     fanning a skewed hash partition out into several reader groups is
     safe — the stream side probes per batch and a build side is fully
-    materialized either way.  Coalescing is disabled because the only
-    shuffles reaching a join today are explicit ``repartition(n)``s,
-    whose partition count must never be REDUCED below the user's request
-    (REPARTITION_BY_NUM contract; a skewed partition may still fan out,
-    which preserves the requested parallelism floor)."""
+    materialized either way.  Coalescing is allowed ONLY for exchanges
+    the adaptive layer itself inserted (``_aqe_join_exchange``): an
+    explicit ``repartition(n)`` promises n partitions, never REDUCED
+    below the user's request (REPARTITION_BY_NUM contract; a skewed
+    partition may still fan out, which preserves the requested
+    parallelism floor), while an AQE-inserted exchange carries no user
+    promise and small reduce partitions may merge to the advisory
+    size."""
     from spark_rapids_tpu.exec.exchange import (ADAPTIVE_ENABLED,
                                                 AdaptiveShuffleReaderExec,
                                                 ShuffleExchangeExec)
     if not conf.get(ADAPTIVE_ENABLED) or \
             not isinstance(c.exec_node, ShuffleExchangeExec):
         return c
-    reader = AdaptiveShuffleReaderExec(c.exec_node, allow_skew_split=True,
-                                       allow_coalesce=False)
+    reader = AdaptiveShuffleReaderExec(
+        c.exec_node, allow_skew_split=True,
+        allow_coalesce=getattr(c.exec_node, "_aqe_inserted", False))
     return PlannedNode(reader, [], [c])
 
 
@@ -517,9 +546,49 @@ class TpuOverrides:
                 print(text)
         if self.conf.test_enabled:
             self._assert_on_tpu(root)
+        self._insert_stage_boundaries(root)
         self._fuse_stages(root)
         self._form_mesh_regions(root)
         return root.exec_node
+
+    def _insert_stage_boundaries(self, root: PlannedNode) -> None:
+        """Wrap each join whose build side reads an AQE-inserted shuffle
+        in a ``StageBoundaryExec`` (exec/stage_boundary.py): the barrier
+        at which plan/adaptive.py re-plans the join from the build
+        stage's materialized statistics.
+
+        Runs on the realized exec tree BEFORE fusion: the boundary is a
+        pipeline breaker (never fused), and the dynamic-filter targets
+        must be resolved while the probe-side scan is still a visible
+        leaf — fusion later hides the operators above it inside a
+        FusedStageExec, but the scan object itself stays shared, so the
+        captured reference remains live."""
+        from spark_rapids_tpu.exec.exchange import ADAPTIVE_ENABLED
+        if not self.conf.get(ADAPTIVE_ENABLED):
+            return
+        from spark_rapids_tpu.exec.joins import JoinExec
+        from spark_rapids_tpu.exec.stage_boundary import StageBoundaryExec
+        from spark_rapids_tpu.plan.adaptive import (dynamic_filter_targets,
+                                                    unwrap_exchange)
+        done: dict[int, PlanNode] = {}
+
+        def walk(node: PlanNode) -> PlanNode:
+            got = done.get(id(node))
+            if got is not None:
+                return got
+            new_children = tuple(walk(c) for c in node.children)
+            if any(a is not b for a, b in zip(new_children, node.children)):
+                node.children = new_children
+            out = node
+            if type(node) is JoinExec and len(node.children) == 2:
+                ex = unwrap_exchange(node.children[1])
+                if ex is not None and getattr(ex, "_aqe_inserted", False):
+                    out = StageBoundaryExec(node,
+                                            dynamic_filter_targets(node))
+            done[id(node)] = out
+            return out
+
+        root.exec_node = walk(root.exec_node)
 
     def _fuse_stages(self, root: PlannedNode) -> None:
         """Collapse runs of adjacent elementwise operators into

@@ -37,7 +37,14 @@ class ZstdCodec(Codec):
     name = "zstd"
 
     def __init__(self):
-        import zstandard
+        try:
+            import zstandard
+        except ImportError as e:
+            raise RuntimeError(
+                "spark.rapids.shuffle.compression.codec=zstd requires the "
+                "'zstandard' package, which is not installed in this "
+                "environment; install zstandard or pick codec 'lz4' or "
+                "'none'") from e
         self._c = zstandard.ZstdCompressor()
         self._d = zstandard.ZstdDecompressor()
 

@@ -55,6 +55,7 @@ class _Slot:
     epoch: int
     item: Any          # ("spillable", scb) | ("bytes", data, raw) | None
     size: int
+    rows: int = 0
 
 
 class LocalShuffleTransport:
@@ -75,6 +76,7 @@ class LocalShuffleTransport:
         # (shuffle_id, part_id) -> list of _Slot in map-batch order
         self._store: dict[tuple, list[_Slot]] = {}
         self._sizes: dict[tuple, int] = {}
+        self._rows: dict[tuple, int] = {}
         self._batch_sizes: dict[tuple, list[int]] = {}
         # (shuffle_id, map_id) -> current output epoch; a write tagged
         # with an older epoch raced a recovery and is discarded
@@ -107,6 +109,7 @@ class LocalShuffleTransport:
             else:
                 item = ("bytes", raw, len(raw))
             size = len(item[1])
+        rows = int(getattr(batch, "known_rows", 0) or 0)
         stale = None
         with self._lock:
             current = self._epochs.get((shuffle_id, map_id), 0)
@@ -126,14 +129,17 @@ class LocalShuffleTransport:
                     refill.item = item
                     refill.epoch = eff
                     refill.size = size
+                    refill.rows = rows
                     idx = slots.index(refill)
                     self._batch_sizes[(shuffle_id, part_id)][idx] = size
                 else:
-                    slots.append(_Slot(map_id, eff, item, size))
+                    slots.append(_Slot(map_id, eff, item, size, rows))
                     self._batch_sizes.setdefault((shuffle_id, part_id),
                                                  []).append(size)
                 self._sizes[(shuffle_id, part_id)] = \
                     self._sizes.get((shuffle_id, part_id), 0) + size
+                self._rows[(shuffle_id, part_id)] = \
+                    self._rows.get((shuffle_id, part_id), 0) + rows
         if stale is not None:
             if stale[0] == "spillable":
                 stale[1].close()
@@ -184,6 +190,8 @@ class LocalShuffleTransport:
                         # judge it already-recovered and never retry
                         s.epoch = new_epochs[s.map_id]
                         self._sizes[(sid, pid)] -= s.size
+                        self._rows[(sid, pid)] = \
+                            self._rows.get((sid, pid), 0) - s.rows
                         self.metrics["map_outputs_invalidated"] += 1
         # close OUTSIDE the transport lock: spillable close takes the
         # catalog lock (and may unlink disk files); nesting the two
@@ -198,6 +206,14 @@ class LocalShuffleTransport:
         MapStatus sizes feeding AQE's coalescing decisions)."""
         with self._lock:
             return {pid: sz for (sid, pid), sz in self._sizes.items()
+                    if sid == shuffle_id}
+
+    def partition_rows(self, shuffle_id: "int | str") -> dict[int, int]:
+        """Exact row counts per reduce partition, from the batch mirror's
+        ``known_rows`` stamped at map-write time — the second statistic
+        (after bytes) the adaptive re-optimizer feeds on."""
+        with self._lock:
+            return {pid: n for (sid, pid), n in self._rows.items()
                     if sid == shuffle_id}
 
     def batch_sizes(self, shuffle_id: "int | str", part_id: int) -> list[int]:

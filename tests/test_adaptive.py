@@ -1,0 +1,342 @@
+"""Adaptive query execution: re-plan the running query from materialized
+stage statistics (plan/adaptive.py + exec/stage_boundary.py).
+
+Covers the three re-optimizer rewrites — shuffle-join -> broadcast-join
+below autoBroadcastJoinThreshold, reader coalescing/skew-splitting for
+AQE-inserted join exchanges, and dynamic filter pushdown into probe
+scans — plus the contracts around them: rows exactly equal the static
+plan, adaptive.enabled=false restores the identical plan shape,
+explicit repartition(n) is never coalesced below n, re-planned
+fragments reuse the compile cache (warm rerun compiles nothing), and
+the whole thing survives the stage-recovery chaos storm.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import ExecCtx, collect_host, device_to_host
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+AQE_ON = {"spark.sql.adaptive.shuffledHashJoin.enabled": True}
+
+SCHEMA_BIG = T.Schema([T.StructField("k", T.LongType()),
+                       T.StructField("v", T.DoubleType())])
+SCHEMA_SMALL = T.Schema([T.StructField("k", T.LongType()),
+                         T.StructField("w", T.DoubleType())])
+
+
+def _big(s, n=600, nkeys=10, skew=0.0, parts=3, rpb=100):
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, nkeys, n)
+    if skew:
+        keys = np.where(rng.random(n) < skew, 7, keys)
+    return s.from_pydict({"k": [int(x) for x in keys],
+                          "v": [float(i) for i in range(n)]},
+                         SCHEMA_BIG, partitions=parts, rows_per_batch=rpb)
+
+
+def _small(s, keys=(1, 2, 3, 4)):
+    return s.from_pydict({"k": list(keys),
+                          "w": [float(k) * 10 for k in keys]}, SCHEMA_SMALL)
+
+
+def _aqe_delta(counters):
+    return {k: v for k, v in counters.items() if k.startswith("aqe_")}
+
+
+def _join(s, how="inner", **big_kw):
+    return _big(s, **big_kw).join(_small(s), on="k", how=how)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "semi", "anti"])
+def test_broadcast_switch_rows_exact(how):
+    want = sorted(_join(TpuSession({}), how).collect(), key=str)
+    s = TpuSession(AQE_ON)
+    before = get_registry().snapshot()
+    got = sorted(_join(s, how).collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert got == want and len(got) > 0
+    # forced-small build side: exactly one broadcast switch
+    assert delta.get("aqe_broadcast_switches", 0) == 1, _aqe_delta(delta)
+
+
+def test_broadcast_switch_rendered_in_explain_analyze():
+    s = TpuSession(AQE_ON)
+    text = _join(s).explain_analyze()
+    # the replanned tree is what renders: broadcast strategy, no live
+    # probe-side shuffle under the boundary
+    assert "BroadcastHashJoinExec" in text
+    assert "BroadcastExchangeExec" in text
+    assert "StageBoundaryExec" in text
+    assert "aqe_broadcast_switches" in text  # counter footer
+
+
+def test_no_switch_above_threshold():
+    conf = dict(AQE_ON)
+    conf["spark.sql.adaptive.autoBroadcastJoinThreshold"] = 0
+    want = sorted(_join(TpuSession({})).collect(), key=str)
+    s = TpuSession(conf)
+    before = get_registry().snapshot()
+    q = _join(s)
+    got = sorted(q.collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert got == want
+    assert delta.get("aqe_broadcast_switches", 0) == 0, _aqe_delta(delta)
+
+
+def test_adaptive_off_restores_static_plan_shape():
+    """adaptive.enabled=false must disable BOTH the exchange insertion
+    and the stage boundary — the plan is byte-identical in shape to the
+    plain static plan, even with shuffledHashJoin requested."""
+    off = dict(AQE_ON)
+    off["spark.sql.adaptive.enabled"] = False
+    _, meta_off = _join(TpuSession(off))._overridden(quiet=True)
+    _, meta_static = _join(
+        TpuSession({"spark.sql.adaptive.enabled": False}))._overridden(
+            quiet=True)
+    assert meta_off.exec_node.tree_string() == \
+        meta_static.exec_node.tree_string()
+    tree = meta_off.exec_node.tree_string()
+    assert "StageBoundaryExec" not in tree
+    assert "ShuffleExchangeExec" not in tree
+
+
+def test_aqe_join_exchanges_are_conf_gated():
+    """Without shuffledHashJoin.enabled the static join plan is
+    unchanged — no exchanges, no boundary (AQE keeps its hands off
+    plans that never shuffle at the join)."""
+    _, meta = _join(TpuSession({}))._overridden(quiet=True)
+    tree = meta.exec_node.tree_string()
+    assert "StageBoundaryExec" not in tree
+    assert "ShuffleExchangeExec" not in tree
+
+
+def test_repartition_by_num_never_coalesced():
+    """Explicit repartition(n) keeps all n partitions with AQE fully
+    enabled and coalescing thresholds tuned to tempt it
+    (REPARTITION_BY_NUM contract, end to end through the planner)."""
+    conf = dict(AQE_ON)
+    conf["spark.sql.adaptive.advisoryPartitionSizeInBytes"] = 1 << 30
+    s = TpuSession(conf)
+    df = _big(s, n=200, parts=2).repartition(4, "k")
+    _, meta = df._overridden(quiet=True)
+    plan = meta.exec_node
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        nparts = plan.num_partitions(ctx)
+        counts = [sum(device_to_host(b).num_rows
+                      for b in plan.partition_iter(ctx, p))
+                  for p in range(nparts)]
+    assert nparts == 4
+    assert sum(counts) == 200 and sum(1 for c in counts if c) > 1
+
+
+def test_coalesce_and_skew_split_on_aqe_exchanges():
+    """The split-only restriction is lifted for AQE-inserted join
+    exchanges: small reduce partitions coalesce toward the advisory
+    size AND a skewed partition splits at map-batch granularity, with
+    rows exactly equal to the static plan."""
+    conf = dict(AQE_ON)
+    conf.update({
+        "spark.sql.adaptive.autoBroadcastJoinThreshold": 0,  # keep shuffled
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": 4096,
+        "spark.sql.adaptive.skewedPartitionThresholdInBytes": 16384,
+    })
+    kw = dict(n=4000, nkeys=64, skew=0.9, parts=6, rpb=512)
+
+    def q(s):
+        return _big(s, **kw).join(_small(s, keys=range(64)), on="k",
+                                  how="inner")
+
+    want = sorted(q(TpuSession({})).collect(), key=str)
+    s = TpuSession(conf)
+    before = get_registry().snapshot()
+    got = sorted(q(s).collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert got == want and len(got) == 4000
+    assert delta.get("aqe_skew_splits", 0) >= 1, _aqe_delta(delta)
+    assert delta.get("aqe_partitions_coalesced", 0) >= 1, _aqe_delta(delta)
+
+
+@pytest.fixture()
+def parquet_probe(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(1)
+    n = 2000
+    path = str(tmp_path / "probe.parquet")
+    pq.write_table(pa.table({"k": rng.integers(0, 100, n),
+                             "v": rng.random(n)}), path)
+    return path
+
+
+def test_dynamic_filter_pushed_into_probe_scan(parquet_probe):
+    def q(s):
+        return s.read_parquet(parquet_probe).join(
+            _small(s, keys=(3, 5, 9)), on="k", how="inner")
+
+    want = sorted(q(TpuSession({})).collect(), key=str)
+    s = TpuSession(AQE_ON)
+    before = get_registry().snapshot()
+    got = sorted(q(s).collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert got == want and len(got) > 0
+    assert delta.get("aqe_dynamic_filters", 0) >= 1, _aqe_delta(delta)
+
+
+def test_dynamic_filter_skips_shared_scans(parquet_probe):
+    """A scan consumed by more than one plan branch must NOT receive a
+    join-derived filter (it would narrow the other branch); the query
+    still returns exact rows."""
+    # shared-scan shape: the same parquet read feeds the join AND a
+    # second branch of one union
+    def q(s):
+        probe = s.read_parquet(parquet_probe)
+        j = probe.join(_small(s, keys=(3, 5, 9)), on="k", how="inner") \
+            .select("k", "v")
+        return j.union(probe.select("k", "v"))
+
+    want = sorted(q(TpuSession({})).collect(), key=str)
+    s = TpuSession(AQE_ON)
+    before = get_registry().snapshot()
+    got = sorted(q(s).collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert got == want and len(got) > 0
+    assert delta.get("aqe_dynamic_filters", 0) == 0, _aqe_delta(delta)
+
+
+def test_empty_build_side_replans_to_empty():
+    want = []
+    s = TpuSession(AQE_ON)
+    big = _big(s)
+    empty = s.from_pydict({"k": [], "w": []}, SCHEMA_SMALL)
+    before = get_registry().snapshot()
+    got = big.join(empty, on="k", how="inner").collect()
+    delta = get_registry().delta(before)["counters"]
+    assert got == want
+    assert delta.get("aqe_broadcast_switches", 0) == 1, _aqe_delta(delta)
+
+
+def test_warm_rerun_compiles_nothing():
+    """Re-planned fragments hit the same structural compile-cache keys:
+    a second run of the adaptive query has compile_count delta 0."""
+    s = TpuSession(AQE_ON)
+    first = sorted(_join(s).collect(), key=str)
+    before = get_registry().snapshot()
+    again = sorted(_join(s).collect(), key=str)
+    delta = get_registry().delta(before)["counters"]
+    assert again == first
+    assert delta.get("compile_count", 0) == 0, delta
+    assert delta.get("aqe_broadcast_switches", 0) == 1  # re-decided fresh
+
+
+def test_replan_composes_with_host_oracle():
+    """The host (oracle) path of a stage boundary resolves to the
+    static child: collect_host over the SAME prepared plan matches the
+    device (re-planned) rows — the differential harness stays valid for
+    adaptive plans."""
+    s = TpuSession(AQE_ON)
+    df = _join(s)
+    dev = sorted(df.collect(), key=str)
+    _, meta = df._overridden(quiet=True)
+    host = sorted(collect_host(meta.exec_node, s.conf), key=str)
+    assert dev == host and len(dev) > 0
+
+
+# -- TPC-H: adaptive rows exactly equal static, single-chip + mesh -------
+
+_TPCH_QUERIES = ["q3", "q12", "q18"]
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    d = str(tmp_path_factory.mktemp("tpch_adaptive") / "sf001")
+    generate_tpch(d, sf=0.01)
+    _split_tables(d, ("lineitem", "orders", "customer"), parts=4)
+    return d
+
+
+def _split_tables(data_dir: str, tables, parts: int) -> None:
+    """Multi-file tables so scans are multi-partition and the planner
+    actually exercises exchanges (same shape as the recovery chaos
+    suite)."""
+    import pyarrow.parquet as pq
+    for table in tables:
+        path = os.path.join(data_dir, table, "part-0.parquet")
+        t = pq.read_table(path)
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(data_dir, table,
+                                        f"part-{i}.parquet"))
+
+
+@pytest.mark.parametrize("query", _TPCH_QUERIES)
+def test_tpch_adaptive_matches_oracle(tpch_dir, query):
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    r = run_benchmark(tpch_dir, 0.01, [query], verify=True,
+                      generate=False, suite="tpch",
+                      session_conf=dict(AQE_ON))[0]
+    assert "error" not in r, r
+    assert r["ok"], r
+
+
+@pytest.mark.parametrize("query", _TPCH_QUERIES)
+def test_tpch_adaptive_matches_oracle_mesh(tpch_dir, query):
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    conf = dict(AQE_ON)
+    conf["spark.rapids.tpu.mesh.deviceCount"] = 8
+    r = run_benchmark(tpch_dir, 0.01, [query], verify=True,
+                      generate=False, suite="tpch", session_conf=conf)[0]
+    assert "error" not in r, r
+    assert r["ok"], r
+
+
+def test_tpch_adaptive_exact_under_loss_storm(tpch_dir):
+    """Replanning must not break lineage recovery: the broadcast reads
+    the build exchange's map output through the recovering fetch, so
+    the peer-death + spill-corruption storm still yields exact rows."""
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    conf = dict(AQE_ON)
+    conf.update({
+        "spark.rapids.test.faults":
+            ("shuffle.peer.dead:dead,times=2;"
+             "spill.disk.corrupt:corrupt,priority=0,times=2"),
+        "spark.rapids.memory.tpu.spillStoreSize": 1 << 16,
+        "spark.rapids.memory.host.spillStorageSize": 4096,
+    })
+    r = run_benchmark(tpch_dir, 0.01, ["q18"], verify=True,
+                      generate=False, suite="tpch", session_conf=conf)[0]
+    assert "error" not in r, r
+    assert r["ok"], r
+    cat = r["metrics"].get("BufferCatalog", {})
+    assert cat.get("stage_recomputes", 0) > 0, cat
+
+
+def test_shuffle_transport_partition_rows():
+    """shuffle/local.py row statistics: exact per-partition counts from
+    known_rows, maintained across invalidation (the second statistic the
+    re-optimizer feeds on)."""
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.exec.core import host_to_device
+    from spark_rapids_tpu.host.batch import HostBatch, HostColumn
+    from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
+
+    schema = T.Schema([T.StructField("x", T.IntegerType())])
+    conf = TpuConf({})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = LocalShuffleTransport(conf, ctx)
+        for m in range(3):
+            hb = HostBatch([HostColumn(
+                np.arange(4, dtype=np.int32), np.ones(4, bool),
+                T.IntegerType())], schema)
+            b = host_to_device(hb)
+            b.known_rows = 4
+            t.write_partition(9, m, m % 2, b)
+        assert t.partition_rows(9) == {0: 8, 1: 4}
+        t.invalidate_map_outputs(9, [0])  # map 0 wrote only to pid 0
+        assert t.partition_rows(9) == {0: 4, 1: 4}
+        t.close()

@@ -29,6 +29,8 @@ def _df(s, n=150):
 
 @pytest.mark.parametrize("codec", ["none", "lz4", "zstd"])
 def test_cache_roundtrip_both_backends(codec):
+    if codec == "zstd":
+        pytest.importorskip("zstandard")
     s = TpuSession({"spark.rapids.sql.cache.compression.codec": codec})
     base = _df(s).where(col("k") < lit(6))
     cached = base.cache()
