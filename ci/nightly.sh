@@ -20,6 +20,8 @@ mkdir -p artifacts
 
 {
   echo "== nightly @ ${STAMP} (commit $(git rev-parse --short HEAD)) =="
+  echo "-- static analysis: enginelint --strict --"
+  python -m tools.enginelint spark_rapids_tpu/ --strict
   echo "-- full TPC-DS (99) + TPC-H (22) oracle sweeps --"
   TPCDS_FULL=1 TPCH_FULL=1 python -m pytest \
     tests/test_tpcds.py tests/test_tpch.py -q --durations=20

@@ -22,6 +22,66 @@ mkdir -p artifacts
 
 {
   echo "== premerge @ ${STAMP} (commit $(git rev-parse --short HEAD)) =="
+  echo "-- static analysis: enginelint --strict --"
+  # source-convention gate (docs/developer-guide.md): zero unsuppressed
+  # findings, and every suppression carries a written reason
+  python -m tools.enginelint spark_rapids_tpu/ --strict
+  echo "-- plan verifier smoke: TPC-H ladder, mesh-8, fusion+AQE --"
+  # every ladder plan must verify clean through EVERY rewrite pass
+  # (everyPass mode), and the default-mode walk (one pass after the
+  # final rewrite) must add <2% to the bench's planning step
+  # (build_query + prepare) aggregated across the ladder
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import os, tempfile, time
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.plan.verify import verify_plan
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+LADDER = ["q1", "q3", "q6", "q12", "q13", "q18"]
+BASE = {"spark.rapids.tpu.mesh.deviceCount": 8,
+        "spark.sql.adaptive.shuffledHashJoin.enabled": True}
+
+# 1) zero violations with per-pass verification armed on every query
+every = TpuSession({**BASE, "spark.rapids.sql.verify.plan.everyPass": True})
+for q in LADDER:
+    build_tpch_query(q, every, d)._overridden(quiet=True)
+print(f"verifier smoke: {len(LADDER)} ladder plans clean through every pass")
+
+# 2) overhead probe: default-mode verify (one final-pass walk) must add
+# <2% to plan-time, aggregated across the ladder (median-of-samples)
+s = TpuSession({**BASE, "spark.rapids.sql.verify.plan": False})
+tot_plan = tot_verify = 0.0
+for q in LADDER:
+    df = build_tpch_query(q, s, d)
+    for _ in range(30):  # warm tag/expr caches before timing
+        df._overridden(quiet=True)
+    plans, ts_plan = [], []
+    for _ in range(60):
+        t0 = time.perf_counter()
+        df2 = build_tpch_query(q, s, d)
+        ov, meta = df2._overridden(quiet=True)
+        ts_plan.append(time.perf_counter() - t0)
+        plans.append(meta.exec_node)
+    ts_verify = []
+    for p in plans:
+        t0 = time.perf_counter()
+        verify_plan(p, s.conf)  # first verify of a fresh plan
+        ts_verify.append(time.perf_counter() - t0)
+    ts_plan.sort(); ts_verify.sort()
+    med_p, med_v = ts_plan[len(ts_plan)//2], ts_verify[len(ts_verify)//2]
+    tot_plan += med_p; tot_verify += med_v
+    print(f"  {q}: plan={med_p*1e6:.0f}us verify={med_v*1e6:.1f}us "
+          f"({med_v/med_p*100:.2f}%)")
+frac = tot_verify / tot_plan
+print(f"verifier overhead across ladder: {frac*100:.2f}% of plan-time")
+assert frac < 0.02, \
+    f"plan verifier adds {frac*100:.2f}% to plan-time (budget: 2%)"
+PY
   echo "-- unit + differential suite (CPU mesh) --"
   python -m pytest tests/ -q --durations=10
   echo "-- shuffle fault-tolerance chaos suite (seeded, CPU-only) --"

@@ -41,6 +41,7 @@ try:
     import pyarrow as _pa
     _pa.set_cpu_count(1)
     _pa.set_io_thread_count(1)
+# enginelint: disable=RL001 (pyarrow optional at import time; no query can be running yet)
 except Exception:  # pyarrow optional at import time
     pass
 

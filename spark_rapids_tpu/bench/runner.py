@@ -221,6 +221,14 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
             else:
                 rec["ok"] = True
         except Exception as e:  # noqa: BLE001 - per-query isolation
+            from spark_rapids_tpu.exec.lifecycle import QueryLifecycleError
+            if isinstance(e, QueryLifecycleError):
+                # cancellation / deadline / shutdown apply to the whole
+                # run — recording them as a per-query failure and moving
+                # on would keep benchmarking a killed session.  Other
+                # terminal errors (e.g. unrecoverable map-output loss)
+                # kill only THIS query and are part of the report.
+                raise
             rec["error"] = f"{type(e).__name__}: {e}"
             rec["ok"] = False
         reports.append(rec)

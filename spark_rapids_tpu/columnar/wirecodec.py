@@ -240,6 +240,7 @@ def maybe_dict_arrow(arr, n: int):
     import pyarrow.compute as pc
     try:
         enc = arr.dictionary_encode()
+    # enginelint: disable=RL001 (dictionary codec is best-effort; un-encodable arrays ship raw)
     except Exception:  # noqa: BLE001 - codec is best-effort
         return None
     k = len(enc.dictionary)

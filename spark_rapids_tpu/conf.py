@@ -370,6 +370,7 @@ def generate_docs() -> str:
             continue
         try:
             importlib.import_module(m.name)
+        # enginelint: disable=RL001 (docs walker: one failing import skips one module and warns loudly below; no query context)
         except Exception as e:  # noqa: BLE001 - any import-time failure
             # (not just ImportError: device/backend init in a module
             # must not abort the whole generator) skips ONE module; a

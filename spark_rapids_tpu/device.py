@@ -151,6 +151,7 @@ def initialize_device(conf=None, probe=None) -> None:
         def work():
             try:
                 result["devices"] = (probe or _probe_devices)()
+            # enginelint: disable=RL001 (probe error is forwarded via the result dict and re-raised by the caller)
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 result["error"] = e
 
@@ -177,6 +178,7 @@ def initialize_device(conf=None, probe=None) -> None:
         stats = {}
         try:
             stats = d.memory_stats() or {}
+        # enginelint: disable=RL001 (memory_stats is an optional probe; absence leaves the HBM limit unknown)
         except Exception:
             pass
         limit = stats.get("bytes_limit")

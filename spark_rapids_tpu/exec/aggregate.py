@@ -417,6 +417,7 @@ class HashAggregateExec(PlanNode):
             def sync_counts():
                 if len(chunk) == 1:
                     return [chunk[0][1].host_num_rows()]
+                # enginelint: disable=RL003 (one stacked transfer for the whole chunk; this IS the batched sync)
                 return list(_jax.device_get(ctx.dispatch(
                     _jnp.stack, [p.num_rows for _s, p in chunk])))
 

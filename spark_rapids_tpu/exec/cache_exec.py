@@ -133,6 +133,7 @@ class CachedScanExec(PlanNode):
         # snapshot under the lock, re-materializing if a concurrent
         # unpersist() raced in between: yielding an empty partition
         # would be silently wrong results, not just a crash
+        # enginelint: disable=RL004 (re-runs only when a concurrent unpersist() raced; _ensure() either succeeds or raises)
         while True:
             self._ensure()
             with self._lock:

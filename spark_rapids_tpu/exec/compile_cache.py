@@ -311,6 +311,7 @@ def _cpu_backend() -> bool:
         try:
             import jax
             _SERIALIZE_COMPILES = jax.default_backend() == "cpu"
+        # enginelint: disable=RL001 (backend probe; falls back to non-serialized compiles)
         except Exception:
             _SERIALIZE_COMPILES = False
         if _SERIALIZE_COMPILES:
@@ -422,6 +423,7 @@ class SharedJit:
     def __call__(self, *args, **kwargs):
         try:
             sig = self._signature(args, kwargs)
+        # enginelint: disable=RL001 (unhashable static leaf falls back to an uncounted dispatch)
         except Exception:
             with dispatch_guard():
                 return self.fn(*args, **kwargs)

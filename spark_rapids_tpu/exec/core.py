@@ -338,6 +338,7 @@ class ExecCtx:
                     os.makedirs(d, exist_ok=True)
                     tracer.export(os.path.join(
                         d, f"trace_{tracer.query_id}.json"))
+            # enginelint: disable=RL001 (trace export is best-effort teardown; the query already finished)
             except Exception:
                 pass
 
@@ -429,6 +430,7 @@ class PlanNode:
             first_t0 = None
             batches = 0
             rows = 0
+            # enginelint: disable=RL004 (driven by next(it); terminates with the child iterator and propagates its exceptions)
             while True:
                 t0 = time.perf_counter()
                 if first_t0 is None:
@@ -696,6 +698,7 @@ def drain_partitions_indexed(ctx: ExecCtx, node: PlanNode) -> Iterator:
                 try:
                     for sb in fut.result():
                         sb.close()
+                # enginelint: disable=RL001 (finally-block cleanup: raising would mask the in-flight exception; normal completion already consumed every future)
                 except BaseException:
                     pass
 

@@ -310,7 +310,9 @@ class JoinExec(PlanNode):
 
             def sync_totals():
                 if len(pending) == 1:
+                    # enginelint: disable=RL003 (single-entry fast path; one scalar sync)
                     return [int(jax.device_get(pending[0][2]))]
+                # enginelint: disable=RL003 (stacked transfer for all pending probes; this IS the batched sync)
                 return [int(t) for t in jax.device_get(ctx.dispatch(
                     jnp.stack, [p[2] for p in pending]))]
 

@@ -588,6 +588,7 @@ class MeshExchangeExec(_MeshOutputMixin, PlanNode):
         send_cap = ctx.conf.get(MESH_SEND_CAPACITY) or None
         result, flags = self._program(mesh, send_cap)(stacked)
         if send_cap is not None and bool(
+                # enginelint: disable=RL003 (overflow-flag check; one scalar sync gates the recompile fallback)
                 np.asarray(jax.device_get(flags)).any()):
             get_registry().inc("mesh_send_overflows")
             result, _ = self._program(mesh, None)(stacked)
@@ -648,6 +649,7 @@ def output_name_safe(e: Expression) -> str:
     from spark_rapids_tpu.expr.core import output_name
     try:
         return output_name(e)
+    # enginelint: disable=RL001 (descriptive label only; falls back to repr)
     except Exception:  # noqa: BLE001 - descriptive label only
         return repr(e)
 

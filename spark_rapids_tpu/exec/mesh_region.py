@@ -342,6 +342,7 @@ class MeshRegionExec(_MeshOutputMixin, PlanNode):
         send_cap = ctx.conf.get(MESH_SEND_CAPACITY) or None
         result, flags = self._program(mesh, send_cap)(stacked)
         if send_cap is not None and bool(
+                # enginelint: disable=RL003 (overflow-flag check; one scalar sync gates the recompile fallback)
                 np.asarray(jax.device_get(flags)).any()):
             get_registry().inc("mesh_send_overflows")
             result, _ = self._program(mesh, None)(stacked)

@@ -118,6 +118,7 @@ class RoundRobinPartitioning(Partitioning):
         # ONE device round trip, not one per batch)
         if is_device:
             counts = [int(c) for c in
+                      # enginelint: disable=RL003 (ONE stacked round trip for all batch counts; this IS the batched sync)
                       jax.device_get([b.num_rows for b in batches])]
         else:
             counts = [b.num_rows for b in batches]

@@ -59,6 +59,12 @@ class StageBoundaryExec(PlanNode):
             # reparent so explain_analyze / tree renders walk the plan
             # that actually executed
             self.children = (new,)
+        # runtime half of the plan invariant verifier: the re-planned
+        # subtree must still satisfy the boundary/schema contracts
+        # (plan/verify.py; the prepare-time passes ran their own hooks)
+        from spark_rapids_tpu.plan.verify import PLAN_VERIFY, verify_plan
+        if ctx.conf is not None and ctx.conf.get(PLAN_VERIFY):
+            verify_plan(self, ctx.conf, "aqe_replan")
         return new
 
     def num_partitions(self, ctx: ExecCtx) -> int:

@@ -114,7 +114,26 @@ import threading
 
 from spark_rapids_tpu.conf import TEST_FAULTS, TEST_FAULTS_SEED
 
-__all__ = ["FaultRegistry", "FaultRule", "FaultAction", "InjectedFault"]
+__all__ = ["FaultRegistry", "FaultRule", "FaultAction", "InjectedFault",
+           "KNOWN_POINTS"]
+
+#: every injection point wired into the engine (the module docstring
+#: documents each).  enginelint RL005 cross-checks this registry against
+#: the live ``.check("point", ...)`` call sites in both directions, so a
+#: renamed site or a stale entry fails premerge instead of silently
+#: turning a fault plan into a no-op.
+KNOWN_POINTS = frozenset({
+    "tcp.server.frame",
+    "tcp.client.connect",
+    "store.fetch",
+    "shuffle.peer.hang",
+    "shuffle.peer.dead",
+    "spill.disk.corrupt",
+    "spill.disk.enospc",
+    "mesh.slice.lost",
+    "memory.oom",
+    "memory.oom.until_rows",
+})
 
 #: keys with registry-level meaning; everything else in a rule is a
 #: context filter

@@ -163,6 +163,7 @@ def stripe_column_stats(path: str) -> list[list[dict]] | None:
                         if f2 == 1 and w2 == 2]
                 stripes.append(cols)
         return stripes or None
+    # enginelint: disable=RL001 (stats pruning is best-effort; None keeps every stripe)
     except Exception:  # noqa: BLE001 - pruning is best-effort
         return None
 

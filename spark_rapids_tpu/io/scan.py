@@ -336,6 +336,7 @@ class FileScanExec(PlanNode):
                             rb, string_widths=self._width_map(rb))):
                         return
                 put(DONE)
+            # enginelint: disable=RL001 (prefetch thread forwards the exception through the queue; the consumer re-raises it)
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 put(e)
 

@@ -202,5 +202,6 @@ class HostArena:
     def __del__(self):
         try:
             self.close()
+        # enginelint: disable=RL001 (interpreter-shutdown __del__: raising here aborts finalization)
         except Exception:
             pass

@@ -49,10 +49,12 @@ def _catalog_view(ctx) -> dict:
     view = {}
     try:
         view["metrics"] = dict(cat.metrics)
+    # enginelint: disable=RL001 (diag view is best-effort; section omitted on failure)
     except Exception:
         pass
     try:
         view["tier_occupancy"] = cat.tier_occupancy()
+    # enginelint: disable=RL001 (diag view is best-effort; section omitted on failure)
     except Exception:
         pass
     return view
@@ -62,6 +64,7 @@ def _fault_view(ctx) -> dict:
     spec = None
     try:
         spec = ctx.conf.settings.get("spark.rapids.test.faults")
+    # enginelint: disable=RL001 (conf read is best-effort for the bundle)
     except Exception:
         pass
     # fault registries hang off transports / readers parked in the stage
@@ -77,6 +80,7 @@ def _fault_view(ctx) -> dict:
             try:
                 fired = [dict(e) if isinstance(e, dict) else str(e)
                          for e in list(reg.log)[-_MAX_FAULT_LOG:]]
+            # enginelint: disable=RL001 (fault audit log is best-effort; section left empty)
             except Exception:
                 fired = []
             if fired:
@@ -97,6 +101,7 @@ def _lifecycle_view(ctx) -> dict:
                 "timeout_s": lc.timeout,
                 "deadline_remaining_s": lc.remaining(),
                 "cancel_requested": lc.cancel_event.is_set()}
+    # enginelint: disable=RL001 (lifecycle view is best-effort; section omitted)
     except Exception:
         return {}
 
@@ -112,6 +117,7 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
         tracer = getattr(ctx, "tracer", None)
         try:
             max_ev = int(ctx.conf.get(DIAG_MAX_SPAN_EVENTS))
+        # enginelint: disable=RL001 (bad conf value falls back to the default event cap)
         except Exception:
             max_ev = 256
 
@@ -133,12 +139,14 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
             from ..plan.overrides import explain_analyze
             bundle["plan_analyzed"] = explain_analyze(plan, ctx).splitlines() \
                 if plan is not None else []
+        # enginelint: disable=RL001 (plan render is best-effort; section left empty)
         except Exception:
             bundle["plan_analyzed"] = []
 
         try:
             from .registry import query_metrics_snapshot
             bundle["metrics"] = query_metrics_snapshot(ctx)
+        # enginelint: disable=RL001 (metrics snapshot is best-effort; section left empty)
         except Exception:
             bundle["metrics"] = {}
 
@@ -150,6 +158,7 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
         try:
             bundle["conf"] = {k: v for k, v in ctx.conf.settings.items()
                               if str(k).startswith("spark.")}
+        # enginelint: disable=RL001 (conf snapshot is best-effort; section left empty)
         except Exception:
             bundle["conf"] = {}
 
@@ -160,5 +169,6 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
             json.dump(bundle, f, default=str)
         os.replace(tmp, path)
         return path
+    # enginelint: disable=RL001 (bundle emission must never mask the original query error)
     except Exception:
         return None

@@ -102,6 +102,7 @@ class MetricsRegistry:
         for name, fn in sources:
             try:
                 vals = fn()
+            # enginelint: disable=RL001 (metric source callbacks are best-effort; a failing source is skipped)
             except Exception:
                 continue
             if not isinstance(vals, dict):

@@ -213,6 +213,7 @@ def decompose_maps(plan: L.LogicalPlan, conf: TpuConf) -> L.LogicalPlan:
             for e in _node_exprs(n):
                 try:
                     nm = _oname(e)
+                # enginelint: disable=RL001 (expression without an output name cannot collide; skip it)
                 except Exception:
                     continue
                 if nm in names and not (

@@ -73,6 +73,7 @@ def sync_cpu_dispatch() -> None:
     try:
         import jax
         jax.config.update("jax_cpu_enable_async_dispatch", False)
+    # enginelint: disable=RL001 (jax may be absent; sync dispatch only matters once it exists)
     except Exception:
         pass
     _cpu_sync_dispatch = True
@@ -128,6 +129,7 @@ def pin_arrow_threads() -> None:
         import pyarrow as pa
         pa.set_cpu_count(1)
         pa.set_io_thread_count(1)
+    # enginelint: disable=RL001 (pyarrow optional; thread pinning is best-effort)
     except Exception:
         pass
     _arrow_pinned = True
@@ -168,6 +170,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         import jax
         platforms = jax.config.jax_platforms or os.environ.get(
             "JAX_PLATFORMS", "")
+    # enginelint: disable=RL001 (fingerprint falls back to the env var when jax config is unreadable)
     except Exception:
         platforms = os.environ.get("JAX_PLATFORMS", "")
     fp.update(str(platforms).encode())
@@ -213,6 +216,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         try:
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # enginelint: disable=RL001 (knob name varies across jax versions; the cache works without it)
         except Exception:
             pass  # knob name varies across jax versions
         _enabled_dir = cache_dir
@@ -248,6 +252,7 @@ def ensure_runtime(conf=None) -> None:
         try:
             import jax
             on = jax.default_backend() != "cpu"
+        # enginelint: disable=RL001 (backend probe defaults to cache-off when jax is unavailable)
         except Exception:
             on = False
     else:

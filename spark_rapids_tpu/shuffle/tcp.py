@@ -248,6 +248,7 @@ class TcpShuffleServer:
     def _serve(self, conn: socket.socket) -> None:
         try:
             with conn:
+                # enginelint: disable=RL004 (per-connection serve loop; peer close raises ConnectionError and server shutdown closes the socket)
                 while True:
                     try:
                         _, body = _recv_frame(conn, _MAX_CTRL_FRAME)
@@ -271,6 +272,7 @@ class TcpShuffleServer:
                                  "detail": "reported by peer",
                                  "observed_empty":
                                      e.observed_empty})).encode())
+                    # enginelint: disable=RL001 (failure is surfaced to the peer as an error frame, not swallowed)
                     except Exception as e:  # noqa: BLE001 - sent to peer
                         # store/codec failures must reach the client as a
                         # diagnosable error frame, not a connection reset
@@ -325,6 +327,7 @@ class TcpShuffleServer:
             try:
                 ctx = getattr(self._store, "ctx", None)
                 tracer = ctx.tracer if ctx is not None else None
+            # enginelint: disable=RL001 (tracing is best-effort; serving proceeds without a span)
             except Exception:
                 tracer = None
             if tracer is not None:
@@ -549,6 +552,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                     f"{crc_name!r} (offered {list(_CRC_ALGOS)})")
             recv_window = 0
             index = lo
+            # enginelint: disable=RL004 (frame pump bounded by the socket timeout; END/ERROR frames or ConnectionError exit)
             while True:
                 tag, frame = _recv_frame(sock, max_frame)
                 if tag == _TAG_END:

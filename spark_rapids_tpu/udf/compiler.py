@@ -76,6 +76,7 @@ def compile_udf(fn: Callable, args: Sequence[Expression]) -> Expression | None:
     construct is outside the supported subset (silent fallback)."""
     try:
         return _compile(fn, list(args))
+    # enginelint: disable=RL001 (unsupported bytecode falls back to the interpreted UDF)
     except Exception:
         return None
 
@@ -92,6 +93,7 @@ def _as_bool(e: Expression) -> Expression:
         return lit(bool(e.value))
     try:
         is_bool = isinstance(e.dtype, T.BooleanType)
+    # enginelint: disable=RL001 (unbound dtype at compile time; numeric truthiness assumed)
     except Exception:
         # unbound attribute: dtype unknown at compile time — assume
         # numeric truthiness (comparisons/logic produce Boolean nodes

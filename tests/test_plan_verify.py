@@ -1,0 +1,232 @@
+"""Physical-plan invariant verifier (plan/verify.py).
+
+Two halves, per the static-analysis tentpole contract:
+
+- every TPC-H ladder plan verifies CLEAN through all rewrite passes —
+  single-chip and mesh-8, fusion and AQE on and off (run under
+  ``everyPass`` so the verifier fires inside ``prepare()`` after each
+  pass and a violation aborts planning at the pass that caused it);
+- hand-broken plans (schema mismatch on a pass-through node, a
+  donate_ok fused stage over a shared input, a stripped lineage stamp,
+  a host transition captured inside a mesh region) each raise a
+  :class:`PlanInvariantError` naming the RIGHT node path and the pass
+  after which the broken shape was observed.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan.verify import (PASS_ORDER, PlanInvariantError,
+                                          verify_plan)
+
+_LADDER = ["q1", "q3", "q6", "q12", "q13", "q18"]
+
+# every ladder conf turns everyPass on so the suite exercises the
+# per-pass attribution mode end to end (the default steady-state mode
+# verifies once, after the final pass — pinned separately below)
+_EVERY = {"spark.rapids.sql.verify.plan.everyPass": True}
+
+_CONFS = {
+    "single": {**_EVERY},
+    "mesh8": {**_EVERY, "spark.rapids.tpu.mesh.deviceCount": 8},
+    "fusion_off": {**_EVERY, "spark.rapids.sql.fusion.enabled": False},
+    "aqe": {**_EVERY,
+            "spark.sql.adaptive.shuffledHashJoin.enabled": True},
+    "mesh8_aqe": {**_EVERY, "spark.rapids.tpu.mesh.deviceCount": 8,
+                  "spark.sql.adaptive.shuffledHashJoin.enabled": True},
+}
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    d = str(tmp_path_factory.mktemp("tpch_verify") / "sf001")
+    generate_tpch(d, sf=0.01)
+    return d
+
+
+def _plan(df):
+    ov, meta = df._overridden(quiet=True)
+    return meta.exec_node
+
+
+def _find(node, name, seen=None):
+    seen = set() if seen is None else seen
+    if id(node) in seen:
+        return None
+    seen.add(id(node))
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        hit = _find(c, name, seen)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _pydict_plan(conf=None):
+    """filter -> project -> group_by over 4 partitions: the smallest
+    plan carrying a FusedStageExec AND a ShuffleExchangeExec (or, under
+    mesh confs, a MeshRegionExec) — SF0.01 TPC-H scans are
+    single-partition and plan no exchange at all."""
+    s = TpuSession(dict(conf or {}))
+    data = {"k": (np.arange(40) % 5).astype(np.int32),
+            "v": np.arange(40, dtype=np.int64)}
+    df = (s.from_pydict(data, SCHEMA, partitions=4)
+            .filter(col("v") > lit(3))
+            .select(col("k"), (col("v") * lit(2)).alias("w"))
+            .group_by("k").agg(Sum(col("w"))))
+    return _plan(df), s
+
+
+# ---------------------------------------------------------------------------
+# clean plans: every ladder query under every conf verifies through
+# prepare()'s per-pass hooks AND an explicit final walk
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("confname", sorted(_CONFS))
+@pytest.mark.parametrize("query", _LADDER)
+def test_tpch_plans_verify_clean(data_dir, query, confname):
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    s = TpuSession(dict(_CONFS[confname]))
+    df = build_tpch_query(query, s, data_dir)
+    plan = _plan(df)  # prepare() already verified after every pass
+    verify_plan(plan, s.conf)  # and the final shape re-verifies
+
+
+def test_pydict_plans_verify_clean():
+    for confname in sorted(_CONFS):
+        plan, s = _pydict_plan(_CONFS[confname])
+        verify_plan(plan, s.conf)
+
+
+def _trace_verify_calls(monkeypatch):
+    from spark_rapids_tpu.plan import verify as V
+    calls = []
+    real = V.verify_plan
+    monkeypatch.setattr(
+        V, "verify_plan",
+        lambda root, conf=None, pass_name="mesh_regions":
+            (calls.append(pass_name), real(root, conf, pass_name))[1])
+    return calls
+
+
+def test_every_pass_mode_verifies_after_every_pass(monkeypatch):
+    """Under everyPass, prepare() verifies once per rewrite pass, in
+    PASS_ORDER (sans the runtime-only aqe_replan hook)."""
+    calls = _trace_verify_calls(monkeypatch)
+    _pydict_plan(_EVERY)
+    assert tuple(calls) == PASS_ORDER[:-1]
+
+
+def test_default_mode_verifies_final_plan_once(monkeypatch):
+    """Default steady state: one walk, after the final rewrite pass —
+    the <2% plan-time budget that keeps the verifier on everywhere."""
+    calls = _trace_verify_calls(monkeypatch)
+    _pydict_plan()
+    assert calls == ["mesh_regions"]
+
+
+def test_verifier_conf_gate_off(monkeypatch):
+    from spark_rapids_tpu.plan import verify as V
+    calls = []
+    monkeypatch.setattr(V, "verify_plan",
+                        lambda *a, **k: calls.append(a))
+    _pydict_plan({"spark.rapids.sql.verify.plan": False})
+    assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# broken plans: each hand-introduced violation names node and pass
+# ---------------------------------------------------------------------------
+
+def test_schema_mismatch_on_passthrough_node():
+    from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+
+    class _BadSwitch(BackendSwitchExec):
+        """Pass-through that silently drops its child's last field."""
+        @property
+        def output_schema(self):
+            full = self.children[0].output_schema
+            return T.Schema(list(full.fields[:-1]))
+
+    plan, s = _pydict_plan()
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(_BadSwitch(plan, "device"), s.conf, "transitions")
+    e = ei.value
+    assert e.pass_name == "transitions"
+    assert e.node_path.startswith("_BadSwitch")
+    assert "diverges" in e.message
+
+
+def test_double_consumer_donation():
+    from spark_rapids_tpu.exec.basic import GlobalLimitExec
+    from spark_rapids_tpu.exec.core import PlanNode
+
+    class _Tee(PlanNode):
+        """Test-only 2-parent shape: both children share a subtree."""
+        @property
+        def output_schema(self):
+            return self.children[0].output_schema
+
+    plan, s = _pydict_plan()
+    fused = _find(plan, "FusedStageExec")
+    assert fused is not None
+    # second consumer of the fused stage's input -> donation illegal
+    root = _Tee([plan, GlobalLimitExec(1, fused.children[0])])
+    fused.donate_ok = True
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(root, s.conf, "fusion")
+    e = ei.value
+    assert e.pass_name == "fusion"
+    assert "FusedStageExec" in e.node_path
+    assert "non-exclusive" in e.message
+
+
+def test_stripped_lineage_stamp():
+    plan, s = _pydict_plan()
+    ex = _find(plan, "ShuffleExchangeExec")
+    assert ex is not None and getattr(ex, "_conf_fp", None)
+    ex._conf_fp = None
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(plan, s.conf, "stamp_lineage")
+    e = ei.value
+    assert e.pass_name == "stamp_lineage"
+    assert e.node_path.endswith("ShuffleExchangeExec[0]")
+    assert "lineage stamp" in e.message
+    # before the stamping pass ran, the same shape is legal
+    verify_plan(plan, s.conf, "shared_scans")
+
+
+def test_transition_captured_inside_mesh_region():
+    from spark_rapids_tpu.exec.transitions import BackendSwitchExec
+    plan, s = _pydict_plan({"spark.rapids.tpu.mesh.deviceCount": 8})
+    region = _find(plan, "MeshRegionExec")
+    assert region is not None
+    verify_plan(plan, s.conf)  # sane before the breakage
+    region._members = region._members + (
+        BackendSwitchExec(region._members[-1], "host"),)
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(plan, s.conf, "mesh_regions")
+    e = ei.value
+    assert e.pass_name == "mesh_regions"
+    assert "MeshRegionExec" in e.node_path
+    assert "host transition" in e.message
+
+
+def test_error_is_structured():
+    plan, s = _pydict_plan()
+    ex = _find(plan, "ShuffleExchangeExec")
+    ex._conf_fp = None
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(plan, s.conf)
+    e = ei.value
+    # message embeds both structured fields, for log triage
+    assert e.node_path in str(e) and "mesh_regions" in str(e)
+    assert isinstance(e, RuntimeError)

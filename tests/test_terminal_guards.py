@@ -1,0 +1,135 @@
+"""Terminal-exception discipline (enginelint RL001) at the sites this
+PR fixed or pinned: a ``terminal = True`` lifecycle error
+(QueryCancelled / QueryDeadlineExceeded / MapOutputLostError) must
+never be swallowed by per-item isolation handlers — it aborts the
+whole operation — while ordinary per-item errors keep their existing
+isolation semantics.  One representative site per subsystem:
+
+* bench: ``run_benchmark``'s per-query handler (the RL001 fix in this
+  PR) re-raises lifecycle errors (QueryLifecycleError) instead of
+  recording them as a per-query failure and benchmarking on in a
+  killed session — data-loss terminals (MapOutputLostError, recovery
+  exhaustion) kill only their query and stay in the report;
+* shuffle: ``fetch_remote_with_retry`` surfaces a terminal fetch error
+  immediately — no retry ladder, no breaker penalty;
+* exec: a terminal error raised mid-drain propagates out of
+  ``collect()`` (the finally-block future cleanup must not eat it).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.lifecycle import QueryCancelled
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.shuffle.errors import ShuffleFetchError
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+
+
+def test_lifecycle_errors_are_terminal():
+    assert QueryCancelled("q").terminal is True
+
+
+# ---------------------------------------------------------------------------
+# bench: per-query isolation must not absorb a terminal error
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    d = str(tmp_path_factory.mktemp("tpch_guards") / "sf001")
+    generate_tpch(d, sf=0.01)
+    return d
+
+
+def test_bench_reraises_terminal(tpch_dir, monkeypatch):
+    from spark_rapids_tpu.bench import runner
+
+    def cancelled(df, backend, plan=None, **kw):
+        raise QueryCancelled("bench-q6", "session shut down")
+
+    monkeypatch.setattr(runner, "_collect_rows", cancelled)
+    with pytest.raises(QueryCancelled):
+        runner.run_benchmark(tpch_dir, 0.01, ["q6"], suite="tpch",
+                             generate=False)
+
+
+def test_bench_records_nonterminal_and_continues(tpch_dir, monkeypatch):
+    from spark_rapids_tpu.bench import runner
+
+    def broken(df, backend, plan=None, **kw):
+        raise ValueError("synthetic per-query failure")
+
+    monkeypatch.setattr(runner, "_collect_rows", broken)
+    reports = runner.run_benchmark(tpch_dir, 0.01, ["q6", "q1"],
+                                   suite="tpch", generate=False)
+    assert [r["query"] for r in reports] == ["q6", "q1"]
+    assert all(not r["ok"] for r in reports)
+    assert all(r["error"].startswith("ValueError") for r in reports)
+
+
+# ---------------------------------------------------------------------------
+# shuffle: terminal fetch errors skip the retry ladder entirely
+# ---------------------------------------------------------------------------
+
+def test_fetch_retry_surfaces_terminal_immediately(monkeypatch):
+    from spark_rapids_tpu.shuffle import retry
+
+    attempts = []
+
+    def dead_peer(peer, shuffle_id, part_id, **kw):
+        attempts.append(1)
+        err = ShuffleFetchError("map output lost")
+        err.terminal = True
+        raise err
+        yield  # pragma: no cover - keeps this a generator
+
+    monkeypatch.setattr(retry, "fetch_remote", dead_peer)
+    with pytest.raises(ShuffleFetchError):
+        list(retry.fetch_remote_with_retry(
+            ("127.0.0.1", 1), "s", 0, max_retries=5, retry_wait=0.0))
+    assert len(attempts) == 1  # no reconnects against lost DATA
+
+
+def test_fetch_retry_still_retries_transient(monkeypatch):
+    from spark_rapids_tpu.shuffle import retry
+    retry.reset_circuit_breakers()
+
+    attempts = []
+
+    def flaky(peer, shuffle_id, part_id, **kw):
+        attempts.append(1)
+        raise ShuffleFetchError("connection reset")
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(retry, "fetch_remote", flaky)
+    with pytest.raises(ShuffleFetchError) as ei:
+        list(retry.fetch_remote_with_retry(
+            ("127.0.0.1", 2), "s", 0, max_retries=2, retry_wait=0.0,
+            backoff=1.0))
+    assert len(attempts) == 3  # first try + 2 retries
+    assert ei.value.terminal is True  # exhaustion marks it terminal
+
+
+# ---------------------------------------------------------------------------
+# exec: terminal errors propagate out of the collect drain
+# ---------------------------------------------------------------------------
+
+def test_collect_drain_propagates_terminal(monkeypatch):
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+
+    s = TpuSession({})
+    data = {"k": (np.arange(16) % 4).astype(np.int32),
+            "v": np.arange(16, dtype=np.int64)}
+    df = s.from_pydict(data, SCHEMA, partitions=2).filter(
+        col("v") >= lit(0))
+
+    def cancelled_iter(self, ctx, pid):
+        raise QueryCancelled("drain-q", "cancelled mid-stream")
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(LocalScanExec, "partition_iter", cancelled_iter)
+    with pytest.raises(QueryCancelled):
+        df.collect()

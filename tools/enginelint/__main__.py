@@ -1,0 +1,61 @@
+"""CLI: ``python -m tools.enginelint <paths> [--strict] [--rule RLnnn]``.
+
+Exit codes: 0 clean, 1 findings (or, with --strict, reason-less
+suppressions), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.enginelint import run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="enginelint",
+        description="AST-based engine-specific lint for spark_rapids_tpu")
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail suppressions that carry no written "
+                         "reason")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="RLnnn", help="run only these rules")
+    ap.add_argument("--list-suppressed", action="store_true",
+                    help="print suppressed findings with their reasons")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rule:
+        from tools.enginelint.rules import RULES
+        unknown = [r for r in args.rule if r.upper() not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(unknown)}")
+        rules = {r.upper(): RULES[r.upper()] for r in args.rule}
+
+    findings = run_lint(args.paths, rules=rules)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    bad_suppressions = [f for f in suppressed if not f.reason]
+
+    for f in active:
+        print(f.render())
+    if args.strict:
+        for f in bad_suppressions:
+            print(f"{f.path}:{f.line}: {f.rule} suppression carries no "
+                  "written reason — use "
+                  f"'# enginelint: disable={f.rule} (why it is safe)'")
+    if args.list_suppressed:
+        for f in suppressed:
+            print(f"{f.render()}  # reason: {f.reason or '<none>'}")
+
+    print(f"enginelint: {len(active)} finding(s), {len(suppressed)} "
+          f"suppressed ({len(bad_suppressions)} without reason)",
+          file=sys.stderr)
+    if active or (args.strict and bad_suppressions):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
