@@ -245,6 +245,18 @@ def _scenario_pass(sf: float, session_conf, aqe: bool) -> list:
                         .get("registry", {}).get("counters", {}))
             row["aqe"] = {k: v for k, v in counters.items()
                           if k.startswith("aqe_")}
+        # memory-governor movement for this query: reclaim/grant/shed
+        # counters plus the per-query peak-bytes gauges (the registry
+        # delta is captured while the query's ExecCtx is still open, so
+        # its governor.q.<qid>.* gauges are present)
+        reg = sr.get("observability", {}).get("registry", {})
+        gov = {k: v for k, v in reg.get("counters", {}).items()
+               if k.startswith("governor_")}
+        gov.update({k: v for k, v in reg.get("gauges", {}).items()
+                    if k.startswith("governor.q.")
+                    and k.endswith("peak_bytes")})
+        if gov:
+            row["governor"] = gov
         out.append(row)
     return out
 

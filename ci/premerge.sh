@@ -245,6 +245,99 @@ leaked = [t.name for t in threading.enumerate()
 assert not leaked, f"leaked engine threads after shutdown: {leaked}"
 print("lifecycle gate: cancel/deadline/exact x2 + clean shutdown: ok")
 PY
+  echo "-- memory governor gate: pressure shed + exact + zero leaked reservations --"
+  # four concurrent queries on one session under a small device budget
+  # with the shed watermark forced low: at least one NEW admission must
+  # be load-shed with QueryRejected while the four run, the four must
+  # return EXACT results, the governor_* counters/gauges must be
+  # present, and after shutdown(drain=True) the governor holds zero
+  # ledgers, zero reservations, and its daemon thread is gone
+  JAX_PLATFORMS=cpu python - <<'PY'
+import threading
+import time
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.lifecycle import QueryRejected
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.memory.governor import get_governor
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.plan.verify import verify_governor_ledger
+from spark_rapids_tpu.session import TpuSession
+
+s = TpuSession({
+    "spark.rapids.sql.admission.maxConcurrentQueries": 4,
+    "spark.rapids.sql.admission.maxQueuedQueries": 0,
+    "spark.rapids.memory.tpu.spillStoreSize": 8 << 20,
+    "spark.rapids.memory.governor.shedWatermark": 0.01,
+    "spark.rapids.memory.governor.shedHoldSeconds": 0.05,
+})
+schema = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+
+def big():
+    return s.from_pydict({"k": [i % 97 for i in range(400000)],
+                          "v": list(range(400000))}, schema, partitions=8) \
+        .group_by("k").agg(Sum(col("v")))
+
+expected = sorted(big().collect())
+gov = get_governor()
+before = get_registry().snapshot()
+
+results = {}
+def run(name, df):
+    try:
+        results[name] = ("ok", df.collect())
+    except BaseException as e:
+        results[name] = ("err", e)
+
+threads = [threading.Thread(target=run, args=(f"q{i}", big()))
+           for i in range(4)]
+for t in threads:
+    t.start()
+
+# wait for sustained pressure, then the fifth admission must shed
+shed = None
+probe = big()
+deadline = time.monotonic() + 60.0
+while time.monotonic() < deadline and shed is None:
+    if gov.admission_pressure() is None:
+        time.sleep(0.01)
+        continue
+    try:
+        probe.collect()
+    except QueryRejected as e:
+        shed = e
+assert shed is not None, "no admission was pressure-shed within 60s"
+assert "shedWatermark" in str(shed), shed
+
+for t in threads:
+    t.join(timeout=180.0)
+    assert not t.is_alive(), "query did not finish in time"
+for name, (kind, val) in results.items():
+    assert kind == "ok" and sorted(val) == expected, (name, kind)
+
+moved = get_registry().delta(before)["counters"]
+assert moved.get("governor_pressure_sheds", 0) >= 1, moved
+gauges = get_registry().snapshot()["gauges"]
+for g in ("governor.device_bytes_total", "governor.reserved_bytes",
+          "governor.queries_registered", "governor.budget_bytes"):
+    assert g in gauges, (g, sorted(gauges))
+
+s.shutdown(drain=True, timeout=60.0)
+assert gov.query_stats() == {}, gov.query_stats()
+assert gov.reserved_bytes() == 0, "leaked grant reservation"
+verify_governor_ledger(gov)
+deadline = time.monotonic() + 5.0
+while time.monotonic() < deadline and any(
+        t.name == "tpu-mem-governor" for t in threading.enumerate()):
+    time.sleep(0.05)
+leaked = [t.name for t in threading.enumerate()
+          if t.name.startswith(("tpu-task", "tpu-shuffle-srv",
+                                "tpu-mem-governor"))]
+assert not leaked, f"leaked engine threads after shutdown: {leaked}"
+print("governor gate: pressure shed, 4x exact, zero leaked reservations: ok")
+PY
   echo "-- fusion + compile-cache gate: warm reruns compile NOTHING --"
   # the same query run twice in one process must be pure cache reuse
   # (compile_count delta 0 on the second run — the whole point of the

@@ -165,6 +165,13 @@ class ExecCtx:
                 # spill I/O is a cooperative cancellation point: a
                 # cancelled query must stop pushing bytes to disk
                 cat.lifecycle = self.lifecycle
+                # cross-query governor (memory/governor.py): attribute
+                # this catalog's device bytes to the query and let OOM
+                # retries arbitrate against peer queries instead of
+                # blind-sweeping.  No-op when the governor conf is off
+                from spark_rapids_tpu.memory.governor import maybe_register
+                maybe_register(cat, self.query_id, self.lifecycle,
+                               self.conf)
                 self.cache["catalog"] = cat
             return self.cache["catalog"]
 

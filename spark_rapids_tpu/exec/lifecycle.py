@@ -31,7 +31,11 @@ scheduler to inherit that from, so the equivalent plane lives here:
   concurrent queries (``spark.rapids.sql.admission.*``).  Beyond the
   queue bound (or queue wait timeout, or after shutdown began) new
   queries are load-shed with :class:`QueryRejected` instead of piling
-  onto the DeviceSemaphore and worker pool.
+  onto the DeviceSemaphore and worker pool.  When the cross-query
+  memory governor is enabled the session also wires its pressure hook
+  here: sustained device occupancy above the shed watermark rejects
+  NEW queries rather than admitting them into an OOM-retry storm
+  (memory/governor.py).
 
 Post-cancel invariants (asserted by tests/test_lifecycle.py): the
 DeviceSemaphore is back at full capacity, the spill directory is
@@ -293,6 +297,13 @@ class AdmissionController:
         self._active = 0
         self._queue: deque = deque()
         self._shutdown = False
+        # memory-pressure shed hook (memory/governor.py, wired by the
+        # session when the governor is enabled): a callable returning a
+        # reason string when NEW admissions should be load-shed —
+        # sustained occupancy above the shed watermark — or None.
+        # Late-bound attribute, not an import: this module stays
+        # stdlib + conf + obs so hot modules can import it freely
+        self.pressure_hook = None
 
     @classmethod
     def from_conf(cls, conf) -> "AdmissionController":
@@ -319,12 +330,24 @@ class AdmissionController:
     def admit(self, query_id: str = "?",
               timeout: "float | None" = None) -> None:
         """Block until admitted (FIFO).  Raises :class:`QueryRejected`
-        when the session is shutting down, the wait queue is full, or
-        the queue wait exceeds ``timeout`` (default: the
-        queueTimeoutSeconds conf; 0 waits forever)."""
+        when the session is shutting down, the wait queue is full, the
+        queue wait exceeds ``timeout`` (default: the
+        queueTimeoutSeconds conf; 0 waits forever), or the memory
+        governor's pressure hook reports sustained overload."""
         reg = get_registry()
         tmo = self.queue_timeout if timeout is None else timeout
         token = object()
+        hook = self.pressure_hook
+        if hook is not None:
+            # checked OUTSIDE the condition (the hook takes the
+            # governor's own lock) and before queueing: a query shed
+            # for memory pressure never occupied a queue slot
+            reason = hook()
+            if reason:
+                reg.inc("queries_rejected")
+                raise QueryRejected(
+                    query_id,
+                    f"query {query_id} rejected: {reason}")
         with self._cond:
             if self._shutdown:
                 reg.inc("queries_rejected")

@@ -81,6 +81,22 @@ Injection points wired today (site -> actions it interprets):
                         working set below the threshold, making the
                         split path deterministically provable without a
                         real device.
+    memory.grant.stall  governor grant-wait entry (ctx: query_id,
+                        need; memory/governor.py).  Action ``stall``
+                        holds the waiter ``seconds`` (default 0.05)
+                        before the normal bounded wait loop runs — a
+                        deterministic mid-grant-wait window for chaos
+                        tests to land cancellations in, proving the
+                        reservation is released on terminal unwind.
+    memory.governor.oom_storm
+                        governor reclaim entry (ctx: query_id, need;
+                        memory/governor.py).  Action ``oom`` makes the
+                        arbitration report ZERO bytes freed — an OOM
+                        storm spilling cannot keep up with — so the
+                        requester's split-and-retry ladder absorbs the
+                        pressure; chaos tests use it to prove bounded
+                        wall time (no eviction livelock) under
+                        concurrent queries.
 
 Trigger keys (all optional):
 
@@ -133,6 +149,8 @@ KNOWN_POINTS = frozenset({
     "mesh.slice.lost",
     "memory.oom",
     "memory.oom.until_rows",
+    "memory.grant.stall",
+    "memory.governor.oom_storm",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a

@@ -183,8 +183,11 @@ class BufferCatalog:
         pinned = PINNED_POOL_SIZE.get(settings)
         if pinned and pinned > 0:
             from spark_rapids_tpu.runtime import get_pinned_arena
+            # borrower=self: this catalog holds numpy views into the
+            # arena, so a later larger request must park (not destroy)
+            # this mapping until the catalog is collected
             self._arena_obj = get_pinned_arena(
-                max(self._host_limit, pinned))
+                max(self._host_limit, pinned), borrower=self)
             self._arena_shared = True
         self._spill_dir_base = spill_dir or SPILL_DIR.get(settings) or None
         self._spill_dir_made: str | None = None
@@ -197,6 +200,14 @@ class BufferCatalog:
         # spill I/O checks it so a cancelled query stops pushing bytes
         # between tiers instead of finishing a multi-buffer spill sweep
         self.lifecycle = None
+        # cross-query memory governor (memory/governor.py), bound by
+        # ExecCtx via maybe_register when the governor conf is on: the
+        # catalog mirrors every device-byte move into the per-query
+        # ledger so arbitration and admission shedding see who holds
+        # HBM.  None (the default) keeps the catalog query-blind —
+        # byte-identical to the pre-governor engine
+        self.governor = None
+        self.query_id = None
         self.metrics = {"device_spills": 0, "host_spills": 0,
                         "bytes_spilled_to_host": 0,
                         "bytes_spilled_to_disk": 0,
@@ -248,6 +259,20 @@ class BufferCatalog:
             self._spill_dir_made = d
         return self._spill_dir_made
 
+    def _gov_account(self, delta: int) -> None:
+        """Mirror a device_used move into the governor's per-query
+        ledger (no-op when ungoverned).  Called at every site that
+        mutates ``device_used`` so the ledger can never drift from
+        catalog occupancy."""
+        gov = self.governor
+        if gov is not None:
+            gov.account(self, delta)
+
+    def _gov_pinned(self, delta: int) -> None:
+        gov = self.governor
+        if gov is not None:
+            gov.account_pinned(self, delta)
+
     # -- registration --------------------------------------------------
     def add_batch(self, batch: ColumnBatch, priority: int) -> int:
         """Register a device batch; may synchronously spill others."""
@@ -257,6 +282,7 @@ class BufferCatalog:
             self._next_id += 1
             self._entries[bid] = _Entry(bid, priority, size, batch=batch)
             self.device_used += size
+            self._gov_account(size)
             if self.device_used > self.metrics["device_bytes_peak"]:
                 self.metrics["device_bytes_peak"] = self.device_used
             if self.device_used > self.device_limit:
@@ -275,6 +301,8 @@ class BufferCatalog:
             except Exception:
                 e.refcount -= 1
                 raise
+            if e.refcount == 1:
+                self._gov_pinned(e.size)
             return e.batch
 
     def release(self, buffer_id: int) -> None:
@@ -282,10 +310,14 @@ class BufferCatalog:
             e = self._entries[buffer_id]
             assert e.refcount > 0, f"release without acquire: {buffer_id}"
             e.refcount -= 1
+            if e.refcount == 0:
+                self._gov_pinned(-e.size)
 
     def remove(self, buffer_id: int) -> None:
         with self._lock:
             e = self._entries.pop(buffer_id)
+            if e.refcount > 0:
+                self._gov_pinned(-e.size)
             self._drop_storage_locked(e)
 
     # -- spill ----------------------------------------------------------
@@ -383,6 +415,7 @@ class BufferCatalog:
             self.metrics["bytes_spilled_to_disk"] += total
         e.batch = None
         self.device_used -= e.size
+        self._gov_account(-e.size)
         self.metrics["device_spills"] += 1
 
     def _spill_host_one_locked(self) -> bool:
@@ -490,6 +523,7 @@ class BufferCatalog:
         e.treedef = None
         e.tier = "device"
         self.device_used += e.size
+        self._gov_account(e.size)
         if self.device_used > self.metrics["device_bytes_peak"]:
             self.metrics["device_bytes_peak"] = self.device_used
         if self.device_used > self.device_limit:
@@ -540,6 +574,7 @@ class BufferCatalog:
     def _drop_storage_locked(self, e: _Entry) -> None:
         if e.tier == "device":
             self.device_used -= e.size
+            self._gov_account(-e.size)
         elif e.tier == "host" and e.arena_offset is not None:
             self._arena.free(e.arena_offset)
         elif e.tier == "disk" and e.disk_path:
@@ -580,6 +615,12 @@ class BufferCatalog:
             if self._arena_obj is not None and not self._arena_shared:
                 self._arena_obj.close()
             self._arena_obj = None
+        gov = self.governor
+        if gov is not None:
+            # after the entries drained (each drop mirrored its ledger
+            # move): a finished query stops counting against the shed
+            # watermark the moment its catalog closes
+            gov.unregister(self)
 
 
 def _unlink_quiet(path: str) -> None:
@@ -700,11 +741,32 @@ def _sync_dispatch() -> bool:
     return _SYNC_DISPATCH
 
 
+def _need_estimate(args, kwargs) -> int:
+    """Estimate the failed allocation from the dispatched inputs: the
+    device bytes of every batch argument (a program's output is on the
+    order of its inputs).  0 when nothing measurable was passed — the
+    governor then applies its conf'd floor."""
+    need = 0
+    for a in list(args) + list(kwargs.values()):
+        sz = getattr(a, "device_size_bytes", None)
+        if callable(sz):
+            try:
+                need += int(sz())
+            except Exception:  # enginelint: disable=RL001 (sizing is best-effort; the floor covers a batch that cannot report)
+                pass
+    return need
+
+
 def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
                          max_retries: int = 3, spill_bytes: int | None = None,
                          **kwargs):
     """Dispatch ``fn(*args, **kwargs)``; on XLA OOM spill from the catalog
-    and retry (the DeviceMemoryEventHandler.onAllocFailure loop)."""
+    and retry (the DeviceMemoryEventHandler.onAllocFailure loop).
+
+    Spill sizing: governed catalogs ask the memory governor for a
+    need-sized reclaim (own buffers first, then younger peers' —
+    memory/governor.py); ungoverned catalogs keep the legacy blind
+    quarter-budget sweep, byte-identical to the pre-governor engine."""
     faults = getattr(catalog, "faults", None)
     attempt = 0
     while True:
@@ -732,7 +794,12 @@ def run_with_spill_retry(fn, catalog: BufferCatalog, *args,
             attempt += 1
             if attempt > max_retries:
                 raise
-            freed = catalog.spill_device(
-                spill_bytes or catalog.device_limit // 4)
+            gov = getattr(catalog, "governor", None)
+            if gov is not None:
+                freed = gov.reclaim(
+                    catalog, spill_bytes or _need_estimate(args, kwargs))
+            else:
+                freed = catalog.spill_device(
+                    spill_bytes or catalog.device_limit // 4)
             if freed == 0:
                 raise

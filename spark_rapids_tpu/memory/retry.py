@@ -152,6 +152,21 @@ def _bump(catalog, key: str) -> None:
     catalog.metrics[key] = catalog.metrics.get(key, 0) + 1
 
 
+def _reclaim(catalog, need_bytes: int) -> int:
+    """Free device memory for a failed allocation, sized to the actual
+    need (the dispatched batch's device bytes; the governor applies a
+    conf'd floor, spark.rapids.memory.governor.minSpillBytes) instead
+    of the historical blind ``device_limit // 4`` sweep.  Governed
+    catalogs arbitrate cross-query (own lowest-priority buffers first,
+    then younger peers', wound-wait ordered — memory/governor.py);
+    ungoverned catalogs keep the legacy sweep byte-identical to the
+    pre-governor engine."""
+    gov = getattr(catalog, "governor", None)
+    if gov is not None:
+        return gov.reclaim(catalog, need_bytes)
+    return catalog.spill_device(catalog.device_limit // 4)
+
+
 def with_retry(fn, catalog, inp, *, split=split_half, op: str | None = None,
                settings=None, checkpoint=None, restore=None,
                pairs: bool = False, max_retries: int | None = None,
@@ -221,8 +236,13 @@ def with_retry(fn, catalog, inp, *, split=split_half, op: str | None = None,
                 # spill with the piece still PINNED: evicting our own
                 # input is not progress — it would round-trip back on
                 # the next attempt and the budget would exhaust without
-                # ever splitting
-                freed = catalog.spill_device(catalog.device_limit // 4)
+                # ever splitting.  Sized to the failed work (input
+                # bytes), not a blind quarter of the budget
+                try:
+                    need = int(b.device_size_bytes())
+                except Exception:  # enginelint: disable=RL001 (sizing is best-effort; the governor floor covers it)
+                    need = 0
+                freed = _reclaim(catalog, need)
                 if spillable:
                     piece.unpin()
                 if freed > 0:
@@ -290,6 +310,9 @@ def retry_sync(sync_fn, catalog, *, redo=None, op: str = "sync",
             attempts += 1
             if attempts > max_retries:
                 raise
-            catalog.spill_device(catalog.device_limit // 4)
+            # a sync point reports no allocation size; the governor's
+            # minSpillBytes floor sizes the request (ungoverned: legacy
+            # quarter-budget sweep)
+            _reclaim(catalog, 0)
             if redo is not None:
                 redo()

@@ -57,6 +57,17 @@ def _catalog_view(ctx) -> dict:
     # enginelint: disable=RL001 (diag view is best-effort; section omitted on failure)
     except Exception:
         pass
+    try:
+        # cross-query picture at death: who held HBM and whether this
+        # query died mid-grant-wait (reserved_bytes > 0) — the first
+        # question in an OOM-storm post-mortem
+        gov = getattr(cat, "governor", None)
+        if gov is not None:
+            view["governor"] = {"queries": gov.query_stats(),
+                                "reserved_bytes": gov.reserved_bytes()}
+    # enginelint: disable=RL001 (diag view is best-effort; section omitted on failure)
+    except Exception:
+        pass
     return view
 
 

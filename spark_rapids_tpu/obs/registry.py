@@ -34,7 +34,19 @@ broadcast-join rewrites, plan/adaptive.py) /
 regrouping from map-output sizes, exec/exchange.py) /
 ``aqe_dynamic_filters`` (build-side IN-set/min-max filters pushed into
 probe scans) — each incremented at the decision site, so a per-query
-delta shows exactly what the re-optimizer did.
+delta shows exactly what the re-optimizer did; and the cross-query
+memory governor's ``governor_*`` family (memory/governor.py):
+``governor_reclaims`` / ``governor_spill_bytes_own`` /
+``governor_spills_peer`` / ``governor_spill_bytes_peer`` (need-sized
+arbitration, own-then-younger-peer order), ``governor_grant_waits`` /
+``governor_grants`` / ``governor_grant_timeouts`` (wound-wait losers
+parked for memory), ``governor_background_spills`` /
+``governor_spill_bytes_background`` (watermark thread),
+``governor_pressure_sheds`` (admissions rejected under sustained
+occupancy), ``governor_victim_errors`` (peer spills skipped because
+the victim failed), and ``governor_storm_denials`` (injected
+``memory.governor.oom_storm`` reclaim denials) — plus the ``governor`` pull source's aggregate and
+per-query ``q.<query_id>.{device,pinned,peak}_bytes`` gauges.
 """
 from __future__ import annotations
 

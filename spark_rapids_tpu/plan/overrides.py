@@ -1088,6 +1088,16 @@ def explain_analyze(plan, ctx) -> str:
                  if isinstance(v, (int, float))]
         if parts:
             lines.append("catalog: " + ", ".join(parts))
+    gov = getattr(cat, "governor", None) if cat is not None else None
+    if gov is not None:
+        # this query's slice of the cross-query HBM ledger: live/pinned/
+        # peak device bytes as the governor attributed them
+        stats = gov.query_stats(ctx.query_id).get(ctx.query_id)
+        if stats:
+            parts = [_fmt_metric(k, stats[k]) for k in
+                     ("device_bytes", "pinned_bytes", "peak_bytes")
+                     if k in stats]
+            lines.append("governor: " + ", ".join(parts))
     from spark_rapids_tpu.obs.registry import get_registry
     counters = get_registry().snapshot()["counters"]
     if counters:

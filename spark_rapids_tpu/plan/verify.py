@@ -51,7 +51,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.conf import bool_conf
 
 __all__ = ["PLAN_VERIFY", "PLAN_VERIFY_EVERY_PASS", "PASS_ORDER",
-           "PlanInvariantError", "verify_plan"]
+           "PlanInvariantError", "verify_plan", "verify_governor_ledger"]
 
 PLAN_VERIFY = bool_conf(
     "spark.rapids.sql.verify.plan", True,
@@ -491,6 +491,45 @@ def verify_plan(root, conf=None, pass_name: str = "mesh_regions") -> None:
         v._sig_memo.clear()
         if len(_POOL) < 4:
             _POOL.append(v)
+
+
+def verify_governor_ledger(gov) -> None:
+    """Runtime sibling of :func:`verify_plan` for the cross-query memory
+    governor (memory/governor.py): check the invariants the arbitration
+    logic only promises.  Called by the governor test suite and the
+    premerge governor gate after ``shutdown(drain=True)``; raises
+    :class:`PlanInvariantError` (node path ``<governor>``, pass
+    ``governor_ledger``) on the first violation:
+
+    * no negative ledger entries — a double-release or mis-attributed
+      free would drive ``device_bytes``/``pinned_bytes`` below zero;
+    * ``pinned_bytes <= device_bytes`` per query — pinned is a subset
+      of the live working set, never more than what is resident;
+    * ``peak_bytes >= device_bytes`` — the high-water mark is monotone;
+    * zero outstanding reservations once no grant wait is in flight —
+      a leaked reservation permanently shrinks every peer's headroom.
+    """
+    if gov is None:
+        return
+
+    def _fail(msg: str):
+        raise PlanInvariantError("<governor>", "governor_ledger", msg)
+
+    stats = gov.query_stats()
+    for qid, s in stats.items():
+        if s["device_bytes"] < 0 or s["pinned_bytes"] < 0:
+            _fail(f"query {qid}: negative ledger "
+                  f"(device={s['device_bytes']} pinned={s['pinned_bytes']})")
+        if s["pinned_bytes"] > s["device_bytes"]:
+            _fail(f"query {qid}: pinned_bytes {s['pinned_bytes']} exceeds "
+                  f"device_bytes {s['device_bytes']}")
+        if s["peak_bytes"] < s["device_bytes"]:
+            _fail(f"query {qid}: peak_bytes {s['peak_bytes']} below live "
+                  f"device_bytes {s['device_bytes']}")
+    reserved = gov.reserved_bytes()
+    if reserved:
+        _fail(f"leaked grant reservation: {reserved} bytes still "
+              "reserved with no waiter in flight")
 
 
 #: small reuse pool: one walk per prepare means the same dicts serve

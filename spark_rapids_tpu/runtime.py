@@ -48,6 +48,11 @@ COMPILATION_CACHE_DIR = register(ConfEntry(
 _enabled_dir: str | None = None
 _arrow_pinned = False
 _pinned_arena = None
+_pinned_borrowers = None       # weakref.WeakSet of current borrowers
+_retired_arenas: list = []     # (arena, borrower WeakSet) until drained
+import threading as _threading
+
+_pinned_lock = _threading.Lock()
 _stacks_widened = False
 
 
@@ -101,16 +106,42 @@ def widen_thread_stacks(size: int = 64 * 1024 * 1024) -> None:
     _stacks_widened = True
 
 
-def get_pinned_arena(size: int):
+def get_pinned_arena(size: int, borrower=None):
     """Process-level pinned staging arena (reference
     allocatePinnedMemory, GpuDeviceManager.scala:264-270: allocated once
-    per executor process, not per query).  Grown only, never closed —
-    BufferCatalog shares it when pinnedPool.size > 0."""
-    global _pinned_arena
-    if _pinned_arena is None or _pinned_arena.capacity < size:
-        from spark_rapids_tpu.native import HostArena
-        _pinned_arena = HostArena(size)
-    return _pinned_arena
+    per executor process, not per query).  BufferCatalog shares it when
+    pinnedPool.size > 0.
+
+    Growth is by REPLACEMENT (the C++ arena cannot extend its mapping),
+    and the replaced arena must outlive its borrowers: a catalog handed
+    the old arena holds numpy views whose base pointers reach into the
+    old mapping, so letting the ref drop here would run
+    ``HostArena.__del__`` -> ``arena_destroy`` and turn every
+    outstanding view into a use-after-free.  Replaced arenas are parked
+    in ``_retired_arenas`` keyed by a WeakSet of their borrowers and
+    only released (closing via ``__del__``) once every borrower has
+    been collected.  Callers that may outlive a growth event pass
+    themselves as ``borrower``; an untracked borrower set behaves like
+    the pre-fix code (immediate replacement) for callers that provably
+    don't retain views."""
+    global _pinned_arena, _pinned_borrowers
+    import weakref
+    with _pinned_lock:
+        # sweep: a retired arena whose borrowers all drained can close
+        _retired_arenas[:] = [(a, s) for a, s in _retired_arenas
+                              if len(s) > 0]
+        if _pinned_arena is None or _pinned_arena.capacity < size:
+            from spark_rapids_tpu.native import HostArena
+            if _pinned_arena is not None and _pinned_borrowers and \
+                    len(_pinned_borrowers) > 0:
+                _retired_arenas.append((_pinned_arena, _pinned_borrowers))
+            _pinned_arena = HostArena(size)
+            _pinned_borrowers = weakref.WeakSet()
+        if borrower is not None:
+            if _pinned_borrowers is None:
+                _pinned_borrowers = weakref.WeakSet()
+            _pinned_borrowers.add(borrower)
+        return _pinned_arena
 
 
 def pin_arrow_threads() -> None:

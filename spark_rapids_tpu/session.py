@@ -54,6 +54,15 @@ class TpuSession:
                 from spark_rapids_tpu.exec.lifecycle import \
                     AdmissionController
                 self._admission = AdmissionController.from_conf(self.conf)
+                from spark_rapids_tpu.memory.governor import (
+                    GOVERNOR_ENABLED, get_governor)
+                if GOVERNOR_ENABLED.get(self.conf.settings):
+                    # memory-pressure shedding: sustained device
+                    # occupancy above the shed watermark rejects NEW
+                    # queries at admission (memory/governor.py) —
+                    # inert with the governor conf off
+                    self._admission.pressure_hook = \
+                        get_governor().admission_pressure
             return self._admission
 
     def active_queries(self) -> list[str]:
