@@ -3,6 +3,13 @@
 Prints one JSON line per metric:
   {"metric": "tpcds_q6_sf..._speedup_vs_cpu_oracle", "value": N, ...}
   {"metric": "tpch_multichip_scaling_sf...", "value": N, "ladder": [...]}
+  {"metric": "tpch_multistream_qph_sf...", "value": N, "ladder": [...]}
+
+The third line is the serving-tier THROUGHPUT ladder
+(spark_rapids_tpu/bench/throughput.py): N ∈ {1,2,4,8} concurrent
+tenant streams through ONE session, distinct query permutations per
+stream, warm queries-per-hour per rung with cache-hit and fairness
+counters, every stream's rows verified against the host oracle.
 
 The second line is the pod-scale device-count ladder: TPC-H q6 and q3
 at 1/2/4/8 mesh devices (spark.rapids.tpu.mesh.deviceCount), wall time
@@ -72,6 +79,16 @@ MULTICHIP_LADDER = tuple(
 MULTICHIP_SF = float(os.environ.get("BENCH_MULTICHIP_SF", "0.1"))
 MULTICHIP_TIMEOUT_S = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S",
                                            "420"))
+# multi-stream THROUGHPUT ladder (serving-tier metric): N concurrent
+# tenant streams through one session, queries-per-hour per rung, warm
+# (result cache + compile cache primed), per-stream oracle-verified
+THROUGHPUT_SF = float(os.environ.get("BENCH_THROUGHPUT_SF", "0.1"))
+THROUGHPUT_STREAMS = tuple(
+    int(x) for x in os.environ.get("BENCH_THROUGHPUT_STREAMS",
+                                   "1,2,4,8").split(",") if x.strip())
+THROUGHPUT_QUERIES = ("q3", "q13", "q18")
+THROUGHPUT_TIMEOUT_S = float(os.environ.get("BENCH_THROUGHPUT_TIMEOUT_S",
+                                            "420"))
 
 
 def _mesh_env(n_devices: int) -> dict:
@@ -377,6 +394,88 @@ def _mchild(n_devices: int, platform: str) -> None:
     os._exit(0)
 
 
+def _tchild(platform: str) -> None:
+    """One killable multi-stream throughput run (the whole ladder lives
+    in one child: rungs share the warm session-level caches, which is
+    the point of the measurement)."""
+    import jax
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    from spark_rapids_tpu.bench.throughput import run_throughput
+    sf = THROUGHPUT_SF
+    rep = run_throughput(os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
+                         streams=THROUGHPUT_STREAMS,
+                         queries=THROUGHPUT_QUERIES, suite="tpch")
+    print(_REPORT_PREFIX + json.dumps(rep))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _throughput(deadline: float, tpu_probe_ok: bool) -> None:
+    """Third metric line: the multi-stream throughput ladder.
+
+    value = warm queries-per-hour at the LARGEST verified stream count;
+    the rung list carries the whole curve plus cache-hit and fairness
+    counter movement, and ``scaling_4v1`` pins the acceptance shape
+    (4-stream warm throughput vs 1-stream)."""
+    platform = "tpu" if tpu_probe_ok else "cpu"
+    budget = min(THROUGHPUT_TIMEOUT_S, deadline - time.monotonic())
+    rec = {
+        "metric": f"tpch_multistream_qph_sf{THROUGHPUT_SF:g}_{platform}",
+        "value": 0.0,
+        "unit": "queries/hour",
+        "streams": list(THROUGHPUT_STREAMS),
+        "queries": list(THROUGHPUT_QUERIES),
+    }
+    if budget < 45:
+        rec["error"] = "no budget for throughput ladder"
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        return
+    cmd = [sys.executable, os.path.abspath(__file__), "--tchild", platform]
+    rc, out, errout = _run_killable(
+        cmd, budget,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+    rep = None
+    if rc is not None:
+        for line in reversed(out.splitlines()):
+            line = line.strip()
+            if line.startswith(_REPORT_PREFIX):
+                try:
+                    rep = json.loads(line[len(_REPORT_PREFIX):])
+                except json.JSONDecodeError:
+                    pass
+                break
+    if rep is None:
+        tail = (errout or "")[-300:].replace("\n", " | ")
+        rec["error"] = (f"throughput run killed after {budget:.0f}s"
+                        if rc is None else
+                        f"throughput run rc={rc} no report; {tail}")
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        return
+    rungs = rep.get("streams", [])
+    qph = {r["streams"]: r for r in rungs
+           if r.get("qph") and not r.get("errors")
+           and not r.get("mismatches")}
+    if qph:
+        top = max(qph)
+        rec["value"] = qph[top]["qph"]
+        rec["streams_at_value"] = top
+        if 1 in qph and 4 in qph and qph[1]["qph"] > 0:
+            rec["scaling_4v1"] = round(qph[4]["qph"] / qph[1]["qph"], 3)
+    rec["ok"] = bool(rep.get("ok"))
+    rec["qph_cold_1stream"] = rep.get("qph_cold_1stream")
+    rec["ladder"] = rungs
+    if rep.get("error"):
+        rec["error"] = str(rep["error"])[:500]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 def _emit_multichip(rungs: list, backend: str, error: str | None) -> None:
     """Second metric line: the MULTICHIP device-count scaling ladder.
 
@@ -526,6 +625,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--mchild":
         _mchild(int(sys.argv[2]), sys.argv[3])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--tchild":
+        _tchild(sys.argv[2])
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--prewarm":
         _prewarm(float(sys.argv[2]) if len(sys.argv) > 2 else 0.1)
         return
@@ -577,6 +679,17 @@ def main() -> None:
         _multichip(mc_deadline, probe_detail)
     except Exception as e:  # pragma: no cover - rider must not gate
         _emit_multichip([], "none", f"multichip ladder crashed: {e}")
+    # third metric line: the multi-stream serving-tier throughput ladder
+    # (queries-per-hour at 1/2/4/8 concurrent tenant streams, warm)
+    t_deadline = time.monotonic() + THROUGHPUT_TIMEOUT_S
+    try:
+        _throughput(t_deadline, probe_ok)
+    except Exception as e:  # pragma: no cover - rider must not gate
+        print(json.dumps({
+            "metric": f"tpch_multistream_qph_sf{THROUGHPUT_SF:g}_none",
+            "value": 0.0, "unit": "queries/hour",
+            "error": f"throughput ladder crashed: {e}"}))
+        sys.stdout.flush()
     sys.exit(rc)
 
 

@@ -502,6 +502,119 @@ assert zrows == prows
 assert not any(n.startswith("Mesh") for n in zero), zero
 print("mesh gate: q6/q3 exact, warm rerun compiles 0, deviceCount=0 reversible: ok")
 PY
+  echo "-- serving tier gate: warm cache hit, weighted order, tenant shed, reversible --"
+  # the multi-tenant serving tier's four contracts: (1) 8 queries from
+  # 2 tenants at 3:1 weights, then the identical warm set again — the
+  # warm round must be pure result-cache hits with compile_count delta
+  # 0 AND queries_executed delta 0 (the executor is never dispatched);
+  # (2) the observed admission order under a 6:2 backlog respects the
+  # 3:1 weights; (3) a pressure event sheds the over-quota tenant and
+  # spares the quiet one; (4) resultCache.enabled=false is
+  # byte-identical to today — same rows, every query re-executed, and
+  # not one result_cache counter moves
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, tempfile, threading, time
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.exec.lifecycle import AdmissionController, QueryRejected
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+WEIGHTS = {"spark.rapids.sql.admission.tenantWeights": "etl:3,bi:1"}
+PLAN = [("etl", "q3"), ("etl", "q13"), ("etl", "q18"), ("bi", "q3"),
+        ("etl", "q3"), ("bi", "q13"), ("etl", "q13"), ("etl", "q18")]
+
+def run_plan(s):
+    out = {}
+    for tenant, q in PLAN:
+        rows = build_tpch_query(q, s, d).collect(tenant=tenant)
+        out[q] = sorted(rows, key=str)
+    return out
+
+# 1) 8 queries from 2 tenants cold, then the identical set warm: the
+# warm round is served entirely from the result cache — zero compiles,
+# zero executor dispatches
+s = TpuSession(dict(WEIGHTS))
+cold = run_plan(s)
+before = get_registry().snapshot()
+warm = run_plan(s)
+moved = get_registry().delta(before)["counters"]
+assert warm == cold, "warm cache-served rows != cold rows"
+assert moved.get("compile_count", 0) == 0, f"warm round compiled: {moved}"
+assert moved.get("queries_executed", 0) == 0, \
+    f"warm round dispatched the executor: {moved}"
+assert moved.get("result_cache_hits", 0) >= len(PLAN), moved
+
+# 2) admission order respects the 3:1 weights: saturate the one slot,
+# backlog 6 etl + 2 bi with pinned arrival order, drain, and check the
+# admission log — 6:2 overall, >=2x share while bi is queued, and bi
+# is not starved out of the first 4 slots
+ac = AdmissionController(max_concurrent=1, max_queued=16,
+                         queue_timeout=30.0,
+                         tenant_weights={"etl": 3.0, "bi": 1.0})
+ac.admit("holder")
+specs = [("etl", f"e{i}") for i in range(6)] + \
+        [("bi", f"b{i}") for i in range(2)]
+threads = []
+for i, (tenant, name) in enumerate(specs):
+    def wait_in(t=tenant, n=name):
+        ac.admit(n, tenant=t)
+        ac.release(tenant=t)
+    th = threading.Thread(target=wait_in)
+    th.start()
+    threads.append(th)
+    deadline = time.monotonic() + 5.0
+    while ac.queued < i + 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert ac.queued == i + 1
+ac.release()
+for t in threads:
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "queued admission never drained"
+log = [tenant for tenant, _q in ac.admission_log if tenant != "default"]
+assert log.count("etl") == 6 and log.count("bi") == 2, log
+last_bi = max(i for i, t in enumerate(log) if t == "bi")
+window = log[:last_bi + 1]
+assert window.count("etl") >= 2 * window.count("bi"), log
+assert "bi" in log[:4], log
+
+# 3) pressure sheds the over-quota tenant first: hog holds 3 of 4
+# occupied slots at equal weight, so the pressure event rejects hog's
+# next admission while the quiet tenant is spared and admitted
+before = get_registry().snapshot()
+ac2 = AdmissionController(max_concurrent=0)
+for i in range(3):
+    ac2.admit(f"h{i}", tenant="hog")
+ac2.admit("q0", tenant="quiet")
+ac2.pressure_hook = lambda: "memory pressure: premerge"
+try:
+    ac2.admit("h3", tenant="hog")
+    raise SystemExit("over-quota tenant was not pressure-shed")
+except QueryRejected:
+    pass
+ac2.admit("q1", tenant="quiet")
+dm = get_registry().delta(before)["counters"]
+assert dm.get("admission.tenant.hog.rejected") == 1, dm
+assert dm.get("admission.tenant.quiet.rejected", 0) == 0, dm
+assert dm.get("admission_pressure_spared") == 1, dm
+
+# 4) reversibility: resultCache.enabled=false is byte-identical —
+# same rows, both runs dispatch the executor, no cache counter moves
+off = TpuSession(dict(WEIGHTS,
+                      **{"spark.rapids.sql.resultCache.enabled": "false"}))
+before = get_registry().snapshot()
+off1 = run_plan(off)
+off2 = run_plan(off)
+moved = get_registry().delta(before)["counters"]
+assert off1 == cold and off2 == cold, "cache-off rows diverge"
+assert moved.get("queries_executed", 0) == 2 * len(PLAN), moved
+assert not any(k.startswith("result_cache") for k in moved), moved
+print("serving gate: warm hit 0-dispatch, 3:1 order, tenant shed, "
+      "cache-off identical: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

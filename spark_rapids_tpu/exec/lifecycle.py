@@ -27,15 +27,29 @@ scheduler to inherit that from, so the equivalent plane lives here:
   refuse to swallow them with one ``getattr(ex, "terminal", False)``
   check and no import.
 
-* :class:`AdmissionController` — session-level FIFO admission bounding
+* :class:`AdmissionController` — session-level admission bounding
   concurrent queries (``spark.rapids.sql.admission.*``).  Beyond the
   queue bound (or queue wait timeout, or after shutdown began) new
   queries are load-shed with :class:`QueryRejected` instead of piling
-  onto the DeviceSemaphore and worker pool.  When the cross-query
-  memory governor is enabled the session also wires its pressure hook
-  here: sustained device occupancy above the shed watermark rejects
-  NEW queries rather than admitting them into an OOM-retry storm
-  (memory/governor.py).
+  onto the DeviceSemaphore and worker pool.  Admission is
+  **weighted-fair across tenants** (``collect(tenant=...)`` or the
+  ``spark.rapids.sql.tenant`` default): each tenant owns a FIFO queue
+  and a virtual-time stride — the next admitted query comes from the
+  backlogged tenant with the smallest virtual time, which converges on
+  ``tenantWeights`` shares under saturation while a SINGLE tenant
+  degenerates to exactly the old FIFO token deque.  Queue bounds and
+  per-tenant ``tenantMaxConcurrent`` caps apply per tenant, so one
+  storming tenant sheds only itself.  When the cross-query memory
+  governor is enabled the session also wires its pressure hook here:
+  sustained device occupancy above the shed watermark rejects NEW
+  queries rather than admitting them into an OOM-retry storm — but
+  only for tenants AT OR ABOVE their weighted share of the running
+  set, so the noisy tenant absorbs the shed, not its neighbors
+  (memory/governor.py; the governor first evicts the result cache,
+  its lowest-priority occupant, before any query is shed).  A query
+  cancelled while still QUEUED releases its queue slot and surfaces
+  ``QueryCancelled`` (counted once by the cancel itself) — never
+  ``queries_rejected``.
 
 Post-cancel invariants (asserted by tests/test_lifecycle.py): the
 DeviceSemaphore is back at full capacity, the spill directory is
@@ -58,6 +72,7 @@ from spark_rapids_tpu.obs.registry import get_registry
 __all__ = [
     "QueryLifecycle", "AdmissionController", "QueryLifecycleError",
     "QueryCancelled", "QueryDeadlineExceeded", "QueryRejected",
+    "SQL_TENANT", "parse_tenant_map",
     "ADMITTED", "RUNNING", "FINISHED", "FAILED", "CANCELLED",
     "DEADLINE_EXCEEDED",
 ]
@@ -92,6 +107,36 @@ ADMISSION_QUEUE_TIMEOUT = register(ConfEntry(
     "rejected with QueryRejected (0 = wait forever). Keeps a wedged "
     "run from silently stalling everything queued behind it.",
     conv=float))
+SQL_TENANT = register(ConfEntry(
+    "spark.rapids.sql.tenant", "default",
+    "Tenant name queries run under when DataFrame.collect(tenant=...) "
+    "does not name one. Tenants are the unit of weighted-fair "
+    "admission, per-tenant queue bounds/concurrency caps, and "
+    "per-tenant memory-pressure shedding — one noisy tenant cannot "
+    "starve the rest. A single tenant (the default) makes admission "
+    "behave exactly like the plain FIFO queue."))
+ADMISSION_TENANT_WEIGHTS = register(ConfEntry(
+    "spark.rapids.sql.admission.tenantWeights", "",
+    "Comma-separated tenant:weight pairs (e.g. 'etl:3,dashboards:1'; "
+    "unlisted tenants weigh 1). Under saturation each backlogged "
+    "tenant is admitted in proportion to its weight via virtual-time "
+    "stride scheduling; an idle tenant accrues no credit, so it "
+    "cannot burst past its share after sitting out."))
+ADMISSION_TENANT_MAX_CONCURRENT = register(ConfEntry(
+    "spark.rapids.sql.admission.tenantMaxConcurrent", "",
+    "Comma-separated tenant:N pairs capping how many of a tenant's "
+    "queries may run concurrently (unlisted/0 = only the global "
+    "maxConcurrentQueries bound applies). A capped tenant's surplus "
+    "waits in ITS queue; other tenants admit past it — per-tenant "
+    "caps never cause cross-tenant head-of-line blocking."))
+ADMISSION_DEADLINE_ORDERING = register(ConfEntry(
+    "spark.rapids.sql.admission.deadlineOrdering", False,
+    "Order each tenant's admission queue earliest-deadline-first "
+    "(queries carrying collect(timeout=)/queryTimeout deadlines jump "
+    "ahead of unbounded ones) instead of strict FIFO. Off by default: "
+    "FIFO within a tenant preserves the pre-tenant admission order "
+    "byte for byte.", conv=lambda v: str(v).lower() in
+    ("true", "1", "yes")))
 
 # -- states ----------------------------------------------------------------
 
@@ -163,9 +208,11 @@ class QueryLifecycle:
     promptly and the next :meth:`check` raises the terminal error.
     """
 
-    def __init__(self, query_id: str, timeout: "float | None" = None):
+    def __init__(self, query_id: str, timeout: "float | None" = None,
+                 tenant: str = "default"):
         self.query_id = query_id
         self.timeout = timeout if timeout and timeout > 0 else None
+        self.tenant = tenant
         self.cancel_event = threading.Event()
         self._lock = threading.Lock()
         self._state = ADMITTED
@@ -174,14 +221,16 @@ class QueryLifecycle:
         self._cancel_reason = "cancelled"
 
     @classmethod
-    def from_conf(cls, query_id: str, conf,
-                  timeout: "float | None" = None) -> "QueryLifecycle":
+    def from_conf(cls, query_id: str, conf, timeout: "float | None" = None,
+                  tenant: "str | None" = None) -> "QueryLifecycle":
         """Effective deadline = the tighter of the conf queryTimeout
-        and the per-call ``timeout``."""
+        and the per-call ``timeout``; tenant defaults from
+        ``spark.rapids.sql.tenant``."""
         settings = getattr(conf, "settings", None) or {}
         conf_tmo = QUERY_TIMEOUT.get(settings)
         cands = [t for t in (conf_tmo, timeout) if t and t > 0]
-        return cls(query_id, timeout=min(cands) if cands else None)
+        return cls(query_id, timeout=min(cands) if cands else None,
+                   tenant=tenant or SQL_TENANT.get(settings))
 
     # -- transitions -------------------------------------------------------
 
@@ -280,23 +329,83 @@ class QueryLifecycle:
 
 # -- session-level admission -----------------------------------------------
 
+def parse_tenant_map(spec: str, conv=float) -> dict:
+    """'a:3,b:1' -> {'a': 3.0, 'b': 1.0} (tenantWeights /
+    tenantMaxConcurrent grammar; blanks ignored, bad pairs raise)."""
+    out: dict = {}
+    for pair in (spec or "").split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        name, sep, val = pair.rpartition(":")
+        if not sep or not name.strip():
+            raise ValueError(f"bad tenant map entry {pair!r}: "
+                             "want 'tenant:value'")
+        out[name.strip()] = conv(val.strip())
+    return out
+
+
+class _TenantState:
+    """One tenant's admission book-keeping: its FIFO/EDF wait queue,
+    running count, and virtual-time stride (1/weight per admission)."""
+
+    __slots__ = ("name", "weight", "max_concurrent", "active", "vtime",
+                 "queue")
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 max_concurrent: int = 0):
+        self.name = name
+        self.weight = weight if weight > 0 else 1.0
+        self.max_concurrent = max_concurrent
+        self.active = 0
+        self.vtime = 0.0
+        self.queue: deque = deque()
+
+
+class _Waiter:
+    __slots__ = ("tenant", "seq", "deadline_key")
+
+    def __init__(self, tenant: _TenantState, seq: int,
+                 deadline_key: float):
+        self.tenant = tenant
+        self.seq = seq
+        self.deadline_key = deadline_key
+
+
 class AdmissionController:
-    """FIFO admission: at most ``max_concurrent`` queries run, at most
-    ``max_queued`` wait, the rest are load-shed with
-    :class:`QueryRejected`.  A single condition variable guards both
-    counters; FIFO order is enforced by a token deque — a waiter only
-    proceeds when its token reaches the head, so a late arrival can
-    never overtake a query that queued first."""
+    """Weighted-fair admission: at most ``max_concurrent`` queries run
+    and at most ``max_queued`` wait PER TENANT; the rest are load-shed
+    with :class:`QueryRejected`.  One condition variable guards every
+    counter.  Each tenant keeps its own FIFO queue; when a slot frees,
+    the backlogged tenant with the smallest virtual time admits its
+    head, and admitting advances that tenant's virtual time by
+    1/weight — stride scheduling, so saturated tenants converge on
+    ``tenantWeights`` shares while a single tenant reduces to exactly
+    the old FIFO token deque (a waiter only proceeds when it is the
+    deterministic selection, so a late arrival can never overtake a
+    same-tenant query that queued first)."""
 
     def __init__(self, max_concurrent: int = 0, max_queued: int = 16,
-                 queue_timeout: float = 30.0):
+                 queue_timeout: float = 30.0,
+                 tenant_weights: "dict | None" = None,
+                 tenant_max_concurrent: "dict | None" = None,
+                 deadline_ordering: bool = False):
         self.max_concurrent = max_concurrent
         self.max_queued = max_queued
         self.queue_timeout = queue_timeout
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_max_concurrent = dict(tenant_max_concurrent or {})
+        self.deadline_ordering = deadline_ordering
         self._cond = threading.Condition()
+        self._tenants: "dict[str, _TenantState]" = {}
         self._active = 0
-        self._queue: deque = deque()
+        self._seq = 0
+        self._vclock = 0.0
         self._shutdown = False
+        #: audit trail of admissions in order — (tenant, query_id) —
+        #: so fairness is observable, not just statistical (the CI
+        #: serving gate asserts weighted order against this)
+        self.admission_log: deque = deque(maxlen=1024)
         # memory-pressure shed hook (memory/governor.py, wired by the
         # session when the governor is enabled): a callable returning a
         # reason string when NEW admissions should be load-shed —
@@ -304,6 +413,11 @@ class AdmissionController:
         # Late-bound attribute, not an import: this module stays
         # stdlib + conf + obs so hot modules can import it freely
         self.pressure_hook = None
+        # serving-tier fault registry (faults.py, wired by the session
+        # when spark.rapids.test.faults is set) for the
+        # admission.tenant.storm injection point — late-bound for the
+        # same dependency reason as pressure_hook
+        self.faults = None
 
     @classmethod
     def from_conf(cls, conf) -> "AdmissionController":
@@ -311,7 +425,12 @@ class AdmissionController:
         return cls(
             max_concurrent=ADMISSION_MAX_CONCURRENT.get(settings),
             max_queued=ADMISSION_MAX_QUEUED.get(settings),
-            queue_timeout=ADMISSION_QUEUE_TIMEOUT.get(settings))
+            queue_timeout=ADMISSION_QUEUE_TIMEOUT.get(settings),
+            tenant_weights=parse_tenant_map(
+                ADMISSION_TENANT_WEIGHTS.get(settings)),
+            tenant_max_concurrent=parse_tenant_map(
+                ADMISSION_TENANT_MAX_CONCURRENT.get(settings), conv=int),
+            deadline_ordering=ADMISSION_DEADLINE_ORDERING.get(settings))
 
     @property
     def active(self) -> int:
@@ -321,92 +440,206 @@ class AdmissionController:
     @property
     def queued(self) -> int:
         with self._cond:
-            return len(self._queue)
+            return sum(len(t.queue) for t in self._tenants.values())
 
     @property
     def shutting_down(self) -> bool:
         return self._shutdown
 
-    def admit(self, query_id: str = "?",
-              timeout: "float | None" = None) -> None:
-        """Block until admitted (FIFO).  Raises :class:`QueryRejected`
-        when the session is shutting down, the wait queue is full, the
-        queue wait exceeds ``timeout`` (default: the
+    def tenant_stats(self) -> dict:
+        """{tenant: {active, queued, weight, vtime}} — the fairness
+        ledger (bench observability block, chaos assertions)."""
+        with self._cond:
+            return {t.name: {"active": t.active, "queued": len(t.queue),
+                             "weight": t.weight, "vtime": t.vtime}
+                    for t in self._tenants.values()}
+
+    # -- internals (under self._cond) --------------------------------------
+
+    def _tenant_locked(self, name: str) -> _TenantState:
+        st = self._tenants.get(name)
+        if st is None:
+            st = _TenantState(
+                name, weight=self.tenant_weights.get(name, 1.0),
+                max_concurrent=int(
+                    self.tenant_max_concurrent.get(name, 0)))
+            self._tenants[name] = st
+        return st
+
+    def _head_locked(self, st: _TenantState) -> "_Waiter | None":
+        if not st.queue:
+            return None
+        if not self.deadline_ordering:
+            return st.queue[0]
+        return min(st.queue, key=lambda w: (w.deadline_key, w.seq))
+
+    def _select_locked(self) -> "_Waiter | None":
+        """The deterministic next admission: among tenants with
+        waiters and per-tenant headroom, the smallest (vtime, head
+        seq).  Tenants at their own cap are skipped — a capped
+        tenant's backlog never blocks its neighbors."""
+        best = None
+        best_key = None
+        for st in self._tenants.values():
+            if st.max_concurrent > 0 and st.active >= st.max_concurrent:
+                continue
+            head = self._head_locked(st)
+            if head is None:
+                continue
+            key = (st.vtime, head.seq)
+            if best_key is None or key < best_key:
+                best, best_key = head, key
+        return best
+
+    def _admitted_locked(self, st: _TenantState, query_id: str) -> None:
+        self._active += 1
+        st.active += 1
+        # stride bookkeeping: service starts at max(own vtime, the
+        # global virtual clock) so an idle tenant re-enters at "now"
+        # with no hoarded credit, then advances by 1/weight
+        start = max(st.vtime, self._vclock)
+        st.vtime = start + 1.0 / st.weight
+        self._vclock = start
+        self.admission_log.append((st.name, query_id))
+        reg = get_registry()
+        reg.inc("queries_admitted")
+        reg.inc(f"admission.tenant.{st.name}.admitted")
+
+    def _tenant_over_share(self, tenant: str) -> bool:
+        """Is this tenant at/above its weighted share of the running
+        set?  The per-tenant pressure-shed predicate: with a single
+        tenant this is always True (identical to the old
+        shed-everyone behavior); a tenant running BELOW its share is
+        spared — the pressure is someone else's doing."""
+        with self._cond:
+            st = self._tenant_locked(tenant)
+            total = self._active
+            if total <= 0:
+                return True
+            sum_w = sum(t.weight for t in self._tenants.values()
+                        if t.active > 0 or t is st)
+            return st.active * sum_w >= total * st.weight
+
+    def _reject(self, reg, tenant: str, query_id: str,
+                why: str) -> "QueryRejected":
+        reg.inc("queries_rejected")
+        reg.inc(f"admission.tenant.{tenant}.rejected")
+        return QueryRejected(query_id,
+                             f"query {query_id} rejected: {why}")
+
+    def admit(self, query_id: str = "?", timeout: "float | None" = None,
+              tenant: str = "default",
+              lifecycle: "QueryLifecycle | None" = None) -> None:
+        """Block until admitted.  Raises :class:`QueryRejected` when
+        the session is shutting down, the tenant's wait queue is full,
+        the queue wait exceeds ``timeout`` (default: the
         queueTimeoutSeconds conf; 0 waits forever), or the memory
-        governor's pressure hook reports sustained overload."""
+        governor's pressure hook reports sustained overload AND this
+        tenant is at/above its weighted share.  With ``lifecycle``,
+        a cancel landing while still queued releases the queue slot
+        and raises the terminal :class:`QueryCancelled` instead —
+        counted once as ``queries_cancelled`` by the cancel itself,
+        never as a rejection."""
         reg = get_registry()
         tmo = self.queue_timeout if timeout is None else timeout
-        token = object()
+        faults = self.faults
+        if faults is not None:
+            act = faults.check("admission.tenant.storm", tenant=tenant,
+                               query_id=query_id)
+            if act is not None:
+                # the tenant's traffic storm saturated its own queue:
+                # shed THIS arrival exactly like a full tenant queue
+                raise self._reject(
+                    reg, tenant, query_id,
+                    f"injected admission storm on tenant {tenant!r}")
         hook = self.pressure_hook
         if hook is not None:
             # checked OUTSIDE the condition (the hook takes the
             # governor's own lock) and before queueing: a query shed
-            # for memory pressure never occupied a queue slot
+            # for memory pressure never occupied a queue slot.  Only
+            # the over-share tenant absorbs the shed.
             reason = hook()
             if reason:
-                reg.inc("queries_rejected")
-                raise QueryRejected(
-                    query_id,
-                    f"query {query_id} rejected: {reason}")
+                if self._tenant_over_share(tenant):
+                    raise self._reject(reg, tenant, query_id, reason)
+                reg.inc("admission_pressure_spared")
         with self._cond:
+            st = self._tenant_locked(tenant)
             if self._shutdown:
-                reg.inc("queries_rejected")
-                raise QueryRejected(
-                    query_id, f"query {query_id} rejected: session is "
-                    "shutting down")
+                raise self._reject(reg, tenant, query_id,
+                                   "session is shutting down")
             if self.max_concurrent <= 0:
-                self._active += 1
-                reg.inc("queries_admitted")
+                self._admitted_locked(st, query_id)
                 return
-            if self._active < self.max_concurrent and not self._queue:
-                self._active += 1
-                reg.inc("queries_admitted")
+            if self._active < self.max_concurrent \
+                    and not any(t.queue for t in self._tenants.values()) \
+                    and (st.max_concurrent <= 0
+                         or st.active < st.max_concurrent):
+                self._admitted_locked(st, query_id)
                 return
-            if len(self._queue) >= self.max_queued:
-                reg.inc("queries_rejected")
-                raise QueryRejected(
-                    query_id, f"query {query_id} rejected: admission "
-                    f"queue full ({len(self._queue)} >= "
+            if len(st.queue) >= self.max_queued:
+                raise self._reject(
+                    reg, tenant, query_id,
+                    f"admission queue full for tenant {tenant!r} "
+                    f"({len(st.queue)} >= "
                     f"maxQueuedQueries={self.max_queued})")
-            self._queue.append(token)
+            self._seq += 1
+            dkey = float("inf")
+            if lifecycle is not None and lifecycle.timeout:
+                dkey = time.monotonic() + lifecycle.timeout
+            me = _Waiter(st, self._seq, dkey)
+            st.queue.append(me)
             deadline = time.monotonic() + tmo if tmo and tmo > 0 \
                 else None
+            admitted = False
             try:
                 while True:
                     if self._shutdown:
-                        raise QueryRejected(
-                            query_id, f"query {query_id} rejected: "
-                            "session is shutting down")
-                    if self._queue[0] is token and \
-                            self._active < self.max_concurrent:
-                        self._queue.popleft()
-                        self._active += 1
-                        reg.inc("queries_admitted")
+                        raise self._reject(reg, tenant, query_id,
+                                           "session is shutting down")
+                    if lifecycle is not None:
+                        # cancel-while-queued: surface the terminal
+                        # lifecycle error; the finally below frees the
+                        # queue slot, and queries_cancelled was already
+                        # counted exactly once by cancel() itself
+                        lifecycle.check()
+                    if self._active < self.max_concurrent and \
+                            self._select_locked() is me:
+                        st.queue.remove(me)
+                        self._admitted_locked(st, query_id)
+                        admitted = True
                         return
                     rem = None if deadline is None \
                         else deadline - time.monotonic()
                     if rem is not None and rem <= 0:
-                        raise QueryRejected(
-                            query_id, f"query {query_id} rejected: "
+                        raise self._reject(
+                            reg, tenant, query_id,
                             f"waited {tmo:g}s in the admission queue "
                             "(queueTimeoutSeconds)")
+                    # a condition wait cannot observe the lifecycle's
+                    # cancel event, so cancellable waiters poll in
+                    # bounded slices
+                    if lifecycle is not None:
+                        rem = 0.05 if rem is None else min(rem, 0.05)
                     self._cond.wait(rem)
-            except QueryRejected:
-                reg.inc("queries_rejected")
-                try:
-                    self._queue.remove(token)
-                except ValueError:
-                    pass
-                # the head token may have changed: wake the queue
-                self._cond.notify_all()
-                raise
+            finally:
+                if not admitted:
+                    try:
+                        st.queue.remove(me)
+                    except ValueError:
+                        pass
+                    # the selection may have changed: wake the queue
+                    self._cond.notify_all()
 
-    def release(self) -> None:
+    def release(self, tenant: str = "default") -> None:
         """One admitted query finished (success, failure, or cancel):
-        free its slot and wake the queue head."""
+        free its slot — global and per-tenant — and wake the queue."""
         with self._cond:
             if self._active > 0:
                 self._active -= 1
+            st = self._tenants.get(tenant)
+            if st is not None and st.active > 0:
+                st.active -= 1
             self._cond.notify_all()
 
     def begin_shutdown(self) -> None:

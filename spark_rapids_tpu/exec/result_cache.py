@@ -1,0 +1,416 @@
+"""Process-wide result/fragment cache: the serving tier's reuse plane.
+
+The reference amortizes repeated work inside ONE query through
+ReuseExchange / ReuseSubquery and shared broadcast build sides
+(GpuTransitionOverrides); a serving tier answering "heavy traffic from
+millions of users" (ROADMAP north star) needs the cross-QUERY analog:
+identical or overlapping queries arriving concurrently or back to back
+must not recompute everything from the parquet files up.
+
+Two entry kinds live in one LRU, both keyed so a hit is provably the
+same computation:
+
+* **result** — the full row set of one ``collect``.  Key =
+  ``fragment_key("result", <structural plan part>, backend)`` (the
+  compile cache's canonical fingerprint machinery, exec/compile_cache)
+  x ``recovery.conf_fingerprint(conf)`` (results are only deterministic
+  under the exact conf they were computed with) x the **input
+  snapshot**: every leaf scan's ``FileScanExec.snapshot_fingerprint()``
+  — (path, size, mtime_ns) per file — so mutating an input invalidates
+  instead of serving stale rows.  Rows are stored as a pickled blob
+  with a CRC32 verified on every hit (the ``cache.result.corrupt``
+  fault point poisons the blob to prove the verify-drop-recompute
+  path); a hit serves rows without minting an ExecCtx — zero executor
+  dispatches, zero compiles.
+
+* **fragment** — a shared scan's materialized device batches
+  (io/scan.py ``share_output``), routed here instead of the per-query
+  ``ExecCtx.cached`` so CONCURRENT queries over the same table at the
+  same snapshot share one host-read + pack.  Entries are
+  consumer-counted like the PR 2 parked entries: a consumer mid-drain
+  pins its entry against eviction; an idle entry is plain LRU weight.
+
+Plans whose identity cannot be proven are never cached: a leaf that is
+not a ``FileScanExec`` has no snapshot, and a fingerprint carrying an
+opaque-state serial (a UDF closure, slotted native state) is unique by
+construction — ``result_key`` returns None and the query runs exactly
+as before.
+
+Memory: the cache is bounded by ``spark.rapids.sql.resultCache.maxBytes``
+(LRU), and it registers with the PR 11 memory governor as the LOWEST
+priority occupant — unpinned, rebuildable — so sustained device
+pressure evicts cache entries before any query is load-shed and
+``reclaim`` drops fragments before wounding a peer query's working set
+(memory/governor.py ``register_cache``).
+
+Single-flight: concurrent identical queries coalesce onto one in-flight
+computation.  The wait is lifecycle-integrated — a waiter's
+cancel/deadline aborts the WAIT (its own ``QueryLifecycle.check``),
+never the computation the owner query owns; when the owner fails, a
+waiter takes over and computes (its own admission, its own lifecycle).
+
+Counters (obs/registry.py): ``result_cache_hits`` / ``_misses`` /
+``_corrupt`` / ``_evictions`` / ``_coalesced`` /
+``result_cache_fragment_hits`` / ``_fragment_misses`` plus the
+``result_cache`` pull source (entries/bytes gauges).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import zlib
+
+from spark_rapids_tpu.conf import bool_conf, int_conf
+from spark_rapids_tpu.exec.compile_cache import fingerprint, fragment_key
+from spark_rapids_tpu.exec.recovery import conf_fingerprint
+from spark_rapids_tpu.obs.registry import get_registry
+
+__all__ = ["ResultCache", "get_result_cache", "maybe_cache",
+           "RESULT_CACHE_ENABLED", "RESULT_CACHE_MAX_BYTES"]
+
+RESULT_CACHE_ENABLED = bool_conf(
+    "spark.rapids.sql.resultCache.enabled", True,
+    "Serve a repeated identical query (same structural plan, same "
+    "effective conf, same input snapshot — file paths/sizes/mtimes) "
+    "from the process-wide result cache instead of re-executing, and "
+    "share scan materializations across concurrent queries at the same "
+    "snapshot. Hits are CRC-verified; mutating any input file or any "
+    "conf forces a full recompute. Entries are the memory governor's "
+    "first eviction victims, before any query is shed. false restores "
+    "execute-every-time behavior byte for byte.")
+
+RESULT_CACHE_MAX_BYTES = int_conf(
+    "spark.rapids.sql.resultCache.maxBytes", 256 << 20,
+    "Upper bound on bytes the result/fragment cache may hold (LRU "
+    "eviction; result entries count their pickled blob, fragment "
+    "entries their device batch bytes). A single result larger than "
+    "this is returned to its caller but never cached.")
+
+#: fingerprint substrings that mean "state we could not canonicalize":
+#: the compile cache poisons such state with a unique serial, so a key
+#: built from it can never legitimately hit — refuse to cache instead
+#: of filling the LRU with dead entries
+_POISON = ("<opaque:", "<slots:", "<deep:")
+
+
+def _plan_part(plan):
+    """Structural identity of a logical plan: scans by their stable
+    ``scan_fingerprint`` (NOT their mutable exec-node state — bucket
+    caches and skip counters change across runs), every other node by
+    class + canonicalized non-child attributes + recursed children."""
+    from spark_rapids_tpu.plan import logical as L
+    if isinstance(plan, L.Scan):
+        return ("scan", plan.exec_node.scan_fingerprint())
+    attrs = {k: v for k, v in vars(plan).items()
+             if not isinstance(v, L.LogicalPlan)}
+    return (type(plan).__name__, fingerprint(attrs),
+            tuple(_plan_part(c) for c in plan.children))
+
+
+def plan_snapshot(logical):
+    """The input snapshot of a logical plan: every leaf scan's
+    ``snapshot_fingerprint()``, or None when any leaf is not a
+    file-backed scan (in-memory/local data has no provable snapshot
+    identity) or a file vanished mid-key."""
+    from spark_rapids_tpu.io.scan import FileScanExec
+    from spark_rapids_tpu.plan import logical as L
+    snaps = []
+
+    def walk(p) -> bool:
+        if isinstance(p, L.Scan):
+            node = p.exec_node
+            if not isinstance(node, FileScanExec):
+                return False
+            try:
+                snaps.append(node.snapshot_fingerprint())
+            except OSError:
+                return False
+            return True
+        kids = p.children
+        if not kids:
+            return False
+        return all(walk(c) for c in kids)
+
+    if not walk(logical) or not snaps:
+        return None
+    return tuple(snaps)
+
+
+class _Entry:
+    __slots__ = ("key", "kind", "blob", "crc", "value", "nbytes",
+                 "consumers")
+
+    def __init__(self, key, kind: str, nbytes: int, blob: bytes = b"",
+                 crc: int = 0, value=None):
+        self.key = key
+        self.kind = kind          # "result" | "fragment"
+        self.blob = blob
+        self.crc = crc
+        self.value = value
+        self.nbytes = nbytes
+        self.consumers = 0        # active fragment drains (pin vs evict)
+
+
+class ResultCache:
+    """Bounded LRU of results and scan fragments with single-flight
+    computation.  Thread-safe; every blocking wait is either
+    lifecycle-sliced (cancel/deadline abort the wait) or bounded."""
+
+    def __init__(self, max_bytes: int = RESULT_CACHE_MAX_BYTES.default):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "dict[tuple, _Entry]" = {}   # insertion order = LRU
+        self._inflight: "dict[tuple, threading.Event]" = {}
+        self._bytes = 0
+        get_registry().register_source("result_cache", self._source)
+
+    # -- keys --------------------------------------------------------------
+
+    def result_key(self, logical, backend: str, conf):
+        """(plan fp, conf fp, snapshot) for one collect, or None when
+        the query's identity cannot be proven (non-file leaves, opaque
+        plan state) and it must execute normally."""
+        snap = plan_snapshot(logical)
+        if snap is None:
+            return None
+        part = _plan_part(logical)
+        fp = fingerprint(part, backend)
+        if any(m in fp for m in _POISON):
+            return None
+        return (fragment_key("result", part, backend),
+                conf_fingerprint(conf), snap)
+
+    # -- whole-query results -----------------------------------------------
+
+    def get_or_compute(self, key, compute, lifecycle=None, faults=None):
+        """Serve ``key`` from cache, or coalesce onto / become the one
+        in-flight computation.  ``compute`` runs the full admission +
+        execution path; a waiter whose owner fails takes over with its
+        own ``compute`` (never inheriting the owner's failure)."""
+        reg = get_registry()
+        while True:
+            owner = False
+            blob = None
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    if faults is not None:
+                        act = faults.check("cache.result.corrupt",
+                                           kind=e.kind)
+                        if act is not None and e.blob:
+                            # flip one seeded byte so the CRC verify
+                            # below fails exactly like real corruption
+                            poisoned = bytearray(e.blob)
+                            poisoned[act.rng.randrange(
+                                len(poisoned))] ^= 0x40
+                            e.blob = bytes(poisoned)
+                    if zlib.crc32(e.blob) != e.crc:
+                        reg.inc("result_cache_corrupt")
+                        self._drop_locked(key)
+                        e = None
+                    else:
+                        self._touch_locked(key)
+                        blob = e.blob
+                if e is None:
+                    ev = self._inflight.get(key)
+                    if ev is None:
+                        ev = self._inflight[key] = threading.Event()
+                        owner = True
+            if blob is not None:
+                if lifecycle is not None:
+                    lifecycle.check()
+                reg.inc("result_cache_hits")
+                return pickle.loads(blob)
+            if owner:
+                try:
+                    rows = compute()
+                except BaseException:
+                    with self._lock:
+                        self._inflight.pop(key, None)
+                    ev.set()
+                    raise
+                out = pickle.dumps(rows, protocol=4)
+                with self._lock:
+                    self._store_locked(_Entry(key, "result", len(out),
+                                              blob=out,
+                                              crc=zlib.crc32(out)))
+                    self._inflight.pop(key, None)
+                ev.set()
+                reg.inc("result_cache_misses")
+                return rows
+            # coalesced waiter: wait on the owner's event in slices so
+            # OUR cancel/deadline aborts the wait — never the owner's
+            # computation, which other queries may also be waiting on
+            reg.inc("result_cache_coalesced")
+            if lifecycle is not None:
+                lifecycle.start()   # the wait IS this query's run
+                while not ev.wait(0.05):
+                    lifecycle.check()
+            else:
+                ev.wait()
+            # loop: entry present -> served as a hit; owner failed ->
+            # this waiter becomes the owner and computes for itself
+
+    # -- shared scan fragments ---------------------------------------------
+
+    def fragment_entry(self, key, builder, lifecycle=None) -> _Entry:
+        """Single-flight materialization of a shared scan partition.
+        Returns the entry with its consumer count already incremented;
+        the caller MUST pair it with :meth:`fragment_release` after
+        draining (a consumed entry is pinned against eviction, an idle
+        one is plain LRU weight — the PR 2 consumer-count discipline,
+        process-wide)."""
+        reg = get_registry()
+        while True:
+            owner = False
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    e.consumers += 1
+                    self._touch_locked(key)
+                else:
+                    ev = self._inflight.get(key)
+                    if ev is None:
+                        ev = self._inflight[key] = threading.Event()
+                        owner = True
+            if e is not None:
+                reg.inc("result_cache_fragment_hits")
+                return e
+            if owner:
+                try:
+                    val = builder()
+                except BaseException:
+                    with self._lock:
+                        self._inflight.pop(key, None)
+                    ev.set()
+                    raise
+                nbytes = 0
+                for b in val:
+                    sz = getattr(b, "device_size_bytes", None)
+                    if sz is not None:
+                        nbytes += sz()
+                e = _Entry(key, "fragment", nbytes, value=val)
+                with self._lock:
+                    self._store_locked(e)
+                    e.consumers += 1
+                    self._inflight.pop(key, None)
+                ev.set()
+                reg.inc("result_cache_fragment_misses")
+                return e
+            if lifecycle is not None:
+                while not ev.wait(0.05):
+                    lifecycle.check()
+            else:
+                ev.wait()
+
+    def fragment_release(self, entry: _Entry) -> None:
+        with self._lock:
+            if entry.consumers > 0:
+                entry.consumers -= 1
+
+    # -- memory ------------------------------------------------------------
+
+    def evict(self, need_bytes: "int | None" = None,
+              kind: "str | None" = None) -> int:
+        """Drop idle entries, oldest first, until ``need_bytes`` are
+        freed (None = drop everything idle).  ``kind`` restricts the
+        sweep ("fragment" = device batches only — the governor's
+        reclaim path, which needs HBM bytes, not host pickle blobs).
+        The governor's pressure and reclaim paths call this BEFORE
+        shedding or wounding any query — cache is the lowest-priority
+        occupant by design."""
+        reg = get_registry()
+        freed = 0
+        with self._lock:
+            for key in list(self._entries):
+                if need_bytes is not None and freed >= need_bytes:
+                    break
+                e = self._entries[key]
+                if e.consumers > 0 or (kind is not None and e.kind != kind):
+                    continue
+                freed += e.nbytes
+                self._drop_locked(key)
+                reg.inc("result_cache_evictions")
+        return freed
+
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def device_bytes(self) -> int:
+        """Bytes of DEVICE memory the cache holds (fragment entries
+        only — result blobs are host pickles and never relieve HBM)."""
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values()
+                       if e.kind == "fragment")
+
+    def clear(self) -> None:
+        """Test hook: drop every entry regardless of consumers."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # -- internals (all under self._lock) ----------------------------------
+
+    def _store_locked(self, e: _Entry) -> None:
+        if e.nbytes > self.max_bytes:
+            return      # serve the caller, never cache the oversized
+        self._drop_locked(e.key)
+        reg = get_registry()
+        for key in list(self._entries):
+            if self._bytes + e.nbytes <= self.max_bytes:
+                break
+            old = self._entries[key]
+            if old.consumers > 0:
+                continue
+            self._drop_locked(key)
+            reg.inc("result_cache_evictions")
+        if self._bytes + e.nbytes > self.max_bytes:
+            return      # everything resident is mid-drain; skip caching
+        self._entries[e.key] = e
+        self._bytes += e.nbytes
+
+    def _drop_locked(self, key) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+
+    def _touch_locked(self, key) -> None:
+        self._entries[key] = self._entries.pop(key)
+
+    def _source(self) -> dict:
+        with self._lock:
+            frags = [e for e in self._entries.values()
+                     if e.kind == "fragment"]
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "fragment_entries": len(frags),
+                "fragment_bytes": sum(e.nbytes for e in frags),
+            }
+
+
+_CACHE: "ResultCache | None" = None
+_CACHE_LOCK = threading.Lock()
+
+
+def get_result_cache() -> ResultCache:
+    """The process-wide cache singleton, governor-wired on first use."""
+    global _CACHE
+    with _CACHE_LOCK:
+        if _CACHE is None:
+            _CACHE = ResultCache()
+            from spark_rapids_tpu.memory.governor import get_governor
+            get_governor().register_cache(_CACHE)
+        return _CACHE
+
+
+def maybe_cache(conf) -> "ResultCache | None":
+    """The singleton when ``spark.rapids.sql.resultCache.enabled``,
+    else None — every call site degrades to today's behavior on None."""
+    settings = getattr(conf, "settings", None) or {}
+    if not RESULT_CACHE_ENABLED.get(settings):
+        return None
+    cache = get_result_cache()
+    cache.max_bytes = RESULT_CACHE_MAX_BYTES.get(settings)
+    return cache

@@ -97,6 +97,22 @@ Injection points wired today (site -> actions it interprets):
                         pressure; chaos tests use it to prove bounded
                         wall time (no eviction livelock) under
                         concurrent queries.
+    cache.result.corrupt
+                        result-cache hit verification (ctx: kind;
+                        exec/result_cache.py).  Action ``corrupt``
+                        flips one seeded byte of the cached blob so the
+                        per-hit CRC32 verify fails: the entry is
+                        dropped, ``result_cache_corrupt`` counts it,
+                        and the query recomputes — corruption is a
+                        cache miss, never stale rows or a crash.
+    admission.tenant.storm
+                        weighted-fair admission entry (ctx: tenant,
+                        query_id; exec/lifecycle.py).  Action ``storm``
+                        (any name works) rejects the arrival with
+                        QueryRejected before it takes a queue slot —
+                        a deterministic per-tenant admission storm for
+                        chaos tests to prove other tenants' queries
+                        still flow (no cross-tenant starvation).
 
 Trigger keys (all optional):
 
@@ -151,6 +167,8 @@ KNOWN_POINTS = frozenset({
     "memory.oom.until_rows",
     "memory.grant.stall",
     "memory.governor.oom_storm",
+    "cache.result.corrupt",
+    "admission.tenant.storm",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a

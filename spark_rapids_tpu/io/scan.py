@@ -256,12 +256,51 @@ class FileScanExec(PlanNode):
                 tuple(self._runtime_filters),
                 self._string_width, self._requested_parts)
 
+    def snapshot_fingerprint(self) -> tuple:
+        """Input-snapshot identity: (path, size, mtime_ns) per file, so
+        two scans with equal structural AND snapshot fingerprints read
+        byte-identical inputs — the invalidation half of every
+        result-cache key (exec/result_cache.py).  Raises OSError when a
+        file vanished; callers treat that as "no provable snapshot"."""
+        out = []
+        for f in self._files:
+            st = os.stat(f)
+            out.append((f, st.st_size, st.st_mtime_ns))
+        return tuple(out)
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
         files = self._partition_files(ctx, pid)
         mode = READER_TYPE[self.format_name].get(ctx.conf.settings)
         rbs = self._decode_iter(ctx, files, mode)
         if ctx.is_device:
             if self.share_output:
+                from spark_rapids_tpu.exec.result_cache import maybe_cache
+                rc = maybe_cache(ctx.conf)
+                if rc is not None:
+                    try:
+                        snap = self.snapshot_fingerprint()
+                    except OSError:
+                        snap = None
+                    if snap is not None:
+                        # cross-query path: one host-read + pack shared
+                        # by every concurrent query over this table at
+                        # this snapshot.  Raw device batches (no
+                        # catalog parking — a cached fragment must not
+                        # die with one query's catalog); the entry is
+                        # consumer-pinned for the drain and governor-
+                        # evictable when idle.
+                        from spark_rapids_tpu.exec.recovery import \
+                            conf_fingerprint
+                        fkey = ("scan", self.scan_fingerprint(), snap,
+                                conf_fingerprint(ctx.conf), pid)
+                        entry = rc.fragment_entry(
+                            fkey, lambda: list(self._device_batches(rbs)),
+                            lifecycle=ctx.cache.get("lifecycle"))
+                        try:
+                            yield from entry.value
+                        finally:
+                            rc.fragment_release(entry)
+                        return
                 from spark_rapids_tpu.memory.catalog import (
                     SpillableColumnarBatch, SpillPriority)
                 key = ("scan_share", self.scan_fingerprint(), pid)

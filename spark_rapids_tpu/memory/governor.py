@@ -160,7 +160,32 @@ class MemoryGovernor:
         self._shed_hold = GOVERNOR_SHED_HOLD.default
         self._grant_timeout = GOVERNOR_GRANT_TIMEOUT.default
         self._poll_s = GOVERNOR_POLL_MS.default / 1000.0
+        # the process result cache (exec/result_cache.py), weakly held:
+        # its entries are the governor's LOWEST-priority occupants —
+        # unpinned, rebuildable — evicted before any query is wounded
+        # or load-shed
+        self._cache_ref = None
         get_registry().register_source("governor", self._source)
+
+    def register_cache(self, cache) -> None:
+        """Bind the process-wide result/fragment cache as the first
+        eviction victim under memory pressure (weakref: the governor
+        must never keep the cache alive)."""
+        self._cache_ref = weakref.ref(cache)
+
+    def _evict_cache(self, need_bytes, kind=None) -> int:
+        """Drop idle cache entries; returns DEVICE bytes freed (host
+        result blobs relieve RAM, not HBM, so only fragment bytes
+        count toward device pressure)."""
+        ref = self._cache_ref
+        cache = ref() if ref is not None else None
+        if cache is None:
+            return 0
+        dev_before = cache.device_bytes()
+        freed = cache.evict(need_bytes, kind=kind)
+        if freed:
+            get_registry().inc("governor_cache_evict_bytes", freed)
+        return dev_before - cache.device_bytes()
 
     # -- registration ------------------------------------------------------
 
@@ -275,8 +300,15 @@ class MemoryGovernor:
                 return 0
         reg = get_registry()
         reg.inc("governor_reclaims")
-        freed = catalog.spill_device(need)
-        reg.inc("governor_spill_bytes_own", freed)
+        # lowest priority first: idle shared-scan fragments in the
+        # result cache are rebuildable — drop them before spilling the
+        # requester's own working set, let alone wounding a peer
+        freed = self._evict_cache(need, kind="fragment")
+        if freed >= need:
+            return freed
+        own = catalog.spill_device(need - freed)
+        freed += own
+        reg.inc("governor_spill_bytes_own", own)
         if freed >= need or st is None:
             return freed
         freed += self._reclaim_from_peers(st, need - freed)
@@ -418,6 +450,13 @@ class MemoryGovernor:
             if held < self._shed_hold:
                 return None
             frac = self._total_locked() / self._budget
+        # lowest-priority occupant goes first: if dropping idle cached
+        # scan fragments actually freed device bytes, this pressure
+        # event is absorbed by the cache and no query is shed (result
+        # blobs are host memory and cannot relieve HBM — they don't
+        # spare a shed)
+        if self._evict_cache(None, kind="fragment") > 0:
+            return None
         get_registry().inc("governor_pressure_sheds")
         return (f"memory pressure: device occupancy {frac:.0%} above "
                 f"shedWatermark={self._shed_wm:g} for {held:.1f}s "

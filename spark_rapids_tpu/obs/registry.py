@@ -46,7 +46,24 @@ parked for memory), ``governor_background_spills`` /
 occupancy), ``governor_victim_errors`` (peer spills skipped because
 the victim failed), and ``governor_storm_denials`` (injected
 ``memory.governor.oom_storm`` reclaim denials) — plus the ``governor`` pull source's aggregate and
-per-query ``q.<query_id>.{device,pinned,peak}_bytes`` gauges.
+per-query ``q.<query_id>.{device,pinned,peak}_bytes`` gauges; and the
+serving tier's two families: the result-cache plane's
+``result_cache_hits`` / ``result_cache_misses`` (whole-query serves vs
+computes — a hit moves NO ``queries_executed`` and NO
+``compile_count``), ``result_cache_fragment_hits`` /
+``result_cache_fragment_misses`` (cross-query shared-scan
+materializations), ``result_cache_corrupt`` (CRC-failed hits dropped
+and recomputed), ``result_cache_evictions``,
+``result_cache_coalesced`` (waiters single-flighted onto an in-flight
+identical query), ``governor_cache_evict_bytes`` (cache bytes the
+governor reclaimed under pressure) plus the ``result_cache`` pull
+source (entries/bytes gauges, exec/result_cache.py); and the
+multi-tenant admission plane's ``queries_executed`` (incremented at
+executor entry — the zero-delta proof that a cache hit never touched
+the executor), per-tenant ``admission.tenant.<t>.admitted`` /
+``admission.tenant.<t>.rejected``, and ``admission_pressure_spared``
+(pressure sheds skipped because the arriving tenant was under its
+weighted share — exec/lifecycle.py).
 """
 from __future__ import annotations
 

@@ -63,6 +63,11 @@ class TpuSession:
                     # inert with the governor conf off
                     self._admission.pressure_hook = \
                         get_governor().admission_pressure
+                # serving-tier fault points (admission.tenant.storm,
+                # cache.result.corrupt) — inert unless
+                # spark.rapids.test.faults names a plan
+                from spark_rapids_tpu.faults import FaultRegistry
+                self._admission.faults = FaultRegistry.from_conf(self.conf)
             return self._admission
 
     def active_queries(self) -> list[str]:
@@ -120,22 +125,34 @@ class TpuSession:
         return True
 
     def _run_query(self, node, backend: str,
-                   timeout: float | None = None) -> list[tuple]:
-        """Admission -> lifecycle registration -> execution -> cleanup
-        for one collect.  The ExecCtx cache is pre-seeded with the
-        lifecycle handle (and its query_id) so every cancellation
-        point down the stack observes the session's cancel/deadline."""
+                   timeout: float | None = None, logical=None,
+                   tenant: str | None = None) -> list[tuple]:
+        """Result-cache lookup -> admission -> lifecycle registration
+        -> execution -> cleanup for one collect.  The lifecycle is
+        registered in ``_live`` BEFORE admission so a cancel reaches a
+        query still waiting in the queue (releasing its queue slot;
+        counted once as cancelled, never rejected).  The ExecCtx cache
+        is pre-seeded with the lifecycle handle (and its query_id) so
+        every cancellation point down the stack observes the session's
+        cancel/deadline.  A result-cache hit (exec/result_cache.py)
+        serves rows without admission or an ExecCtx — zero executor
+        dispatches — and a concurrent identical query coalesces onto
+        the one in-flight run."""
         import uuid
         from spark_rapids_tpu.exec.lifecycle import (QueryLifecycle,
                                                      QueryLifecycleError)
         admission = self._admission_controller()
         query_id = uuid.uuid4().hex[:16]
-        admission.admit(query_id)
         lc = QueryLifecycle.from_conf(query_id, self.conf,
-                                      timeout=timeout)
+                                      timeout=timeout, tenant=tenant)
         with self._lc_cond:
             self._live[query_id] = lc
-        try:
+        admitted = False
+
+        def run() -> list[tuple]:
+            nonlocal admitted
+            admission.admit(query_id, tenant=lc.tenant, lifecycle=lc)
+            admitted = True
             lc.start()
             try:
                 out = self._execute_collect(node, backend, query_id, lc)
@@ -152,13 +169,39 @@ class TpuSession:
                 raise
             lc.finish()
             return out
+
+        try:
+            rcache = None
+            key = None
+            if logical is not None and not admission.shutting_down:
+                from spark_rapids_tpu.exec.result_cache import maybe_cache
+                rcache = maybe_cache(self.conf)
+                if rcache is not None:
+                    # backend is part of the key: the host oracle must
+                    # never be served a device run's rows (differential
+                    # testing would silently compare a cache to itself)
+                    key = rcache.result_key(logical, backend, self.conf)
+            if key is None:
+                out = run()
+            else:
+                out = rcache.get_or_compute(
+                    key, run, lifecycle=lc, faults=admission.faults)
+                lc.finish()
+            return out
         finally:
             with self._lc_cond:
                 self._live.pop(query_id, None)
                 self._lc_cond.notify_all()
-            admission.release()
+            if admitted:
+                admission.release(tenant=lc.tenant)
 
     def _execute_collect(self, node, backend: str, query_id: str, lc):
+        # the executor-entry chokepoint: a result-cache hit never gets
+        # here, so a zero delta on this counter across a repeated query
+        # PROVES the executor was untouched (CI serving gate)
+        from spark_rapids_tpu.obs.registry import get_registry
+        get_registry().inc("queries_executed")
+
         def make_ctx(be: str) -> ExecCtx:
             ctx = ExecCtx(backend=be, conf=self.conf)
             ctx.cache["query_id"] = query_id
@@ -443,7 +486,8 @@ class DataFrame:
             self._plan))
 
     # -- actions -------------------------------------------------------
-    def collect(self, timeout: float | None = None) -> list[tuple]:
+    def collect(self, timeout: float | None = None,
+                tenant: str | None = None) -> list[tuple]:
         """Run the query and return every row as a python tuple.
 
         ``timeout`` (seconds) sets a per-call deadline, combined with
@@ -453,11 +497,18 @@ class DataFrame:
         while in flight, so ``session.cancel(query_id)`` /
         ``cancel_all()`` raise QueryCancelled from here, and admission
         control (``spark.rapids.sql.admission.*``) may make this call
-        wait its turn or raise QueryRejected under overload."""
+        wait its turn or raise QueryRejected under overload.
+
+        ``tenant`` names the weighted-fair admission tenant this query
+        runs under (default: ``spark.rapids.sql.tenant``).  A repeated
+        identical query over unchanged inputs may be served from the
+        process-wide result cache (``spark.rapids.sql.resultCache.*``)
+        without touching the executor."""
         ov, meta = self._overridden()
         backend = "device" if meta.backend == "device" else "host"
         return self._s._run_query(meta.exec_node, backend,
-                                  timeout=timeout)
+                                  timeout=timeout, logical=self._plan,
+                                  tenant=tenant)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -226,9 +226,12 @@ def test_opaque_state_never_falsely_shares():
 # ---------------------------------------------------------------------------
 
 def test_second_run_zero_new_compiles(data_dir):
-    _tpch_rows(data_dir, "q6")  # warm
+    # result cache off: this test pins the COMPILE cache, so the second
+    # run must actually reach the executor instead of being served rows
+    off = {"spark.rapids.sql.resultCache.enabled": "false"}
+    _tpch_rows(data_dir, "q6", off)  # warm
     before = get_registry().snapshot()
-    rows, _ = _tpch_rows(data_dir, "q6")
+    rows, _ = _tpch_rows(data_dir, "q6", off)
     moved = get_registry().delta(before)["counters"]
     assert moved.get("compile_count", 0) == 0, moved
     assert moved.get("fusion_cache_misses", 0) == 0, moved

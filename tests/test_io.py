@@ -309,12 +309,14 @@ def test_shared_scan_single_decode_and_release(pq_dir):
     consumer sees the same rows, and the last consumer releases the
     parked catalog entries (formerly leaked until catalog close —
     q28-style plans accumulated every shared table in the spill
-    tiers)."""
+    tiers).  Result cache OFF: this pins the catalog-parking fallback
+    path; the cache-routed path is pinned separately below."""
     from spark_rapids_tpu.exec.core import device_to_host
     scan = ParquetScanExec(pq_dir, partitions=2)
     scan.share_output = True
     scan.share_consumers = 3
-    with ExecCtx(backend="device") as ctx:
+    conf = TpuConf({"spark.rapids.sql.resultCache.enabled": "false"})
+    with ExecCtx(backend="device", conf=conf) as ctx:
         baseline = len(ctx.catalog._entries)
         rows = []
         for consumer in range(3):
@@ -335,6 +337,43 @@ def test_shared_scan_single_decode_and_release(pq_dir):
     # all three consumers read identical data
     assert rows[0:2] == rows[2:4] == rows[4:6]
     assert sum(len(r) for r in rows[0:2]) == 50 + 60 + 70 + 80
+
+
+def test_shared_scan_routes_through_fragment_cache(pq_dir):
+    """With the result cache ON (the default), a shared scan's
+    materialization lives in the process-wide fragment cache instead of
+    the per-query catalog: one decode per partition, later consumers
+    are fragment hits, nothing is parked in the catalog, and no
+    consumer pin is left behind after the drains."""
+    from spark_rapids_tpu.exec.core import device_to_host
+    from spark_rapids_tpu.exec.result_cache import get_result_cache
+    from spark_rapids_tpu.obs.registry import get_registry
+    scan = ParquetScanExec(pq_dir, partitions=2)
+    scan.share_output = True
+    scan.share_consumers = 3
+    before = get_registry().snapshot()
+    with ExecCtx(backend="device") as ctx:
+        baseline = len(ctx.catalog._entries)
+        rows = []
+        for _consumer in range(3):
+            for pid in range(scan.num_partitions(ctx)):
+                got = []
+                for b in scan.partition_iter(ctx, pid):
+                    got.extend(device_to_host(b).to_rows())
+                rows.append(sorted(got, key=_sort_key))
+            # the shared table is cache-resident, never catalog-parked
+            assert len(ctx.catalog._entries) == baseline
+            assert not any(k[0] == "scan_share" for k in ctx.cache
+                           if isinstance(k, tuple))
+    moved = get_registry().delta(before)["counters"]
+    assert moved.get("result_cache_fragment_misses", 0) == 2, moved
+    assert moved.get("result_cache_fragment_hits", 0) == 4, moved
+    assert rows[0:2] == rows[2:4] == rows[4:6]
+    assert sum(len(r) for r in rows[0:2]) == 50 + 60 + 70 + 80
+    cache = get_result_cache()
+    with cache._lock:
+        pinned = [e.key for e in cache._entries.values() if e.consumers > 0]
+    assert not pinned, f"consumer pins leaked: {pinned}"
 
 
 def test_shared_scan_planner_counts_consumers(pq_dir):

@@ -292,6 +292,265 @@ def test_admission_shutdown_rejects_new_and_queued():
 
 
 # ---------------------------------------------------------------------------
+# weighted-fair multi-tenant admission + cancel-while-queued
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_map():
+    from spark_rapids_tpu.exec.lifecycle import parse_tenant_map
+    assert parse_tenant_map("") == {}
+    assert parse_tenant_map("etl:3,dash:1") == {"etl": 3.0, "dash": 1.0}
+    assert parse_tenant_map("a:2", conv=int) == {"a": 2}
+    with pytest.raises(ValueError):
+        parse_tenant_map("no-colon")
+    with pytest.raises(ValueError):
+        parse_tenant_map("a:notanumber")
+
+
+def _queue_waiters(ac, specs):
+    """Start one admit-then-release thread per (tenant, name), pinning
+    arrival order by waiting for the queue to grow between starts."""
+    threads = []
+    for i, (tenant, name) in enumerate(specs):
+        def wait_in(t=tenant, n=name):
+            ac.admit(n, tenant=t)
+            ac.release(tenant=t)
+
+        th = threading.Thread(target=wait_in)
+        th.start()
+        threads.append(th)
+        deadline = time.monotonic() + 5.0
+        while ac.queued < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert ac.queued == i + 1
+    return threads
+
+
+def test_weighted_fair_admission_order():
+    from spark_rapids_tpu.exec.lifecycle import AdmissionController
+    ac = AdmissionController(max_concurrent=1, max_queued=16,
+                             queue_timeout=30.0,
+                             tenant_weights={"etl": 3.0, "dash": 1.0})
+    ac.admit("holder")
+    specs = [("etl", f"e{i}") for i in range(6)] + \
+            [("dash", f"d{i}") for i in range(2)]
+    threads = _queue_waiters(ac, specs)
+    ac.release()           # holder done -> the cascade drains the queue
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+    log = [tenant for tenant, _q in ac.admission_log
+           if tenant != "default"]
+    assert len(log) == 8
+    # stride scheduling: a weight-3 tenant gets 3 of every 4 slots
+    # while both are backlogged — assert the share over the window
+    # where dash was still queued, not one exact interleaving
+    assert log.count("etl") == 6 and log.count("dash") == 2
+    last_dash = max(i for i, t in enumerate(log) if t == "dash")
+    window = log[:last_dash + 1]
+    assert window.count("etl") >= 2 * window.count("dash"), log
+    # and no tenant was starved: the first 4 admissions include dash
+    assert "dash" in log[:4], log
+
+
+def test_single_tenant_stays_fifo_with_weights_configured():
+    from spark_rapids_tpu.exec.lifecycle import AdmissionController
+    ac = AdmissionController(max_concurrent=1, max_queued=8,
+                             queue_timeout=30.0,
+                             tenant_weights={"etl": 3.0})
+    ac.admit("holder")
+    threads = _queue_waiters(ac, [("default", f"w{i}") for i in range(3)])
+    ac.release()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert [q for t, q in ac.admission_log if t == "default"] == \
+        ["holder", "w0", "w1", "w2"]
+
+
+def test_tenant_cap_does_not_block_neighbors():
+    from spark_rapids_tpu.exec.lifecycle import AdmissionController
+    ac = AdmissionController(max_concurrent=4, max_queued=8,
+                             queue_timeout=30.0,
+                             tenant_max_concurrent={"capped": 1})
+    ac.admit("c1", tenant="capped")      # capped tenant at its cap
+    done = []
+
+    def capped_waiter():
+        ac.admit("c2", tenant="capped")  # must queue behind the cap
+        done.append("c2")
+
+    t = threading.Thread(target=capped_waiter)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while ac.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert ac.queued == 1
+    # global capacity exists: another tenant must sail past the
+    # capped tenant's backlog
+    ac.admit("o1", tenant="other")
+    assert ac.active == 2 and not done
+    ac.release(tenant="capped")          # c1 done -> c2 admits
+    t.join(timeout=5.0)
+    assert done == ["c2"]
+
+
+def test_deadline_ordering_admits_tightest_first():
+    from spark_rapids_tpu.exec.lifecycle import AdmissionController
+    ac = AdmissionController(max_concurrent=1, max_queued=8,
+                             queue_timeout=30.0, deadline_ordering=True)
+    ac.admit("holder")
+    lc_loose = QueryLifecycle("loose", timeout=60.0)
+    lc_tight = QueryLifecycle("tight", timeout=0.8)
+    order: list = []
+
+    def wait_in(name, lc):
+        ac.admit(name, lifecycle=lc)
+        order.append(name)
+        ac.release()
+
+    threads = []
+    for name, lc in (("loose", lc_loose), ("tight", lc_tight)):
+        t = threading.Thread(target=wait_in, args=(name, lc))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5.0
+        while ac.queued < len(threads) and time.monotonic() < deadline:
+            time.sleep(0.002)
+    ac.release()
+    for t in threads:
+        t.join(timeout=10.0)
+    # EDF within the tenant: the tight deadline overtakes the earlier
+    # arrival instead of missing its deadline behind it
+    assert order == ["tight", "loose"]
+
+
+def test_cancel_while_queued_releases_slot_counts_once():
+    from spark_rapids_tpu.exec.lifecycle import AdmissionController
+    before = get_registry().snapshot()
+    ac = AdmissionController(max_concurrent=1, max_queued=4,
+                             queue_timeout=30.0)
+    ac.admit("holder")
+    lc = QueryLifecycle("queued")
+    errs: list = []
+
+    def waiter():
+        try:
+            ac.admit("queued", lifecycle=lc)
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while ac.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert ac.queued == 1
+    assert lc.cancel("user abort")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert errs and isinstance(errs[0], QueryCancelled)
+    # the queue token was released and the accounting is exact:
+    # one cancellation, ZERO rejections (idempotent-cancel extended
+    # to the queued state)
+    assert ac.queued == 0
+    assert not lc.cancel("again")
+    assert _counter_delta(before, "queries_cancelled") == 1
+    assert _counter_delta(before, "queries_rejected") == 0
+    # the slot still works: the next arrival flows normally
+    ac.release()
+    ac.admit("next")
+    assert ac.active == 1
+
+
+def test_session_cancel_reaches_queued_query(data_dir):
+    """A collect still waiting in the admission queue is visible in
+    active_queries() and cancellable — the session registers the
+    lifecycle BEFORE admission."""
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.session import TpuSession
+    session = TpuSession({
+        "spark.rapids.sql.admission.maxConcurrentQueries": 1,
+        "spark.rapids.sql.resultCache.enabled": "false",
+    })
+    ac = session._admission_controller()
+    ac.admit("blocker")            # saturate the only slot
+    before = get_registry().snapshot()
+    df = build_tpch_query("q6", session, data_dir)
+    outcome: list = []
+
+    def run():
+        try:
+            outcome.append(("ok", df.collect()))
+        except BaseException as e:  # noqa: BLE001 - recorded for asserts
+            outcome.append(("err", e))
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while ac.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert ac.queued == 1
+    qids = session.active_queries()
+    assert len(qids) == 1          # queued, not yet admitted — but live
+    assert session.cancel(qids[0])
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    kind, val = outcome[0]
+    assert kind == "err" and isinstance(val, QueryCancelled), outcome
+    assert ac.queued == 0
+    assert _counter_delta(before, "queries_cancelled") == 1
+    assert _counter_delta(before, "queries_rejected") == 0
+    assert session.active_queries() == []
+    ac.release()                   # the manual blocker
+
+
+def test_pressure_shed_hits_over_share_tenant_only():
+    from spark_rapids_tpu.exec.lifecycle import (AdmissionController,
+                                                 QueryRejected)
+    before = get_registry().snapshot()
+    ac = AdmissionController(max_concurrent=0)
+    for i in range(3):
+        ac.admit(f"h{i}", tenant="hog")
+    ac.admit("q0", tenant="quiet")
+    ac.pressure_hook = lambda: "memory pressure: test"
+    # hog holds 3 of 4 slots at equal weight: over its share -> shed
+    with pytest.raises(QueryRejected, match="memory pressure"):
+        ac.admit("h3", tenant="hog")
+    # quiet is under its share: spared, admitted, counted
+    ac.admit("q1", tenant="quiet")
+    d = get_registry().delta(before)["counters"]
+    assert d.get("admission_pressure_spared") == 1
+    assert d.get("admission.tenant.hog.rejected") == 1
+    assert d.get("admission.tenant.quiet.rejected", 0) == 0
+    # single-tenant degenerate case: the only tenant is always at its
+    # share, so pressure sheds it — identical to the pre-tenant gate
+    ac2 = AdmissionController(max_concurrent=0)
+    ac2.admit("a", tenant="default")
+    ac2.pressure_hook = lambda: "memory pressure: test"
+    with pytest.raises(QueryRejected):
+        ac2.admit("b", tenant="default")
+
+
+def test_admission_tenant_storm_fault_sheds_only_that_tenant():
+    from spark_rapids_tpu.exec.lifecycle import (AdmissionController,
+                                                 QueryRejected)
+    from spark_rapids_tpu.faults import FaultRegistry
+    before = get_registry().snapshot()
+    ac = AdmissionController(max_concurrent=0)
+    ac.faults = FaultRegistry(
+        "admission.tenant.storm:storm,tenant=noisy,times=2")
+    with pytest.raises(QueryRejected, match="admission storm"):
+        ac.admit("n1", tenant="noisy")
+    ac.admit("c1", tenant="calm")          # unaffected tenant flows
+    with pytest.raises(QueryRejected):
+        ac.admit("n2", tenant="noisy")
+    ac.admit("n3", tenant="noisy")         # times=2 exhausted
+    d = get_registry().delta(before)["counters"]
+    assert d.get("admission.tenant.noisy.rejected") == 2
+    assert d.get("admission.tenant.calm.admitted") == 1
+    assert d.get("faults.injected.admission.tenant.storm") == 2
+
+
+# ---------------------------------------------------------------------------
 # early consumer exit stops drain workers (exec/core.py stop flag)
 # ---------------------------------------------------------------------------
 
