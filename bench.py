@@ -3,7 +3,14 @@
 Prints one JSON line per metric:
   {"metric": "tpcds_q6_sf..._speedup_vs_cpu_oracle", "value": N, ...}
   {"metric": "tpch_multichip_scaling_sf...", "value": N, "ladder": [...]}
+  {"metric": "tpch_cluster_scaling_sf...", "value": N, "ladder": [...]}
   {"metric": "tpch_multistream_qph_sf...", "value": N, "ladder": [...]}
+
+The cluster line is the driver/worker runtime ladder
+(spark_rapids_tpu/cluster): q6 + q3 at 1/2/4 local worker processes
+(spark.rapids.cluster.mode=local[N]) with map-side shuffle work
+sharded over the pool and per-worker registry deltas in each rung's
+observability block.
 
 The third line is the serving-tier THROUGHPUT ladder
 (spark_rapids_tpu/bench/throughput.py): N ∈ {1,2,4,8} concurrent
@@ -89,6 +96,17 @@ THROUGHPUT_STREAMS = tuple(
 THROUGHPUT_QUERIES = ("q3", "q13", "q18")
 THROUGHPUT_TIMEOUT_S = float(os.environ.get("BENCH_THROUGHPUT_TIMEOUT_S",
                                             "420"))
+# cluster-runtime worker ladder (CLUSTER metric): q6 + q3 at 1/2/4
+# local worker subprocesses over the DCN shuffle plane
+# (spark.rapids.cluster.mode=local[N]).  Always measured on the CPU
+# backend: co-tenant worker processes cannot share one exclusively-held
+# TPU, so a CPU ladder is the honest shape measurement.
+CLUSTER_QUERIES = ("q6", "q3")
+CLUSTER_LADDER = tuple(
+    int(x) for x in os.environ.get("BENCH_CLUSTER_LADDER",
+                                   "1,2,4").split(",") if x.strip())
+CLUSTER_SF = float(os.environ.get("BENCH_CLUSTER_SF", "0.05"))
+CLUSTER_TIMEOUT_S = float(os.environ.get("BENCH_CLUSTER_TIMEOUT_S", "420"))
 
 
 def _mesh_env(n_devices: int) -> dict:
@@ -394,6 +412,65 @@ def _mchild(n_devices: int, platform: str) -> None:
     os._exit(0)
 
 
+def _split_tpch_tables(data_dir: str, tables, parts: int) -> None:
+    """Re-write each table as ``parts`` parquet files so its scan is
+    multi-partition and the plans above it contain real shuffle
+    exchanges for the cluster runtime to shard (a 1-file sf0.1 scan
+    plans as a single complete aggregation with nothing to
+    distribute)."""
+    import pyarrow.parquet as pq
+    for table in tables:
+        d = os.path.join(data_dir, table)
+        have = [f for f in os.listdir(d) if f.endswith(".parquet")]
+        if len(have) >= parts:
+            continue
+        t = pq.read_table(os.path.join(d, "part-0.parquet"))
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(d, f"part-{i}.parquet"))
+
+
+def _cchild(n_workers: int, platform: str) -> None:
+    """One CLUSTER rung: q6 + q3 (TPC-H) over a local[N] worker pool.
+
+    Prints a BENCH_REPORT line with per-query wall times plus the
+    cluster's registry movement and per-worker heartbeat deltas."""
+    import jax
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    from spark_rapids_tpu.bench.runner import run_benchmark
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    sf = CLUSTER_SF
+    data = os.path.join(DATA_DIR, f"tpch_cluster_sf{sf:g}")
+    generate_tpch(data, sf=sf)
+    _split_tpch_tables(data, ("lineitem", "orders", "customer"), 4)
+    conf = {"spark.rapids.cluster.mode": f"local[{n_workers}]"}
+    reports = run_benchmark(data, sf, list(CLUSTER_QUERIES), iterations=2,
+                            verify=True, suite="tpch", generate=False,
+                            session_conf=conf)
+    out = {"ok": True, "workers": n_workers, "queries": {}}
+    for r in reports:
+        q = r.get("query")
+        obs = r.get("observability") or {}
+        reg = (obs.get("registry") or {}).get("counters") or {}
+        qr = {"ok": bool(r.get("ok")) and not r.get("error"),
+              "wall_s": r.get("device_s"), "rows": r.get("rows"),
+              "cluster": {k: v for k, v in reg.items()
+                          if k.startswith("cluster")},
+              "worker_deltas": obs.get("cluster_workers")}
+        if r.get("error"):
+            qr["error"] = str(r["error"])[:300]
+        out["queries"][q] = qr
+        out["ok"] = out["ok"] and qr["ok"]
+    print(_REPORT_PREFIX + json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def _tchild(platform: str) -> None:
     """One killable multi-stream throughput run (the whole ladder lives
     in one child: rungs share the warm session-level caches, which is
@@ -571,6 +648,79 @@ def _multichip(deadline: float, tpu_probe_detail: str) -> None:
     _emit_multichip(rungs, backend, err)
 
 
+def _emit_cluster(rungs: list, backend: str, error) -> None:
+    base: dict = {}
+    for r in rungs:
+        if r.get("workers") == 1 and r.get("ok"):
+            for q, qr in r.get("queries", {}).items():
+                if qr.get("ok") and qr.get("wall_s"):
+                    base[q] = qr["wall_s"]
+    value = 0.0
+    top = 0
+    for r in rungs:
+        n = r.get("workers", 0)
+        for q, qr in r.get("queries", {}).items():
+            t = qr.get("wall_s")
+            if qr.get("ok") and t and q in base:
+                qr["speedup_vs_1worker"] = round(base[q] / t, 3)
+                qr["efficiency"] = round(base[q] / (n * t), 3)
+        q3 = r.get("queries", {}).get("q3", {})
+        if r.get("ok") and n > top and "speedup_vs_1worker" in q3:
+            top, value = n, q3["speedup_vs_1worker"]
+    rec = {
+        "metric": f"tpch_cluster_scaling_sf{CLUSTER_SF:g}_{backend}",
+        "value": round(float(value), 3),
+        "unit": "x",
+        "workers": top,
+        "queries": list(CLUSTER_QUERIES),
+        "ladder": rungs,
+    }
+    if error:
+        rec["error"] = str(error)[:500]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _cluster_scaling(deadline: float) -> None:
+    """Climb the worker-count ladder (local[1] -> local[2] -> local[4])
+    and emit the CLUSTER metric line.  Each rung is its own killable
+    subprocess — a wedged worker pool is killed, not waited on — and
+    every query is oracle-verified, so a scaling number can never come
+    from wrong rows."""
+    rungs: list[dict] = []
+    err = None
+    for n in CLUSTER_LADDER:
+        budget = min(CLUSTER_TIMEOUT_S, deadline - time.monotonic())
+        if budget < 45:
+            err = (err or "") + f" (no budget for {n} workers)"
+            break
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--cchild", str(n), "cpu"]
+        rc, out, errout = _run_killable(
+            cmd, budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+        r = {"error": f"rung {n}w killed after {budget:.0f}s"} \
+            if rc is None else None
+        if r is None:
+            for line in reversed(out.splitlines()):
+                line = line.strip()
+                if line.startswith(_REPORT_PREFIX):
+                    try:
+                        r = json.loads(line[len(_REPORT_PREFIX):])
+                    except json.JSONDecodeError:
+                        pass
+                    break
+            if r is None:
+                tail = (errout or "")[-300:].replace("\n", " | ")
+                r = {"error": f"rung {n}w rc={rc} no report; {tail}"}
+        r.setdefault("workers", n)
+        r.setdefault("ok", False)
+        rungs.append(r)
+        if not r["ok"]:
+            err = r.get("error") or f"{n} workers failed"
+    _emit_cluster(rungs, "cpu", err)
+
+
 def _ladder(platform: str, deadline: float, reserve: float, rungs: list):
     """Climb the ladder on one backend; returns ((sf, report) | None,
     err).  Every rung attempt (pass or fail) is appended to ``rungs`` so
@@ -624,6 +774,9 @@ def main() -> None:
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--mchild":
         _mchild(int(sys.argv[2]), sys.argv[3])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--cchild":
+        _cchild(int(sys.argv[2]), sys.argv[3])
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tchild":
         _tchild(sys.argv[2])
@@ -679,6 +832,14 @@ def main() -> None:
         _multichip(mc_deadline, probe_detail)
     except Exception as e:  # pragma: no cover - rider must not gate
         _emit_multichip([], "none", f"multichip ladder crashed: {e}")
+    # cluster-runtime worker ladder (q6 + q3 at local[1]/[2]/[4]):
+    # runs after the primary metric so a wedged worker pool can never
+    # eat the gate number
+    c_deadline = time.monotonic() + CLUSTER_TIMEOUT_S
+    try:
+        _cluster_scaling(c_deadline)
+    except Exception as e:  # pragma: no cover - rider must not gate
+        _emit_cluster([], "none", f"cluster ladder crashed: {e}")
     # third metric line: the multi-stream serving-tier throughput ladder
     # (queries-per-hour at 1/2/4/8 concurrent tenant streams, warm)
     t_deadline = time.monotonic() + THROUGHPUT_TIMEOUT_S

@@ -615,6 +615,76 @@ assert not any(k.startswith("result_cache") for k in moved), moved
 print("serving gate: warm hit 0-dispatch, 3:1 order, tenant shed, "
       "cache-off identical: ok")
 PY
+  echo "-- cluster runtime gate: local[2] exact, worker-death recovery, clean drain --"
+  # driver/worker pools over the DCN shuffle plane (cluster/): q6+q3 on
+  # local[2] must equal the host-oracle rows exactly; SIGKILLing a
+  # worker mid-q18 must recompute only the lost map outputs on the
+  # survivor (exact rows, nonzero recovery counters); and
+  # shutdown(drain=True) must leave zero orphan worker processes and
+  # no cluster threads
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, tempfile, threading, time
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.bench.runner import run_benchmark
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+# split tables so scans are multi-partition and the planner inserts
+# real shuffle exchanges for the cluster to shard
+for table in ("lineitem", "orders", "customer"):
+    t = pq.read_table(os.path.join(d, table, "part-0.parquet"))
+    step = -(-t.num_rows // 4)
+    for i in range(4):
+        pq.write_table(t.slice(i * step, step),
+                       os.path.join(d, table, f"part-{i}.parquet"))
+
+FAST = {"spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.shuffle.tcp.maxRetries": 1,
+        "spark.rapids.shuffle.tcp.retryWaitSeconds": 0.1}
+
+# 1) local[2] q6+q3 exact vs the host oracle, q3's shuffles clustered
+reports = run_benchmark(d, 0.01, ["q6", "q3"], verify=True, generate=False,
+                        suite="tpch", session_conf=dict(FAST))
+for r in reports:
+    assert r.get("ok") and "error" not in r, r
+reg = (reports[1]["observability"].get("registry") or {}) \
+    .get("counters") or {}
+assert reg.get("cluster.shuffles_clustered", 0) >= 1, reg
+
+# 2) worker SIGKILLed mid-q18: lineage recovery on the survivor, exact
+chaos = dict(FAST)
+chaos["spark.rapids.test.faults"] = "cluster.worker.dead:dead,times=1"
+r = run_benchmark(d, 0.01, ["q18"], verify=True, generate=False,
+                  suite="tpch", session_conf=chaos)[0]
+assert r.get("ok") and "error" not in r, r
+reg = (r["observability"].get("registry") or {}).get("counters") or {}
+assert reg.get("cluster_workers_lost", 0) >= 1, reg
+assert reg.get("stage_recomputes", 0) > 0, reg
+assert reg.get("map_outputs_recomputed", 0) > 0, reg
+
+# 3) shutdown(drain=True) reaps every worker and every cluster thread
+s = TpuSession({"spark.rapids.cluster.mode": "local[2]"})
+handles = s._cluster().workers()
+assert len(handles) == 2 and all(h.alive for h in handles)
+s.shutdown(drain=True)
+for h in handles:
+    assert h.proc.poll() is not None, \
+        f"orphan worker {h.worker_id} after shutdown"
+deadline = time.monotonic() + 5.0
+while time.monotonic() < deadline and any(
+        t.name in ("tpu-cluster-rpc", "tpu-cluster-monitor")
+        for t in threading.enumerate()):
+    time.sleep(0.05)
+leaked = [t.name for t in threading.enumerate()
+          if t.name in ("tpu-cluster-rpc", "tpu-cluster-monitor")]
+assert not leaked, f"leaked cluster threads after shutdown: {leaked}"
+print("cluster gate: local[2] q6/q3 exact, worker-death recovery, "
+      "clean drain: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

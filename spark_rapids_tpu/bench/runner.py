@@ -28,13 +28,24 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None,
     if plan is None:
         ov, meta = df._overridden(quiet=True)
         plan = meta.exec_node
+
+    def make_ctx() -> ExecCtx:
+        ctx = ExecCtx(backend=backend, conf=df._s.conf)
+        if backend == "device":
+            # session-owned cluster pool (cluster/driver.py); the host
+            # oracle stays single-process on purpose
+            cluster = df._s._cluster()
+            if cluster is not None:
+                ctx.cache["cluster"] = cluster
+        return ctx
+
     if metrics_out is None:
         if backend == "host":
             return collect_host(plan, df._s.conf)
-        return collect_device(plan, df._s.conf)
+        return collect_device(plan, df._s.conf, ctx=make_ctx())
     # metrics-capturing run (reference BenchUtils JSON reports include
     # per-exec SQL metrics, docs/benchmarks.md:149-163)
-    with ExecCtx(backend=backend, conf=df._s.conf) as ctx:
+    with make_ctx() as ctx:
         from spark_rapids_tpu.obs.registry import get_registry
         before = get_registry().snapshot() if obs_out is not None else None
         out = []
@@ -60,6 +71,13 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None,
             obs_out["query_id"] = ctx.query_id
             obs_out["trace_id"] = ctx.trace_id
             obs_out["registry"] = get_registry().delta(before)
+            cluster = ctx.cache.get("cluster")
+            if cluster is not None:
+                # per-worker registry movement (heartbeat snapshots
+                # diffed against each worker's first) — the cluster
+                # bench rungs report these alongside the driver's delta
+                obs_out["cluster_workers"] = \
+                    cluster.worker_registry_deltas()
             obs_out["plan_analyzed"] = explain_analyze(
                 plan, ctx).splitlines()
         return out
@@ -231,6 +249,11 @@ def run_benchmark(data_dir: str, sf: float, queries, iterations: int = 1,
                 raise
             rec["error"] = f"{type(e).__name__}: {e}"
             rec["ok"] = False
+        finally:
+            # release per-query session resources NOW, not at interpreter
+            # exit — in cluster mode each session owns a pool of worker
+            # subprocesses that would otherwise pile up across queries
+            session.shutdown(drain=False)
         reports.append(rec)
     return reports
 

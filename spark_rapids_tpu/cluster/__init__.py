@@ -1,0 +1,94 @@
+"""Cluster runtime: driver/worker multi-process execution over the DCN
+shuffle plane.
+
+``spark.rapids.cluster.mode=local[N]`` turns one TpuSession into a
+driver that spawns N worker subprocesses (cluster/worker.py).  The
+driver keeps planning, admission, AQE, and broadcast materialization;
+map-side shuffle work for clusterable exchanges is sharded over the
+workers, each of which hosts its map output in a persistent
+LocalShuffleTransport behind the existing TCP shuffle server
+(shuffle/tcp.py).  Reduce-side reads stream over the same DCN shuffle
+plane via fetch_remote_with_retry, and a dead worker feeds the standard
+lineage-recovery machinery (exec/recovery.py) with REASSIGNMENT: lost
+map outputs are recomputed on surviving workers.
+
+The reference splits the same roles across Spark's driver/executor
+processes (RapidsShuffleManager + RapidsShuffleServer/Client over UCX,
+docs: rapids-shuffle.md); here the control plane is cluster/rpc.py —
+CRC-framed JSON over TCP reusing the shuffle wire helpers — because the
+engine is a standalone runtime without Spark's RPC env.
+
+``cluster.mode=off`` (the default) is byte-identical to the
+single-process engine: no tagging pass runs, no cache key is seeded,
+no subprocess is spawned.
+"""
+from __future__ import annotations
+
+import re
+
+from spark_rapids_tpu.conf import (ConfEntry, float_conf, int_conf,
+                                   register)
+
+_MODE_RE = re.compile(r"local\[(\d+)\]")
+
+CLUSTER_MODE = register(ConfEntry(
+    "spark.rapids.cluster.mode", "off",
+    "Cluster execution mode: 'off' runs the classic single-process "
+    "engine (byte-identical plans and behavior); 'local[N]' spawns N "
+    "worker subprocesses and shards map-side shuffle work for "
+    "hash/single-partitioned exchanges across them over the DCN "
+    "shuffle plane (cluster/driver.py). Analog of the reference's "
+    "multi-executor RapidsShuffleManager deployment.",
+    check=lambda v: v == "off" or bool(_MODE_RE.fullmatch(str(v))),
+    check_doc="must be off or local[N] with N >= 1"))
+
+HEARTBEAT_INTERVAL = float_conf(
+    "spark.rapids.cluster.heartbeat.intervalSeconds", 1.0,
+    "How often each worker heartbeats its liveness + metrics delta to "
+    "the driver control plane.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+HEARTBEAT_TIMEOUT = float_conf(
+    "spark.rapids.cluster.heartbeat.timeoutSeconds", 10.0,
+    "Heartbeat silence after which the driver declares a worker dead, "
+    "SIGKILLs the process, and routes its map outputs into lineage "
+    "recovery on the surviving workers.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+RPC_TIMEOUT = float_conf(
+    "spark.rapids.cluster.rpc.timeoutSeconds", 120.0,
+    "Socket timeout for one control-plane RPC (fragment execution "
+    "included, so size it for the slowest plan fragment).",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+RPC_MAX_RETRIES = int_conf(
+    "spark.rapids.cluster.rpc.maxRetries", 3,
+    "Connection-level retries for one control-plane RPC before the "
+    "peer is reported failed to the caller.",
+    check=lambda v: v >= 0, check_doc="must be >= 0")
+
+RPC_COMPRESSION_CODEC = register(ConfEntry(
+    "spark.rapids.cluster.rpc.compression.codec", "none",
+    "Codec for control-plane blob payloads (plan fragments, broadcast "
+    "batches): none, lz4, or zstd. Negotiated per call like the "
+    "shuffle data plane's codec handshake.",
+    check=lambda v: v in ("none", "lz4", "zstd"),
+    check_doc="must be none|lz4|zstd"))
+
+WORKER_STARTUP_TIMEOUT = float_conf(
+    "spark.rapids.cluster.worker.startupTimeoutSeconds", 60.0,
+    "How long the driver waits for a spawned worker subprocess to "
+    "print its READY line (imports + JAX init included) before "
+    "declaring the launch failed.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+
+def parse_cluster_mode(conf) -> int:
+    """Number of workers requested by spark.rapids.cluster.mode:
+    0 for 'off', N for 'local[N]'."""
+    settings = conf.settings if hasattr(conf, "settings") else dict(conf)
+    mode = CLUSTER_MODE.get(settings)
+    if mode == "off":
+        return 0
+    m = _MODE_RE.fullmatch(str(mode))
+    return int(m.group(1)) if m else 0

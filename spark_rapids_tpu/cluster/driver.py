@@ -1,0 +1,291 @@
+"""Cluster driver: worker pool lifecycle, heartbeat liveness, and the
+control-plane endpoint workers report into.
+
+The driver owns planning, admission, AQE and broadcast builds exactly
+as in single-process mode; this module only adds the pool: N
+``local[N]`` worker subprocesses (cluster/worker.py) spawned over
+stdin/stdout handshake, an :class:`RpcServer` accepting their
+heartbeats (liveness + a metrics-registry snapshot that feeds
+per-worker gauges and the bench observability block), and a monitor
+thread whose dead-worker verdict — heartbeat silence past
+``cluster.heartbeat.timeoutSeconds`` or an exited process — marks the
+handle lost so the map-output trackers (cluster/exec.py) route the
+worker's slots into lineage recovery.
+
+Fault point ``cluster.worker.hang`` fires in the heartbeat HANDLER:
+the worker keeps running but the driver ignores its heartbeats, so the
+timeout path is exercised for real rather than simulated.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from spark_rapids_tpu.cluster import (HEARTBEAT_INTERVAL,
+                                      HEARTBEAT_TIMEOUT,
+                                      RPC_COMPRESSION_CODEC,
+                                      WORKER_STARTUP_TIMEOUT,
+                                      parse_cluster_mode)
+from spark_rapids_tpu.cluster.rpc import RpcServer, rpc_call
+from spark_rapids_tpu.cluster.worker import READY_PREFIX
+from spark_rapids_tpu.obs.registry import get_registry
+
+
+class WorkerHandle:
+    """Driver-side view of one worker subprocess."""
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.pid: int | None = None
+        self.rpc_addr: tuple | None = None
+        self.shuffle_addr: tuple | None = None
+        self.ready = threading.Event()
+        self.alive = False
+        self.lost_reason: str | None = None
+        self.last_heartbeat = 0.0
+        #: last heartbeat's registry snapshot and the first one seen —
+        #: their counter diff is the worker's per-run registry delta
+        self.metrics: dict = {}
+        self.baseline: dict = {}
+
+
+class ClusterDriver:
+    """Spawns and supervises the ``local[N]`` worker pool for one
+    TpuSession (the scheduler/heartbeat half of the reference's driver
+    process; map-output bookkeeping lives per-shuffle in
+    ClusterMapOutputTracker)."""
+
+    def __init__(self, conf):
+        from spark_rapids_tpu.faults import FaultRegistry
+        self.conf = conf
+        n = parse_cluster_mode(conf)
+        if n <= 0:
+            raise ValueError("ClusterDriver requires cluster.mode="
+                             "local[N] with N >= 1")
+        self._faults = FaultRegistry.from_conf(conf)
+        self._hb_timeout = HEARTBEAT_TIMEOUT.get(conf.settings)
+        self._lock = threading.Lock()
+        self._handles: dict[str, WorkerHandle] = {}
+        self._hang_ignored: set[str] = set()
+        self._closed = threading.Event()
+        self._io_threads: list[threading.Thread] = []
+        self.rpc = RpcServer(
+            {"heartbeat": self._h_heartbeat},
+            codec_name=RPC_COMPRESSION_CODEC.get(conf.settings))
+        try:
+            for i in range(n):
+                self._spawn(f"w{i}")
+            self._await_ready()
+        except BaseException:
+            self.shutdown()
+            raise
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="tpu-cluster-monitor")
+        self._monitor.start()
+        get_registry().register_source("cluster", self._source)
+        get_registry().inc("cluster.workers_spawned", n)
+        atexit.register(self.shutdown)
+
+    # -- spawn ----------------------------------------------------------
+    def _spawn(self, worker_id: str) -> None:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.cluster.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, env=dict(os.environ))
+        h = WorkerHandle(worker_id, proc)
+        with self._lock:
+            self._handles[worker_id] = h
+        cfg = {"worker_id": worker_id, "driver": list(self.rpc.address),
+               "conf": dict(self.conf.settings)}
+        proc.stdin.write(json.dumps(cfg) + "\n")
+        proc.stdin.flush()
+        t = threading.Thread(target=self._pump_stdout, args=(h,),
+                             daemon=True,
+                             name=f"tpu-cluster-io-{worker_id}")
+        t.start()
+        self._io_threads.append(t)
+
+    def _pump_stdout(self, h: WorkerHandle) -> None:
+        """Scan for the READY line, then keep draining so the worker
+        never blocks on a full pipe; its logging passes through to the
+        driver's stderr."""
+        for line in h.proc.stdout:
+            if line.startswith(READY_PREFIX):
+                info = json.loads(line[len(READY_PREFIX):])
+                h.pid = info.get("pid")
+                h.rpc_addr = tuple(info["rpc"])
+                h.shuffle_addr = tuple(info["shuffle"])
+                h.alive = True
+                h.last_heartbeat = time.monotonic()
+                h.ready.set()
+            else:
+                print(f"[{h.worker_id}] {line.rstrip()}",
+                      file=sys.stderr)
+
+    def _await_ready(self) -> None:
+        deadline = time.monotonic() + WORKER_STARTUP_TIMEOUT.get(
+            self.conf.settings)
+        for h in list(self._handles.values()):
+            if not h.ready.wait(max(0.0, deadline - time.monotonic())):
+                rc = h.proc.poll()
+                raise RuntimeError(
+                    f"cluster worker {h.worker_id} did not become ready "
+                    f"within spark.rapids.cluster.worker."
+                    f"startupTimeoutSeconds "
+                    f"(process {'exited rc=%s' % rc if rc is not None else 'still starting'})")
+
+    # -- heartbeats + liveness ------------------------------------------
+    def _h_heartbeat(self, payload: dict, blob: bytes):
+        wid = payload.get("worker_id", "")
+        if self._faults is not None:
+            act = self._faults.check("cluster.worker.hang", worker=wid)
+            if act is not None:
+                self._hang_ignored.add(wid)
+        if wid in self._hang_ignored:
+            # the worker is "hung" from the driver's point of view: its
+            # heartbeats no longer count, and the timeout declares it dead
+            return ({"ok": True, "ignored": True}, b"")
+        h = self._handles.get(wid)
+        if h is not None:
+            h.last_heartbeat = time.monotonic()
+            snap = payload.get("metrics") or {}
+            if not h.baseline:
+                h.baseline = snap
+            h.metrics = snap
+        return ({"ok": True}, b"")
+
+    def _monitor_loop(self) -> None:
+        interval = min(0.5, HEARTBEAT_INTERVAL.get(self.conf.settings))
+        while not self._closed.wait(interval):
+            now = time.monotonic()
+            for h in self.live_workers():
+                if h.proc.poll() is not None:
+                    self.mark_worker_lost(
+                        h.worker_id,
+                        f"process exited rc={h.proc.returncode}")
+                elif now - h.last_heartbeat > self._hb_timeout:
+                    self.mark_worker_lost(
+                        h.worker_id,
+                        f"no heartbeat for {now - h.last_heartbeat:.1f}s")
+
+    def mark_worker_lost(self, worker_id: str, reason: str) -> None:
+        """Idempotently declare one worker dead: SIGKILL whatever is
+        left of the process and count the loss.  Map-output trackers
+        observe ``alive`` flipping and surface the worker's slots as
+        MapOutputLostError on the next fetch."""
+        with self._lock:
+            h = self._handles.get(worker_id)
+            if h is None or not h.alive:
+                return
+            h.alive = False
+            h.lost_reason = reason
+        try:
+            h.proc.kill()
+        except OSError:
+            pass
+        get_registry().inc("cluster_workers_lost")
+        print(f"cluster: worker {worker_id} lost: {reason}",
+              file=sys.stderr)
+
+    def kill_worker(self, worker_id: str) -> None:
+        """SIGKILL only — no bookkeeping.  Chaos injection uses this so
+        the DETECTION machinery (failed fetch / heartbeat timeout) finds
+        the death the same way a real crash surfaces."""
+        h = self._handles.get(worker_id)
+        if h is not None:
+            try:
+                h.proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+
+    # -- views ----------------------------------------------------------
+    def workers(self) -> list[WorkerHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def live_workers(self) -> list[WorkerHandle]:
+        with self._lock:
+            return [h for h in self._handles.values() if h.alive]
+
+    def worker_by_id(self, worker_id: str) -> WorkerHandle | None:
+        return self._handles.get(worker_id)
+
+    def worker_by_shuffle_addr(self, addr) -> WorkerHandle | None:
+        addr = tuple(addr)
+        with self._lock:
+            for h in self._handles.values():
+                if h.shuffle_addr == addr:
+                    return h
+        return None
+
+    # -- observability ---------------------------------------------------
+    @staticmethod
+    def _flat(snap: dict) -> dict:
+        # a worker snapshot is {"counters", "gauges"}; object sources
+        # (WorkerRuntime.metrics among them) surface as gauges
+        return {**(snap.get("counters") or {}),
+                **(snap.get("gauges") or {})}
+
+    def _source(self) -> dict:
+        out = {"workers_live": float(len(self.live_workers()))}
+        for h in self.workers():
+            for k, v in self._flat(h.metrics).items():
+                if k.startswith(("cluster", "shuffle", "faults")):
+                    out[f"worker.{h.worker_id}.{k}"] = float(v)
+        return out
+
+    def worker_registry_deltas(self) -> dict:
+        """Per-worker counter deltas since each worker's first
+        heartbeat — the bench harness folds these into the
+        tpch_cluster_scaling observability block."""
+        out: dict = {}
+        for h in self.workers():
+            base = self._flat(h.baseline)
+            cur = self._flat(h.metrics)
+            d = {k: v - base.get(k, 0) for k, v in cur.items()
+                 if v - base.get(k, 0)}
+            out[h.worker_id] = {"alive": h.alive, "counters": d}
+        return out
+
+    # -- teardown --------------------------------------------------------
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Drain the pool: polite shutdown RPCs, a bounded wait, then
+        SIGKILL stragglers.  Leaves zero orphan worker processes; safe
+        to call more than once (atexit safety net)."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for h in self.live_workers():
+            try:
+                rpc_call(h.rpc_addr, "shutdown", conf=self.conf,
+                         retries=0, timeout=2.0)
+            except (ConnectionError, OSError):
+                pass
+        deadline = time.monotonic() + timeout
+        for h in self.workers():
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                h.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            h.alive = False
+            if h.proc.stdin is not None:
+                try:
+                    h.proc.stdin.close()
+                except OSError:
+                    pass
+        self.rpc.close()
+        get_registry().unregister_source("cluster")
+        atexit.unregister(self.shutdown)

@@ -1,0 +1,278 @@
+"""Control-plane RPC for the cluster runtime (driver <-> worker).
+
+One RPC is two frames on a fresh connection: a JSON control frame
+``{op, payload, crc, codec, raw_len}`` and an optional DATA frame
+carrying an opaque blob (pickled plan fragments, serialized broadcast
+batches).  The wire format deliberately reuses the shuffle data
+plane's helpers (shuffle/tcp.py): the same length-prefixed tagged
+frames, the same negotiated-checksum scheme (``crc32c`` when the C
+binding imports, ``crc32`` otherwise) prefixed to the blob, and the
+same codec family (shuffle/compression.py) with an 8-byte raw-size
+prefix so the receiver can size the inflate exactly.  Mirrors how the
+reference rides its shuffle transport for control traffic instead of
+inventing a second wire stack (RapidsShuffleServer handles metadata
+requests on the data port).
+
+Fault point ``cluster.rpc.drop`` fires before a dial and surfaces as a
+ConnectionError the retry ladder absorbs — proving control-plane
+flakiness degrades to retries, not query failure.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from spark_rapids_tpu.cluster import (RPC_COMPRESSION_CODEC,
+                                      RPC_MAX_RETRIES, RPC_TIMEOUT)
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.shuffle.compression import get_codec
+from spark_rapids_tpu.shuffle.tcp import (_CRC, _CRC_ALGOS,
+                                          _MAX_CTRL_FRAME, _recv_frame,
+                                          _send_frame, _TAG_DATA,
+                                          _TAG_ERROR, _TAG_JSON)
+
+#: control frames carry op + JSON payload (partition lists, metrics
+#: deltas) — bigger than shuffle control traffic, still bounded so a
+#: desynced peer can't force a huge allocation
+_MAX_RPC_CTRL = 8 << 20
+#: blob frames carry pickled fragments / broadcast batches
+_MAX_RPC_BLOB = 2 << 30
+_RAW_LEN = struct.Struct(">Q")
+
+
+class RpcError(ConnectionError):
+    """Control-plane call failed after retries (peer down, handler
+    raised, or frame corruption)."""
+
+
+class RpcHandlerError(RpcError):
+    """The peer's handler raised: the error frame is authoritative and
+    retrying the call would re-run the handler — not a transport
+    failure, so the retry ladder re-raises it immediately."""
+
+
+def _crc_of(algo: str, data: bytes) -> int:
+    return _CRC_ALGOS[algo](data) & 0xFFFFFFFF
+
+
+def _pack_blob(blob: bytes, codec_name: str) -> tuple[bytes, dict]:
+    """(wire bytes, header fields) for one blob: codec-compress, then
+    checksum the COMPRESSED bytes (what the wire actually carries)."""
+    codec = get_codec(codec_name)
+    raw_len = len(blob)
+    body = codec.compress(blob) if codec is not None else blob
+    algo = next(iter(_CRC_ALGOS))
+    return (_CRC.pack(_crc_of(algo, body)) + _RAW_LEN.pack(raw_len) + body,
+            {"codec": codec_name, "crc": algo})
+
+
+def _unpack_blob(payload: bytes, header: dict, peer: str) -> bytes:
+    if len(payload) < _CRC.size + _RAW_LEN.size:
+        raise RpcError(f"rpc blob from {peer} truncated "
+                       f"({len(payload)} bytes)")
+    (want,) = _CRC.unpack(payload[:_CRC.size])
+    (raw_len,) = _RAW_LEN.unpack(
+        payload[_CRC.size:_CRC.size + _RAW_LEN.size])
+    body = payload[_CRC.size + _RAW_LEN.size:]
+    algo = header.get("crc", "crc32")
+    fn = _CRC_ALGOS.get(algo)
+    if fn is None:
+        raise RpcError(f"rpc blob from {peer} uses unknown checksum "
+                       f"algo {algo!r} (have {list(_CRC_ALGOS)})")
+    if (fn(body) & 0xFFFFFFFF) != want:
+        raise RpcError(f"rpc blob from {peer} failed {algo} check")
+    codec_name = header.get("codec", "none")
+    try:
+        codec = get_codec(codec_name)
+    except (ValueError, RuntimeError) as e:
+        raise RpcError(f"rpc blob from {peer} compressed with "
+                       f"unsupported codec {codec_name!r}: {e}") from e
+    if codec is None:
+        return body
+    out = codec.decompress(body, raw_len)
+    if len(out) != raw_len:
+        raise RpcError(f"rpc blob from {peer} inflated to {len(out)} "
+                       f"bytes, expected {raw_len}")
+    return out
+
+
+class RpcServer:
+    """Serves control-plane ops from a handler table.
+
+    ``handlers`` maps op name -> ``fn(payload: dict, blob: bytes) ->
+    (reply: dict, reply_blob: bytes)``.  Each accepted connection gets
+    its own thread; one connection serves one call (the callers are
+    sparse — fragment dispatch and heartbeats — so connection reuse
+    buys nothing and per-call connections keep failure isolation
+    trivial)."""
+
+    def __init__(self, handlers: dict, bind: str = "127.0.0.1",
+                 port: int = 0, timeout: float | None = None,
+                 codec_name: str = "none"):
+        self._handlers = dict(handlers)
+        self._codec_name = codec_name
+        self.metrics = {"rpc_requests": 0, "rpc_errors": 0,
+                        "rpc_bytes_in": 0, "rpc_bytes_out": 0}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind, port))
+        self._sock.listen(16)
+        host, bound_port = self._sock.getsockname()
+        self.address = (host, bound_port)
+        self._timeout = timeout
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True,
+                                        name="tpu-cluster-rpc")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.settimeout(self._timeout)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                try:
+                    tag, body = _recv_frame(conn, _MAX_RPC_CTRL)
+                    req = json.loads(body.decode())
+                except (ConnectionError, OSError, ValueError):
+                    return
+                blob = b""
+                if req.get("has_blob"):
+                    try:
+                        tag, payload = _recv_frame(conn, _MAX_RPC_BLOB)
+                        if tag != _TAG_DATA:
+                            raise RpcError("expected rpc blob frame, "
+                                           f"got tag {tag!r}")
+                        blob = _unpack_blob(payload, req, "client")
+                    except (ConnectionError, OSError):
+                        return
+                    except RpcError as e:
+                        _send_frame(conn, _TAG_ERROR, str(e).encode())
+                        return
+                self.metrics["rpc_requests"] += 1
+                self.metrics["rpc_bytes_in"] += len(body) + len(blob)
+                op = req.get("op", "")
+                fn = self._handlers.get(op)
+                try:
+                    if fn is None:
+                        raise RpcError(f"unknown rpc op {op!r} "
+                                       f"(have {sorted(self._handlers)})")
+                    reply, reply_blob = fn(req.get("payload") or {}, blob)
+                # enginelint: disable=RL001 (failure is surfaced to the peer as an error frame, not swallowed)
+                except Exception as e:  # noqa: BLE001 - sent to peer
+                    self.metrics["rpc_errors"] += 1
+                    _send_frame(conn, _TAG_ERROR,
+                                f"{type(e).__name__}: {e}".encode())
+                    return
+                header: dict = {"ok": True, "payload": reply,
+                                "has_blob": bool(reply_blob)}
+                wire = b""
+                if reply_blob:
+                    wire, fields = _pack_blob(reply_blob, self._codec_name)
+                    header.update(fields)
+                out = json.dumps(header).encode()
+                _send_frame(conn, _TAG_JSON, out)
+                if wire:
+                    _send_frame(conn, _TAG_DATA, wire)
+                self.metrics["rpc_bytes_out"] += len(out) + len(wire)
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._closed.set()
+        # shutdown() before close(): closing a listening socket does
+        # not reliably wake a thread blocked in accept(), which would
+        # leak one tpu-cluster-rpc thread per server lifetime
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def rpc_call(address, op: str, payload: dict | None = None,
+             blob: bytes = b"", conf=None, faults=None,
+             timeout: float | None = None,
+             retries: int | None = None) -> tuple[dict, bytes]:
+    """One control-plane call with a small connection-retry ladder.
+
+    Returns ``(reply_payload, reply_blob)``.  Connection-level failures
+    (dial refused, reset, timeout, frame desync) are retried up to
+    ``cluster.rpc.maxRetries`` times; an error FRAME from the peer means
+    the handler ran and failed — re-raised immediately as
+    RpcHandlerError so callers can distinguish "peer down" from "peer
+    rejected the op"."""
+    settings = getattr(conf, "settings", None) or {}
+    if timeout is None:
+        timeout = RPC_TIMEOUT.get(settings)
+    if retries is None:
+        retries = RPC_MAX_RETRIES.get(settings)
+    codec_name = RPC_COMPRESSION_CODEC.get(settings)
+    reg = get_registry()
+    host, port = address
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        if faults is not None:
+            # deterministic control-plane flakiness: the dial "fails"
+            # before any bytes move, exactly like a refused connection
+            action = faults.check("cluster.rpc.drop", op=op)
+            if action is not None:
+                reg.inc("cluster.rpc.dropped")
+                last = ConnectionError(
+                    f"cluster.rpc.drop fault: {op} to {host}:{port}")
+                continue
+        try:
+            return _call_once(host, port, op, payload, blob, codec_name,
+                              timeout)
+        except RpcHandlerError:
+            raise
+        except (ConnectionError, OSError, ValueError) as e:
+            last = e
+            reg.inc("cluster.rpc.retries")
+    raise RpcError(f"rpc {op} to {host}:{port} failed after "
+                   f"{retries + 1} attempts: {last}") from last
+
+
+def _call_once(host, port, op, payload, blob, codec_name,
+               timeout) -> tuple[dict, bytes]:
+    req: dict = {"op": op, "payload": payload or {},
+                 "has_blob": bool(blob)}
+    wire = b""
+    if blob:
+        wire, fields = _pack_blob(blob, codec_name)
+        req.update(fields)
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_frame(sock, _TAG_JSON, json.dumps(req).encode())
+        if wire:
+            _send_frame(sock, _TAG_DATA, wire)
+        tag, body = _recv_frame(sock, _MAX_RPC_CTRL)
+        if tag == _TAG_ERROR:
+            raise RpcHandlerError(
+                f"rpc {op} to {host}:{port} rejected: {body.decode()}")
+        if tag != _TAG_JSON:
+            raise RpcError(f"rpc {op}: expected header frame, got "
+                           f"tag {tag!r}")
+        header = json.loads(body.decode())
+        reply_blob = b""
+        if header.get("has_blob"):
+            tag, data = _recv_frame(sock, _MAX_RPC_BLOB)
+            if tag != _TAG_DATA:
+                raise RpcError(f"rpc {op}: expected blob frame, got "
+                               f"tag {tag!r}")
+            reply_blob = _unpack_blob(data, header, f"{host}:{port}")
+        return header.get("payload") or {}, reply_blob

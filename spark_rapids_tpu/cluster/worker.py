@@ -1,0 +1,238 @@
+"""Cluster worker process: ``python -m spark_rapids_tpu.cluster.worker``.
+
+One worker = one long-lived process hosting
+
+- a persistent :class:`LocalShuffleTransport` (``ctx=None`` so map
+  outputs live as serialized bytes, never entangled with any query's
+  spill catalog) — the worker-local shard of the DCN shuffle plane,
+- the existing :class:`TcpShuffleServer` serving those outputs to the
+  driver and to peer workers (shuffle/tcp.py — the same data plane,
+  codec + checksum negotiation included, that single-process remote
+  reads use),
+- an :class:`RpcServer` control plane (cluster/rpc.py) accepting plan
+  fragments from the driver.
+
+Protocol with the driver (cluster/driver.py): the driver writes one
+JSON config line on stdin ``{worker_id, driver: [host, port], conf}``;
+the worker binds its servers and prints one READY line on stdout, then
+heartbeats liveness + a metrics-registry snapshot to the driver until
+told to shut down.  The reference splits these roles the same way:
+Spark executors host RapidsShuffleServer for their locally-cached map
+output and answer the driver's scheduler over the RPC env.
+
+A ``run_fragment`` call carries a pickled clone of one
+ShuffleExchangeExec whose child subtree reads upstream cluster
+shuffles through WorkerShuffleReaderExec leaves (cluster/exec.py).
+The worker executes the assigned child partitions and writes the
+partitioned pieces into its local store under composite map ids
+``cpid * MAP_ID_STRIDE + batch_index`` — integers, because
+MapOutputLostError round-trips map ids through JSON as ints — then
+returns per-slot registrations for the driver's map-output tracker.
+
+The conf shipped to workers is scrubbed of ``cluster.mode`` (a worker
+must never recursively spawn a cluster) and ``test.faults`` (fault
+injection is driven from the driver so a plan fires exactly once per
+cluster, not once per process).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import threading
+
+#: composite map-id encoding: map_id = cpid * stride + map batch index.
+#: One child partition producing >= a million batches would collide;
+#: batch coalescing keeps real counts orders of magnitude below this.
+MAP_ID_STRIDE = 1_000_000
+
+#: stdout marker the driver scans for; everything else on the worker's
+#: stdout/stderr is passthrough logging
+READY_PREFIX = "CLUSTER_WORKER_READY "
+
+_SCRUBBED_KEYS = ("spark.rapids.cluster.mode", "spark.rapids.test.faults")
+
+
+def scrub_worker_conf(settings: dict) -> dict:
+    out = dict(settings)
+    for k in _SCRUBBED_KEYS:
+        out.pop(k, None)
+    return out
+
+
+class WorkerRuntime:
+    """Everything one worker process owns; also constructible in-process
+    for tests (the premerge gate spot-checks fragment execution without
+    paying subprocess startup)."""
+
+    def __init__(self, worker_id: str, driver_addr=None,
+                 settings: dict | None = None):
+        from spark_rapids_tpu.cluster import (HEARTBEAT_INTERVAL,
+                                              RPC_COMPRESSION_CODEC,
+                                              RPC_TIMEOUT)
+        from spark_rapids_tpu.cluster.rpc import RpcServer
+        from spark_rapids_tpu.conf import TpuConf
+        from spark_rapids_tpu.shuffle.local import LocalShuffleTransport
+        from spark_rapids_tpu.shuffle.tcp import TcpShuffleServer
+        self.worker_id = worker_id
+        self.driver = tuple(driver_addr) if driver_addr else None
+        self.conf = TpuConf(scrub_worker_conf(settings or {}))
+        self._hb_interval = HEARTBEAT_INTERVAL.get(self.conf.settings)
+        self.store = LocalShuffleTransport(self.conf, ctx=None)
+        self.shuffle_server = TcpShuffleServer(self.store)
+        self._stop = threading.Event()
+        self._runtime_ready = False
+        self._runtime_lock = threading.Lock()
+        self.metrics = {"fragments_run": 0, "fragment_failures": 0,
+                        "map_batches_written": 0}
+        # heartbeat snapshots carry the process registry; folding this
+        # runtime in gives the driver per-worker fragment counters
+        from spark_rapids_tpu.obs.registry import get_registry
+        get_registry().register_object_source("cluster.worker", self)
+        self.rpc = RpcServer(
+            {"ping": self._h_ping,
+             "run_fragment": self._h_run_fragment,
+             "release_shuffle": self._h_release_shuffle,
+             "shutdown": self._h_shutdown},
+            timeout=RPC_TIMEOUT.get(self.conf.settings),
+            codec_name=RPC_COMPRESSION_CODEC.get(self.conf.settings))
+        self._hb_thread: threading.Thread | None = None
+
+    # -- handlers -------------------------------------------------------
+    def _h_ping(self, payload: dict, blob: bytes):
+        return ({"worker_id": self.worker_id, "pid": os.getpid()}, b"")
+
+    def _h_release_shuffle(self, payload: dict, blob: bytes):
+        freed = self.store.release_shuffle(payload["shuffle_id"])
+        return ({"freed": freed}, b"")
+
+    def _h_shutdown(self, payload: dict, blob: bytes):
+        self._stop.set()
+        return ({"ok": True}, b"")
+
+    def _ensure_runtime(self) -> None:
+        # first fragment pays JAX/runtime init, keeping READY fast
+        with self._runtime_lock:
+            if not self._runtime_ready:
+                from spark_rapids_tpu.runtime import ensure_runtime
+                ensure_runtime(self.conf)
+                self._runtime_ready = True
+
+    def _h_run_fragment(self, payload: dict, blob: bytes):
+        """Execute one map-side fragment: drain the assigned child
+        partitions of the shipped exchange clone and write the
+        partitioned pieces into the local store.  Structured failure
+        payloads (never error frames) let the driver distinguish a
+        peer's data loss — which routes into lineage recovery — from
+        this worker's own fault."""
+        from spark_rapids_tpu.cluster.exec import WorkerFetchFailed
+        from spark_rapids_tpu.conf import TpuConf
+        from spark_rapids_tpu.exec.core import ExecCtx
+        from spark_rapids_tpu.shuffle.errors import MapOutputLostError
+        self._ensure_runtime()
+        spec = pickle.loads(blob)
+        exchange = spec["exchange"]
+        n = int(spec["num_parts"])
+        cpids = [int(c) for c in spec["cpids"]]
+        epochs = {int(k): int(v)
+                  for k, v in (spec.get("epochs") or {}).items()}
+        sid = exchange.shuffle_id
+        conf = TpuConf(scrub_worker_conf(spec.get("conf") or
+                                         self.conf.settings))
+        child = exchange.children[0]
+        self.metrics["fragments_run"] += 1
+        try:
+            with ExecCtx(backend="device", conf=conf) as ctx:
+                for cpid in cpids:
+                    for k, b in enumerate(child.partition_iter(ctx, cpid)):
+                        enc = cpid * MAP_ID_STRIDE + k
+                        exchange._write_map_batch(
+                            ctx, self.store, enc, b, False, n,
+                            epoch=epochs.get(enc))
+                        self.metrics["map_batches_written"] += 1
+        except WorkerFetchFailed as e:
+            self.metrics["fragment_failures"] += 1
+            return ({"error": str(e), "error_kind": "peer_fetch",
+                     "peer": list(e.address),
+                     "lost_sid": e.shuffle_id}, b"")
+        except MapOutputLostError as e:
+            self.metrics["fragment_failures"] += 1
+            return ({"error": str(e), "error_kind": "map_lost",
+                     "lost_sid": e.shuffle_id, "part": e.part_id,
+                     "lost": {str(k): v for k, v in e.lost.items()},
+                     "observed_empty": e.observed_empty}, b"")
+        wanted = set(cpids)
+        entries = []
+        for pid in range(n):
+            for wslot, (mid, size, rows, ep) in enumerate(
+                    self.store.slots_for(sid, pid)):
+                if mid // MAP_ID_STRIDE in wanted:
+                    entries.append([mid, pid, wslot, size, rows, ep])
+        return ({"ok": True, "entries": entries,
+                 "shuffle": list(self.shuffle_server.address)}, b"")
+
+    # -- liveness -------------------------------------------------------
+    def start_heartbeat(self) -> None:
+        if self.driver is None:
+            return
+        self._hb_thread = threading.Thread(target=self._hb_loop,
+                                           daemon=True,
+                                           name="tpu-cluster-heartbeat")
+        self._hb_thread.start()
+
+    def _hb_loop(self) -> None:
+        from spark_rapids_tpu.cluster.rpc import rpc_call
+        from spark_rapids_tpu.obs.registry import get_registry
+        while not self._stop.wait(self._hb_interval):
+            try:
+                rpc_call(self.driver, "heartbeat",
+                         {"worker_id": self.worker_id,
+                          "pid": os.getpid(),
+                          "metrics": get_registry().snapshot()},
+                         conf=self.conf, retries=0, timeout=5.0)
+            except (ConnectionError, OSError):
+                # driver unreachable: keep trying — the driver's timeout
+                # is the authority on whether this worker is dead
+                pass
+
+    def wait(self) -> None:
+        self._stop.wait()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.rpc.close()
+        self.shuffle_server.close()
+        self.store.close()
+
+
+def main() -> int:
+    line = sys.stdin.readline()
+    if not line:
+        print("cluster worker: no config line on stdin", file=sys.stderr)
+        return 2
+    cfg = json.loads(line)
+    rt = WorkerRuntime(cfg["worker_id"], cfg.get("driver"),
+                       cfg.get("conf") or {})
+    print(READY_PREFIX + json.dumps(
+        {"worker_id": rt.worker_id, "pid": os.getpid(),
+         "rpc": list(rt.rpc.address),
+         "shuffle": list(rt.shuffle_server.address)}), flush=True)
+    rt.start_heartbeat()
+    # orphan reaper: the driver holds our stdin pipe open for its whole
+    # life, so EOF here means the driver process is GONE (even SIGKILL,
+    # which skips its shutdown RPCs) — exit instead of lingering as an
+    # orphan shuffle server
+    def _watch_stdin() -> None:
+        while sys.stdin.readline():
+            pass
+        rt._stop.set()
+    threading.Thread(target=_watch_stdin, daemon=True,
+                     name="tpu-cluster-stdin").start()
+    rt.wait()
+    rt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -246,6 +246,16 @@ class ShuffleExchangeExec(PlanNode):
         from spark_rapids_tpu.exec.core import drain_partitions_indexed
         from spark_rapids_tpu.exec.recovery import ShuffleLineage
         from spark_rapids_tpu.shuffle import make_transport
+        cluster = ctx.cache.get("cluster")
+        if cluster is not None and getattr(self, "_cluster_ok", False):
+            # cluster runtime: shard the map side over the worker pool
+            # (cluster/exec.py); None means it could not run there
+            # (unpicklable fragment, dead pool) and the classic
+            # in-process path below stays the fallback
+            from spark_rapids_tpu.cluster.exec import cluster_do_shuffle
+            out = cluster_do_shuffle(cluster, self, ctx, child)
+            if out is not None:
+                return out
         indexed = list(drain_partitions_indexed(ctx, child))
         map_src = {bi: cpid for bi, (cpid, _) in enumerate(indexed)}
         batches = [b for _, b in indexed]

@@ -105,6 +105,29 @@ Injection points wired today (site -> actions it interprets):
                         dropped, ``result_cache_corrupt`` counts it,
                         and the query recomputes — corruption is a
                         cache miss, never stale rows or a crash.
+    cluster.worker.dead checked in the driver-side map-output tracker
+                        on each reduce fetch (ctx: shuffle, part,
+                        worker).  Any action name works (use ``dead``);
+                        the driver SIGKILLs the worker owning the first
+                        requested map output — a real process death,
+                        driving heartbeat-loss detection plus lineage
+                        reassignment onto surviving workers.  Never
+                        fires when only one worker remains alive (a
+                        0-worker cluster cannot recover anything).
+                        ``.dead`` default times=0 applies; chaos plans
+                        should pass ``times=1`` to kill exactly one.
+    cluster.worker.hang checked in the driver's heartbeat handler (ctx:
+                        worker).  Any action name works (use ``hang``);
+                        once fired the driver IGNORES that worker's
+                        subsequent heartbeats — the process lives but
+                        goes silent, so the heartbeat monitor declares
+                        it dead after cluster.heartbeat.timeoutSeconds
+                        and recovery reassigns its partitions.
+    cluster.rpc.drop    before each control-plane RPC send (ctx: op).
+                        Any action name works (use ``drop``); the dial
+                        fails with a ConnectionError the RPC retry
+                        ladder absorbs — a dropped/blackholed control
+                        message, distinct from a dead worker.
     admission.tenant.storm
                         weighted-fair admission entry (ctx: tenant,
                         query_id; exec/lifecycle.py).  Action ``storm``
@@ -169,6 +192,9 @@ KNOWN_POINTS = frozenset({
     "memory.governor.oom_storm",
     "cache.result.corrupt",
     "admission.tenant.storm",
+    "cluster.worker.dead",
+    "cluster.worker.hang",
+    "cluster.rpc.drop",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a

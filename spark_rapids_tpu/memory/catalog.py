@@ -33,6 +33,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.conf import ConfEntry, register, _bool
+from spark_rapids_tpu.shuffle.compression import get_codec
 
 __all__ = ["BufferCatalog", "SpillPriority", "SpillableColumnarBatch",
            "SpillCorruptionError", "DeviceSemaphore", "run_with_spill_retry"]
@@ -123,6 +124,18 @@ SPILL_DIR = register(ConfEntry(
     "are fsynced before the catalog entry flips to tier=disk and "
     "deleted on restore, invalidation, and catalog close (reference "
     "spark.local.dir placement of RapidsDiskStore block files)."))
+SPILL_COMPRESSION_CODEC = register(ConfEntry(
+    "spark.rapids.memory.spill.compression.codec", "none",
+    "Codec for disk-tier spill files: none, lz4 (native C++ block codec, "
+    "native/lz4.cpp) or zstd — the shuffle codec ladder "
+    "(shuffle/compression.py) applied to the RapidsDiskStore analog. "
+    "The .crc sidecar is computed over the COMPRESSED bytes, so "
+    "read-back verifies exactly what the disk stored; a corrupt or "
+    "truncated compressed spill degrades into the existing lost-tier "
+    "path (SpillCorruptionError -> lineage recompute where available), "
+    "never a decompressor crash. (ref RapidsConf.scala:729)",
+    check=lambda v: v in ("none", "lz4", "zstd"),
+    check_doc="must be none|lz4|zstd"))
 
 
 class SpillPriority:
@@ -145,6 +158,7 @@ class _Entry:
     leaf_meta: list | None = None   # (dtype, shape, nbytes, offset_in_slice)
     arena_offset: int | None = None
     disk_path: str | None = None
+    disk_codec: str | None = None   # codec the disk file was written with
 
 
 class BufferCatalog:
@@ -191,6 +205,7 @@ class BufferCatalog:
             self._arena_shared = True
         self._spill_dir_base = spill_dir or SPILL_DIR.get(settings) or None
         self._spill_dir_made: str | None = None
+        self._spill_codec = get_codec(SPILL_COMPRESSION_CODEC.get(settings))
         # deterministic fault plan (spark.rapids.test.faults): the
         # memory.oom point drives run_with_spill_retry exactly like a
         # real XLA RESOURCE_EXHAUSTED; None when unset (inert)
@@ -222,6 +237,9 @@ class BufferCatalog:
                         # they live here because the catalog is the one
                         # metrics sink the bench runner already exports)
                         "spill_crc_failures": 0, "spill_enospc": 0,
+                        # disk-tier compression: bytes before/after the
+                        # spill codec (zero deltas when codec=none)
+                        "spill_raw_bytes": 0, "spill_compressed_bytes": 0,
                         "stage_recomputes": 0, "map_outputs_recomputed": 0,
                         "recovery_wall_s": 0.0}
         # surface catalog counters in the process metrics registry as
@@ -355,6 +373,45 @@ class BufferCatalog:
         if lc is not None:
             lc.check()
 
+    def _compress_spill(self, raw: bytes) -> "tuple[bytes, str | None]":
+        """Apply the spill codec to one disk payload; identity when
+        codec=none.  Counters track the before/after byte volumes so
+        the compression ratio is observable per catalog."""
+        codec = self._spill_codec
+        if codec is None:
+            return raw, None
+        data = codec.compress(raw)
+        self.metrics["spill_raw_bytes"] += len(raw)
+        self.metrics["spill_compressed_bytes"] += len(data)
+        return data, codec.name
+
+    def _decompress_spill_locked(self, e: _Entry, data: bytes,
+                                 out_size: int) -> bytes:
+        """Inverse of ``_compress_spill`` at read-back (the sidecar CRC
+        over the compressed bytes already passed).  Any decode failure
+        — truncation racing the sidecar, a codec the process can no
+        longer construct — marks the entry LOST like a CRC failure
+        does: data loss the lineage layer can recompute, not a
+        decompressor crash."""
+        if not e.disk_codec:
+            return data
+        try:
+            codec = self._spill_codec \
+                if self._spill_codec is not None \
+                and self._spill_codec.name == e.disk_codec \
+                else get_codec(e.disk_codec)
+            out = codec.decompress(data, out_size)
+            if len(out) != out_size:
+                raise ValueError(f"decompressed {len(out)}B, "
+                                 f"want {out_size}B")
+            return out
+        except Exception as ex:
+            self._mark_lost_locked(e)
+            raise SpillCorruptionError(
+                f"buffer {e.buffer_id}: {e.disk_codec} spill "
+                f"decompression failed ({type(ex).__name__}: {ex}); "
+                "storage dropped") from ex
+
     def _spill_one_to_host_locked(self, e: _Entry) -> None:
         self._check_cancel()
         leaves, treedef = jax.tree_util.tree_flatten(e.batch)
@@ -390,7 +447,7 @@ class BufferCatalog:
                 flat = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
                 packed[m[3]:m[3] + m[2]] = flat
             path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
-            data = packed.tobytes()
+            data, disk_codec = self._compress_spill(packed.tobytes())
             try:
                 self._check_enospc_fault(e)
                 with open(path, "wb") as f:
@@ -411,6 +468,7 @@ class BufferCatalog:
                 e.leaf_meta = None
                 raise _SpillDiskFull(str(ex)) from ex
             e.disk_path = path
+            e.disk_codec = disk_codec
             e.tier = "disk"
             self.metrics["bytes_spilled_to_disk"] += total
         e.batch = None
@@ -429,30 +487,53 @@ class BufferCatalog:
         e = cands[0]
         total = _align_total(e.leaf_meta)
         path = os.path.join(self._spill_dir, f"buf_{e.buffer_id}.bin")
-        # checksum the arena slice (the source of truth) before it is
-        # freed; verified against the file on read-back
-        crc = _spill_crc(bytes(self._arena.view(e.arena_offset, total)))
-        try:
-            self._check_enospc_fault(e)
-            self._arena.write_to_disk(e.arena_offset, total, path)
-            fd = os.open(path, os.O_RDONLY)
+        disk_codec = None
+        if self._spill_codec is not None:
+            # compressed spill cannot stream straight from the arena:
+            # materialize the slice, compress, write + fsync; the
+            # sidecar covers the COMPRESSED bytes (what the disk holds)
+            raw = bytes(self._arena.view(e.arena_offset, total))
+            data, disk_codec = self._compress_spill(raw)
             try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-            _write_sidecar(path, crc, total)
-        except OSError as ex:
-            if not _is_enospc(ex):
-                raise
-            # full disk: the buffer stays on the host tier; callers see
-            # False ("nothing moved") and stop pushing
-            self.metrics["spill_enospc"] += 1
-            _unlink_quiet(path)
-            _unlink_quiet(_sidecar(path))
-            return False
+                self._check_enospc_fault(e)
+                with open(path, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                _write_sidecar(path, _spill_crc(data), len(data))
+            except OSError as ex:
+                if not _is_enospc(ex):
+                    raise
+                self.metrics["spill_enospc"] += 1
+                _unlink_quiet(path)
+                _unlink_quiet(_sidecar(path))
+                return False
+        else:
+            # checksum the arena slice (the source of truth) before it
+            # is freed; verified against the file on read-back
+            crc = _spill_crc(bytes(self._arena.view(e.arena_offset, total)))
+            try:
+                self._check_enospc_fault(e)
+                self._arena.write_to_disk(e.arena_offset, total, path)
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+                _write_sidecar(path, crc, total)
+            except OSError as ex:
+                if not _is_enospc(ex):
+                    raise
+                # full disk: the buffer stays on the host tier; callers
+                # see False ("nothing moved") and stop pushing
+                self.metrics["spill_enospc"] += 1
+                _unlink_quiet(path)
+                _unlink_quiet(_sidecar(path))
+                return False
         self._arena.free(e.arena_offset)
         e.arena_offset = None
         e.disk_path = path
+        e.disk_codec = disk_codec
         e.tier = "disk"
         self.metrics["host_spills"] += 1
         self.metrics["bytes_spilled_to_disk"] += total
@@ -478,6 +559,7 @@ class BufferCatalog:
                 except SpillCorruptionError:
                     self._mark_lost_locked(e)
                     raise
+                raw = self._decompress_spill_locked(e, raw, max(total, 1))
                 packed = np.frombuffer(raw, np.uint8)
                 leaves = [jnp.asarray(np.frombuffer(
                     packed[rel:rel + nb].tobytes(), dtype=dtype
@@ -485,6 +567,7 @@ class BufferCatalog:
                 os.unlink(e.disk_path)
                 _unlink_quiet(_sidecar(e.disk_path))
                 e.disk_path = None
+                e.disk_codec = None
                 self._finish_unspill_locked(e, leaves)
                 return
             off = self._arena.alloc(max(total, 1))
@@ -492,20 +575,41 @@ class BufferCatalog:
                 if not self._spill_host_one_locked():
                     raise MemoryError("host arena exhausted during unspill")
                 off = self._arena.alloc(max(total, 1))
-            try:
-                self._arena.read_from_disk(off, total, e.disk_path)
-                _verify_sidecar(e.disk_path,
-                                bytes(self._arena.view(off, total)))
-            except SpillCorruptionError:
-                self._arena.free(off)
-                self._mark_lost_locked(e)
-                raise
-            except Exception:
-                self._arena.free(off)
-                raise
+            if e.disk_codec:
+                # compressed file is smaller than the arena slice: read,
+                # verify the sidecar over the compressed bytes, inflate,
+                # then copy into the slice
+                try:
+                    with open(e.disk_path, "rb") as f:
+                        raw = f.read()
+                    _verify_sidecar(e.disk_path, raw)
+                    raw = self._decompress_spill_locked(e, raw, total)
+                    self._arena.view(off, total)[:] = np.frombuffer(
+                        raw, np.uint8)
+                except SpillCorruptionError:
+                    self._arena.free(off)
+                    if e.tier != "lost":
+                        self._mark_lost_locked(e)
+                    raise
+                except Exception:
+                    self._arena.free(off)
+                    raise
+            else:
+                try:
+                    self._arena.read_from_disk(off, total, e.disk_path)
+                    _verify_sidecar(e.disk_path,
+                                    bytes(self._arena.view(off, total)))
+                except SpillCorruptionError:
+                    self._arena.free(off)
+                    self._mark_lost_locked(e)
+                    raise
+                except Exception:
+                    self._arena.free(off)
+                    raise
             os.unlink(e.disk_path)
             _unlink_quiet(_sidecar(e.disk_path))
             e.disk_path = None
+            e.disk_codec = None
             e.arena_offset = off
             e.tier = "host"
         leaves = []
@@ -565,6 +669,7 @@ class BufferCatalog:
             _unlink_quiet(e.disk_path)
             _unlink_quiet(_sidecar(e.disk_path))
         e.disk_path = None
+        e.disk_codec = None
         e.arena_offset = None
         e.batch = None
         e.treedef = None

@@ -546,6 +546,8 @@ class TpuOverrides:
         verify(root, "shared_scans")
         self._stamp_lineage(root)
         verify(root, "stamp_lineage")
+        self._lower_cluster(root)
+        verify(root, "cluster")
         explain_mode = self.conf.explain
         if explain and explain_mode and explain_mode != "NONE":
             text = self.explain(root, only_fallback=(explain_mode
@@ -788,6 +790,40 @@ class TpuOverrides:
         def walk(node) -> None:
             if isinstance(node, ShuffleExchangeExec):
                 node._conf_fp = fp
+            for c in node.children:
+                walk(c)
+
+        walk(root.exec_node)
+
+    def _lower_cluster(self, root: PlannedNode) -> None:
+        """Tag exchanges the cluster runtime may shard over the worker
+        pool (cluster/exec.py reads the tag at materialization time).
+        Gated on the RAW setting so ``cluster.mode=off`` — the default —
+        never imports the cluster package and the planned tree is
+        byte-identical to the single-process engine.
+
+        Only hash and single partitionings are clusterable: their
+        partition ids are a pure per-batch function, so independent
+        workers computing them agree.  Round-robin and range
+        partitionings build global ``prepare()`` state from ALL map
+        batches (a running row offset; sampled range bounds) that
+        cannot be split across processes without changing results."""
+        if self.conf.settings.get("spark.rapids.cluster.mode",
+                                  "off") == "off":
+            return
+        from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+        from spark_rapids_tpu.exec.partitioning import (HashPartitioning,
+                                                        SinglePartitioning)
+        seen: set[int] = set()
+
+        def walk(node) -> None:
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if isinstance(node, ShuffleExchangeExec) and isinstance(
+                    node.partitioning,
+                    (HashPartitioning, SinglePartitioning)):
+                node._cluster_ok = True
             for c in node.children:
                 walk(c)
 

@@ -67,8 +67,9 @@ PLAN_VERIFY = bool_conf(
 PLAN_VERIFY_EVERY_PASS = bool_conf(
     "spark.rapids.sql.verify.plan.everyPass", False,
     "Verify after EVERY plan rewrite pass (tag, coalesce, transitions, "
-    "mesh alignment, shared scans, lineage stamping, stage boundaries, "
-    "fusion, mesh regions) instead of once at the end, so a violation "
+    "mesh alignment, shared scans, lineage stamping, cluster lowering, "
+    "stage boundaries, fusion, mesh regions) instead of once at the "
+    "end, so a violation "
     "names the pass that introduced it. The test suite and premerge "
     "gate run with this on; requires spark.rapids.sql.verify.plan.")
 
@@ -76,8 +77,8 @@ PLAN_VERIFY_EVERY_PASS = bool_conf(
 #: that establishes its invariant has run (e.g. lineage stamps exist
 #: only from ``stamp_lineage`` on)
 PASS_ORDER = ("tag", "coalesce", "transitions", "mesh_align",
-              "shared_scans", "stamp_lineage", "stage_boundaries",
-              "fusion", "mesh_regions", "aqe_replan")
+              "shared_scans", "stamp_lineage", "cluster",
+              "stage_boundaries", "fusion", "mesh_regions", "aqe_replan")
 
 _PASS_IDX = {name: i for i, name in enumerate(PASS_ORDER)}
 

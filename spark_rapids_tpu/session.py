@@ -46,6 +46,7 @@ class TpuSession:
         self._lc_cond = threading.Condition()
         self._live: dict = {}        # query_id -> QueryLifecycle
         self._admission = None       # built lazily from the live conf
+        self._cluster_handle = None  # ClusterDriver, lazily spawned
 
     # -- query lifecycle (exec/lifecycle.py) ---------------------------
     def _admission_controller(self):
@@ -69,6 +70,20 @@ class TpuSession:
                 from spark_rapids_tpu.faults import FaultRegistry
                 self._admission.faults = FaultRegistry.from_conf(self.conf)
             return self._admission
+
+    def _cluster(self):
+        """Lazily spawn the ``local[N]`` worker pool (cluster/driver.py)
+        on the first device query.  Raw-settings gated: with
+        ``cluster.mode=off`` (the default) the cluster package is never
+        imported and this returns None without side effects."""
+        if self.conf.settings.get("spark.rapids.cluster.mode",
+                                  "off") == "off":
+            return None
+        with self._lc_cond:
+            if self._cluster_handle is None:
+                from spark_rapids_tpu.cluster.driver import ClusterDriver
+                self._cluster_handle = ClusterDriver(self.conf)
+            return self._cluster_handle
 
     def active_queries(self) -> list[str]:
         """query_ids currently admitted and running."""
@@ -110,6 +125,10 @@ class TpuSession:
             # their cooperative checkpoints a bounded grace to unwind
             self.cancel_all()
             self._wait_idle(10.0)
+        with self._lc_cond:
+            cluster, self._cluster_handle = self._cluster_handle, None
+        if cluster is not None:
+            cluster.shutdown()
 
     def _wait_idle(self, timeout: float | None) -> bool:
         import time as _time
@@ -206,6 +225,13 @@ class TpuSession:
             ctx = ExecCtx(backend=be, conf=self.conf)
             ctx.cache["query_id"] = query_id
             ctx.cache["lifecycle"] = lc
+            if be == "device":
+                # the host backend is the differential ORACLE: it must
+                # never see the cluster, or cluster bugs would cancel
+                # out of the comparison
+                cluster = self._cluster()
+                if cluster is not None:
+                    ctx.cache["cluster"] = cluster
             return ctx
 
         if backend != "device":

@@ -222,6 +222,17 @@ class LocalShuffleTransport:
         with self._lock:
             return list(self._batch_sizes.get((shuffle_id, part_id), ()))
 
+    def slots_for(self, shuffle_id: "int | str",
+                  part_id: int) -> list[tuple[int, int, int, int]]:
+        """Per-slot ``(map_id, size, rows, epoch)`` of one reduce
+        partition in fetch order — the map-output registration record a
+        cluster worker rolls back to the driver so its tracker can
+        address individual slots for locality-aware reduce fetches
+        (cluster/exec.py; reference MapStatus -> MapOutputTracker)."""
+        with self._lock:
+            return [(s.map_id, s.size, s.rows, s.epoch)
+                    for s in self._store.get((shuffle_id, part_id), ())]
+
     def _slice_or_lost(self, shuffle_id, part_id, lo, hi) -> list[_Slot]:
         """Snapshot the requested slot slice, raising MapOutputLostError
         naming EVERY lost map output in it (recovery recomputes them all
@@ -333,6 +344,32 @@ class LocalShuffleTransport:
                 raise InjectedFault(
                     f"injected fault: store.fetch {act.action} "
                     f"(shuffle={shuffle_id} part={part_id})")
+
+    def release_shuffle(self, shuffle_id) -> int:
+        """Drop every slot of ONE shuffle (map outputs, sizes, epochs)
+        and return the byte count released.  The cluster plane calls
+        this from the driver once a query's tracker closes, so a
+        long-lived worker store does not accumulate dead shuffles
+        across queries (the in-process engine instead closes the whole
+        transport with its ExecCtx)."""
+        with self._lock:
+            keys = [k for k in self._store if k[0] == shuffle_id]
+            freed = 0
+            items = []
+            for k in keys:
+                for s in self._store.pop(k, ()):
+                    if s.item is not None:
+                        items.append(s.item)
+                    freed += s.size
+                self._sizes.pop(k, None)
+                self._rows.pop(k, None)
+                self._batch_sizes.pop(k, None)
+            for mk in [k for k in self._epochs if k[0] == shuffle_id]:
+                self._epochs.pop(mk, None)
+        for item in items:
+            if item[0] == "spillable":
+                item[1].close()
+        return freed
 
     def close(self) -> None:
         from spark_rapids_tpu.obs.registry import get_registry

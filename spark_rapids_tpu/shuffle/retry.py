@@ -164,6 +164,26 @@ def _peer_label(peer) -> str:
         else str(peer)
 
 
+def _is_conn_refused(err: BaseException) -> bool:
+    """True when a ConnectionRefusedError sits anywhere on the error's
+    cause/context chain.  A refused dial means no process is listening
+    YET — the normal state of a ``local[N]`` worker that is still
+    binding its shuffle server — not a sick peer.  Counting it toward
+    the per-peer circuit breaker lets N concurrent reduce fetches trip
+    the breaker (maxFailures=8) during a startup race and turn a
+    would-succeed-in-50ms query into a terminal failure, so the ladder
+    retries these WITHOUT charging the breaker (the attempt budget
+    still bounds them)."""
+    seen: set[int] = set()
+    e: BaseException | None = err
+    while e is not None and id(e) not in seen:
+        if isinstance(e, ConnectionRefusedError):
+            return True
+        seen.add(id(e))
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return False
+
+
 def _breaker_gauges() -> dict:
     """Registry source: per-peer breaker state, visible to snapshots as
     shuffle.breaker.<host:port>.{failures,open} gauges."""
@@ -219,7 +239,10 @@ def remote_partition_sizes_with_retry(address, shuffle_id: "int | str",
             breaker.record_success()
             return out
         except ShuffleFetchError as e:
-            breaker.record_failure(e, threshold)
+            if _is_conn_refused(e):
+                get_registry().inc("shuffle.fetch.conn_refused")
+            else:
+                breaker.record_failure(e, threshold)
             attempt += 1
             if attempt > max_retries:
                 raise ShuffleFetchError(
@@ -309,7 +332,13 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                                  peer=plabel, part=part_id,
                                  delivered=delivered, error=str(e)[:256])
                 raise
-            breaker.record_failure(e, threshold)
+            if _is_conn_refused(e):
+                # startup race (nothing listening yet), not peer illness:
+                # retry with backoff inside the attempt budget but do NOT
+                # charge the breaker
+                reg.inc("shuffle.fetch.conn_refused")
+            else:
+                breaker.record_failure(e, threshold)
             reg.inc("shuffle.fetch.retries")
             reg.inc(f"shuffle.peer.{plabel}.fetch_failures")
             failures = 1 if delivered > before else failures + 1

@@ -143,6 +143,30 @@ except ImportError:  # pragma: no cover - env without the binding
 _CRC_ALGOS["crc32"] = zlib.crc32
 _CRC = struct.Struct(">I")
 
+#: codec names this endpoint can DECODE, advertised in fetch requests
+#: (resolved once; zstd only when its binding imports)
+_CLIENT_CODECS: "list[str] | None" = None
+
+
+def _client_codecs() -> "list[str]":
+    """Codecs the client side can inflate, carried in the fetch request
+    as ``codecs`` so a server whose store compresses with something the
+    client lacks refuses the stream with a diagnosable error frame
+    instead of letting the client die inside get_codec/decompress.
+    Old peers send/understand no ``codecs`` key — same interop pattern
+    as the ``crc`` negotiation."""
+    global _CLIENT_CODECS
+    if _CLIENT_CODECS is None:
+        names = ["none", "lz4"]
+        try:
+            import zstandard  # noqa: F401
+
+            names.append("zstd")
+        except ImportError:  # pragma: no cover - env without zstd
+            pass
+        _CLIENT_CODECS = names
+    return _CLIENT_CODECS
+
 
 def _max_frame(conf=None) -> int:
     if conf is None:
@@ -339,6 +363,21 @@ class TcpShuffleServer:
                              part=req["part_id"],
                              lo=req.get("lo", 0), hi=req.get("hi"))
         window = int(req.get("window") or TCP_INFLIGHT_LIMIT.default)
+        # codec negotiation: a new client lists the codecs it can
+        # decode; when this store's codec is not among them the stream
+        # is refused with a diagnosable error frame — the client would
+        # otherwise die inside decompress on the first data frame.  An
+        # old peer sends no "codecs" key and is served as before.
+        accepts = req.get("codecs")
+        if accepts is not None and self._store.codec_name not in accepts:
+            self.metrics["codec_rejects"] = \
+                self.metrics.get("codec_rejects", 0) + 1
+            _send_frame(conn, _TAG_ERROR, (
+                f"shuffle codec {self._store.codec_name!r} not accepted "
+                f"by client (client accepts {list(accepts)}); align "
+                "spark.rapids.shuffle.compression.codec across peers"
+            ).encode())
+            return
         # checksum negotiation: the client advertises the algorithms it
         # can verify; pick the first this server also knows and echo it
         # in the header.  An old peer sends/understands no "crc" key and
@@ -528,7 +567,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                 sock.settimeout(sock_timeout)
             req = {"op": "fetch", "shuffle_id": shuffle_id,
                    "part_id": part_id, "lo": lo, "hi": hi,
-                   "window": window}
+                   "window": window, "codecs": _client_codecs()}
             if checksum:
                 req["crc"] = list(_CRC_ALGOS)
             if trace:
@@ -543,7 +582,23 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
             if tag != _TAG_JSON:
                 raise ShuffleTransportError(f"bad fetch header tag {tag!r}")
             header = json.loads(body.decode())
-            codec = get_codec(header.get("codec", "none"))
+            codec_name = header.get("codec", "none")
+            try:
+                codec = get_codec(codec_name)
+            except (ValueError, RuntimeError) as e:
+                # negotiation should have caught this server-side; a
+                # header naming a codec this build cannot construct is
+                # a config/version mismatch, not a transient — surface
+                # it terminally with the fix in the message
+                err = ShuffleFetchError(
+                    f"peer {address} serves shuffle {shuffle_id} with "
+                    f"codec {codec_name!r} this client cannot decode "
+                    f"(supports {_client_codecs()}): {e}")
+                err.terminal = True
+                raise err from e
+            # handshake record: which codec each fetch stream actually
+            # negotiated (tests + diag bundles read this)
+            get_registry().inc(f"shuffle.fetch.codec.{codec_name}")
             crc_name = header.get("crc")
             crc_fn = _CRC_ALGOS.get(crc_name)
             if crc_name is not None and crc_fn is None:

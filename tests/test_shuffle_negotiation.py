@@ -1,0 +1,118 @@
+"""Shuffle-plane negotiation tests (cluster-runtime satellites).
+
+Two behaviors a multi-process deployment depends on:
+
+1. A refused dial (ConnectionRefusedError anywhere on the error chain)
+   means no process is listening YET — the normal state of a worker
+   still binding its shuffle server — so the retry ladder must retry it
+   WITHOUT charging the per-peer circuit breaker (shuffle/retry.py).
+   Otherwise N concurrent reduce fetches trip the breaker during a
+   startup race and turn a would-succeed query into a terminal failure.
+
+2. Codec negotiation across processes (shuffle/tcp.py): the client
+   advertises the codecs it can decode; a server whose store compresses
+   with something else must answer with an error FRAME (plus a
+   ``codec_rejects`` metric), not undecodable bytes.  A matched fetch
+   counts ``shuffle.fetch.codec.<name>`` so operators can see which
+   codec actually moves bytes.
+"""
+import socket
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.shuffle.errors import ShuffleFetchError
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.LongType(), True),
+    T.StructField("v", T.LongType(), True),
+])
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_conn_refused_retries_without_charging_breaker():
+    from spark_rapids_tpu.shuffle.retry import (_breaker,
+                                                fetch_remote_with_retry,
+                                                reset_circuit_breakers)
+    reset_circuit_breakers()
+    addr = ("127.0.0.1", _dead_port())
+    before = get_registry().snapshot()
+    with pytest.raises(ShuffleFetchError, match="giving up"):
+        list(fetch_remote_with_retry(addr, 7, 0, device=False,
+                                     max_retries=2, retry_wait=0.01))
+    d = get_registry().delta(before)["counters"]
+    # every attempt was classified as conn-refused ...
+    assert d.get("shuffle.fetch.conn_refused", 0) >= 3, d
+    # ... and NONE of them charged the breaker
+    assert d.get("shuffle.breaker.opens", 0) == 0, d
+    assert _breaker(addr).failures == 0
+    reset_circuit_breakers()
+
+
+def test_conn_refused_metadata_plane():
+    from spark_rapids_tpu.shuffle.retry import (
+        _breaker, remote_partition_sizes_with_retry,
+        reset_circuit_breakers)
+    reset_circuit_breakers()
+    addr = ("127.0.0.1", _dead_port())
+    before = get_registry().snapshot()
+    with pytest.raises(ShuffleFetchError, match="giving up"):
+        remote_partition_sizes_with_retry(addr, 7, max_retries=1,
+                                          retry_wait=0.01)
+    d = get_registry().delta(before)["counters"]
+    assert d.get("shuffle.fetch.conn_refused", 0) >= 2, d
+    assert _breaker(addr).failures == 0
+    reset_circuit_breakers()
+
+
+def test_codec_mismatch_rejected_with_error_frame(monkeypatch):
+    """Server store compresses lz4; a client that can only decode
+    ``none`` must get a terminal error frame naming the codec — and the
+    server counts the reject — instead of bytes it cannot inflate."""
+    import spark_rapids_tpu.shuffle.tcp as tcp
+    from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
+    from spark_rapids_tpu.host.batch import HostBatch
+    conf = TpuConf({"spark.rapids.shuffle.compression.codec": "lz4"})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = tcp.TcpShuffleTransport(conf, ctx)
+        try:
+            hb = HostBatch.from_pydict({"k": [1, 2], "v": [3, 4]}, SCHEMA)
+            t.write_partition(1, 0, 0, host_to_device(hb))
+            monkeypatch.setattr(tcp, "_client_codecs", lambda: ["none"])
+            with pytest.raises(ShuffleFetchError) as ei:
+                list(tcp.fetch_remote(t.address, 1, 0, device=False))
+            assert "lz4" in str(ei.value)
+            assert "not accepted" in str(ei.value)
+            assert t.server_metrics.get("codec_rejects", 0) == 1
+        finally:
+            t.close()
+
+
+def test_codec_match_roundtrips_and_counts():
+    from spark_rapids_tpu.exec.core import ExecCtx, host_to_device
+    from spark_rapids_tpu.host.batch import HostBatch
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport, fetch_remote
+    conf = TpuConf({"spark.rapids.shuffle.compression.codec": "lz4"})
+    with ExecCtx(backend="device", conf=conf) as ctx:
+        t = TcpShuffleTransport(conf, ctx)
+        try:
+            hb = HostBatch.from_pydict({"k": [1, 2], "v": [3, 4]}, SCHEMA)
+            t.write_partition(1, 0, 0, host_to_device(hb))
+            before = get_registry().snapshot()
+            got = list(fetch_remote(t.address, 1, 0, device=False))
+            assert len(got) == 1
+            assert got[0].to_pydict() == {"k": [1, 2], "v": [3, 4]}
+            d = get_registry().delta(before)["counters"]
+            assert d.get("shuffle.fetch.codec.lz4", 0) >= 1, d
+            assert t.server_metrics.get("codec_rejects", 0) == 0
+        finally:
+            t.close()

@@ -1,0 +1,132 @@
+"""Disk-tier spill compression (memory/catalog.py).
+
+``spark.rapids.memory.spill.compression.codec`` runs the shuffle codec
+ladder over disk-tier spill files — the RapidsDiskStore-compression
+analog.  The .crc sidecar is computed over the COMPRESSED bytes (what
+the disk actually stores), so read-back verifies exactly what was
+written; a corrupted compressed file must degrade into the existing
+lost-tier path (SpillCorruptionError), never inflate into garbage rows.
+"""
+import glob
+import os
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.host.batch import HostBatch
+from spark_rapids_tpu.memory import BufferCatalog, SpillPriority
+from spark_rapids_tpu.memory.catalog import SpillCorruptionError
+
+SCHEMA = T.Schema([
+    T.StructField("a", T.LongType(), True),
+    T.StructField("s", T.StringType(), True),
+])
+
+
+def _batch(rng, n=256):
+    # s repeats heavily -> compressible payload
+    return HostBatch.from_pydict({
+        "a": [int(x) for x in rng.integers(-1000, 1000, n)],
+        "s": [f"str{x}" if x % 7 else None
+              for x in rng.integers(0, 9, n)],
+    }, SCHEMA).to_device()
+
+
+def _rows(b):
+    return HostBatch.from_device(b).to_rows()
+
+
+def _conf(tmp_path, codec="lz4"):
+    return TpuConf({
+        "spark.rapids.memory.spill.compression.codec": codec,
+        "spark.rapids.memory.spill.dir": str(tmp_path),
+    })
+
+
+def test_compressed_spill_through_to_disk_roundtrip(rng, tmp_path):
+    b1, b2 = _batch(rng), _batch(rng)
+    w1, w2 = _rows(b1), _rows(b2)
+    size = b1.device_size_bytes()
+    # host arena fits ~one batch -> second host spill pushes first to disk
+    cat = BufferCatalog(device_limit=1, host_limit=size + 4096,
+                        conf=_conf(tmp_path))
+    id1 = cat.add_batch(b1, priority=0)
+    id2 = cat.add_batch(b2, priority=1)
+    assert cat.tier_of(id1) == "disk"
+    assert cat.metrics["spill_raw_bytes"] > 0
+    assert cat.metrics["spill_compressed_bytes"] > 0
+    # the repeated strings must actually compress
+    assert cat.metrics["spill_compressed_bytes"] < \
+        cat.metrics["spill_raw_bytes"]
+    # the disk file holds the COMPRESSED size, not the raw size
+    (path,) = glob.glob(os.path.join(str(tmp_path), "buf_*.bin"))
+    assert os.path.getsize(path) == cat.metrics["spill_compressed_bytes"]
+    got1 = cat.acquire(id1)
+    assert _rows(got1) == w1
+    cat.release(id1)
+    got2 = cat.acquire(id2)
+    assert _rows(got2) == w2
+    cat.release(id2)
+    assert cat.metrics["spill_crc_failures"] == 0
+    cat.close()
+
+
+def test_compressed_direct_to_disk_roundtrip(rng, tmp_path):
+    """Oversized buffer: device->disk fallthrough (host arena too small)
+    takes the OTHER disk-write path; it must compress identically."""
+    b = _batch(rng, n=4096)
+    want = _rows(b)
+    cat = BufferCatalog(device_limit=1, host_limit=4096,
+                        conf=_conf(tmp_path))
+    bid = cat.add_batch(b, SpillPriority.SHUFFLE_OUTPUT)
+    assert cat.tier_of(bid) == "disk"
+    assert 0 < cat.metrics["spill_compressed_bytes"] < \
+        cat.metrics["spill_raw_bytes"]
+    got = cat.acquire(bid)
+    assert _rows(got) == want
+    cat.release(bid)
+    cat.close()
+
+
+def test_corrupt_compressed_spill_detected_as_lost(rng, tmp_path):
+    """One flipped byte in the compressed file: the sidecar CRC (over
+    the compressed bytes) must catch it BEFORE any inflate runs, and
+    the buffer lands in the lost tier."""
+    b = _batch(rng)
+    cat = BufferCatalog(device_limit=1, host_limit=4096,
+                        conf=_conf(tmp_path))
+    bid = cat.add_batch(b, SpillPriority.SHUFFLE_OUTPUT)
+    assert cat.tier_of(bid) == "disk"
+    (path,) = glob.glob(os.path.join(str(tmp_path), "buf_*.bin"))
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(raw)
+    with pytest.raises(SpillCorruptionError):
+        cat.acquire(bid)
+    assert cat.metrics["spill_crc_failures"] == 1
+    assert cat.tier_of(bid) == "lost"
+    # lost stays lost: a second acquire is the same terminal error,
+    # not a second CRC count
+    with pytest.raises(SpillCorruptionError):
+        cat.acquire(bid)
+    assert cat.metrics["spill_crc_failures"] == 1
+    cat.close()
+
+
+def test_spill_codec_none_writes_raw(rng, tmp_path):
+    """codec=none keeps the streaming write path: no compression
+    counters move and the file holds the raw aligned bytes."""
+    b = _batch(rng)
+    want = _rows(b)
+    cat = BufferCatalog(device_limit=1, host_limit=4096,
+                        conf=_conf(tmp_path, codec="none"))
+    bid = cat.add_batch(b, SpillPriority.SHUFFLE_OUTPUT)
+    assert cat.tier_of(bid) == "disk"
+    assert cat.metrics["spill_compressed_bytes"] == 0
+    assert cat.metrics["spill_raw_bytes"] == 0
+    got = cat.acquire(bid)
+    assert _rows(got) == want
+    cat.release(bid)
+    cat.close()
