@@ -18,9 +18,11 @@ tenant streams through ONE session, distinct query permutations per
 stream, warm queries-per-hour per rung with cache-hit and fairness
 counters, every stream's rows verified against the host oracle.
 
-The second line is the pod-scale device-count ladder: TPC-H q6 and q3
-at 1/2/4/8 mesh devices (spark.rapids.tpu.mesh.deviceCount), wall time
-and scaling efficiency t1/(n*tn) per rung.  Setting
+The second line is the pod-scale device-count ladder: TPC-H q6, q3,
+q13 and q18 at 1/2/4/8 mesh devices
+(spark.rapids.tpu.mesh.deviceCount), wall time and scaling efficiency
+t1/(n*tn) per rung — q13/q18 exercise shard-resident multi-join
+regions, not just scan->filter->agg.  Setting
 SPARK_RAPIDS_BENCH_MESH_DEVICES=N additionally runs the PRIMARY q6
 ladder itself over an N-device mesh, so a multichip harness run stops
 reporting healthy-but-idle devices.
@@ -77,9 +79,10 @@ LADDER = [sf for sf in (0.1, 1.0, 10.0) if sf <= MAX_SF] or [0.1]
 # probes are the devices the measured plan executes on
 MESH_DEVICES = int(os.environ.get("SPARK_RAPIDS_BENCH_MESH_DEVICES", "0")
                    or "0")
-# device-count scaling ladder (MULTICHIP metric): q6 + q3 at 1/2/4/8
-# devices, wall time and scaling efficiency per rung
-MULTICHIP_QUERIES = ("q6", "q3")
+# device-count scaling ladder (MULTICHIP metric): q6 + q3 + q13 + q18 at
+# 1/2/4/8 devices, wall time and scaling efficiency per rung — q13/q18
+# keep multi-join pipelines (joins absorbed into mesh regions) honest
+MULTICHIP_QUERIES = ("q6", "q3", "q13", "q18")
 MULTICHIP_LADDER = tuple(
     int(x) for x in os.environ.get("BENCH_MULTICHIP_LADDER",
                                    "1,2,4,8").split(",") if x.strip())
@@ -372,7 +375,8 @@ def _child(sf: float, platform: str) -> None:
 
 
 def _mchild(n_devices: int, platform: str) -> None:
-    """One MULTICHIP rung: q6 + q3 (TPC-H) on an n-device mesh.
+    """One MULTICHIP rung: q6 + q3 + q13 + q18 (TPC-H) on an n-device
+    mesh.
 
     Prints a BENCH_REPORT line with per-query wall times.  The parent
     forces ``--xla_force_host_platform_device_count`` in this child's
@@ -824,9 +828,9 @@ def main() -> None:
         _emit(0.0, LADDER[0], backend, error=err or "no rung completed",
               extra=extra)
         rc = 1
-    # second metric line: the pod-scale device-count ladder (q6 + q3 at
-    # 1/2/4/8 devices).  Runs after the primary metric so a wedged mesh
-    # rung can never eat the gate number.
+    # second metric line: the pod-scale device-count ladder (q6 + q3 +
+    # q13 + q18 at 1/2/4/8 devices).  Runs after the primary metric so a
+    # wedged mesh rung can never eat the gate number.
     mc_deadline = time.monotonic() + MULTICHIP_TIMEOUT_S
     try:
         _multichip(mc_deadline, probe_detail)

@@ -502,6 +502,60 @@ assert zrows == prows
 assert not any(n.startswith("Mesh") for n in zero), zero
 print("mesh gate: q6/q3 exact, warm rerun compiles 0, deviceCount=0 reversible: ok")
 PY
+  echo "-- mesh-join gate: joins absorbed into regions, no gather, exact --"
+  # q3's joins must run INSIDE a mesh region (one per-device program,
+  # build broadcast / key exchanges as in-program collectives), with
+  # zero mesh_gather_fallbacks end to end, rows exactly equal to the
+  # single-chip run, and deviceCount=0 must restore the exact
+  # single-chip plan shape untouched by region formation
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import os, tempfile
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+MESH = {"spark.rapids.tpu.mesh.deviceCount": 8}
+
+def plan_and_rows(query, conf):
+    s = TpuSession(dict(conf))
+    df = build_tpch_query(query, s, d)
+    ov, meta = df._overridden(quiet=True)
+    nodes = []
+    def walk(n):
+        nodes.append(n)
+        for c in n.children:
+            walk(c)
+    walk(meta.exec_node)
+    return nodes, sorted(df.collect(), key=str)
+
+# 1) q3 at mesh-8: a region whose program contains a join, zero gather
+#    fallbacks, rows exactly the single-chip rows
+before = get_registry().snapshot()
+mnodes, mrows = plan_and_rows("q3", MESH)
+moved = get_registry().delta(before)["counters"]
+regions = [n for n in mnodes if type(n).__name__ == "MeshRegionExec"]
+assert regions, [type(n).__name__ for n in mnodes]
+assert any("MeshJoinExec" in r.node_desc() for r in regions), \
+    [r.node_desc() for r in regions]
+assert moved.get("mesh_gather_fallbacks", 0) == 0, moved
+assert moved.get("mesh_regions", 0) >= 1, moved
+_, prows = plan_and_rows("q3", {})
+assert mrows == prows, "q3: mesh-8 rows != single-chip rows"
+
+# 2) deviceCount=0 restores the exact single-chip plan shape
+znodes, zrows = plan_and_rows("q3", {"spark.rapids.tpu.mesh.deviceCount": 0})
+pnodes, prows2 = plan_and_rows("q3", {})
+assert [type(n).__name__ for n in znodes] == \
+    [type(n).__name__ for n in pnodes]
+assert zrows == prows2
+print("mesh-join gate: q3 join-in-region, 0 gather fallbacks, exact, "
+      "deviceCount=0 reversible: ok")
+PY
   echo "-- serving tier gate: warm cache hit, weighted order, tenant shed, reversible --"
   # the multi-tenant serving tier's four contracts: (1) 8 queries from
   # 2 tenants at 3:1 weights, then the identical warm set again — the

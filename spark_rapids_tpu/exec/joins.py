@@ -168,7 +168,11 @@ class JoinExec(PlanNode):
 
     # ------------------------------------------------------------------
     def _augment_device(self, batch: ColumnBatch, keys) -> tuple:
-        """Append evaluated key columns; return (batch', key_indices)."""
+        """Append evaluated key columns; return (batch', key_indices).
+
+        Also traced inside mesh-region programs (MeshJoinExec._region_step
+        runs it under shard_map): must stay free of host syncs and of
+        control flow on traced values."""
         n = batch.num_columns
         cols = list(batch.columns)
         fields = list(batch.schema.fields)
@@ -437,7 +441,10 @@ class JoinExec(PlanNode):
 
     def _project_out(self, out, n_left_raw: int, n_left_aug: int,
                      n_right_raw: int, device: bool):
-        """Drop appended key columns from the kernel output."""
+        """Drop appended key columns from the kernel output.
+
+        The device branch is traced inside mesh-region programs; keep the
+        column selection static (pure python ints, no traced values)."""
         keep = list(range(n_left_raw))
         if self.include_right:
             keep += [n_left_aug + i for i in range(n_right_raw)]

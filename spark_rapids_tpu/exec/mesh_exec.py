@@ -46,7 +46,8 @@ from spark_rapids_tpu.parallel.mesh_shuffle import (canonicalize,
                                                     partition_ids_for_keys)
 
 __all__ = ["DeviceSliceLost", "MeshSendOverflow", "MeshAggregateExec",
-           "MeshExchangeExec", "MeshJoinExec", "mesh_for"]
+           "MeshExchangeExec", "MeshJoinExec", "all_gather_batch",
+           "mesh_for"]
 
 
 def _committed_device(b: ColumnBatch):
@@ -160,6 +161,35 @@ def _note_slice_recovery(ctx: ExecCtx, wall_s: float) -> None:
     m["recovery_wall_s"] = m.get("recovery_wall_s", 0.0) + wall_s
 
 
+def all_gather_batch(b: ColumnBatch, p: int, axis: str) -> ColumnBatch:
+    """In-program replication: every device ends up with ALL rows of the
+    sharded batch, front-packed.  Per-column tiled ``all_gather`` plus a
+    segment-aware real mask (gathered rows are packed per shard segment,
+    not globally — the MeshSortExec gather), then one compaction to
+    restore the front-packed num_rows/row_mask contract downstream
+    traced bodies rely on.  This is the replicated mesh join's build
+    broadcast and the global window's input gather."""
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    cap = b.capacity
+    counts = jax.lax.all_gather(b.num_rows, axis)  # int32[P]
+    cols = []
+    for c in b.columns:
+        data = jax.lax.all_gather(c.data, axis, tiled=True)
+        val = jax.lax.all_gather(c.validity, axis, tiled=True)
+        if c.is_string:
+            ln = jax.lax.all_gather(c.lengths, axis, tiled=True)
+            cols.append(DeviceColumn(data, val, c.dtype, ln))
+        else:
+            cols.append(DeviceColumn(data, val, c.dtype))
+    gcap = p * cap
+    idx = jnp.arange(gcap, dtype=jnp.int32)
+    real = (idx % cap) < counts[idx // cap]
+    # num_rows = gcap so compact's row_mask covers every gathered slot;
+    # compact itself front-packs and sets the true count
+    gb = ColumnBatch(cols, jnp.asarray(gcap, jnp.int32), b.schema)
+    return dk.compact(gb, real)
+
+
 def mesh_for(ctx: ExecCtx, size: int, axis_name: str = "data"):
     """The ctx-cached 1-D device mesh, or None if < size devices exist."""
     key = ("mesh", size, axis_name)
@@ -176,23 +206,33 @@ def place_shards(batches: Sequence[ColumnBatch], p: int):
     Round-2 verdict item 7: the old implementation concatenated every
     child batch in the driver process and re-sliced — a full gather
     before the "distributed" program.  Here batches are greedily
-    assigned to shards by size and concatenated only WITHIN their shard
+    assigned to shards and concatenated only WITHIN their shard
     (each shard touches ~1/p of the data; on a multi-host plane each
     host would run its own group).  Capacities and string widths are
     made uniform across shards (stacking onto the mesh requires it) by
     padding, not by gathering.  Row->shard assignment is arbitrary —
     callers shuffle by key immediately after (the reference's map-side
     split has the same freedom).
+
+    Placement is by REAL rows, not storage capacity: inputs arrive
+    padded (a region's split output keeps its program's static
+    capacity; a scan can hand over one table-sized batch), and
+    capacity-based placement both skews every real row onto one device
+    and inflates the shared shard capacity to the fattest padded input
+    — a multi-join region then sorts mostly padding on every device.
+    Oversized free batches are sliced into ~1/p row ranges; committed
+    batches keep their device (cross-device concat is both an error
+    and a needless ICI hop) and are shrunk to their real rows instead.
     """
     groups: list[list[ColumnBatch]] = [[] for _ in range(p)]
     loads = [0] * p
     # device affinity first: batches already committed to a mesh device
-    # (e.g. MeshJoinExec probe output) stay on it — cross-device concat
-    # is both an error and a needless ICI hop
+    # (e.g. MeshJoinExec probe output) stay on it
     devs = jax.devices()[:p]
     dev_index = {repr(d): i for i, d in enumerate(devs)}
     rest = []
     for b in batches:
+        n = b.host_num_rows()
         i = None
         if b.columns and getattr(b.columns[0].data, "committed", False):
             bdevs = b.columns[0].data.devices()
@@ -200,13 +240,23 @@ def place_shards(batches: Sequence[ColumnBatch], p: int):
                 i = dev_index.get(repr(next(iter(bdevs))))
         if i is not None:
             groups[i].append(b)
-            loads[i] += b.capacity
+            loads[i] += n
         else:
-            rest.append(b)
-    for b in sorted(rest, key=lambda b: -b.capacity):
+            rest.append((n, b))
+    total = sum(n for n, _ in rest)
+    chunk = max(1024, -(-total // p))
+    parts = []
+    for n, b in rest:
+        if n <= chunk:
+            parts.append((n, b))
+        else:
+            for lo in range(0, n, chunk):
+                hi = min(lo + chunk, n)
+                parts.append((hi - lo, dk.slice_rows(b, lo, hi)))
+    for n, b in sorted(parts, key=lambda t: -t[0]):
         i = loads.index(min(loads))
         groups[i].append(b)
-        loads[i] += b.capacity
+        loads[i] += n
     cap = round_capacity(max(max(loads), 8))
     # global string widths per column (concat pads only within a group)
     schema = batches[0].schema
@@ -218,8 +268,18 @@ def place_shards(batches: Sequence[ColumnBatch], p: int):
         if not g:
             shards.append(_empty_shard(schema, cap, widths))
             continue
-        s = g[0] if len(g) == 1 and g[0].capacity == cap \
-            else dk.concat_batches(g, out_capacity=cap)
+        # drop each member to its own real-row bucket first: a padded
+        # upstream capacity must not leak into the group concat
+        g = [dk.shrink_capacity(b, round_capacity(max(b.host_num_rows(), 1)))
+             for b in g]
+        if len(g) == 1:
+            s = g[0] if g[0].capacity == cap \
+                else dk.pad_capacity(g[0], cap)
+        else:
+            need = max(cap, round_capacity(sum(b.capacity for b in g)))
+            s = dk.concat_batches(g, out_capacity=need)
+            if s.capacity > cap:
+                s = dk.shrink_capacity(s, cap)
         shards.append(_pad_widths(s, widths))
     return shards
 
@@ -686,6 +746,10 @@ class MeshJoinExec(_MeshOutputMixin, JoinExec):
         super().__init__(left, right, left_keys, right_keys, join_type,
                          condition)
         self.mesh_size = mesh_size
+        # the island path never names the mesh axis (its collectives run
+        # through MeshExchangeExec), but the in-region body issues its
+        # own all_gather/all-to-all under the region's axis
+        self.axis_name = "data"
         self.build_threshold_bytes = build_threshold_bytes
         # unbound key exprs in POST-swap orientation (children[0] =
         # stream, children[1] = build) for the partitioned exchanges
@@ -748,6 +812,10 @@ class MeshJoinExec(_MeshOutputMixin, JoinExec):
 
         def decide() -> bool:
             if self.build_threshold_bytes == 0:
+                get_registry().inc("mesh_join_partitioned")
+                ctx.trace_event(
+                    "aqe.replan", "aqe", node=self.node_desc(),
+                    build_bytes=-1, threshold=0, decision="partitioned")
                 return True
             # cheap probe: sum bytes over the drained batch list (no
             # concat, no build prep); the list is ctx-cached so the
@@ -760,7 +828,15 @@ class MeshJoinExec(_MeshOutputMixin, JoinExec):
             # the mesh analog of plan/adaptive.py's broadcast switch:
             # record the measured-size strategy pick on the trace (no
             # aqe_* counter — this is the static mesh join's built-in
-            # decision, not a stage-boundary re-plan)
+            # decision, not a stage-boundary re-plan) and on the counter
+            # registry (EXPLAIN ANALYZE renders these next to
+            # mesh_all_to_all_bytes)
+            reg = get_registry()
+            if partitioned:
+                reg.inc("mesh_join_partitioned")
+            else:
+                reg.inc("mesh_join_replicated")
+                reg.inc("mesh_join_broadcast_bytes", float(nbytes))
             ctx.trace_event(
                 "aqe.replan", "aqe", node=self.node_desc(),
                 build_bytes=int(nbytes),
@@ -771,6 +847,78 @@ class MeshJoinExec(_MeshOutputMixin, JoinExec):
 
     def _partitioned_exchanges(self):
         return self._exchanges
+
+    # -- region interior -----------------------------------------------
+    def _region_step(self, mode: str, out_cap: int,
+                     send_capacity: int | None = None):
+        """Per-device traceable join body for MeshRegionExec interiors:
+        ``(stream_local, build_local) -> (joined, (total, flags))``.
+
+        ``mode`` is the host-side replicated/partitioned pick
+        (_use_partitioned): replicated runs the build-side broadcast as
+        an in-program all_gather; partitioned runs BOTH key exchanges as
+        in-program all-to-alls (reusing the eagerly-built
+        MeshExchangeExec steps, so partition ids are Spark-bit-exact and
+        co-partitioning is guaranteed by construction).
+
+        ``out_cap`` is the STATIC join output capacity — a host sync of
+        the probe total is impossible inside shard_map, so the region
+        launcher guesses, reads the returned ``total`` in ONE stacked
+        aux fetch, and retries at the rounded-up measured capacity when
+        the guess was short (the output is discarded, never truncated
+        silently).  ``flags`` carries the bounded-send-buffer overflow
+        bits of the partitioned exchanges (empty when replicated)."""
+        from spark_rapids_tpu.ops.join import (gather_join_output,
+                                               join_indices_from_probe,
+                                               join_probe)
+        jt = self.join_type
+        n_right_raw = len(self.children[1].output_schema.fields)
+
+        def step(sb: ColumnBatch, bb: ColumnBatch):
+            flags = ()
+            if mode == "partitioned":
+                sb, s_ovf = self._exchanges[0]._local_step(send_capacity)(sb)
+                bb, b_ovf = self._exchanges[1]._local_step(send_capacity)(bb)
+                flags = (s_ovf, b_ovf)
+            else:
+                bb = all_gather_batch(bb, self.mesh_size, self.axis_name)
+            lb2, lkeys = self._augment_device(sb, self._lkeys_b)
+            rb2, rkeys = self._augment_device(bb, self._rkeys_b)
+            probe_arrays, total = join_probe(lb2, rb2, list(lkeys),
+                                             list(rkeys), jt)
+            plan = join_indices_from_probe(lb2.capacity, probe_arrays, jt,
+                                           out_cap)
+            kf = T.Schema(list(lb2.schema.fields)
+                          + (list(rb2.schema.fields)
+                             if self.include_right else []))
+            out = gather_join_output(lb2, rb2, *plan, kf,
+                                     self.include_right)
+            out = self._project_out(out, sb.num_columns, lb2.num_columns,
+                                    n_right_raw, device=True)
+            if self._condition is not None:
+                c = eval_device(self._cond_b, out)
+                out = dk.compact(out, c.data & c.validity)
+            if self._swapped and self.include_right:
+                out = self._reorder_device(out, sb.num_columns)
+            out = ColumnBatch(out.columns, out.num_rows, self._schema)
+            return out, (total, flags)
+
+        return step
+
+    def _region_step_key_parts(self, mode: str, out_cap: int,
+                               send_capacity: int | None = None) -> tuple:
+        """Fragment-key material for the in-region join body (the region
+        key composes these per member; mesh part added by the builder)."""
+        parts = ("mesh_join", mode, out_cap, self.join_type, self._swapped,
+                 tuple(self._lkeys_b), tuple(self._rkeys_b),
+                 self.children[0].output_schema,
+                 self.children[1].output_schema,
+                 self._cond_b if self._condition is not None else None,
+                 self._schema, self.mesh_size)
+        if mode == "partitioned":
+            parts = parts + self._exchanges[0]._step_key_parts(send_capacity)
+            parts = parts + self._exchanges[1]._step_key_parts(send_capacity)
+        return parts
 
     def _materialize(self, ctx: ExecCtx, which: int):
         # route through the shared drained-list cache so the size probe

@@ -30,6 +30,14 @@ This is layer 3 of the fault-tolerance ladder (docs/tuning-guide.md
 "Fault tolerance"): transient transport failures never get here
 (shuffle/retry.py resumes them), OOMs never get here (memory/retry.py
 splits them); only confirmed DATA LOSS drives recomputation.
+
+Mesh-region programs (exec/mesh_region.py) recover at a coarser grain
+than this per-map-output loop: a device slice lost mid-program takes
+every op fused into the region with it (joins and windows included),
+so the region re-executes whole from its host-cached leaf and build
+batches and counts ONE ``stage_recompute`` regardless of how many ops
+the program absorbed.  Chained regions re-shard from the upstream
+region's host fallback, so a loss never cascades past one region.
 """
 from __future__ import annotations
 

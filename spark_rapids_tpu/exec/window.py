@@ -307,7 +307,13 @@ class WindowExec(PlanNode):
                 lambda bb: app_jit(bb, state), b, op="window_apply")
 
     # ------------------------------------------------------------------
-    def _run_device(self, big: ColumnBatch) -> ColumnBatch:
+    def _window_args(self, big: ColumnBatch) -> tuple:
+        """Augment ``big`` with evaluated partition/order/input columns
+        and build the sort-order spec: ``(aug, orders, part_idx,
+        order_idx, input_idx, nbase)``.  Pure eval_device — callable
+        both eagerly (the single-device path jits the body separately)
+        and INSIDE a trace (MeshWindowExec splices the whole window into
+        a per-device shard_map program)."""
         nbase = big.num_columns
         cols = list(big.columns)
         fields = list(big.schema.fields)
@@ -331,8 +337,13 @@ class WindowExec(PlanNode):
         orders = [SortOrder(i, True, True) for i in part_idx] + \
             [SortOrder(i, asc, nf)
              for i, (_, asc, nf) in zip(order_idx, self._order_b)]
-        out = _jit_window(aug, tuple(orders), tuple(part_idx),
-                          tuple(order_idx), tuple(input_idx),
+        return (aug, tuple(orders), tuple(part_idx), tuple(order_idx),
+                tuple(input_idx), nbase)
+
+    def _run_device(self, big: ColumnBatch) -> ColumnBatch:
+        aug, orders, part_idx, order_idx, input_idx, nbase = \
+            self._window_args(big)
+        out = _jit_window(aug, orders, part_idx, order_idx, input_idx,
                           tuple(self._wexprs), nbase, self._schema)
         return out
 
@@ -504,10 +515,12 @@ def _objs_to_host(data, validity, dtype) -> HostColumn:
     return HostColumn(arr, validity, dtype)
 
 
-@guarded_jit(static_argnames=("orders", "part_idx", "order_idx",
-                                   "input_idx", "wexprs", "nbase", "schema"))
-def _jit_window(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
-                wexprs, nbase: int, schema: T.Schema) -> ColumnBatch:
+def _window_body(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
+                 wexprs, nbase: int, schema: T.Schema) -> ColumnBatch:
+    """The traceable window kernel: sort by (partition, order), derive
+    the shared segment arrays, evaluate every expression.  ``_jit_window``
+    is its eager jitted wrapper; MeshWindowExec calls the body directly
+    inside its per-device program."""
     sb = sort_batch(aug, list(orders))
     seg = W.sorted_segments(sb, part_idx, order_idx)
     out_cols = list(sb.columns[:nbase])
@@ -569,3 +582,8 @@ def _jit_window(aug: ColumnBatch, orders, part_idx, order_idx, input_idx,
             out_cols.append(DeviceColumn(jnp.where(validity, data, zero),
                                          validity, rtype))
     return ColumnBatch(out_cols, sb.num_rows, schema)
+
+
+_jit_window = guarded_jit(
+    static_argnames=("orders", "part_idx", "order_idx", "input_idx",
+                     "wexprs", "nbase", "schema"))(_window_body)

@@ -22,8 +22,8 @@ from spark_rapids_tpu.columnar.batch import ColumnBatch, round_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
 __all__ = ["compact", "take", "concat_batches", "slice_batch",
-           "gather_columns", "shrink_capacity", "pad_capacity",
-           "device_scalar"]
+           "slice_rows", "gather_columns", "shrink_capacity",
+           "pad_capacity", "device_scalar"]
 
 
 @__import__("functools").lru_cache(maxsize=65536)
@@ -110,6 +110,27 @@ def slice_batch(batch: ColumnBatch, limit: jax.Array) -> ColumnBatch:
     identity = jnp.arange(batch.capacity, dtype=jnp.int32)
     cols = gather_columns(batch.columns, identity, new_count)
     return ColumnBatch(cols, new_count, batch.schema)
+
+
+def slice_rows(batch: ColumnBatch, lo: int, hi: int) -> ColumnBatch:
+    """Row range ``[lo, hi)`` of a front-packed batch as its own batch.
+
+    The caller must know (host-side) that ``hi <= num_rows``, so every
+    row in the range is real.  Slices run eagerly: each (lo, hi, shape)
+    triple is unique to its split point, so a jit here would compile a
+    fresh executable per slice (the opposite of the canonical-bucket
+    discipline the jitted shrink/pad kernels exist for)."""
+    cols = []
+    for c in batch.columns:
+        if c.is_var_width:
+            cols.append(DeviceColumn(c.data[lo:hi], c.validity[lo:hi],
+                                     c.dtype, c.lengths[lo:hi]))
+        else:
+            cols.append(DeviceColumn(c.data[lo:hi], c.validity[lo:hi],
+                                     c.dtype))
+    n = hi - lo
+    return ColumnBatch(cols, jnp.asarray(n, jnp.int32), batch.schema,
+                       known_rows=n)
 
 
 def shrink_capacity(batch: ColumnBatch, cap: int) -> ColumnBatch:

@@ -144,6 +144,12 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
         # validates that every expression shares it (window.py)
         first = node.window_exprs[0]
         inner = first.children[0] if isinstance(first, Alias) else first
+        if _mesh_window_ok(c.exec_node, inner.spec, conf,
+                           node.window_exprs):
+            # the mesh window exchanges (or gathers) in-program, so no
+            # planner exchange is inserted on this path
+            return _stack_window_execs(c, node.window_exprs, False,
+                                       conf=conf, mesh=True)
         cur, keys_partitioned = _ensure_window_distribution(
             c, inner.spec, conf)
         return _stack_window_execs(cur, node.window_exprs,
@@ -395,6 +401,30 @@ def _window_key_names(keys) -> tuple | None:
     return tuple(names)
 
 
+def _mesh_window_ok(child_exec: PlanNode, spec, conf: TpuConf,
+                    windows) -> bool:
+    """True when this spec's window functions lower to MeshWindowExec:
+    a mesh is active, the conf gate is on, the spec has partition or
+    order keys (a fully global unordered window keeps the in-process
+    bounded-memory stream — gathering it would be a regression), the
+    child schema is mesh-shardable, and no expression is a pandas
+    window UDF (a mixed native+UDF spec falls back entirely so both
+    halves see the same distribution)."""
+    from spark_rapids_tpu.conf import MESH_WINDOW_ENABLED
+    if conf.mesh_device_count <= 1 or not conf.get(MESH_WINDOW_ENABLED):
+        return False
+    if not (spec.partition_by or spec.order_by):
+        return False
+    if _schema_has_arrays(child_exec):
+        return False
+    from spark_rapids_tpu.exec.python_exec import PandasWindowUDF
+    for w in windows:
+        inner = w.children[0] if isinstance(w, Alias) else w
+        if isinstance(inner.function, PandasWindowUDF):
+            return False
+    return True
+
+
 def _ensure_window_distribution(cur: PlannedNode, spec,
                                 conf: TpuConf) -> tuple[PlannedNode, bool]:
     """Hash-partition on the window partition keys so the window program
@@ -447,6 +477,10 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
         by_spec.setdefault(inner.spec, []).append(w)
     cur = c
     for spec, spec_windows in by_spec.items():
+        if _mesh_window_ok(cur.exec_node, spec, conf, spec_windows):
+            cur = _stack_window_execs(cur, spec_windows, False,
+                                      conf=conf, mesh=True)
+            continue
         cur, keys_partitioned = _ensure_window_distribution(cur, spec, conf)
         cur = _stack_window_execs(cur, spec_windows, keys_partitioned)
     ex = ProjectExec(plain, cur.exec_node)
@@ -454,10 +488,13 @@ def _lower_project(node: L.Project, conf: TpuConf) -> PlannedNode:
 
 
 def _stack_window_execs(cur: PlannedNode, spec_windows,
-                        keys_partitioned: bool) -> PlannedNode:
+                        keys_partitioned: bool, conf: TpuConf = None,
+                        mesh: bool = False) -> PlannedNode:
     """Plan one spec's window expressions, splitting pandas window UDFs
     into WindowInPandasExec (reference GpuWindowInPandasExec) and
-    native functions into WindowExec, stacked over ``cur``."""
+    native functions into WindowExec — or MeshWindowExec when the
+    caller passed ``mesh=True`` (_mesh_window_ok held, so the list is
+    all-native) — stacked over ``cur``."""
     from spark_rapids_tpu.exec.python_exec import (PandasWindowUDF,
                                                    WindowInPandasExec)
 
@@ -468,8 +505,13 @@ def _stack_window_execs(cur: PlannedNode, spec_windows,
     native_ws = [w for w in spec_windows if not _is_udf(w)]
     udf_ws = [w for w in spec_windows if _is_udf(w)]
     if native_ws:
-        ex = WindowExec(native_ws, cur.exec_node,
-                        keys_partitioned=keys_partitioned)
+        if mesh:
+            from spark_rapids_tpu.exec.mesh_region import MeshWindowExec
+            ex = MeshWindowExec(native_ws, cur.exec_node,
+                                conf.mesh_device_count)
+        else:
+            ex = WindowExec(native_ws, cur.exec_node,
+                            keys_partitioned=keys_partitioned)
         cur = PlannedNode(ex, list(native_ws), [cur])
     if udf_ws:
         ex = WindowInPandasExec(udf_ws, cur.exec_node,
@@ -712,13 +754,17 @@ class TpuOverrides:
                 node.donate_ok = False
 
     def _form_mesh_regions(self, root: PlannedNode) -> None:
-        """Grow each mesh collective (aggregate / exchange / sort)
-        downward into a MeshRegionExec absorbing the contiguous
-        elementwise pipeline below it — the absorbable set is exactly
-        whole-stage fusion's (filter / non-partition-aware project /
-        FusedStageExec), so this pass composes with ``_fuse_stages``:
-        an already-fused stage is spliced into the per-device program
-        as one body (exec/mesh_region.py).
+        """Grow each mesh collective (aggregate / exchange / sort /
+        window) downward into a MeshRegionExec absorbing the contiguous
+        pipeline below it — whole-stage fusion's elementwise set
+        (filter / non-partition-aware project / FusedStageExec) PLUS
+        the collective interiors MeshJoinExec and MeshWindowExec, so a
+        region can hold scan→filter→join→project→agg as ONE per-device
+        program (exec/mesh_region.py).  The run grows through a join's
+        STREAM side (children[0]); its build subtree stays a real plan
+        edge (the region drains it host-side and stacks it as an extra
+        program input) and is walked separately so nested collectives
+        below the build form their own regions.
 
         Runs after fusion on the realized exec tree: transitions and
         coalesces are placed, so an absorbable run can never cross a
@@ -731,15 +777,19 @@ class TpuOverrides:
             return
         from spark_rapids_tpu.exec.fused import FusedStageExec, fusible
         from spark_rapids_tpu.exec.mesh_exec import (MeshAggregateExec,
-                                                     MeshExchangeExec)
+                                                     MeshExchangeExec,
+                                                     MeshJoinExec)
         from spark_rapids_tpu.exec.mesh_region import (MeshRegionExec,
-                                                       MeshSortExec)
+                                                       MeshSortExec,
+                                                       MeshWindowExec)
         from spark_rapids_tpu.obs.registry import get_registry
-        terminals = (MeshAggregateExec, MeshExchangeExec, MeshSortExec)
+        terminals = (MeshAggregateExec, MeshExchangeExec, MeshSortExec,
+                     MeshWindowExec)
         done: dict[int, PlanNode] = {}
 
         def absorbable(n: PlanNode) -> bool:
-            return fusible(n) or type(n) is FusedStageExec
+            return fusible(n) or type(n) is FusedStageExec \
+                or type(n) in (MeshJoinExec, MeshWindowExec)
 
         def walk(node: PlanNode) -> PlanNode:
             got = done.get(id(node))
@@ -750,12 +800,20 @@ class TpuOverrides:
                 cur = node.children[0]
                 while absorbable(cur):
                     run.append(cur)
-                    cur = cur.children[0]
+                    cur = cur.children[0]  # join: the STREAM side
                 if run:
                     below = walk(cur)
                     members = list(reversed(run))  # innermost-first
                     if below is not cur:
-                        members[0].children = (below,)
+                        members[0].children = \
+                            (below,) + tuple(members[0].children[1:])
+                    # build subtrees walked BEFORE the region is built:
+                    # its children list snapshots each join's build edge
+                    for m in members:
+                        if isinstance(m, MeshJoinExec):
+                            nb = walk(m.children[1])
+                            if nb is not m.children[1]:
+                                m.children = (m.children[0], nb)
                     region = MeshRegionExec(node, members)
                     # the terminal now yields through the region, which
                     # owns the mesh->single-device boundary

@@ -447,21 +447,28 @@ class _Verifier:
         terminal = node._terminal
         if type(terminal).__name__ not in ("MeshAggregateExec",
                                            "MeshExchangeExec",
-                                           "MeshSortExec"):
+                                           "MeshSortExec",
+                                           "MeshWindowExec"):
             self._fail(node, f"mesh region terminal is "
                              f"{type(terminal).__name__}, not a mesh "
                              "collective")
+        joins = []
         for m in node._members:
             if isinstance(m, self.c["BackendSwitchExec"]):
                 self._fail(
                     node, "host transition (BackendSwitchExec) captured "
                     "inside a mesh region: the per-device program would "
                     "sync to host per shard inside one jitted body")
-            if not (self.c["fusible"](m)
-                    or isinstance(m, self.c["FusedStageExec"])):
+            mname = type(m).__name__
+            if mname == "MeshJoinExec":
+                joins.append(m)
+            elif not (self.c["fusible"](m)
+                      or isinstance(m, self.c["FusedStageExec"])
+                      or mname == "MeshWindowExec"):
                 self._fail(
                     node, f"mesh region member {type(m).__name__} is not "
-                    "absorbable (fusible filter/project or FusedStageExec)")
+                    "absorbable (fusible filter/project, FusedStageExec, "
+                    "MeshJoinExec, or MeshWindowExec)")
             if isinstance(m, self.c["FusedStageExec"]) and \
                     getattr(m, "donate_ok", False):
                 self._fail(
@@ -469,6 +476,56 @@ class _Verifier:
                     "donate_ok: the slice-lost fallback replays the "
                     "member chain per batch, which a donated (deleted) "
                     "input cannot survive")
+            if mname in ("MeshJoinExec", "MeshWindowExec") and \
+                    (getattr(m, "mesh_size", None) != node.mesh_size
+                     or getattr(m, "axis_name", None) != node.axis_name):
+                self._fail(
+                    node, f"collective member {mname} runs on mesh "
+                    f"{getattr(m, 'mesh_size', None)}/"
+                    f"{getattr(m, 'axis_name', None)!r} but the region "
+                    f"program is compiled for {node.mesh_size}/"
+                    f"{node.axis_name!r}")
+        # region closure over the new edges: children must stay exactly
+        # [pipeline leaf] + one build subtree per join member, matching
+        # the members' OWN links — a rewrite that swapped either side
+        # without the other would drain the wrong subtree
+        if len(node.children) != 1 + len(joins):
+            self._fail(
+                node, f"mesh region carries {len(node.children)} children "
+                f"for {len(joins)} join member(s); expected the pipeline "
+                "leaf plus one build subtree per join")
+        if node._members and node._members[0].children[0] \
+                is not node.children[0]:
+            self._fail(
+                node, "mesh region leaf edge diverged: members[0] no "
+                "longer consumes the region's child 0 — the program "
+                "would shard a different subtree than lineage replays")
+        for i, j in enumerate(joins):
+            if j.children[1] is not node.children[1 + i]:
+                self._fail(
+                    node, f"mesh region build edge {i} diverged: the "
+                    "absorbed join's build child is not the region's "
+                    f"child {1 + i} — the stacked build input would not "
+                    "match the join's lineage")
+        # chained-region edge: an upstream mesh exchange (bare or a
+        # region's exchange terminal) feeding this region must serve
+        # the SAME mesh, or the committed shards cannot be consumed
+        # in place
+        leaf = node.children[0]
+        lname = type(leaf).__name__
+        up = leaf if lname == "MeshExchangeExec" else \
+            (leaf._terminal if lname == "MeshRegionExec"
+             and type(leaf._terminal).__name__ == "MeshExchangeExec"
+             else None)
+        if up is not None and \
+                (up.mesh_size != node.mesh_size
+                 or up.axis_name != node.axis_name):
+            self._fail(
+                node, f"chained region edge crosses meshes: upstream "
+                f"exchange is mesh {up.mesh_size}/{up.axis_name!r}, "
+                f"this region {node.mesh_size}/{node.axis_name!r} — "
+                "per-device shards cannot stay committed across the "
+                "chain")
 
 
 def verify_plan(root, conf=None, pass_name: str = "mesh_regions") -> None:

@@ -20,6 +20,17 @@ pin that contract on the virtual 8-device CPU mesh:
   one stage recompute;
 * a bounded [P, C] send buffer that overflows under key skew degrades
   into a counted retry at worst-case capacity — never a truncation.
+
+ISSUE 14 widens the contract: joins are region INTERIOR nodes (q12's
+join runs inside one per-device program, replicated-vs-partitioned
+counted, zero gather fallbacks), window functions lower to
+MeshWindowExec (partitioned and global-ordered, exact at 2/4/8
+devices), a slice lost inside a join- or window-bearing region still
+recovers to exact rows with one recompute, warm reruns of the new
+node kinds compile nothing, and exchange-fed regions chain —
+downstream regions consume upstream shards in place
+(``mesh_region_chains``), reversible via
+``spark.rapids.tpu.mesh.regions.chain.enabled``.
 """
 import numpy as np
 import pytest
@@ -150,6 +161,9 @@ def test_q3_mesh8_zero_gather_fallbacks(tpch_dir, single_device_rows):
     assert delta.get("mesh_gather_fallbacks", 0) == 0, delta
     assert "MeshRegionExec" in analyzed
     assert "counters:" in analyzed and "mesh_regions" in analyzed
+    # the join strategy decision renders next to the a2a bytes
+    assert "mesh_join_replicated" in analyzed or \
+        "mesh_join_partitioned" in analyzed, analyzed
     assert _rows_match(rows, single_device_rows("q3"), strict=True)
 
 
@@ -404,3 +418,195 @@ def test_split_shards_keeps_batches_on_their_devices():
         hb = device_to_host(b)
         got.extend(zip(*[c.to_list() for c in hb.columns]))
     assert sorted(got) == sorted(zip(data["k"], data["s"]))
+
+
+# ---------------------------------------------------------------------------
+# joins absorbed into regions (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def test_q12_join_runs_inside_region(tpch_dir, single_device_rows):
+    """q12's join is a region MEMBER: one per-device program carries
+    scan->filter->join->agg, the replicated-vs-partitioned decision is
+    counted, and not one batch falls back to a host gather."""
+    from spark_rapids_tpu.bench.runner import _rows_match
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    # the join counters fire on EXECUTION: a result-cache hit from an
+    # earlier test's identical q12 run would skip the collect entirely
+    s = TpuSession({**MESH8, "spark.rapids.sql.resultCache.enabled": False})
+    df = build_tpch_query("q12", s, tpch_dir)
+    plan = _executed_plan(df)
+    regions = [n for n in _walk(plan)
+               if type(n).__name__ == "MeshRegionExec"]
+    assert any("MeshJoinExec" in r.node_desc() for r in regions), \
+        [r.node_desc() for r in regions]
+    b0 = get_registry().snapshot()
+    rows = df.collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert delta.get("mesh_gather_fallbacks", 0) == 0, delta
+    assert delta.get("mesh_join_replicated", 0) + \
+        delta.get("mesh_join_partitioned", 0) >= 1, delta
+    assert _rows_match(rows, single_device_rows("q12"), strict=True)
+
+
+def test_join_region_slice_lost_recovers_exact_once(tpch_dir,
+                                                    single_device_rows):
+    """Kill a mesh slice inside q12's join-bearing region: exact rows
+    through exactly one region-level recompute."""
+    from spark_rapids_tpu.bench.runner import _rows_match
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    from spark_rapids_tpu.exec.core import (ExecCtx, _rows_from_host,
+                                            device_to_host)
+    s = TpuSession({**MESH8,
+                    "spark.rapids.test.faults":
+                    "mesh.slice.lost:lost,op=meshregion,times=1"})
+    df = build_tpch_query("q12", s, tpch_dir)
+    plan = _executed_plan(df)
+    assert any("MeshJoinExec" in n.node_desc() for n in _walk(plan)
+               if type(n).__name__ == "MeshRegionExec")
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        rows = []
+        for b in plan.execute(ctx):
+            rows.extend(_rows_from_host(device_to_host(b)))
+        metrics = dict(ctx.catalog.metrics)
+    assert metrics.get("stage_recomputes", 0) == 1, metrics
+    assert _rows_match(rows, single_device_rows("q12"), strict=True)
+
+
+# ---------------------------------------------------------------------------
+# windows under the mesh (MeshWindowExec)
+# ---------------------------------------------------------------------------
+
+def _window_df(s, data, global_order=False):
+    from spark_rapids_tpu.expr.window import (RowNumber, WindowExpression,
+                                              WindowSpec)
+    spec = WindowSpec((), ((col("v"), True), (col("k"), True))) \
+        if global_order else \
+        WindowSpec((col("k"),), ((col("v"), True),))
+    return s.from_pydict(data, SCHEMA, partitions=4) \
+        .select(col("k"), col("v"),
+                WindowExpression(Sum(col("v")), spec).alias("rs"),
+                WindowExpression(RowNumber(), spec).alias("rn"))
+
+
+@pytest.mark.parametrize("devices", DEVICE_COUNTS)
+@pytest.mark.parametrize("global_order", (False, True),
+                         ids=("partitioned", "global_order"))
+def test_mesh_window_matches_single_device(rng, devices, global_order):
+    data = _data(rng)
+    sm = TpuSession({"spark.rapids.tpu.mesh.deviceCount": devices})
+    dfm = _window_df(sm, data, global_order)
+    plan = _executed_plan(dfm)
+    assert any("MeshWindowExec" in n.node_desc() for n in _walk(plan)), \
+        _classes(plan)
+    got = sorted(dfm.collect())
+    want = sorted(_window_df(TpuSession({}), data, global_order).collect())
+    assert got == want
+
+
+def test_window_region_slice_lost_recovers_exact_once(rng):
+    """A filter absorbed under a MeshWindowExec terminal forms a region;
+    a slice lost inside it recovers to exact rows with one recompute."""
+    from spark_rapids_tpu.exec.core import (ExecCtx, _rows_from_host,
+                                            device_to_host)
+    data = _data(rng)
+    s = TpuSession({**MESH8,
+                    "spark.rapids.test.faults":
+                    "mesh.slice.lost:lost,op=meshregion,times=1"})
+    plan = _executed_plan(_windowed_filter(s, data))
+    region = next(n for n in _walk(plan)
+                  if type(n).__name__ == "MeshRegionExec")
+    assert "MeshWindowExec" in region.node_desc()
+    with ExecCtx(backend="device", conf=s.conf) as ctx:
+        rows = []
+        for b in plan.execute(ctx):
+            rows.extend(_rows_from_host(device_to_host(b)))
+        metrics = dict(ctx.catalog.metrics)
+    assert metrics.get("stage_recomputes", 0) == 1, metrics
+    want = _windowed_filter(TpuSession({}), data).collect()
+    assert sorted(rows) == sorted(want)
+
+
+def _windowed_filter(s, data):
+    from spark_rapids_tpu.expr.window import (WindowExpression, WindowSpec)
+    spec = WindowSpec((col("k"),), ((col("v"), True),))
+    return s.from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") > 0) \
+        .select(col("k"), col("v"),
+                WindowExpression(Sum(col("v")), spec).alias("rs"))
+
+
+@pytest.mark.slow
+def test_standalone_mesh_window_slice_lost_recovers(rng):
+    """No region around it: a bare MeshWindowExec's own fallback path
+    recovers a lost slice on host with exact rows."""
+    data = _data(rng)
+    s = TpuSession({**MESH8,
+                    "spark.rapids.test.faults":
+                    "mesh.slice.lost:lost,op=meshwindow,times=1"})
+    got = sorted(_window_df(s, data).collect())
+    want = sorted(_window_df(TpuSession({}), data).collect())
+    assert got == want
+
+
+@pytest.mark.slow
+def test_join_and_window_regions_warm_rerun_compile_nothing(rng, tpch_dir):
+    """Second run of a join-bearing region program and a mesh window at
+    the SAME mesh shape compiles nothing: the new node kinds key into
+    the process-wide compile cache like every other mesh program."""
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    data = _data(rng)
+
+    def run_both():
+        s = TpuSession(MESH8)
+        jrows = build_tpch_query("q12", s, tpch_dir).collect()
+        wrows = _window_df(TpuSession(MESH8), data).collect()
+        return sorted(jrows), sorted(wrows)
+
+    cold = run_both()
+    b0 = get_registry().snapshot()
+    warm = run_both()
+    moved = get_registry().delta(b0)["counters"]
+    assert warm == cold
+    assert moved.get("compile_count", 0) == 0, \
+        f"warm join/window rerun compiled: {moved}"
+
+
+# ---------------------------------------------------------------------------
+# region chaining: exchange-fed regions consume shards in place
+# ---------------------------------------------------------------------------
+
+def _chained_q(s, data):
+    return s.from_pydict(data, SCHEMA, partitions=4) \
+        .where(col("v") != 0) \
+        .repartition(8, col("k")) \
+        .where(col("v") > 0) \
+        .group_by("k").agg(Sum(col("v")).alias("sv"))
+
+
+def test_region_chaining_consumes_shards_in_place(rng):
+    """An exchange-terminal region feeding a downstream region hands
+    its per-device shards over without a host gather/re-shard hop."""
+    data = _data(rng)
+    s = TpuSession(MESH8)
+    df = _chained_q(s, data)
+    plan = _executed_plan(df)
+    assert _classes(plan).count("MeshRegionExec") == 2, _classes(plan)
+    b0 = get_registry().snapshot()
+    rows = df.collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert delta.get("mesh_region_chains", 0) >= 1, delta
+    assert delta.get("mesh_gather_fallbacks", 0) == 0, delta
+    want = _chained_q(TpuSession({}), data).collect()
+    assert sorted(rows) == sorted(want)
+
+
+def test_region_chaining_disabled_same_rows_no_chain(rng):
+    data = _data(rng)
+    s = TpuSession({**MESH8,
+                    "spark.rapids.tpu.mesh.regions.chain.enabled": "false"})
+    b0 = get_registry().snapshot()
+    rows = _chained_q(s, data).collect()
+    delta = get_registry().delta(b0)["counters"]
+    assert delta.get("mesh_region_chains", 0) == 0, delta
+    want = _chained_q(TpuSession({}), data).collect()
+    assert sorted(rows) == sorted(want)

@@ -230,3 +230,94 @@ def test_error_is_structured():
     # message embeds both structured fields, for log triage
     assert e.node_path in str(e) and "mesh_regions" in str(e)
     assert isinstance(e, RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# join/window members and chained-region edges (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def _join_region_plan(data_dir):
+    """q12 under mesh-8: its joins absorb into a region, so the plan
+    carries a MeshRegionExec with at least one MeshJoinExec member and
+    a build-subtree child per join."""
+    from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+    s = TpuSession({**_EVERY, "spark.rapids.tpu.mesh.deviceCount": 8})
+    plan = _plan(build_tpch_query("q12", s, data_dir))
+    region = None
+    def walk(n, seen):
+        nonlocal region
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if type(n).__name__ == "MeshRegionExec" and \
+                any(type(m).__name__ == "MeshJoinExec"
+                    for m in n._members):
+            region = n
+        for c in n.children:
+            walk(c, seen)
+    walk(plan, set())
+    return plan, region, s
+
+
+def test_join_region_verifies_clean_under_every_pass(data_dir):
+    # prepare() under everyPass already verified after every pass; the
+    # final walk re-verifies the join-bearing region shape explicitly
+    plan, region, s = _join_region_plan(data_dir)
+    assert region is not None, "q12 mesh-8 formed no join-bearing region"
+    verify_plan(plan, s.conf)
+
+
+def test_broken_join_build_edge_in_region(data_dir):
+    from spark_rapids_tpu.exec.basic import GlobalLimitExec
+    plan, region, s = _join_region_plan(data_dir)
+    assert region is not None
+    # wedge a node between the region's build child and the absorbed
+    # join's own build link: the identities diverge
+    region.children = (region.children[0],
+                       GlobalLimitExec(1, region.children[1]),
+                       *region.children[2:])
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(plan, s.conf, "mesh_regions")
+    e = ei.value
+    assert e.pass_name == "mesh_regions"
+    assert "build edge" in e.message
+
+
+def test_window_region_verifies_clean_under_every_pass():
+    from spark_rapids_tpu.expr.window import (RowNumber, WindowExpression,
+                                              WindowSpec)
+    s = TpuSession({**_EVERY, "spark.rapids.tpu.mesh.deviceCount": 8})
+    data = {"k": (np.arange(40) % 5).astype(np.int32),
+            "v": np.arange(40, dtype=np.int64)}
+    spec = WindowSpec((col("k"),), ((col("v"), True),))
+    df = (s.from_pydict(data, SCHEMA, partitions=4)
+            .filter(col("v") > lit(3))
+            .select(col("k"),
+                    WindowExpression(RowNumber(), spec).alias("rn")))
+    plan = _plan(df)  # everyPass verified inside prepare()
+    region = _find(plan, "MeshRegionExec")
+    assert region is not None
+    assert type(region._terminal).__name__ == "MeshWindowExec"
+    verify_plan(plan, s.conf)
+
+
+def test_chained_region_edge_crossing_meshes_rejected():
+    s = TpuSession({**_EVERY, "spark.rapids.tpu.mesh.deviceCount": 8})
+    data = {"k": (np.arange(40) % 5).astype(np.int32),
+            "v": np.arange(40, dtype=np.int64)}
+    df = (s.from_pydict(data, SCHEMA, partitions=4)
+            .repartition(8, col("k"))
+            .filter(col("v") > lit(3))
+            .group_by("k").agg(Sum(col("v"))))
+    plan = _plan(df)
+    region = _find(plan, "MeshRegionExec")
+    assert region is not None
+    leaf = region.children[0]
+    assert type(leaf).__name__ == "MeshExchangeExec"
+    verify_plan(plan, s.conf)  # sane before the breakage
+    leaf.mesh_size = 4  # upstream exchange now serves a different mesh
+    with pytest.raises(PlanInvariantError) as ei:
+        verify_plan(plan, s.conf, "mesh_regions")
+    e = ei.value
+    assert e.pass_name == "mesh_regions"
+    assert "chained region edge crosses meshes" in e.message
