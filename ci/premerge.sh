@@ -739,6 +739,128 @@ assert not leaked, f"leaked cluster threads after shutdown: {leaked}"
 print("cluster gate: local[2] q6/q3 exact, worker-death recovery, "
       "clean drain: ok")
 PY
+  echo "-- telemetry gate: live /metrics mid-query, cluster trace, disabled-path imports --"
+  # ISSUE 15 observability plane: the HTTP endpoint must serve
+  # well-formed Prometheus (with at least one latency histogram) WHILE
+  # queries run; a local[2] q3 must yield ONE Perfetto trace carrying
+  # spans from BOTH worker pids on named lanes; and with the confs at
+  # their defaults neither obs/http.py nor obs/history.py may be
+  # imported and no telemetry socket may exist — the disabled path is
+  # zero-overhead by construction
+  JAX_PLATFORMS=cpu python - <<'PY'
+import glob, json, os, re, socket, sys, tempfile, threading, urllib.request
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+
+# 1) live endpoint mid-query: q6 looping in a worker thread, scraped
+# concurrently — every sample line must parse, the query-latency
+# histogram must be present with cumulative buckets
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+sess = TpuSession({"spark.rapids.obs.http.port": str(port)})
+assert sess._http is not None and sess._http.port == port
+stop = threading.Event()
+errs = []
+
+def loop_q6():
+    try:
+        while not stop.is_set():
+            build_tpch_query("q6", sess, d).collect()
+    except Exception as e:  # surfaced below; thread must not die silent
+        errs.append(repr(e))
+
+t = threading.Thread(target=loop_q6, daemon=True)
+t.start()
+try:
+    build_tpch_query("q6", sess, d).collect()   # ensure >= 1 completion
+    scraped = None
+    for _ in range(5):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200, r.status
+            assert r.headers["Content-Type"].startswith("text/plain")
+            scraped = r.read().decode()
+    sample = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[^}]*\})? '
+                        r'[-+0-9.einfa]+$')
+    for ln in scraped.splitlines():
+        if ln and not ln.startswith("#"):
+            assert sample.match(ln), f"malformed sample line: {ln!r}"
+    assert "# TYPE srt_query_wall_seconds histogram" in scraped, scraped
+    buckets = [float(ln.rsplit(" ", 1)[1]) for ln in scraped.splitlines()
+               if ln.startswith("srt_query_wall_seconds_bucket{")]
+    assert buckets and buckets == sorted(buckets) and buckets[-1] >= 1
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+        assert json.loads(r.read())["status"] == "ok"
+finally:
+    stop.set()
+    t.join(timeout=60)
+    sess.shutdown()
+assert not errs, errs
+assert sess._http is None, "endpoint must be torn down by shutdown()"
+print("telemetry gate 1: mid-query /metrics scrape well-formed, "
+      f"{len(buckets)} histogram buckets: ok")
+
+# 2) local[2] q3: ONE merged trace with driver + both worker pids.
+# Multi-part tables so the planner inserts real exchanges for the
+# cluster to shard — single-part scans would keep q3 driver-local.
+import pyarrow.parquet as pq
+for table in ("lineitem", "orders", "customer"):
+    t = pq.read_table(os.path.join(d, table, "part-0.parquet"))
+    step = -(-t.num_rows // 4)
+    for i in range(4):
+        pq.write_table(t.slice(i * step, step),
+                       os.path.join(d, table, f"part-{i}.parquet"))
+tdir = tempfile.mkdtemp()
+sess = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                   "spark.rapids.obs.trace.enabled": "true",
+                   "spark.rapids.obs.trace.dir": tdir})
+try:
+    worker_pids = {h.pid for h in sess._cluster().workers()}
+    build_tpch_query("q3", sess, d).collect()
+finally:
+    sess.shutdown()
+traces = glob.glob(os.path.join(tdir, "trace_*.json"))
+assert len(traces) == 1, f"want ONE merged trace, got {traces}"
+doc = json.load(open(traces[0]))
+lanes = {ev["pid"]: ev["args"]["name"] for ev in doc["traceEvents"]
+         if ev.get("ph") == "M" and ev["name"] == "process_name"}
+span_pids = {ev["pid"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "X"}
+assert worker_pids <= span_pids, (worker_pids, span_pids)
+assert worker_pids <= set(lanes), (worker_pids, lanes)
+assert os.getpid() in span_pids and lanes.get(os.getpid()) == "driver"
+print(f"telemetry gate 2: one trace, lanes {sorted(lanes.values())}, "
+      f"spans from {len(span_pids)} pids: ok")
+
+# 3) disabled path: defaults leave the telemetry modules unimported
+# (checked in a pristine interpreter — this one imported them above)
+import subprocess
+code = """
+import sys
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+sess = TpuSession({})
+build_tpch_query("q6", sess, %r).collect()
+sess.shutdown()
+assert sess._http is None
+bad = [m for m in sys.modules
+       if m in ("spark_rapids_tpu.obs.http", "spark_rapids_tpu.obs.history")]
+assert not bad, f"telemetry modules imported on disabled path: {bad}"
+print("disabled path clean")
+""" % d
+r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                   text=True, timeout=600,
+                   env=dict(os.environ, JAX_PLATFORMS="cpu"))
+assert r.returncode == 0, r.stdout + r.stderr
+print("telemetry gate 3: port-off default imports nothing, no socket: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -1,12 +1,13 @@
 """Validate observability JSON artifacts against ci/obs_schema.json.
 
 Hand-rolled validator for the dependency-free subset of JSON Schema the
-checked-in schema uses (type / required / properties / items / enum) —
+checked-in schema uses (type / required / properties / items / enum /
+additionalProperties-as-schema, with list-form ``type`` for nullables) —
 the CI image carries no jsonschema package, and the gate must not grow a
 dependency just to check its own output.
 
 Usage:
-    python scripts/validate_obs.py <trace|metrics|bundle> <file.json> ...
+    python scripts/validate_obs.py <trace|metrics|bundle|history|histogram> <file.json> ...
 
 Exit 0 when every file validates; 1 with a path-qualified error line per
 violation otherwise.  Also importable: ``validate(instance, schema)``
@@ -24,7 +25,16 @@ _TYPES = {
     # bool is an int subclass in Python; excluded explicitly below
     "integer": int,
     "number": (int, float),
+    "null": type(None),
 }
+
+
+def _type_ok(instance, t: str) -> bool:
+    if not isinstance(instance, _TYPES[t]):
+        return False
+    if t in ("integer", "number") and isinstance(instance, bool):
+        return False
+    return True
 
 
 def validate(instance, schema: dict, path: str = "$") -> list[str]:
@@ -32,12 +42,11 @@ def validate(instance, schema: dict, path: str = "$") -> list[str]:
     errs: list[str] = []
     t = schema.get("type")
     if t is not None:
-        py = _TYPES.get(t)
-        ok = isinstance(instance, py)
-        if t in ("integer", "number") and isinstance(instance, bool):
-            ok = False
-        if not ok:
-            errs.append(f"{path}: expected {t}, "
+        # list form means "any of these": the nullable-field idiom
+        # ("type": ["number", "null"]) used by the history schema
+        names = t if isinstance(t, list) else [t]
+        if not any(_type_ok(instance, n) for n in names):
+            errs.append(f"{path}: expected {'/'.join(names)}, "
                         f"got {type(instance).__name__}")
             return errs  # child checks would only cascade
     if "enum" in schema and instance not in schema["enum"]:
@@ -46,9 +55,18 @@ def validate(instance, schema: dict, path: str = "$") -> list[str]:
         for key in schema.get("required", ()):
             if key not in instance:
                 errs.append(f"{path}: missing required key {key!r}")
-        for key, sub in schema.get("properties", {}).items():
+        props = schema.get("properties", {})
+        for key, sub in props.items():
             if key in instance:
                 errs.extend(validate(instance[key], sub, f"{path}.{key}"))
+        # schema-valued additionalProperties constrains every key NOT
+        # named in properties (the open-keyed histogram maps); the
+        # boolean form is not used by obs_schema.json and is ignored
+        extra = schema.get("additionalProperties")
+        if isinstance(extra, dict):
+            for key, val in instance.items():
+                if key not in props:
+                    errs.extend(validate(val, extra, f"{path}.{key}"))
     if isinstance(instance, list) and "items" in schema:
         for i, item in enumerate(instance):
             errs.extend(validate(item, schema["items"], f"{path}[{i}]"))

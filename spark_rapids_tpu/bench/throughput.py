@@ -32,6 +32,17 @@ import time
 __all__ = ["run_throughput"]
 
 
+def _percentiles(snap: "dict | None") -> "dict | None":
+    """p50/p95/p99 (seconds) from one histogram snapshot delta."""
+    if not snap or not snap.get("count"):
+        return None
+    from spark_rapids_tpu.obs.registry import histogram_percentile
+    out = {f"p{q}": round(histogram_percentile(snap, q), 6)
+           for q in (50, 95, 99)}
+    out["count"] = snap["count"]
+    return out
+
+
 def _build_and_collect(session, build_query, name, data_dir, tenant):
     """One query start-to-rows on the device backend.  Plans are built
     fresh per execution: AQE installs runtime filters ON the scan exec
@@ -134,7 +145,9 @@ def run_throughput(data_dir: str, sf: float, streams=(1, 2, 4, 8),
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t0
-            moved = reg.delta(before)["counters"]
+            delta = reg.delta(before)
+            moved = delta["counters"]
+            hists = delta.get("histograms", {})
             total = n * len(queries)
             qph = total * 3600.0 / wall if wall > 0 else 0.0
             rung = {
@@ -142,6 +155,17 @@ def run_throughput(data_dir: str, sf: float, streams=(1, 2, 4, 8),
                 "queries_run": total,
                 "wall_s": round(wall, 4),
                 "qph": round(qph, 1),
+                # the SLO numbers QpH alone hides: this rung's query
+                # latency distribution, aggregate and per stream, from
+                # the histogram movement during the rung
+                "latency": _percentiles(hists.get("query.wall_seconds")),
+                "stream_latency": {
+                    t: _percentiles(snap) for t, snap in sorted(
+                        (k[len("query.tenant."):-len(".wall_seconds")], v)
+                        for k, v in hists.items()
+                        if k.startswith("query.tenant.stream")
+                        and k.endswith(".wall_seconds"))},
+                "histograms": hists,
                 "cache": {k: moved[k] for k in sorted(moved)
                           if k.startswith("result_cache")},
                 "fairness": {k: moved[k] for k in sorted(moved)

@@ -26,6 +26,13 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
+
+#: per-query bound on heartbeat-shipped span events buffered while the
+#: query is still running, and on how many distinct queries buffer at
+#: once (oldest evicted) — a chatty cluster cannot grow the driver
+_MAX_BUFFERED_SPANS = 8192
+_MAX_SPAN_QUERIES = 16
 
 from spark_rapids_tpu.cluster import (HEARTBEAT_INTERVAL,
                                       HEARTBEAT_TIMEOUT,
@@ -74,6 +81,10 @@ class ClusterDriver:
         self._lock = threading.Lock()
         self._handles: dict[str, WorkerHandle] = {}
         self._hang_ignored: set[str] = set()
+        # query_id -> worker span events shipped on heartbeats, held
+        # until the dispatching stage drains them into ITS tracer
+        self._span_lock = threading.Lock()
+        self._pending_spans: "dict[str, deque]" = {}
         self._closed = threading.Event()
         self._io_threads: list[threading.Thread] = []
         self.rpc = RpcServer(
@@ -160,7 +171,52 @@ class ClusterDriver:
             if not h.baseline:
                 h.baseline = snap
             h.metrics = snap
+        spans = payload.get("spans")
+        if spans:
+            self.buffer_spans(spans.get("events") or [])
         return ({"ok": True}, b"")
+
+    # -- trace aggregation ----------------------------------------------
+    def buffer_spans(self, events: list) -> None:
+        """Hold heartbeat-shipped worker span events per query until the
+        dispatching stage (cluster/exec.py) drains them into the query's
+        driver tracer.  Bounded both per query and across queries."""
+        with self._span_lock:
+            for ev in events:
+                qid = str((ev.get("args") or {}).get("query_id") or "?")
+                dq = self._pending_spans.get(qid)
+                if dq is None:
+                    while len(self._pending_spans) >= _MAX_SPAN_QUERIES:
+                        # evict the oldest query's buffer wholesale
+                        self._pending_spans.pop(
+                            next(iter(self._pending_spans)))
+                    dq = self._pending_spans[qid] = \
+                        deque(maxlen=_MAX_BUFFERED_SPANS)
+                dq.append(ev)
+
+    def drain_query_spans(self, query_id: str) -> list:
+        """Pop every buffered worker span for one query (exactly-once:
+        the caller ingests them into the driver tracer)."""
+        with self._span_lock:
+            dq = self._pending_spans.pop(query_id, None)
+        return list(dq) if dq else []
+
+    def merged_worker_histograms(self) -> dict:
+        """Cluster-wide latency distributions: each worker's histogram
+        movement since its first heartbeat, merged across workers (dead
+        workers included — their last shipped snapshot still counts)."""
+        from spark_rapids_tpu.obs.registry import (
+            delta_histogram_snapshot, merge_histogram_snapshots)
+        out: dict = {}
+        for h in self.workers():
+            cur = (h.metrics or {}).get("histograms") or {}
+            base = (h.baseline or {}).get("histograms") or {}
+            for name, snap in cur.items():
+                moved = delta_histogram_snapshot(snap, base.get(name))
+                if moved is None:
+                    continue
+                out[name] = merge_histogram_snapshots(out.get(name), moved)
+        return out
 
     def _monitor_loop(self) -> None:
         interval = min(0.5, HEARTBEAT_INTERVAL.get(self.conf.settings))
@@ -217,6 +273,13 @@ class ClusterDriver:
 
     def worker_by_id(self, worker_id: str) -> WorkerHandle | None:
         return self._handles.get(worker_id)
+
+    def worker_by_pid(self, pid: int) -> WorkerHandle | None:
+        with self._lock:
+            for h in self._handles.values():
+                if h.pid == pid:
+                    return h
+        return None
 
     def worker_by_shuffle_addr(self, addr) -> WorkerHandle | None:
         addr = tuple(addr)

@@ -638,9 +638,15 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
                                                         max(pending) + 1))
         handles = {h.worker_id: h for h in live}
 
+        tracer = ctx.tracer
+
         def run_one(wid: str, cps: list[int]):
             spec = {"exchange": clone, "num_parts": num_parts,
                     "cpids": cps, "conf": frag_conf}
+            if tracer is not None:
+                # propagate the query/trace ids: the worker's fragment
+                # spans land under THIS query and ship back in the reply
+                spec["trace"] = tracer.trace_header()
             if epochs:
                 spec["epochs"] = {m: e for m, e in epochs.items()
                                   if m // MAP_ID_STRIDE in set(cps)}
@@ -669,6 +675,15 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
                 cluster.mark_worker_lost(wid, f"run_fragment RPC: {res}")
                 next_pending.extend(cps)
                 continue
+            spans = res.get("spans")
+            if tracer is not None and spans:
+                # merge the worker's spans (success OR structured
+                # failure) onto the driver timeline, one labelled lane
+                # per worker pid
+                tracer.ensure_lane(tracer.pid, "driver")
+                tracer.ensure_lane(int(spans["pid"]),
+                                   f"cluster worker {wid}")
+                tracer.ingest_wall(spans.get("events") or [])
             kind = res.get("error_kind")
             if kind:
                 _handle_fragment_loss(cluster, ctx, res)
@@ -757,6 +772,17 @@ def cluster_do_shuffle(cluster, exchange, ctx: ExecCtx, child):
                         workers=len(cluster.live_workers())):
         _dispatch_fragments(cluster, ctx, tracker, clone, n,
                             list(range(ncpids)), frag_conf)
+    tracer = ctx.tracer
+    if tracer is not None:
+        # spans a long fragment streamed back on heartbeats MID-run
+        # (the completion reply only carries what was left unshipped)
+        for ev in cluster.drain_query_spans(ctx.query_id):
+            pid = ev.get("pid")
+            if isinstance(pid, int):
+                h = cluster.worker_by_pid(pid)
+                tracer.ensure_lane(pid, f"cluster worker "
+                                        f"{h.worker_id if h else pid}")
+            tracer.ingest_wall([ev])
     ctx.register_lineage(sid, ClusterLineage(
         exchange_clone=clone, cluster=cluster, tracker=tracker,
         num_parts=n, frag_conf=frag_conf,

@@ -23,6 +23,7 @@ import json
 import socket
 import struct
 import threading
+import time
 
 from spark_rapids_tpu.cluster import (RPC_COMPRESSION_CODEC,
                                       RPC_MAX_RETRIES, RPC_TIMEOUT)
@@ -236,8 +237,12 @@ def rpc_call(address, op: str, payload: dict | None = None,
                     f"cluster.rpc.drop fault: {op} to {host}:{port}")
                 continue
         try:
-            return _call_once(host, port, op, payload, blob, codec_name,
-                              timeout)
+            t0 = time.perf_counter()
+            out = _call_once(host, port, op, payload, blob, codec_name,
+                             timeout)
+            reg.observe("cluster.rpc.round_trip_seconds",
+                        time.perf_counter() - t0)
+            return out
         except RpcHandlerError:
             raise
         except (ConnectionError, OSError, ValueError) as e:

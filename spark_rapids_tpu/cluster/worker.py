@@ -51,7 +51,15 @@ MAP_ID_STRIDE = 1_000_000
 #: stdout/stderr is passthrough logging
 READY_PREFIX = "CLUSTER_WORKER_READY "
 
-_SCRUBBED_KEYS = ("spark.rapids.cluster.mode", "spark.rapids.test.faults")
+_SCRUBBED_KEYS = ("spark.rapids.cluster.mode", "spark.rapids.test.faults",
+                  # workers ship spans back over RPC instead of exporting
+                  # their own files — the driver's single export IS the
+                  # cluster trace (obs/trace.py stamp_for_shipping)
+                  "spark.rapids.obs.trace.dir")
+
+#: per-RPC-message span shipping bound (newest win): heartbeats and
+#: fragment replies stay small even under span storms
+_MAX_SHIP_EVENTS = 2000
 
 
 def scrub_worker_conf(settings: dict) -> dict:
@@ -86,6 +94,11 @@ class WorkerRuntime:
         self._runtime_lock = threading.Lock()
         self.metrics = {"fragments_run": 0, "fragment_failures": 0,
                         "map_batches_written": 0}
+        # tracers of fragments currently executing: the heartbeat drains
+        # them mid-run so a long map stage streams spans to the driver
+        # instead of batching them all on completion
+        self._tracer_lock = threading.Lock()
+        self._live_tracers: list = []
         # heartbeat snapshots carry the process registry; folding this
         # runtime in gives the driver per-worker fragment counters
         from spark_rapids_tpu.obs.registry import get_registry
@@ -142,26 +155,51 @@ class WorkerRuntime:
                                          self.conf.settings))
         child = exchange.children[0]
         self.metrics["fragments_run"] += 1
+        hdr = spec.get("trace") or None
+        tracer = None
         try:
             with ExecCtx(backend="device", conf=conf) as ctx:
-                for cpid in cpids:
-                    for k, b in enumerate(child.partition_iter(ctx, cpid)):
-                        enc = cpid * MAP_ID_STRIDE + k
-                        exchange._write_map_batch(
-                            ctx, self.store, enc, b, False, n,
-                            epoch=epochs.get(enc))
-                        self.metrics["map_batches_written"] += 1
+                if hdr:
+                    # the driver's query/trace ids win: every span this
+                    # fragment records lands under the ORIGINATING query
+                    ctx.cache["query_id"] = hdr["query_id"]
+                tracer = ctx.tracer
+                if tracer is not None:
+                    if hdr and hdr.get("trace_id"):
+                        tracer.trace_id = hdr["trace_id"]
+                    with self._tracer_lock:
+                        self._live_tracers.append(tracer)
+                with ctx.trace_span("worker.fragment", "cluster",
+                                    worker_id=self.worker_id,
+                                    shuffle_id=sid, cpids=list(cpids)):
+                    for cpid in cpids:
+                        for k, b in enumerate(
+                                child.partition_iter(ctx, cpid)):
+                            enc = cpid * MAP_ID_STRIDE + k
+                            exchange._write_map_batch(
+                                ctx, self.store, enc, b, False, n,
+                                epoch=epochs.get(enc))
+                            self.metrics["map_batches_written"] += 1
         except WorkerFetchFailed as e:
             self.metrics["fragment_failures"] += 1
             return ({"error": str(e), "error_kind": "peer_fetch",
                      "peer": list(e.address),
-                     "lost_sid": e.shuffle_id}, b"")
+                     "lost_sid": e.shuffle_id,
+                     **self._spans_field(tracer)}, b"")
         except MapOutputLostError as e:
             self.metrics["fragment_failures"] += 1
             return ({"error": str(e), "error_kind": "map_lost",
                      "lost_sid": e.shuffle_id, "part": e.part_id,
                      "lost": {str(k): v for k, v in e.lost.items()},
-                     "observed_empty": e.observed_empty}, b"")
+                     "observed_empty": e.observed_empty,
+                     **self._spans_field(tracer)}, b"")
+        finally:
+            if tracer is not None:
+                with self._tracer_lock:
+                    try:
+                        self._live_tracers.remove(tracer)
+                    except ValueError:
+                        pass
         wanted = set(cpids)
         entries = []
         for pid in range(n):
@@ -170,7 +208,38 @@ class WorkerRuntime:
                 if mid // MAP_ID_STRIDE in wanted:
                     entries.append([mid, pid, wslot, size, rows, ep])
         return ({"ok": True, "entries": entries,
-                 "shuffle": list(self.shuffle_server.address)}, b"")
+                 "shuffle": list(self.shuffle_server.address),
+                 **self._spans_field(tracer)}, b"")
+
+    def _spans_field(self, tracer) -> dict:
+        """Drain one fragment tracer into a reply-payload field (empty
+        dict when tracing is off — the obs package is untouched)."""
+        if tracer is None:
+            return {}
+        from spark_rapids_tpu.obs.trace import stamp_for_shipping
+        evs = stamp_for_shipping(tracer.drain_events(),
+                                 tracer._wall_origin, os.getpid())
+        if not evs:
+            return {}
+        return {"spans": {"pid": os.getpid(),
+                          "events": evs[-_MAX_SHIP_EVENTS:]}}
+
+    def _drain_live_spans(self) -> "dict | None":
+        """Heartbeat payload: whatever the in-flight fragments have
+        buffered since the last beat (exactly-once shipping — drain
+        pops)."""
+        with self._tracer_lock:
+            tracers = list(self._live_tracers)
+        if not tracers:
+            return None
+        from spark_rapids_tpu.obs.trace import stamp_for_shipping
+        evs: list = []
+        for t in tracers:
+            evs.extend(stamp_for_shipping(t.drain_events(),
+                                          t._wall_origin, os.getpid()))
+        if not evs:
+            return None
+        return {"pid": os.getpid(), "events": evs[-_MAX_SHIP_EVENTS:]}
 
     # -- liveness -------------------------------------------------------
     def start_heartbeat(self) -> None:
@@ -186,10 +255,13 @@ class WorkerRuntime:
         from spark_rapids_tpu.obs.registry import get_registry
         while not self._stop.wait(self._hb_interval):
             try:
-                rpc_call(self.driver, "heartbeat",
-                         {"worker_id": self.worker_id,
-                          "pid": os.getpid(),
-                          "metrics": get_registry().snapshot()},
+                payload = {"worker_id": self.worker_id,
+                           "pid": os.getpid(),
+                           "metrics": get_registry().snapshot()}
+                spans = self._drain_live_spans()
+                if spans is not None:
+                    payload["spans"] = spans
+                rpc_call(self.driver, "heartbeat", payload,
                          conf=self.conf, retries=0, timeout=5.0)
             except (ConnectionError, OSError):
                 # driver unreachable: keep trying — the driver's timeout

@@ -442,9 +442,11 @@ class SharedJit:
                         self._sigs.add(sig)  # purge cleared it
                 return self.fn(*args, **kwargs)
         finally:
+            elapsed = time.perf_counter() - t0
             reg = get_registry()
             reg.inc("compile_count")
-            reg.inc("compile_wall_s", time.perf_counter() - t0)
+            reg.inc("compile_wall_s", elapsed)
+            reg.observe("compile.wall_seconds", elapsed)
 
 
 def instrument(fn) -> SharedJit:

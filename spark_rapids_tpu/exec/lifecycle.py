@@ -248,13 +248,26 @@ class QueryLifecycle:
                 if self.timeout is not None:
                     self._deadline = self._started_at + self.timeout
 
+    def _observe_wall(self) -> None:
+        """Record the query's wall time into the latency histograms at
+        its FIRST terminal transition (queries cancelled while still
+        queued never started — no wall to record)."""
+        started = self._started_at
+        if started is None:
+            return
+        wall = time.monotonic() - started
+        reg = get_registry()
+        reg.observe("query.wall_seconds", wall)
+        reg.observe(f"query.tenant.{self.tenant}.wall_seconds", wall)
+
     def finish(self) -> bool:
         """RUNNING -> FINISHED (no-op once terminal)."""
         with self._lock:
             if self._state in TERMINAL_STATES:
                 return False
             self._state = FINISHED
-            return True
+        self._observe_wall()
+        return True
 
     def fail(self) -> bool:
         """RUNNING -> FAILED on a non-lifecycle error (no-op once
@@ -263,7 +276,8 @@ class QueryLifecycle:
             if self._state in TERMINAL_STATES:
                 return False
             self._state = FAILED
-            return True
+        self._observe_wall()
+        return True
 
     def cancel(self, reason: str = "cancelled") -> bool:
         """Request cooperative cancellation.  Idempotent: only the
@@ -277,6 +291,7 @@ class QueryLifecycle:
             self._cancel_reason = reason
         self.cancel_event.set()
         get_registry().inc("queries_cancelled")
+        self._observe_wall()
         return True
 
     def _expire(self) -> bool:
@@ -286,6 +301,7 @@ class QueryLifecycle:
             self._state = DEADLINE_EXCEEDED
         self.cancel_event.set()
         get_registry().inc("queries_deadline_exceeded")
+        self._observe_wall()
         return True
 
     # -- cooperative checkpoints -------------------------------------------
@@ -541,6 +557,7 @@ class AdmissionController:
         counted once as ``queries_cancelled`` by the cancel itself,
         never as a rejection."""
         reg = get_registry()
+        t_admit = time.monotonic()
         tmo = self.queue_timeout if timeout is None else timeout
         faults = self.faults
         if faults is not None:
@@ -570,12 +587,16 @@ class AdmissionController:
                                    "session is shutting down")
             if self.max_concurrent <= 0:
                 self._admitted_locked(st, query_id)
+                reg.observe("admission.queue_wait_seconds",
+                            time.monotonic() - t_admit)
                 return
             if self._active < self.max_concurrent \
                     and not any(t.queue for t in self._tenants.values()) \
                     and (st.max_concurrent <= 0
                          or st.active < st.max_concurrent):
                 self._admitted_locked(st, query_id)
+                reg.observe("admission.queue_wait_seconds",
+                            time.monotonic() - t_admit)
                 return
             if len(st.queue) >= self.max_queued:
                 raise self._reject(
@@ -608,6 +629,8 @@ class AdmissionController:
                         st.queue.remove(me)
                         self._admitted_locked(st, query_id)
                         admitted = True
+                        reg.observe("admission.queue_wait_seconds",
+                                    time.monotonic() - t_admit)
                         return
                     rem = None if deadline is None \
                         else deadline - time.monotonic()

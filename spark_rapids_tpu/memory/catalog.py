@@ -22,8 +22,10 @@ views of the slice.
 from __future__ import annotations
 
 import errno
+import functools
 import os
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Any
@@ -61,6 +63,22 @@ class SpillCorruptionError(RuntimeError):
 
 def _sidecar(path: str) -> str:
     return path + ".crc"
+
+
+def _timed_spill(fn):
+    """Record each spill/unspill movement's wall time in the
+    ``spill.io_seconds`` histogram (failures included: a slow corrupt
+    read-back is still I/O the query waited on)."""
+    @functools.wraps(fn)
+    def inner(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            from spark_rapids_tpu.obs.registry import get_registry
+            get_registry().observe("spill.io_seconds",
+                                   time.perf_counter() - t0)
+    return inner
 
 
 def _write_sidecar(path: str, value: int, nbytes: int) -> None:
@@ -412,6 +430,7 @@ class BufferCatalog:
                 f"decompression failed ({type(ex).__name__}: {ex}); "
                 "storage dropped") from ex
 
+    @_timed_spill
     def _spill_one_to_host_locked(self, e: _Entry) -> None:
         self._check_cancel()
         leaves, treedef = jax.tree_util.tree_flatten(e.batch)
@@ -476,6 +495,7 @@ class BufferCatalog:
         self._gov_account(-e.size)
         self.metrics["device_spills"] += 1
 
+    @_timed_spill
     def _spill_host_one_locked(self) -> bool:
         """Move one host-tier buffer to disk; False if none exist."""
         self._check_cancel()
@@ -540,6 +560,7 @@ class BufferCatalog:
         return True
 
     # -- unspill ---------------------------------------------------------
+    @_timed_spill
     def _unspill_locked(self, e: _Entry) -> None:
         import jax.numpy as jnp
         self._check_cancel()

@@ -15,7 +15,13 @@ headless engine unifies its equivalents here:
   snapshot/delta semantics and JSON + Prometheus-text exposition.
 * ``obs.diag``     — bounded diagnostic bundles emitted on query failure
   (annotated plan, metrics snapshot, last span events, fault config,
-  catalog tier occupancy).
+  catalog tier occupancy, recent query-history tail).
+* ``obs.http``     — stdlib-only live metrics endpoint (/metrics in
+  Prometheus text, /healthz, /queries) bound to 127.0.0.1, owned by the
+  session and off by default (``spark.rapids.obs.http.port``).
+* ``obs.history``  — append-only JSONL query history log with atomic
+  rotation (``spark.rapids.obs.history.dir``), browsed offline by
+  ``python -m tools.history``.
 
 Import discipline: the hot path must stay obs-free when observability is
 disabled, so this package __init__ resolves submodule attributes LAZILY
@@ -26,7 +32,8 @@ disabled path leaves them out of sys.modules).
 from __future__ import annotations
 
 __all__ = ["Tracer", "MetricsRegistry", "get_registry",
-           "query_metrics_snapshot", "maybe_emit_bundle"]
+           "query_metrics_snapshot", "maybe_emit_bundle",
+           "ObsHttpServer", "QueryHistoryLog", "history_log"]
 
 _LAZY = {
     "Tracer": ("spark_rapids_tpu.obs.trace", "Tracer"),
@@ -35,6 +42,9 @@ _LAZY = {
     "query_metrics_snapshot": ("spark_rapids_tpu.obs.registry",
                                "query_metrics_snapshot"),
     "maybe_emit_bundle": ("spark_rapids_tpu.obs.diag", "maybe_emit_bundle"),
+    "ObsHttpServer": ("spark_rapids_tpu.obs.http", "ObsHttpServer"),
+    "QueryHistoryLog": ("spark_rapids_tpu.obs.history", "QueryHistoryLog"),
+    "history_log": ("spark_rapids_tpu.obs.history", "history_log"),
 }
 
 

@@ -167,6 +167,16 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
         bundle["catalog"] = _catalog_view(ctx)
         bundle["lifecycle"] = _lifecycle_view(ctx)
         try:
+            # recent query history: was this failure the first of a streak,
+            # or query N of a tenant that has been failing all morning?
+            hist_dir = ctx.conf.settings.get("spark.rapids.obs.history.dir")
+            if hist_dir:
+                from .history import read_history_tail
+                bundle["history_tail"] = read_history_tail(hist_dir)
+        # enginelint: disable=RL001 (history tail is best-effort; section omitted)
+        except Exception:
+            pass
+        try:
             bundle["conf"] = {k: v for k, v in ctx.conf.settings.items()
                               if str(k).startswith("spark.")}
         # enginelint: disable=RL001 (conf snapshot is best-effort; section left empty)

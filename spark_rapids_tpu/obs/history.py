@@ -1,0 +1,158 @@
+"""Persistent query history: the engine's Spark-history-server analog.
+
+Every query that reaches a lifecycle terminal state appends ONE JSON
+line to ``<dir>/query_history.jsonl`` — plan fingerprint, analyzed plan,
+tenant, wall time, registry delta (counters + histogram movement),
+cache/AQE decisions, and the failure taxonomy when it failed — so
+post-hoc forensics ("what ran at 3am and why was p99 bad") survive the
+process, the way the reference ecosystem leans on the Spark history
+server + event log (PAPER.md §L3).
+
+Durability/bounds: append is a single ``write()`` of one line on a
+line-buffered handle under a lock; rotation past
+``spark.rapids.obs.history.maxEntries`` keeps the newest entries by
+rewriting to a temp file and ``os.replace`` (atomic on POSIX — readers
+see the old or the new file, never a torn one).
+
+Import discipline: the session gates on the raw conf string, so with
+``spark.rapids.obs.history.dir`` unset this module is never imported
+(ci/premerge.sh asserts it).  ``python -m tools.history`` reads the log
+with NO engine imports at all.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from spark_rapids_tpu.conf import ConfEntry, register
+
+__all__ = ["HISTORY_DIR", "HISTORY_MAX", "QueryHistoryLog", "history_log",
+           "read_entries", "read_history_tail", "HISTORY_FILE"]
+
+HISTORY_DIR = register(ConfEntry(
+    "spark.rapids.obs.history.dir", "",
+    "When set, every query reaching a lifecycle terminal state appends "
+    "one JSON line (plan fingerprint, analyzed plan, tenant, wall, "
+    "registry delta, failure taxonomy) to <dir>/query_history.jsonl; "
+    "inspect with `python -m tools.history`. Empty (default): no "
+    "history, no overhead (the module is never imported)."))
+HISTORY_MAX = register(ConfEntry(
+    "spark.rapids.obs.history.maxEntries", 512,
+    "History log rotation bound: once the log exceeds this many "
+    "entries it is atomically rewritten keeping the newest ones.",
+    conv=int))
+
+HISTORY_FILE = "query_history.jsonl"
+
+
+class QueryHistoryLog:
+    """Append-only bounded JSONL log, safe for concurrent appenders in
+    one process (lock) and for concurrent readers across processes
+    (atomic rotation via ``os.replace``)."""
+
+    def __init__(self, directory: str, max_entries: int = 512):
+        self.dir = directory
+        self.path = os.path.join(directory, HISTORY_FILE)
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._count: int | None = None  # lazily counted on first append
+
+    def _count_locked(self) -> int:
+        if self._count is None:
+            n = 0
+            try:
+                with open(self.path, "rb") as f:
+                    for _ in f:
+                        n += 1
+            except FileNotFoundError:
+                pass
+            self._count = n
+        return self._count
+
+    def append(self, entry: dict) -> None:
+        line = json.dumps(entry, sort_keys=True, default=str)
+        with self._lock:
+            os.makedirs(self.dir, exist_ok=True)
+            self._count_locked()
+            with open(self.path, "ab") as f:
+                # a crash mid-append can leave a torn final line with no
+                # newline; terminate it first so THIS entry stays parseable
+                # (the reader already skips the torn fragment)
+                if f.tell() > 0:
+                    with open(self.path, "rb") as r:
+                        r.seek(-1, os.SEEK_END)
+                        if r.read(1) != b"\n":
+                            f.write(b"\n")
+                f.write(line.encode("utf-8") + b"\n")
+                f.flush()
+            self._count += 1
+            if self._count > self.max_entries:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        with open(self.path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        keep = lines[-self.max_entries:]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.writelines(keep)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._count = len(keep)
+
+    def entries(self, last: int | None = None) -> list[dict]:
+        return read_entries(self.path, last=last)
+
+
+def read_entries(path: str, last: int | None = None) -> list[dict]:
+    """Parse the log, newest last; torn/garbage lines are skipped (a
+    crash mid-append must not poison forensics of every other query)."""
+    out: list[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except FileNotFoundError:
+        return []
+    return out if last is None else out[-last:]
+
+
+def read_history_tail(directory: str, last: int = 16) -> list[dict]:
+    """Bounded newest-entries summary for diag bundles: one compact
+    dict per query, heavy fields (analyzed plan, registry delta)
+    dropped."""
+    tail = read_entries(os.path.join(directory, HISTORY_FILE), last=last)
+    out = []
+    for e in tail:
+        out.append({k: e.get(k) for k in
+                    ("query_id", "state", "tenant", "wall_s",
+                     "submitted_unix_s", "plan_fingerprint", "error")
+                    if e.get(k) is not None})
+    return out
+
+
+_logs: dict[tuple[str, int], QueryHistoryLog] = {}
+_logs_lock = threading.Lock()
+
+
+def history_log(conf) -> "QueryHistoryLog | None":
+    """Process-wide per-directory singleton (two sessions pointed at
+    one dir share a lock and a rotation count)."""
+    settings = getattr(conf, "settings", None) or {}
+    d = HISTORY_DIR.get(settings)
+    if not d:
+        return None
+    key = (os.path.abspath(d), HISTORY_MAX.get(settings))
+    with _logs_lock:
+        log = _logs.get(key)
+        if log is None:
+            log = _logs[key] = QueryHistoryLog(key[0], key[1])
+        return log

@@ -1,0 +1,176 @@
+"""Live telemetry endpoint: a stdlib-only HTTP server owned by the
+session.
+
+The reference ecosystem operates through the Spark UI + a Prometheus
+sink (PAPER.md §L3 GpuMetric plumbing); this headless engine exposes the
+same operational surface as three read-only routes:
+
+* ``/metrics`` — the process-wide :class:`MetricsRegistry` in Prometheus
+  text exposition (counters, gauges, and the latency histograms with
+  cumulative ``_bucket``/``_sum``/``_count`` series).
+* ``/healthz`` — liveness + readiness: admission state (active/queued /
+  shutting-down), memory-governor pressure, cluster worker liveness.
+  Returns 503 once the session begins shutdown — load balancers drain
+  on readiness, not liveness.
+* ``/queries`` — the in-flight query table (query_id -> lifecycle
+  state/tenant/tenant wall so far), the live analog of the history log.
+
+Security: binds 127.0.0.1 ONLY.  The registry carries operational
+detail (tenant names, peer addresses, plan fingerprints) that must not
+face a network; operators who need remote scrape should sidecar a real
+exporter.  Off by default (``spark.rapids.obs.http.port`` = 0) and the
+module is never imported on the disabled path (session gates on the raw
+conf string; ci/premerge.sh asserts sys.modules stays clean).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from spark_rapids_tpu.conf import ConfEntry, register
+from spark_rapids_tpu.obs.registry import get_registry
+
+__all__ = ["OBS_HTTP_PORT", "ObsHttpServer"]
+
+OBS_HTTP_PORT = register(ConfEntry(
+    "spark.rapids.obs.http.port", 0,
+    "TCP port for the live telemetry endpoint (/metrics Prometheus "
+    "text, /healthz, /queries), bound to 127.0.0.1 only. 0 (default): "
+    "no server, and the HTTP module is never imported.",
+    conv=int))
+
+_BIND_HOST = "127.0.0.1"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the protocol default (HTTP/1.0) closes per request; 1.1 lets a
+    # scraper keep its connection
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: ARG002 - silence stderr
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj) -> None:
+        self._reply(code, json.dumps(obj, indent=1, sort_keys=True,
+                                     default=str).encode(),
+                    "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        srv: "ObsHttpServer" = self.server.obs  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._reply(200, get_registry().to_prometheus().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                body = srv.health()
+                self._json(200 if body["status"] == "ok" else 503, body)
+            elif path == "/queries":
+                self._json(200, srv.queries())
+            else:
+                self._reply(404, b"not found: /metrics /healthz /queries\n",
+                            "text/plain")
+        except BrokenPipeError:  # scraper hung up mid-reply
+            pass
+        # enginelint: disable=RL001 (endpoint must never kill the engine)
+        except Exception as e:
+            try:
+                self._reply(500, f"{type(e).__name__}: {e}\n".encode(),
+                            "text/plain")
+            except OSError:
+                pass
+
+
+class ObsHttpServer:
+    """One telemetry server per :class:`TpuSession`, 127.0.0.1-bound.
+
+    ``port=0`` binds an ephemeral port (tests); the session itself
+    treats conf port 0 as "off" and never constructs one."""
+
+    def __init__(self, session, port: int):
+        self._session = session
+        self._server = ThreadingHTTPServer((_BIND_HOST, port), _Handler)
+        self._server.daemon_threads = True
+        self._server.obs = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="obs-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"http://{_BIND_HOST}:{self.port}"
+
+    # -- route bodies (also the programmatic surface for tests) --------
+    def health(self) -> dict:
+        s = self._session
+        adm = s._admission_controller()
+        out: dict = {
+            "status": "draining" if adm.shutting_down else "ok",
+            "unix_s": time.time(),
+            "admission": {"active": adm.active, "queued": adm.queued,
+                          "shutting_down": adm.shutting_down},
+        }
+        try:
+            from spark_rapids_tpu.memory.governor import (GOVERNOR_ENABLED,
+                                                          get_governor)
+            if GOVERNOR_ENABLED.get(s.conf.settings):
+                gov = get_governor()
+                out["governor"] = {
+                    "reserved_bytes": gov.reserved_bytes(),
+                    "pressure": gov.admission_pressure(),
+                }
+        # enginelint: disable=RL001 (health must degrade, not fail — the error string is the report)
+        except Exception as e:
+            out["governor"] = {"error": f"{type(e).__name__}: {e}"}
+        cluster = getattr(s, "_cluster_handle", None)
+        if cluster is not None:
+            workers = []
+            now = time.monotonic()
+            for h in cluster.workers():
+                workers.append({
+                    "worker_id": h.worker_id, "pid": h.pid,
+                    "alive": h.alive, "lost_reason": h.lost_reason,
+                    "heartbeat_age_s": (
+                        None if not h.last_heartbeat
+                        else round(now - h.last_heartbeat, 3)),
+                })
+            out["cluster"] = {"workers": workers}
+            if any(not w["alive"] for w in workers) \
+                    and out["status"] == "ok":
+                out["status"] = "degraded"
+        return out
+
+    def queries(self) -> dict:
+        s = self._session
+        with s._lc_cond:
+            live = dict(s._live)
+        now = time.monotonic()
+        out = {}
+        for qid, lc in live.items():
+            started = lc._started_at
+            out[qid] = {
+                "state": lc.state,
+                "tenant": lc.tenant,
+                "wall_s": (None if started is None
+                           else round(now - started, 3)),
+            }
+        return {"active": out, "count": len(out)}
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)

@@ -64,25 +64,200 @@ the executor), per-tenant ``admission.tenant.<t>.admitted`` /
 ``admission.tenant.<t>.rejected``, and ``admission_pressure_spared``
 (pressure sheds skipped because the arriving tenant was under its
 weighted share — exec/lifecycle.py).
+
+Beyond counters and gauges the registry carries log-bucketed
+**histograms** (``observe``) for the hot latency distributions the
+serving tier's SLOs are defined by: ``query.wall_seconds`` (plus
+per-tenant ``query.tenant.<t>.wall_seconds``), ``admission.queue_wait_seconds``,
+``shuffle.fetch.round_trip_seconds``, ``compile.wall_seconds``,
+``spill.io_seconds``, and ``cluster.rpc.round_trip_seconds`` — each
+observed at its existing chokepoint.  Histogram snapshots ride the same
+snapshot/delta plane as counters (worker heartbeats ship them; the
+driver merges them with :func:`merge_histogram_snapshots`), and
+``to_prometheus`` renders the standard cumulative
+``_bucket``/``_sum``/``_count`` exposition.
 """
 from __future__ import annotations
 
+import bisect
 import json
 import re
 import threading
 import weakref
 
-_SAN = re.compile(r"[^a-zA-Z0-9_:]")
+_SAN = re.compile(r"[^a-zA-Z0-9_]")
+
+#: dotted metric names that ENCODE a label in the name: rendered as one
+#: Prometheus family with a proper label instead of one invalid family
+#: per tenant/point/peer.  (pattern, family template, label name) —
+#: ``val`` is the label value, ``leaf`` the trailing metric leaf.
+_LABELED = (
+    (re.compile(r"^admission\.tenant\.(?P<val>.+)\.(?P<leaf>admitted|rejected)$"),
+     "admission_tenant_{leaf}", "tenant"),
+    (re.compile(r"^query\.tenant\.(?P<val>.+)\.(?P<leaf>wall_seconds)$"),
+     "query_tenant_{leaf}", "tenant"),
+    (re.compile(r"^faults\.injected\.(?P<val>.+)$"),
+     "faults_injected", "point"),
+    (re.compile(r"^shuffle\.peer\.(?P<val>.+)\.(?P<leaf>[A-Za-z0-9_]+)$"),
+     "shuffle_peer_{leaf}", "peer"),
+    (re.compile(r"^shuffle\.breaker\.(?P<val>.+)\.(?P<leaf>failures|open)$"),
+     "shuffle_breaker_{leaf}", "peer"),
+)
+
+
+def _series_parts(name: str) -> "tuple[str, str | None]":
+    """(family, label) for one dotted metric name; label is a rendered
+    ``key="value"`` pair (escaped) or None for plain names."""
+    for pat, fam, label in _LABELED:
+        m = pat.match(name)
+        if m is None:
+            continue
+        gd = m.groupdict()
+        family = _SAN.sub("_", fam.format(leaf=gd.get("leaf", "")))
+        val = gd["val"].replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+        return family, f'{label}="{val}"'
+    return _SAN.sub("_", name), None
+
+
+# -- histograms -------------------------------------------------------------
+
+#: default log-bucketed boundaries: 1ms doubling up to ~35 minutes —
+#: wide enough for spill I/O at the bottom and stuck cluster RPCs at
+#: the top.  Every histogram in the process shares these bounds, so
+#: cross-process snapshot merges are bucket-aligned by construction.
+_DEFAULT_BOUNDS = tuple(0.001 * (2.0 ** i) for i in range(22))
+
+
+def empty_histogram_snapshot(bounds=_DEFAULT_BOUNDS) -> dict:
+    le = [float(b) for b in bounds]
+    return {"le": le, "counts": [0] * (len(le) + 1), "sum": 0.0,
+            "count": 0}
+
+
+def histogram_percentile(snap: "dict | None", q: float) -> "float | None":
+    """Estimate the q-th percentile (q in (0, 100]) from a histogram
+    snapshot by linear interpolation inside the covering bucket.
+    Monotone in q by construction; None for an empty histogram."""
+    if not snap or not snap.get("count"):
+        return None
+    le = snap["le"]
+    counts = snap["counts"]
+    target = (q / 100.0) * snap["count"]
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            lo = le[i - 1] if i > 0 else 0.0
+            # the +Inf bucket has no upper bound: report its lower edge
+            hi = le[i] if i < len(le) else le[-1]
+            return lo + (hi - lo) * max(0.0, min(1.0, (target - cum) / c))
+        cum += c
+    return float(le[-1])
+
+
+def merge_histogram_snapshots(a: "dict | None",
+                              b: "dict | None") -> dict:
+    """Combine two snapshots (worker heartbeat deltas, partial buffers
+    from a worker that died mid-run).  Either side may be None/empty —
+    an empty delta is inert.  Mismatched bucket bounds (a worker on an
+    older build) are re-bucketed conservatively by upper bound."""
+    if not a or not a.get("count"):
+        return dict(b) if b and b.get("count") \
+            else empty_histogram_snapshot((a or b or {}).get(
+                "le", _DEFAULT_BOUNDS))
+    if not b or not b.get("count"):
+        return dict(a)
+    le = list(a["le"])
+    counts = list(a["counts"])
+    if list(b["le"]) == le:
+        counts = [x + y for x, y in zip(counts, b["counts"])]
+    else:
+        for j, c in enumerate(b["counts"]):
+            if not c:
+                continue
+            if j < len(b["le"]):
+                i = bisect.bisect_left(le, float(b["le"][j]))
+            else:
+                i = len(le)
+            counts[i] += c
+    return {"le": le, "counts": counts,
+            "sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+
+def delta_histogram_snapshot(cur: dict,
+                             prev: "dict | None") -> "dict | None":
+    """Per-bucket movement since ``prev``; None when no new samples
+    landed (so empty deltas disappear instead of accumulating)."""
+    if prev is None or list(prev.get("le", ())) != list(cur["le"]):
+        prev = empty_histogram_snapshot(cur["le"])
+    moved = cur["count"] - prev.get("count", 0)
+    if moved <= 0:
+        return None
+    return {"le": list(cur["le"]),
+            "counts": [max(0, c - p) for c, p in
+                       zip(cur["counts"], prev["counts"])],
+            "sum": max(0.0, cur["sum"] - prev.get("sum", 0.0)),
+            "count": moved}
+
+
+class Histogram:
+    """Thread-safe log-bucketed latency histogram.
+
+    Fixed bucket boundaries (``_DEFAULT_BOUNDS`` unless given) keep
+    ``observe`` at one bisect + three adds, make snapshots mergeable
+    across processes, and render directly as the Prometheus cumulative
+    ``_bucket{le=...}`` exposition."""
+
+    __slots__ = ("le", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds=None):
+        self.le = tuple(float(b) for b in (bounds or _DEFAULT_BOUNDS))
+        self._counts = [0] * (len(self.le) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.le, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"le": list(self.le), "counts": list(self._counts),
+                    "sum": self._sum, "count": self._count}
+
+    def percentile(self, q: float) -> "float | None":
+        return histogram_percentile(self.snapshot(), q)
+
+    def merge_snapshot(self, snap: "dict | None") -> None:
+        """Fold a shipped snapshot (another process's delta) into this
+        histogram; an empty/None snapshot is a no-op."""
+        if not snap or not snap.get("count"):
+            return
+        with self._lock:
+            cur = {"le": list(self.le), "counts": list(self._counts),
+                   "sum": self._sum, "count": self._count}
+            merged = merge_histogram_snapshots(cur, snap)
+            self._counts = list(merged["counts"])
+            self._sum = merged["sum"]
+            self._count = merged["count"]
 
 
 class MetricsRegistry:
-    """Thread-safe counters + gauges + pull sources."""
+    """Thread-safe counters + gauges + histograms + pull sources."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._sources: dict[str, object] = {}
+        self._histograms: dict[str, Histogram] = {}
 
     # -- write side --------------------------------------------------------
 
@@ -93,6 +268,21 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+
+    def histogram(self, name: str, bounds=None) -> Histogram:
+        """Get-or-create the named histogram (bounds only apply on
+        first creation; everyone after shares the instance)."""
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.get(name)
+                if h is None:
+                    h = self._histograms[name] = Histogram(bounds)
+        return h
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one latency sample into the named histogram."""
+        self.histogram(name).observe(value)
 
     def register_source(self, name: str, fn) -> None:
         """``fn() -> dict[str, number]``; folded into snapshots under
@@ -128,6 +318,7 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             sources = list(self._sources.items())
+            hists = list(self._histograms.items())
         for name, fn in sources:
             try:
                 vals = fn()
@@ -139,11 +330,14 @@ class MetricsRegistry:
             for k, v in vals.items():
                 if isinstance(v, (int, float)):
                     gauges[f"{name}.{k}"] = v
-        return {"counters": counters, "gauges": gauges}
+        return {"counters": counters, "gauges": gauges,
+                "histograms": {n: h.snapshot() for n, h in hists}}
 
     def delta(self, prev: dict) -> dict:
-        """Counter movement since ``prev`` (a prior ``snapshot()``);
-        gauges are point-in-time and reported as-is."""
+        """Counter and histogram movement since ``prev`` (a prior
+        ``snapshot()``); gauges are point-in-time and reported as-is.
+        Histograms with no new samples are omitted — an empty delta is
+        inert (it merges to nothing on the other side)."""
         cur = self.snapshot()
         before = prev.get("counters", {}) if prev else {}
         moved = {}
@@ -151,31 +345,70 @@ class MetricsRegistry:
             d = v - before.get(k, 0)
             if d:
                 moved[k] = d
-        return {"counters": moved, "gauges": cur["gauges"]}
+        hbefore = prev.get("histograms", {}) if prev else {}
+        hmoved = {}
+        for k, snap in cur.get("histograms", {}).items():
+            d = delta_histogram_snapshot(snap, hbefore.get(k))
+            if d is not None:
+                hmoved[k] = d
+        return {"counters": moved, "gauges": cur["gauges"],
+                "histograms": hmoved}
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
 
     def to_prometheus(self, prefix: str = "srt_") -> str:
-        """Standard Prometheus text exposition (version 0.0.4)."""
+        """Standard Prometheus text exposition (version 0.0.4).
+
+        Metric names are sanitized to ``[a-zA-Z0-9_]``; dotted names
+        that encode a tenant/point/peer (``admission.tenant.<t>.admitted``,
+        ``faults.injected.<point>``, ``shuffle.peer.<addr>.*``) become
+        one family with a proper label.  Histograms render as the
+        cumulative ``_bucket{le=...}``/``_sum``/``_count`` triple."""
         snap = self.snapshot()
         lines = []
         for kind, bucket in (("counter", snap["counters"]),
                              ("gauge", snap["gauges"])):
+            fams: dict = {}
             for name in sorted(bucket):
-                metric = prefix + _SAN.sub("_", name)
-                lines.append(f"# TYPE {metric} {kind}")
+                family, label = _series_parts(name)
+                metric = prefix + family
                 v = bucket[name]
-                lines.append(f"{metric} {v:g}" if isinstance(v, float)
-                             else f"{metric} {v}")
+                val = f"{v:g}" if isinstance(v, float) else str(v)
+                series = f"{metric}{{{label}}} {val}" if label \
+                    else f"{metric} {val}"
+                fams.setdefault(metric, []).append(series)
+            for metric in sorted(fams):
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.extend(fams[metric])
+        hfams: dict = {}
+        for name in sorted(snap.get("histograms", {})):
+            family, label = _series_parts(name)
+            hfams.setdefault(prefix + family, []).append(
+                (label, snap["histograms"][name]))
+        for metric in sorted(hfams):
+            lines.append(f"# TYPE {metric} histogram")
+            for label, h in hfams[metric]:
+                lbl = f"{label}," if label else ""
+                suffix = f"{{{label}}}" if label else ""
+                cum = 0
+                for bound, c in zip(h["le"], h["counts"]):
+                    cum += c
+                    lines.append(
+                        f'{metric}_bucket{{{lbl}le="{bound:g}"}} {cum}')
+                cum += h["counts"][-1]
+                lines.append(f'{metric}_bucket{{{lbl}le="+Inf"}} {cum}')
+                lines.append(f"{metric}_sum{suffix} {h['sum']:g}")
+                lines.append(f"{metric}_count{suffix} {h['count']}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
-        """Test hook: drop all counters/gauges/sources."""
+        """Test hook: drop all counters/gauges/sources/histograms."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._sources.clear()
+            self._histograms.clear()
 
 
 _REGISTRY = MetricsRegistry()

@@ -93,6 +93,11 @@ class Tracer:
         self._origin = time.perf_counter()
         self._wall_origin = time.time()
         self.pid = os.getpid()
+        # pid -> ph="M" process_name metadata, kept OUTSIDE the bounded
+        # deque so lane names survive event-buffer rotation; prepended
+        # at export (Perfetto reads metadata in any position, but names
+        # must not be evictable)
+        self._lanes: dict[int, dict] = {}
         # terminal lifecycle state (CANCELLED / DEADLINE_EXCEEDED / ...)
         # stamped by the query root when the run ends abnormally; carried
         # in the export header so a trace says WHY it ends early
@@ -184,11 +189,48 @@ class Tracer:
         """Record the query's terminal lifecycle state (exec/lifecycle)."""
         self.query_state = state
 
+    # -- cluster aggregation ----------------------------------------------
+
+    def ensure_lane(self, pid: int, name: str) -> None:
+        """Name a process lane (driver, each worker) with a ph="M"
+        process_name metadata record — ONE Perfetto timeline then shows
+        every process's spans on its own labelled track."""
+        with self._lock:
+            if pid in self._lanes:
+                return
+            self._lanes[pid] = {
+                "name": "process_name", "cat": "__metadata", "ph": "M",
+                "pid": pid, "tid": 0, "ts": 0,
+                "args": {**self._base_args(next(self._ids), None),
+                         "name": name},
+            }
+
+    def drain_events(self) -> list[dict]:
+        """Pop and return every buffered event (oldest first).  Used by
+        cluster workers to ship spans incrementally on heartbeats and
+        fragment completion — an event is shipped exactly once."""
+        with self._lock:
+            evs = list(self._events)
+            self._events.clear()
+        return evs
+
+    def ingest_wall(self, events: list[dict]) -> None:
+        """Merge events whose ``ts`` is ABSOLUTE wall-clock µs (see
+        :func:`stamp_for_shipping`) into this tracer's buffer, rebased
+        onto its own origin so driver and worker spans share one
+        timeline.  Clock skew between processes on one host is bounded
+        by NTP-free time.time() drift — microseconds over a query."""
+        base = self._wall_origin * 1e6
+        for ev in events:
+            ev = dict(ev)
+            ev["ts"] = ev.get("ts", 0.0) - base
+            self._push(ev)
+
     # -- export ------------------------------------------------------------
 
     def events_snapshot(self, last: int | None = None) -> list[dict]:
         with self._lock:
-            evs = list(self._events)
+            evs = list(self._lanes.values()) + list(self._events)
         if last is not None and last >= 0:
             evs = evs[-last:]
         return evs
@@ -221,3 +263,21 @@ class Tracer:
         if sid is not None:
             hdr["span_id"] = sid
         return hdr
+
+
+def stamp_for_shipping(events: list[dict], wall_origin: float,
+                       pid: int) -> list[dict]:
+    """Prepare drained events for cross-process shipping: rewrite each
+    ``ts`` from tracer-origin-relative µs to ABSOLUTE wall-clock µs
+    (``wall_origin`` is the shipping tracer's ``_wall_origin``) and
+    stamp the shipping process's pid, so the receiving driver can rebase
+    onto ITS origin (:meth:`Tracer.ingest_wall`) and keep per-worker
+    lanes distinct."""
+    base = wall_origin * 1e6
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        ev["ts"] = ev.get("ts", 0.0) + base
+        ev["pid"] = pid
+        out.append(ev)
+    return out

@@ -47,6 +47,13 @@ class TpuSession:
         self._live: dict = {}        # query_id -> QueryLifecycle
         self._admission = None       # built lazily from the live conf
         self._cluster_handle = None  # ClusterDriver, lazily spawned
+        self._http = None            # ObsHttpServer when the conf is on
+        # raw-settings gated: with the port conf absent/0 (the default)
+        # obs.http is never imported (premerge asserts sys.modules)
+        port = self.conf.settings.get("spark.rapids.obs.http.port")
+        if port and int(port) > 0:
+            from spark_rapids_tpu.obs.http import ObsHttpServer
+            self._http = ObsHttpServer(self, int(port))
 
     # -- query lifecycle (exec/lifecycle.py) ---------------------------
     def _admission_controller(self):
@@ -129,6 +136,10 @@ class TpuSession:
             cluster, self._cluster_handle = self._cluster_handle, None
         if cluster is not None:
             cluster.shutdown()
+        http, self._http = self._http, None
+        if http is not None:
+            # torn down LAST so /healthz reports "draining" throughout
+            http.close()
 
     def _wait_idle(self, timeout: float | None) -> bool:
         import time as _time
@@ -189,6 +200,17 @@ class TpuSession:
             lc.finish()
             return out
 
+        # raw-settings gated: with history.dir unset (the default)
+        # obs.history is never imported (premerge asserts sys.modules)
+        hist_dir = self.conf.settings.get("spark.rapids.obs.history.dir")
+        hist_before = None
+        submitted = None
+        if hist_dir:
+            import time as _time
+            from spark_rapids_tpu.obs.registry import get_registry
+            hist_before = get_registry().snapshot()
+            submitted = _time.time()
+        err: BaseException | None = None
         try:
             rcache = None
             key = None
@@ -207,12 +229,92 @@ class TpuSession:
                     key, run, lifecycle=lc, faults=admission.faults)
                 lc.finish()
             return out
+        except BaseException as e:
+            err = e
+            raise
         finally:
+            if hist_dir:
+                self._record_history(lc, node, logical, err,
+                                     hist_before, submitted)
             with self._lc_cond:
                 self._live.pop(query_id, None)
                 self._lc_cond.notify_all()
             if admitted:
                 admission.release(tenant=lc.tenant)
+
+    def _record_history(self, lc, node, logical, err,
+                        before: dict, submitted: float) -> None:
+        """Append this query's terminal record to the history log
+        (obs/history.py).  Forensics must never fail the query: any
+        error here is swallowed after best-effort assembly."""
+        # enginelint: disable=RL001 (history is best-effort forensics)
+        try:
+            import time as _time
+            from spark_rapids_tpu.exec.lifecycle import (TERMINAL_STATES,
+                                                         QueryRejected)
+            from spark_rapids_tpu.obs.history import history_log
+            from spark_rapids_tpu.obs.registry import get_registry
+            log = history_log(self.conf)
+            if log is None:
+                return
+            state = lc.state
+            if state not in TERMINAL_STATES:
+                state = "REJECTED" if isinstance(err, QueryRejected) \
+                    else ("FAILED" if err is not None else state)
+            started = lc._started_at
+            delta = get_registry().delta(before)
+            counters = delta.get("counters", {})
+            entry: dict = {
+                "kind": "history", "version": 1,
+                "query_id": lc.query_id,
+                "tenant": lc.tenant,
+                "state": state,
+                "submitted_unix_s": submitted,
+                "wall_s": (None if started is None
+                           else round(_time.monotonic() - started, 6)),
+                "registry_delta": {
+                    "counters": counters,
+                    "histograms": delta.get("histograms", {}),
+                },
+                "executed": bool(getattr(lc, "executed", False)),
+                "served_from_cache": (err is None
+                                      and not getattr(lc, "executed",
+                                                      False)),
+                "decisions": {k: v for k, v in counters.items()
+                              if k.startswith(("aqe", "result_cache",
+                                               "fragment_cache",
+                                               "compile_count"))},
+            }
+            if logical is not None:
+                from spark_rapids_tpu.exec.compile_cache import fingerprint
+                from spark_rapids_tpu.exec.result_cache import _plan_part
+                try:
+                    entry["plan_fingerprint"] = \
+                        fingerprint(_plan_part(logical))
+                # enginelint: disable=RL001 (fingerprint fallback only; the query's own error already propagated)
+                except Exception:
+                    # in-memory scans have no stable scan_fingerprint;
+                    # the structural repr is identity enough for diffing
+                    entry["plan_fingerprint"] = fingerprint(repr(logical))
+            ctx = getattr(lc, "ctx", None)
+            if ctx is not None:
+                try:
+                    from spark_rapids_tpu.plan.overrides import \
+                        explain_analyze
+                    entry["plan_analyzed"] = explain_analyze(node, ctx)
+                # enginelint: disable=RL001 (plan render is best-effort; the entry ships without it)
+                except Exception:
+                    pass  # a plan that failed mid-build may not render
+            if err is not None:
+                entry["error"] = {
+                    "type": type(err).__name__,
+                    "message": str(err)[:4096],
+                    "terminal": bool(getattr(err, "terminal", False)),
+                }
+            log.append(entry)
+        # enginelint: disable=RL001 (history recording must never mask the query's own outcome; the real error already propagated to the caller)
+        except Exception:
+            pass
 
     def _execute_collect(self, node, backend: str, query_id: str, lc):
         # the executor-entry chokepoint: a result-cache hit never gets
@@ -220,9 +322,11 @@ class TpuSession:
         # PROVES the executor was untouched (CI serving gate)
         from spark_rapids_tpu.obs.registry import get_registry
         get_registry().inc("queries_executed")
+        lc.executed = True  # vs a result-cache hit, which never gets here
 
         def make_ctx(be: str) -> ExecCtx:
             ctx = ExecCtx(backend=be, conf=self.conf)
+            lc.ctx = ctx  # history records explain_analyze post-run
             ctx.cache["query_id"] = query_id
             ctx.cache["lifecycle"] = lc
             if be == "device":

@@ -301,6 +301,7 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
     rng = random.Random(f"fetch:{peer}:{shuffle_id}:{part_id}")
     delivered = 0     # batches fully yielded downstream, across attempts
     failures = 0      # consecutive failed attempts with NO new batches
+    t_fetch = time.perf_counter()
     while True:
         if lifecycle is not None:
             lifecycle.check()
@@ -320,6 +321,10 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                 yield batch
                 delivered += 1
             breaker.record_success()
+            # round-trip covers the whole ladder (retries + backoff
+            # included): the latency the CONSUMER saw, not one socket
+            reg.observe("shuffle.fetch.round_trip_seconds",
+                        time.perf_counter() - t_fetch)
             return
         except ShuffleFetchError as e:
             if getattr(e, "terminal", False):

@@ -203,8 +203,24 @@ def test_prometheus_exposition_sanitized():
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
-        name = line.split()[0]
-        assert all(c.isalnum() or c in "_:" for c in name), line
+        name = line.split()[0].split("{", 1)[0]
+        assert all(c.isalnum() or c == "_" for c in name), line
+    # the peer address lands in a label, not the metric name
+    assert 'srt_shuffle_peer_bytes{peer="127.0.0.1:9999"} 5' in text
+
+
+def test_prometheus_tenant_and_fault_labels():
+    r = MetricsRegistry()
+    r.inc("admission.tenant.alpha.admitted", 3)
+    r.inc("admission.tenant.beta.rejected", 1)
+    r.inc("faults.injected.cluster.rpc.drop", 2)
+    r.inc("faults.injected", 2)
+    text = r.to_prometheus()
+    assert 'srt_admission_tenant_admitted{tenant="alpha"} 3' in text
+    assert 'srt_admission_tenant_rejected{tenant="beta"} 1' in text
+    assert 'srt_faults_injected{point="cluster.rpc.drop"} 2' in text
+    # the plain aggregate coexists in the same family under ONE TYPE line
+    assert text.count("# TYPE srt_faults_injected counter") == 1
 
 
 def test_breaker_gauges_exported():

@@ -1,0 +1,563 @@
+"""Cluster-wide telemetry: latency histograms, the live HTTP endpoint,
+merged worker traces, and the persistent query history log.
+
+Covers the ISSUE-15 observability plane end to end at unit scale:
+histogram merge across cluster worker snapshot deltas (a dead worker's
+last snapshot still counts; an empty delta is inert), the 127.0.0.1
+telemetry server's three routes, history-log rotation + torn-line
+tolerance + CI-schema conformance, and the cross-process trace lane
+machinery (stamp_for_shipping -> ingest_wall -> one export).
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.obs.registry import (MetricsRegistry,
+                                           delta_histogram_snapshot,
+                                           empty_histogram_snapshot,
+                                           histogram_percentile,
+                                           merge_histogram_snapshots)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+from validate_obs import load_schema, validate  # noqa: E402
+
+sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# histogram semantics
+# ---------------------------------------------------------------------------
+
+def _observe_all(reg, name, values):
+    for v in values:
+        reg.observe(name, v)
+
+
+def test_histogram_percentiles_monotone_and_bounded():
+    reg = MetricsRegistry()
+    values = [0.0005, 0.003, 0.01, 0.05, 0.2, 0.2, 1.5, 7.0]
+    _observe_all(reg, "h", values)
+    snap = reg.snapshot()["histograms"]["h"]
+    assert snap["count"] == len(values)
+    assert snap["sum"] == pytest.approx(sum(values))
+    ps = [histogram_percentile(snap, q) for q in (1, 25, 50, 75, 95, 99)]
+    assert ps == sorted(ps), "percentiles must be non-decreasing"
+    assert ps[0] >= 0.0
+    # p99 of values all <= 7.0 must not exceed the containing bucket
+    assert ps[-1] <= max(snap["le"]) * 2
+
+
+def test_histogram_merge_equals_union():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    u = MetricsRegistry()
+    va = [0.001, 0.02, 0.4, 3.0]
+    vb = [0.005, 0.005, 1.0]
+    _observe_all(a, "h", va)
+    _observe_all(b, "h", vb)
+    _observe_all(u, "h", va + vb)
+    merged = merge_histogram_snapshots(
+        a.snapshot()["histograms"]["h"], b.snapshot()["histograms"]["h"])
+    union = u.snapshot()["histograms"]["h"]
+    assert merged["counts"] == union["counts"]
+    assert merged["count"] == union["count"]
+    assert merged["sum"] == pytest.approx(union["sum"])
+    for q in (50, 95, 99):
+        assert histogram_percentile(merged, q) == pytest.approx(
+            histogram_percentile(union, q))
+
+
+def test_histogram_delta_none_when_unmoved():
+    reg = MetricsRegistry()
+    _observe_all(reg, "h", [0.1, 0.2])
+    snap = reg.snapshot()["histograms"]["h"]
+    assert delta_histogram_snapshot(snap, snap) is None
+    # vs a None/empty baseline the whole snapshot is the delta
+    d = delta_histogram_snapshot(snap, None)
+    assert d is not None and d["count"] == 2
+
+
+def test_histogram_merge_across_worker_snapshot_deltas():
+    """The driver-side cluster merge: each worker ships registry
+    snapshots on heartbeats; the cluster-wide distribution is the merge
+    of per-worker (current - baseline) deltas.  A worker that died
+    mid-run still contributes its last shipped snapshot, and the merged
+    percentiles stay monotone; a worker whose histogram never moved
+    contributes nothing."""
+    from spark_rapids_tpu.cluster.driver import ClusterDriver, WorkerHandle
+
+    def handle(wid, alive, baseline, current):
+        h = WorkerHandle.__new__(WorkerHandle)
+        h.worker_id, h.alive = wid, alive
+        h.baseline = {"histograms": baseline}
+        h.metrics = {"histograms": current}
+        return h
+
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    _observe_all(r0, "query.wall_seconds", [0.01, 0.05, 0.2])
+    base0 = r0.snapshot()["histograms"]
+    _observe_all(r0, "query.wall_seconds", [0.5, 2.0])
+    cur0 = r0.snapshot()["histograms"]
+    _observe_all(r1, "query.wall_seconds", [0.002, 0.004])
+    cur1 = r1.snapshot()["histograms"]
+
+    class _Fake:
+        def workers(self):
+            return self._h
+
+    fake = _Fake()
+    # w0 alive with movement since baseline; w1 DEAD after shipping its
+    # only snapshot (baseline empty); w2 alive but inert (cur == base)
+    fake._h = [
+        handle("w0", True, base0, cur0),
+        handle("w1", False, {}, cur1),
+        handle("w2", True, cur1, cur1),
+    ]
+    merged = ClusterDriver.merged_worker_histograms(fake)
+    h = merged["query.wall_seconds"]
+    # w0 delta (2 observations) + w1 full snapshot (2) = 4; w2 inert
+    assert h["count"] == 4
+    ps = [histogram_percentile(h, q) for q in (50, 90, 95, 99)]
+    assert ps == sorted(ps)
+    assert ps[0] > 0
+
+    # dropping the dead worker entirely only removes ITS observations
+    fake._h = fake._h[:1]
+    alone = ClusterDriver.merged_worker_histograms(fake)
+    assert alone["query.wall_seconds"]["count"] == 2
+
+    # all-inert cluster merges to nothing at all
+    fake._h = [handle("w2", True, cur1, cur1)]
+    assert ClusterDriver.merged_worker_histograms(fake) == {}
+
+
+def test_histogram_snapshot_matches_ci_schema():
+    reg = MetricsRegistry()
+    _observe_all(reg, "h", [0.1])
+    snap = reg.snapshot()["histograms"]["h"]
+    assert validate(snap, load_schema("histogram")) == []
+    assert validate(empty_histogram_snapshot(),
+                    load_schema("histogram")) == []
+
+
+def test_prometheus_histogram_exposition_cumulative():
+    reg = MetricsRegistry()
+    _observe_all(reg, "query.wall_seconds", [0.001, 0.02, 0.5, 3.0])
+    text = reg.to_prometheus()
+    assert "# TYPE srt_query_wall_seconds histogram" in text
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("srt_query_wall_seconds_bucket")]
+    assert bucket_lines, "no _bucket series"
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert bucket_lines[-1].split("{")[1].startswith('le="+Inf"')
+    assert counts[-1] == 4
+    assert "srt_query_wall_seconds_sum" in text
+    assert "srt_query_wall_seconds_count 4" in text
+
+
+# ---------------------------------------------------------------------------
+# live HTTP endpoint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def http_session():
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    yield s
+    s.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_http_endpoint_routes(http_session):
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+    from spark_rapids_tpu.obs.registry import get_registry
+    get_registry().observe("query.wall_seconds", 0.01)
+    srv = ObsHttpServer(http_session, 0)   # ephemeral port
+    try:
+        assert srv.address.startswith("http://127.0.0.1:")
+        st, hdrs, body = _get(srv.address + "/metrics")
+        assert st == 200
+        assert hdrs["Content-Type"].startswith("text/plain")
+        assert b"# TYPE srt_query_wall_seconds histogram" in body
+        assert b"srt_query_wall_seconds_bucket" in body
+
+        st, _, body = _get(srv.address + "/healthz")
+        assert st == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert "admission" in health
+
+        st, _, body = _get(srv.address + "/queries")
+        assert st == 200
+        q = json.loads(body)
+        assert q["count"] == 0 and q["active"] == {}
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.address + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+    # port is actually released (TIME_WAIT from the scrape connections
+    # is fine — REUSEADDR is exactly what a restarting server would use)
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", srv.port))
+
+
+def test_http_healthz_drains_on_shutdown(http_session):
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+    srv = ObsHttpServer(http_session, 0)
+    try:
+        http_session._admission_controller().begin_shutdown()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.address + "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "draining"
+    finally:
+        srv.close()
+
+
+def test_http_metrics_scrape_concurrent_with_observations(http_session):
+    """Scrapes racing observers must never 500 or return torn text."""
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+    from spark_rapids_tpu.obs.registry import get_registry
+    srv = ObsHttpServer(http_session, 0)
+    stop = threading.Event()
+
+    def pound():
+        reg = get_registry()
+        i = 0
+        while not stop.is_set():
+            reg.observe("query.wall_seconds", 0.001 * (i % 50 + 1))
+            reg.inc("queries_executed")
+            i += 1
+
+    t = threading.Thread(target=pound, daemon=True)
+    t.start()
+    try:
+        for _ in range(20):
+            st, _, body = _get(srv.address + "/metrics")
+            assert st == 200
+            text = body.decode()
+            for ln in text.splitlines():
+                if ln and not ln.startswith("#"):
+                    float(ln.rsplit(" ", 1)[1])   # every sample parses
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        srv.close()
+
+
+def test_session_conf_port_zero_means_no_server():
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    try:
+        assert s._http is None
+    finally:
+        s.shutdown()
+
+
+def test_session_conf_port_starts_and_stops_server():
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.obs.http.port": "0"})
+    try:
+        # "0" is falsy-as-int: still off — only a real port starts it
+        assert s._http is None
+    finally:
+        s.shutdown()
+    s = TpuSession({"spark.rapids.obs.http.port": _free_port()})
+    try:
+        assert s._http is not None
+        st, _, _ = _get(s._http.address + "/healthz")
+        assert st == 200
+        addr = s._http.address
+    finally:
+        s.shutdown()
+    assert s._http is None
+    with pytest.raises(OSError):
+        _get(addr + "/healthz")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# query history log
+# ---------------------------------------------------------------------------
+
+def test_history_log_rotation_keeps_newest(tmp_path):
+    from spark_rapids_tpu.obs.history import QueryHistoryLog, read_entries
+    log = QueryHistoryLog(str(tmp_path), max_entries=5)
+    for i in range(12):
+        log.append({"kind": "history", "query_id": f"q{i}"})
+    entries = read_entries(log.path)
+    assert len(entries) == 5
+    assert [e["query_id"] for e in entries] == [f"q{i}" for i in
+                                               range(7, 12)]
+    # no stray temp file left behind
+    assert sorted(os.listdir(tmp_path)) == ["query_history.jsonl"]
+
+
+def test_history_reader_skips_torn_lines(tmp_path):
+    from spark_rapids_tpu.obs.history import QueryHistoryLog, read_entries
+    log = QueryHistoryLog(str(tmp_path))
+    log.append({"query_id": "a"})
+    with open(log.path, "a") as f:
+        f.write('{"query_id": "torn-mid-cra')   # crash mid-append
+    log.append({"query_id": "b"})
+    ids = [e["query_id"] for e in read_entries(log.path)]
+    assert ids == ["a", "b"]
+
+
+def test_history_concurrent_appenders(tmp_path):
+    from spark_rapids_tpu.obs.history import QueryHistoryLog, read_entries
+    log = QueryHistoryLog(str(tmp_path), max_entries=1000)
+    n_threads, per = 8, 25
+
+    def appender(k):
+        for i in range(per):
+            log.append({"query_id": f"t{k}-{i}"})
+
+    ts = [threading.Thread(target=appender, args=(k,))
+          for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    entries = read_entries(log.path)
+    assert len(entries) == n_threads * per
+    assert len({e["query_id"] for e in entries}) == n_threads * per
+
+
+def test_history_entry_written_at_terminal_state(tmp_path):
+    """One entry per executed query after shutdown(drain=True), with
+    terminal state, registry delta, analyzed plan — and it conforms to
+    the checked-in CI schema."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.obs.history import HISTORY_FILE, read_entries
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.obs.history.dir": str(tmp_path)})
+    schema = T.Schema([T.StructField("a", T.IntegerType())])
+    df = s.from_pydict({"a": list(range(20))}, schema, partitions=2)
+    df.where(col("a") > lit(3)).collect()
+    df.where(col("a") > lit(10)).collect()
+    s.shutdown(drain=True)
+    entries = read_entries(os.path.join(str(tmp_path), HISTORY_FILE))
+    assert len(entries) == 2
+    hs = load_schema("history")
+    for e in entries:
+        assert validate(e, hs) == []
+        assert e["state"] == "FINISHED"
+        assert e["plan_fingerprint"]
+        assert e["plan_analyzed"]
+        assert e["registry_delta"]["counters"]
+        assert e["wall_s"] is not None and e["wall_s"] >= 0
+        assert e["executed"] is True
+
+
+def test_history_records_failure_taxonomy(tmp_path):
+    """A query that dies at runtime (injected shuffle-peer death with
+    the recovery budget exhausted) lands in the history log as FAILED
+    with the error taxonomy filled in."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.obs.history import HISTORY_FILE, read_entries
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({
+        "spark.rapids.obs.history.dir": str(tmp_path),
+        "spark.rapids.test.faults": "shuffle.peer.dead:dead,times=0",
+        "spark.rapids.shuffle.recovery.maxStageAttempts": "1",
+    })
+    schema = T.Schema([T.StructField("k", T.IntegerType()),
+                       T.StructField("v", T.DoubleType())])
+    df = s.from_pydict({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]},
+                       schema, partitions=2) \
+        .group_by("k").agg(Sum(col("v")))
+    with pytest.raises(Exception):
+        df.collect()
+    s.shutdown()
+    entries = read_entries(os.path.join(str(tmp_path), HISTORY_FILE))
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["state"] == "FAILED"
+    assert e["error"]["type"]
+    assert e["error"]["message"]
+    assert validate(e, load_schema("history")) == []
+
+
+def test_history_tool_is_engine_free(tmp_path):
+    """python -m tools.history must not import the engine: it has to
+    work on a forensics box with no jax."""
+    import subprocess
+    from spark_rapids_tpu.obs.history import QueryHistoryLog
+    log = QueryHistoryLog(str(tmp_path))
+    log.append({"kind": "history", "version": 1, "query_id": "abc123",
+                "tenant": "default", "state": "FINISHED",
+                "submitted_unix_s": 1.0, "wall_s": 0.5,
+                "registry_delta": {"counters": {}, "histograms": {}}})
+    code = ("import sys, tools.history; "
+            "bad = [m for m in sys.modules if m.startswith("
+            "'spark_rapids_tpu') or m == 'jax']; "
+            "sys.exit(1 if bad else 0)")
+    r = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.join(os.path.dirname(__file__), ".."),
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.history", "--dir", str(tmp_path),
+         "list"],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "abc123" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace lanes
+# ---------------------------------------------------------------------------
+
+def test_trace_ship_and_ingest_one_timeline(tmp_path):
+    """Worker events drained, stamped to wall-clock, ingested by the
+    driver tracer: ONE export with both pids on named lanes, worker ts
+    rebased onto the driver origin."""
+    from spark_rapids_tpu.obs.trace import Tracer, stamp_for_shipping
+    driver = Tracer(query_id="q1")
+    worker = Tracer(query_id="q1", trace_id=driver.trace_id)
+    worker.pid = driver.pid + 1   # simulate a separate process
+
+    with driver.span("cluster.map_stage", "cluster"):
+        with worker.span("worker.fragment", "cluster"):
+            pass
+    shipped = stamp_for_shipping(worker.drain_events(),
+                                 worker._wall_origin, worker.pid)
+    assert shipped and all(ev["pid"] == worker.pid for ev in shipped)
+    # drain is exactly-once
+    assert worker.drain_events() == []
+
+    driver.ensure_lane(driver.pid, "driver")
+    driver.ensure_lane(worker.pid, "cluster worker w0")
+    driver.ensure_lane(worker.pid, "dup ignored")   # idempotent
+    driver.ingest_wall(shipped)
+
+    path = driver.export(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    assert validate(doc, load_schema("trace")) == []
+    lanes = {ev["pid"]: ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert lanes == {driver.pid: "driver",
+                     worker.pid: "cluster worker w0"}
+    pids = {ev["pid"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+    assert pids == {driver.pid, worker.pid}
+    # the worker span's rebased ts must land within the driver span
+    dspan = next(ev for ev in doc["traceEvents"]
+                 if ev["name"] == "cluster.map_stage")
+    wspan = next(ev for ev in doc["traceEvents"]
+                 if ev["name"] == "worker.fragment")
+    assert dspan["ts"] - 1e4 <= wspan["ts"] <= dspan["ts"] + dspan["dur"] \
+        + 1e4
+
+
+def test_trace_lanes_survive_buffer_rotation(tmp_path):
+    from spark_rapids_tpu.obs.trace import Tracer
+    tr = Tracer(query_id="q2", max_events=4)
+    tr.ensure_lane(tr.pid, "driver")
+    for i in range(32):
+        tr.event(f"e{i}")
+    evs = tr.events_snapshot()
+    assert evs[0]["ph"] == "M", "lane metadata must survive rotation"
+    assert sum(1 for e in evs if e["ph"] == "i") == 4
+
+
+def test_cluster_span_buffer_bounds():
+    """Driver-side heartbeat span buffering is bounded per query and in
+    query count, and drains exactly once."""
+    import threading as _t
+    from collections import deque
+
+    from spark_rapids_tpu.cluster.driver import (_MAX_SPAN_QUERIES,
+                                                 ClusterDriver)
+    d = ClusterDriver.__new__(ClusterDriver)
+    d._span_lock = _t.Lock()
+    d._pending_spans = {}
+    for qi in range(_MAX_SPAN_QUERIES + 3):
+        d.buffer_spans([{"name": "x", "args": {"query_id": f"q{qi}"}}])
+    assert len(d._pending_spans) == _MAX_SPAN_QUERIES
+    assert "q0" not in d._pending_spans      # oldest evicted wholesale
+    last = f"q{_MAX_SPAN_QUERIES + 2}"
+    assert len(d.drain_query_spans(last)) == 1
+    assert d.drain_query_spans(last) == []   # exactly-once
+    assert all(isinstance(v, deque) for v in d._pending_spans.values())
+
+
+# ---------------------------------------------------------------------------
+# import discipline
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_never_imports_http_or_history():
+    """With both confs off, a full query leaves obs.http / obs.history
+    out of sys.modules — zero overhead on the disabled path."""
+    import subprocess
+    code = """
+import sys
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu import types as T
+s = TpuSession({})
+schema = T.Schema([T.StructField("a", T.IntegerType())])
+s.from_pydict({"a": [1, 2, 3]}, schema).collect()
+s.shutdown()
+bad = [m for m in sys.modules
+       if m in ("spark_rapids_tpu.obs.http", "spark_rapids_tpu.obs.history")]
+sys.exit(1 if bad else 0)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.join(os.path.dirname(__file__), ".."),
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_obs_package_lazy_exports():
+    import importlib
+
+    import spark_rapids_tpu.obs as obs
+    assert set(obs.__all__) >= {"ObsHttpServer", "QueryHistoryLog",
+                                "history_log"}
+    assert obs.QueryHistoryLog is not None
+    mod = importlib.import_module("spark_rapids_tpu.obs.history")
+    assert obs.history_log is mod.history_log
+    with pytest.raises(AttributeError):
+        obs.no_such_name
+
+
+# ---------------------------------------------------------------------------
+# conf surface
+# ---------------------------------------------------------------------------
+
+def test_telemetry_confs_registered():
+    # importing the gated modules registers their entries
+    import spark_rapids_tpu.obs.history  # noqa: F401
+    import spark_rapids_tpu.obs.http  # noqa: F401
+    from spark_rapids_tpu.conf import registered_entries
+    names = set(registered_entries())
+    assert "spark.rapids.obs.http.port" in names
+    assert "spark.rapids.obs.history.dir" in names
+    assert "spark.rapids.obs.history.maxEntries" in names
+    conf = TpuConf({"spark.rapids.obs.history.maxEntries": "7"})
+    from spark_rapids_tpu.obs.history import HISTORY_MAX
+    assert HISTORY_MAX.get(conf.settings) == 7

@@ -1,0 +1,161 @@
+"""Query-history forensics CLI over the JSONL log written by
+``spark.rapids.obs.history.dir`` (obs/history.py) — the operator-facing
+analog of browsing the Spark history server.
+
+Deliberately engine-free (pure stdlib, no spark_rapids_tpu imports): it
+must work on a laptop against a log scp'd off a serving box where the
+engine (and jax) are not installed.
+
+    python -m tools.history [--dir DIR] list [-n N]
+    python -m tools.history [--dir DIR] show QUERY_ID
+    python -m tools.history [--dir DIR] diff QUERY_ID1 QUERY_ID2
+
+``list`` prints the newest entries (state, tenant, wall, when); ``show``
+pretty-prints one entry (query_id prefix match, newest wins); ``diff``
+compares two queries' analyzed plans (unified diff) and registry deltas
+— the "why did the same query get slow" tool.
+"""
+from __future__ import annotations
+
+import argparse
+import difflib
+import json
+import os
+import sys
+import time
+
+HISTORY_FILE = "query_history.jsonl"
+
+
+def _read(directory: str) -> list[dict]:
+    path = os.path.join(directory, HISTORY_FILE)
+    out: list[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except FileNotFoundError:
+        raise SystemExit(f"no history log at {path} "
+                         "(is spark.rapids.obs.history.dir set?)")
+    return out
+
+
+def _find(entries: list[dict], qid: str) -> dict:
+    hits = [e for e in entries if str(e.get("query_id", "")).startswith(qid)]
+    if not hits:
+        raise SystemExit(f"no history entry matches query_id {qid!r}")
+    return hits[-1]  # newest wins on prefix ambiguity
+
+
+def _when(e: dict) -> str:
+    ts = e.get("submitted_unix_s")
+    if not ts:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+
+
+def _fmt_wall(e: dict) -> str:
+    w = e.get("wall_s")
+    return "-" if w is None else f"{w:.3f}s"
+
+
+def cmd_list(entries: list[dict], n: int) -> int:
+    rows = entries[-n:]
+    if not rows:
+        print("history log is empty")
+        return 0
+    print(f"{'query_id':<18} {'state':<18} {'tenant':<10} "
+          f"{'wall':>9}  submitted")
+    for e in rows:
+        extra = ""
+        if e.get("served_from_cache"):
+            extra = "  [cache hit]"
+        err = e.get("error") or {}
+        if err.get("type"):
+            extra = f"  [{err['type']}]"
+        print(f"{str(e.get('query_id', '?')):<18} "
+              f"{str(e.get('state', '?')):<18} "
+              f"{str(e.get('tenant', '?')):<10} "
+              f"{_fmt_wall(e):>9}  {_when(e)}{extra}")
+    return 0
+
+
+def cmd_show(entries: list[dict], qid: str) -> int:
+    e = _find(entries, qid)
+    plan = e.pop("plan_analyzed", None)
+    print(json.dumps(e, indent=2, sort_keys=True))
+    if plan:
+        print("\n-- analyzed plan " + "-" * 40)
+        print(plan)
+    return 0
+
+
+def _counters(e: dict) -> dict:
+    return (e.get("registry_delta") or {}).get("counters") or {}
+
+
+def cmd_diff(entries: list[dict], qid_a: str, qid_b: str) -> int:
+    a, b = _find(entries, qid_a), _find(entries, qid_b)
+    ida, idb = a.get("query_id", qid_a), b.get("query_id", qid_b)
+    print(f"A: {ida}  state={a.get('state')}  wall={_fmt_wall(a)}  "
+          f"submitted={_when(a)}")
+    print(f"B: {idb}  state={b.get('state')}  wall={_fmt_wall(b)}  "
+          f"submitted={_when(b)}")
+    if a.get("plan_fingerprint") != b.get("plan_fingerprint"):
+        print("plan fingerprints DIFFER")
+    pa = (a.get("plan_analyzed") or "").splitlines(keepends=True)
+    pb = (b.get("plan_analyzed") or "").splitlines(keepends=True)
+    if pa or pb:
+        diff = list(difflib.unified_diff(pa, pb, fromfile=f"plan {ida}",
+                                         tofile=f"plan {idb}"))
+        if diff:
+            print("\n-- analyzed plan diff " + "-" * 35)
+            sys.stdout.writelines(diff)
+        else:
+            print("analyzed plans are identical")
+    ca, cb = _counters(a), _counters(b)
+    keys = sorted(set(ca) | set(cb))
+    moved = [(k, ca.get(k, 0), cb.get(k, 0)) for k in keys
+             if ca.get(k, 0) != cb.get(k, 0)]
+    if moved:
+        print("\n-- registry delta diff " + "-" * 34)
+        print(f"{'counter':<44} {'A':>12} {'B':>12}")
+        for k, va, vb in moved:
+            print(f"{k:<44} {va:>12g} {vb:>12g}")
+    else:
+        print("registry counter deltas are identical")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.history",
+        description="Inspect the engine's query-history log.")
+    p.add_argument("--dir", default=".",
+                   help="history directory (spark.rapids.obs.history.dir; "
+                        "default: cwd)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pl = sub.add_parser("list", help="newest entries")
+    pl.add_argument("-n", type=int, default=20)
+    ps = sub.add_parser("show", help="one entry in full")
+    ps.add_argument("query_id")
+    pd = sub.add_parser("diff", help="compare two queries")
+    pd.add_argument("query_id_a")
+    pd.add_argument("query_id_b")
+    args = p.parse_args(argv)
+    entries = _read(args.dir)
+    if args.cmd == "list":
+        return cmd_list(entries, args.n)
+    if args.cmd == "show":
+        return cmd_show(entries, args.query_id)
+    return cmd_diff(entries, args.query_id_a, args.query_id_b)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
