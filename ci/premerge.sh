@@ -739,6 +739,130 @@ assert not leaked, f"leaked cluster threads after shutdown: {leaked}"
 print("cluster gate: local[2] q6/q3 exact, worker-death recovery, "
       "clean drain: ok")
 PY
+  echo "-- elasticity gate: mid-query drain, straggler speculation, quarantine --"
+  # ISSUE 16 elastic membership: retiring a worker mid-q18 must migrate
+  # its map outputs to the survivor (exact rows, ZERO recomputes — a
+  # planned scale-down costs a copy, not a recompute); a fragment held
+  # by the slow fault must be speculatively duplicated and the
+  # duplicate's rows committed exactly once; and a flaky worker must be
+  # quarantined after maxFailures, re-admitted after probation, with
+  # zero orphan processes at the end
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, tempfile, time
+
+import numpy as np
+import pyarrow.parquet as pq
+
+import spark_rapids_tpu.cluster.exec as cexec
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+for table in ("lineitem", "orders", "customer"):
+    t = pq.read_table(os.path.join(d, table, "part-0.parquet"))
+    step = -(-t.num_rows // 4)
+    for i in range(4):
+        pq.write_table(t.slice(i * step, step),
+                       os.path.join(d, table, f"part-{i}.parquet"))
+
+# 1) graceful drain mid-q18: retire w1 synchronously at the reduce's
+# first map-output fetch (all maps registered, nothing consumed yet)
+s0 = TpuSession()
+want = sorted(build_tpch_query("q18", s0, d).collect())
+s0.shutdown()
+s = TpuSession({"spark.rapids.cluster.mode": "local[2]"})
+drv = s._cluster()
+fired = {}
+orig = cexec.ClusterMapOutputTracker.fetch_partition
+def hooked(self, shuffle_id, pid, lo=0, hi=None):
+    if not fired:
+        fired["ok"] = True
+        fired.update(drv.remove_worker("w1", drain=True))
+    return orig(self, shuffle_id, pid, lo, hi)
+cexec.ClusterMapOutputTracker.fetch_partition = hooked
+before = get_registry().snapshot()
+got = sorted(build_tpch_query("q18", s, d).collect())
+cexec.ClusterMapOutputTracker.fetch_partition = orig
+assert fired.get("ok"), "drain never triggered mid-q18"
+assert got == want, "drained q18 rows diverge from the oracle"
+reg = get_registry().delta(before)["counters"]
+assert reg.get("map_outputs_migrated", 0) > 0, reg
+assert reg.get("stage_recomputes", 0) == 0, reg
+h = drv.worker_by_id("w1")
+assert h.retired and h.proc.poll() is not None
+s.shutdown(drain=True)
+
+SCHEMA = T.Schema([T.StructField("k", T.IntegerType(), True),
+                   T.StructField("v", T.LongType(), True)])
+rng = np.random.default_rng(16)
+data = {"k": [int(x) for x in rng.integers(0, 997, 20000)],
+        "v": [int(x) for x in rng.integers(-1000, 1000, 20000)]}
+s0 = TpuSession()
+want = sorted(s0.from_pydict(data, SCHEMA, partitions=6,
+                             rows_per_batch=512)
+              .group_by("k").agg(Sum(col("v")).alias("sv")).collect())
+s0.shutdown()
+
+# 2) straggler storm: a 2s hold on one worker's fragment must be beaten
+# by a speculative duplicate, rows committed exactly once
+s = TpuSession({
+    "spark.rapids.cluster.mode": "local[2]",
+    "spark.rapids.cluster.speculation.enabled": "true",
+    "spark.rapids.cluster.speculation.multiplier": "2.0",
+    "spark.rapids.cluster.speculation.minRuntimeSeconds": "0.2",
+    "spark.rapids.test.faults":
+        "cluster.worker.slow:slow,seconds=2.0,worker=w1,times=1"})
+df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+q = df.group_by("k").agg(Sum(col("v")).alias("sv"))
+assert sorted(q.collect()) == want  # warm-up seeds the wall median
+before = get_registry().snapshot()
+assert sorted(q.collect()) == want, "speculated rows diverge"
+reg = get_registry().delta(before)["counters"]
+assert reg.get("speculative_launched", 0) >= 1, reg
+assert reg.get("speculative_wasted", 0) >= 1, reg
+assert reg.get("stage_recomputes", 0) == 0, reg
+s.shutdown(drain=True)
+
+# 3) flaky worker: quarantined after 2 consecutive failures, old map
+# outputs stay fetchable, probation re-admits, zero orphans
+s = TpuSession({
+    "spark.rapids.cluster.mode": "local[2]",
+    "spark.rapids.cluster.quarantine.maxFailures": "2",
+    "spark.rapids.cluster.quarantine.probationSeconds": "4.0",
+    "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2",
+    "spark.rapids.test.faults":
+        "cluster.worker.flaky:flaky,worker=w1,times=2"})
+df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+q = df.group_by("k").agg(Sum(col("v")).alias("sv"))
+before = get_registry().snapshot()
+assert sorted(q.collect()) == want, "flaky-worker rows diverge"
+reg = get_registry().delta(before)["counters"]
+assert reg.get("cluster_workers_quarantined", 0) == 1, reg
+drv = s._cluster()
+h = drv.worker_by_id("w1")
+assert h.alive and h.state == "quarantined"
+deadline = time.monotonic() + 10.0
+while time.monotonic() < deadline and \
+        drv.worker_by_id("w1").quarantined_until is not None:
+    time.sleep(0.1)
+assert drv.worker_by_id("w1").quarantined_until is None, \
+    "probation never re-admitted the quarantined worker"
+reg = get_registry().delta(before)["counters"]
+assert reg.get("cluster_workers_readmitted", 0) == 1, reg
+handles = drv.workers()
+s.shutdown(drain=True)
+for h in handles:
+    assert h.proc.poll() is not None, \
+        f"orphan worker {h.worker_id} after elasticity gate"
+print("elasticity gate: mid-q18 drain 0-recompute, speculation "
+      "exactly-once, quarantine+re-admission: ok")
+PY
   echo "-- telemetry gate: live /metrics mid-query, cluster trace, disabled-path imports --"
   # ISSUE 15 observability plane: the HTTP endpoint must serve
   # well-formed Prometheus (with at least one latency histogram) WHILE

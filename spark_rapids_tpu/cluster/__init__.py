@@ -26,8 +26,8 @@ from __future__ import annotations
 
 import re
 
-from spark_rapids_tpu.conf import (ConfEntry, float_conf, int_conf,
-                                   register)
+from spark_rapids_tpu.conf import (ConfEntry, bool_conf, float_conf,
+                                   int_conf, register)
 
 _MODE_RE = re.compile(r"local\[(\d+)\]")
 
@@ -80,6 +80,76 @@ WORKER_STARTUP_TIMEOUT = float_conf(
     "How long the driver waits for a spawned worker subprocess to "
     "print its READY line (imports + JAX init included) before "
     "declaring the launch failed.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+MIN_WORKERS = int_conf(
+    "spark.rapids.cluster.minWorkers", 1,
+    "Floor on live (non-retired) workers: ClusterDriver.remove_worker "
+    "refuses a removal that would shrink the pool below it. Planned "
+    "scale-down cannot strand a cluster with no map-side capacity.",
+    check=lambda v: v >= 1, check_doc="must be >= 1")
+
+MAX_WORKERS = int_conf(
+    "spark.rapids.cluster.maxWorkers", 0,
+    "Ceiling on live (non-retired) workers: ClusterDriver.add_worker "
+    "refuses to grow past it. 0 (default): unbounded.",
+    check=lambda v: v >= 0, check_doc="must be >= 0")
+
+DRAIN_TIMEOUT = float_conf(
+    "spark.rapids.cluster.drain.timeoutSeconds", 30.0,
+    "Bound on one graceful drain (remove_worker(drain=True)): waiting "
+    "for in-flight fragments to finish plus each map-output migration "
+    "RPC. Past the deadline the retiring worker's remaining slots fall "
+    "back to lineage recovery instead of blocking removal forever.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+DEATH_PROBE_TIMEOUT = float_conf(
+    "spark.rapids.cluster.death.probeTimeoutSeconds", 2.0,
+    "Timeout for the single direct RPC ping the driver sends before a "
+    "heartbeat-silence death verdict. A worker that answers (GC pause, "
+    "scheduler stall, heartbeat-path congestion) is kept alive instead "
+    "of paying a full lineage recompute of everything it holds.",
+    check=lambda v: v > 0, check_doc="must be > 0")
+
+SPECULATION_ENABLED = bool_conf(
+    "spark.rapids.cluster.speculation.enabled", False,
+    "Re-dispatch a duplicate of any map fragment exceeding "
+    "speculation.multiplier x the running median fragment wall time "
+    "onto another healthy worker; the first attempt to register wins "
+    "and the loser's slots are discarded by the map-output tracker's "
+    "epoch discipline (exactly-once). Off (default): the dispatch "
+    "barrier waits for every fragment, byte-identical to the "
+    "pre-elastic scheduler. (reference: spark.speculation)")
+
+SPECULATION_MULTIPLIER = float_conf(
+    "spark.rapids.cluster.speculation.multiplier", 3.0,
+    "A running fragment is speculation-eligible once its wall time "
+    "exceeds this multiple of the round's median completed-fragment "
+    "wall time. (reference: spark.speculation.multiplier)",
+    check=lambda v: v > 1.0, check_doc="must be > 1.0")
+
+SPECULATION_MIN_RUNTIME = float_conf(
+    "spark.rapids.cluster.speculation.minRuntimeSeconds", 1.0,
+    "Floor below which no fragment is ever speculated, whatever the "
+    "median says — protects sub-second fragments from duplicate "
+    "dispatch on scheduling jitter.",
+    check=lambda v: v >= 0, check_doc="must be >= 0")
+
+QUARANTINE_MAX_FAILURES = int_conf(
+    "spark.rapids.cluster.quarantine.maxFailures", 0,
+    "Consecutive dispatch failures (RPC errors or fragment failures) "
+    "after which a worker that still answers a direct ping is "
+    "QUARANTINED — no new fragments, map outputs still servable — "
+    "instead of being declared dead. 0 (default): disabled, any "
+    "dispatch failure marks the worker lost exactly as before. "
+    "(reference: spark.blacklist.application.maxFailedTasksPerExecutor)",
+    check=lambda v: v >= 0, check_doc="must be >= 0")
+
+QUARANTINE_PROBATION = float_conf(
+    "spark.rapids.cluster.quarantine.probationSeconds", 30.0,
+    "How long a quarantined worker sits out before the monitor "
+    "re-admits it to scheduling with a cleared failure count. "
+    "(reference: spark.blacklist.timeout)",
     check=lambda v: v > 0, check_doc="must be > 0")
 
 

@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 import time
+import weakref
 from collections import deque
 
 #: per-query bound on heartbeat-shipped span events buffered while the
@@ -34,12 +35,16 @@ from collections import deque
 _MAX_BUFFERED_SPANS = 8192
 _MAX_SPAN_QUERIES = 16
 
-from spark_rapids_tpu.cluster import (HEARTBEAT_INTERVAL,
-                                      HEARTBEAT_TIMEOUT,
+from spark_rapids_tpu.cluster import (DEATH_PROBE_TIMEOUT, DRAIN_TIMEOUT,
+                                      HEARTBEAT_INTERVAL,
+                                      HEARTBEAT_TIMEOUT, MAX_WORKERS,
+                                      MIN_WORKERS,
+                                      QUARANTINE_MAX_FAILURES,
+                                      QUARANTINE_PROBATION,
                                       RPC_COMPRESSION_CODEC,
                                       WORKER_STARTUP_TIMEOUT,
                                       parse_cluster_mode)
-from spark_rapids_tpu.cluster.rpc import RpcServer, rpc_call
+from spark_rapids_tpu.cluster.rpc import RpcError, RpcServer, rpc_call
 from spark_rapids_tpu.cluster.worker import READY_PREFIX
 from spark_rapids_tpu.obs.registry import get_registry
 
@@ -57,10 +62,34 @@ class WorkerHandle:
         self.alive = False
         self.lost_reason: str | None = None
         self.last_heartbeat = 0.0
+        #: elastic membership state: a draining worker accepts no new
+        #: fragments while its slots migrate; a quarantined worker sat
+        #: out too many consecutive dispatch failures but still serves
+        #: its map outputs; a retired worker exited via planned removal
+        self.draining = False
+        self.quarantined_until: float | None = None
+        self.failures = 0
+        self.retired = False
+        self.io_thread: threading.Thread | None = None
         #: last heartbeat's registry snapshot and the first one seen —
         #: their counter diff is the worker's per-run registry delta
         self.metrics: dict = {}
         self.baseline: dict = {}
+
+    @property
+    def state(self) -> str:
+        """One of retired/lost/draining/quarantined/alive — the
+        /healthz and cluster_workers{state=...} vocabulary.  Only
+        ``lost`` is an UNPLANNED condition."""
+        if self.retired:
+            return "retired"
+        if not self.alive:
+            return "lost"
+        if self.draining:
+            return "draining"
+        if self.quarantined_until is not None:
+            return "quarantined"
+        return "alive"
 
 
 class ClusterDriver:
@@ -77,10 +106,22 @@ class ClusterDriver:
             raise ValueError("ClusterDriver requires cluster.mode="
                              "local[N] with N >= 1")
         self._faults = FaultRegistry.from_conf(conf)
-        self._hb_timeout = HEARTBEAT_TIMEOUT.get(conf.settings)
+        s = conf.settings
+        self._hb_timeout = HEARTBEAT_TIMEOUT.get(s)
+        self._probe_timeout = DEATH_PROBE_TIMEOUT.get(s)
+        self._drain_timeout = DRAIN_TIMEOUT.get(s)
+        self._min_workers = MIN_WORKERS.get(s)
+        self._max_workers = MAX_WORKERS.get(s)
+        self._quar_max = QUARANTINE_MAX_FAILURES.get(s)
+        self._quar_probation = QUARANTINE_PROBATION.get(s)
         self._lock = threading.Lock()
         self._handles: dict[str, WorkerHandle] = {}
         self._hang_ignored: set[str] = set()
+        self._next_worker = n
+        # live ClusterMapOutputTrackers (one per in-flight cluster
+        # shuffle): a graceful drain walks them to migrate the retiring
+        # worker's slots; weak so a finished query's tracker vanishes
+        self._trackers: "weakref.WeakSet" = weakref.WeakSet()
         # query_id -> worker span events shipped on heartbeats, held
         # until the dispatching stage drains them into ITS tracer
         self._span_lock = threading.Lock()
@@ -122,24 +163,30 @@ class ClusterDriver:
                              daemon=True,
                              name=f"tpu-cluster-io-{worker_id}")
         t.start()
+        h.io_thread = t
+        self._io_threads = [x for x in self._io_threads if x.is_alive()]
         self._io_threads.append(t)
 
     def _pump_stdout(self, h: WorkerHandle) -> None:
         """Scan for the READY line, then keep draining so the worker
         never blocks on a full pipe; its logging passes through to the
         driver's stderr."""
-        for line in h.proc.stdout:
-            if line.startswith(READY_PREFIX):
-                info = json.loads(line[len(READY_PREFIX):])
-                h.pid = info.get("pid")
-                h.rpc_addr = tuple(info["rpc"])
-                h.shuffle_addr = tuple(info["shuffle"])
-                h.alive = True
-                h.last_heartbeat = time.monotonic()
-                h.ready.set()
-            else:
-                print(f"[{h.worker_id}] {line.rstrip()}",
-                      file=sys.stderr)
+        try:
+            for line in h.proc.stdout:
+                if line.startswith(READY_PREFIX):
+                    info = json.loads(line[len(READY_PREFIX):])
+                    h.pid = info.get("pid")
+                    h.rpc_addr = tuple(info["rpc"])
+                    h.shuffle_addr = tuple(info["shuffle"])
+                    h.alive = True
+                    h.last_heartbeat = time.monotonic()
+                    h.ready.set()
+                else:
+                    print(f"[{h.worker_id}] {line.rstrip()}",
+                          file=sys.stderr)
+        except (ValueError, OSError):
+            # teardown closed the pipe out from under the blocking read
+            pass
 
     def _await_ready(self) -> None:
         deadline = time.monotonic() + WORKER_STARTUP_TIMEOUT.get(
@@ -223,14 +270,35 @@ class ClusterDriver:
         while not self._closed.wait(interval):
             now = time.monotonic()
             for h in self.live_workers():
+                if h.draining:
+                    # planned removal in progress: remove_worker owns
+                    # this handle's fate; the death verdict must not
+                    # race its shutdown sequence
+                    continue
+                if h.quarantined_until is not None \
+                        and now >= h.quarantined_until:
+                    h.quarantined_until = None
+                    h.failures = 0
+                    get_registry().inc("cluster_workers_readmitted")
+                    print(f"cluster: worker {h.worker_id} re-admitted "
+                          "after probation", file=sys.stderr)
                 if h.proc.poll() is not None:
                     self.mark_worker_lost(
                         h.worker_id,
                         f"process exited rc={h.proc.returncode}")
                 elif now - h.last_heartbeat > self._hb_timeout:
+                    silence = now - h.last_heartbeat
+                    # one direct RPC probe before the verdict: stalled
+                    # heartbeats (or a driver that stopped counting
+                    # them) on a live control plane is not a death
+                    if self._probe_worker(h):
+                        h.last_heartbeat = time.monotonic()
+                        self._hang_ignored.discard(h.worker_id)
+                        continue
                     self.mark_worker_lost(
                         h.worker_id,
-                        f"no heartbeat for {now - h.last_heartbeat:.1f}s")
+                        f"no heartbeat for {silence:.1f}s "
+                        "(probe failed)")
 
     def mark_worker_lost(self, worker_id: str, reason: str) -> None:
         """Idempotently declare one worker dead: SIGKILL whatever is
@@ -262,6 +330,233 @@ class ClusterDriver:
             except OSError:
                 pass
 
+    # -- elastic membership ----------------------------------------------
+    def register_tracker(self, tracker) -> None:
+        """Weakly track one live ClusterMapOutputTracker so a graceful
+        drain can migrate the retiring worker's slots; finished queries'
+        trackers vanish on their own."""
+        self._trackers.add(tracker)
+
+    def add_worker(self) -> str:
+        """Spawn one new worker into the live pool and wait for its
+        READY handshake.  The next dispatch round's worker snapshot —
+        and therefore the next query — picks it up without a restart."""
+        with self._lock:
+            if self._closed.is_set():
+                raise RuntimeError("cluster driver is shut down")
+            live = [h for h in self._handles.values()
+                    if h.alive and not h.draining]
+            if self._max_workers and len(live) >= self._max_workers:
+                raise RuntimeError(
+                    f"cannot add a worker: spark.rapids.cluster."
+                    f"maxWorkers={self._max_workers} already live")
+            wid = f"w{self._next_worker}"
+            self._next_worker += 1
+        self._spawn(wid)
+        h = self._handles[wid]
+        if not h.ready.wait(WORKER_STARTUP_TIMEOUT.get(self.conf.settings)):
+            rc = h.proc.poll()
+            try:
+                h.proc.kill()
+            except OSError:
+                pass
+            with self._lock:
+                self._handles.pop(wid, None)
+            raise RuntimeError(
+                f"added worker {wid} did not become ready "
+                f"(process {'exited rc=%s' % rc if rc is not None else 'still starting'})")
+        reg = get_registry()
+        reg.inc("cluster_workers_added")
+        reg.inc("cluster.workers_spawned")
+        print(f"cluster: worker {wid} added", file=sys.stderr)
+        return wid
+
+    def remove_worker(self, worker_id: str, drain: bool = True) -> dict:
+        """Planned scale-down of one worker.  With ``drain=True`` the
+        worker first stops accepting fragments, then its live map
+        outputs stream to survivors over the shuffle plane (tracker
+        entries rewritten under an epoch bump) — the removal costs a
+        copy, not a recompute.  Whatever cannot migrate (drain=False,
+        no survivor, or an injected ``cluster.migrate.drop``) is marked
+        lost so readers fall into lineage recovery.  Returns
+        ``{"migrated": n, "dropped": n}``."""
+        with self._lock:
+            h = self._handles.get(worker_id)
+            if h is None:
+                raise KeyError(f"unknown worker {worker_id!r}")
+            if h.retired:
+                return {"migrated": 0, "dropped": 0}
+            rest = [w for w in self._handles.values()
+                    if w.alive and not w.draining
+                    and w.worker_id != worker_id]
+            if h.alive and len(rest) < self._min_workers:
+                raise RuntimeError(
+                    f"cannot remove {worker_id}: spark.rapids.cluster."
+                    f"minWorkers={self._min_workers} would be violated")
+            h.draining = True
+        stats = {"migrated": 0, "dropped": 0}
+        if drain and h.alive:
+            deadline = time.monotonic() + self._drain_timeout
+            while time.monotonic() < deadline:
+                try:
+                    reply, _ = rpc_call(h.rpc_addr, "drain",
+                                        conf=self.conf, retries=0,
+                                        timeout=2.0)
+                except (RpcError, ConnectionError, OSError):
+                    break
+                if not reply.get("active"):
+                    break
+                time.sleep(0.05)
+            # the dispatching thread registers a fragment's slots just
+            # AFTER the worker's RPC returns — give in-flight
+            # registrations a beat to land before snapshotting what
+            # must move (anything that still slips through is swept
+            # into lineage below)
+            time.sleep(0.2)
+            for tracker in list(self._trackers):
+                if getattr(tracker, "_closed", False):
+                    continue
+                m, d = self._migrate_worker_outputs(tracker, h)
+                stats["migrated"] += m
+                stats["dropped"] += d
+        # leftover sweep: anything still registered on the retiring
+        # worker (not drained, migration dropped/failed, or a race)
+        # goes through the standard lineage recovery path
+        for tracker in list(self._trackers):
+            if not getattr(tracker, "_closed", False):
+                tracker.mark_worker_lost(worker_id)
+        if h.alive:
+            try:
+                rpc_call(h.rpc_addr, "shutdown", conf=self.conf,
+                         retries=0, timeout=2.0)
+            except (RpcError, ConnectionError, OSError):
+                pass
+        try:
+            h.proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            h.proc.kill()
+            try:
+                h.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        with self._lock:
+            h.alive = False
+            h.retired = True
+            h.lost_reason = "drained" if drain else "removed"
+        if h.io_thread is not None:
+            h.io_thread.join(timeout=5.0)
+        for stream in (h.proc.stdin, h.proc.stdout):
+            if stream is not None:
+                try:
+                    stream.close()
+                except OSError:
+                    pass
+        get_registry().inc("cluster_workers_drained" if drain
+                           else "cluster_workers_removed")
+        print(f"cluster: worker {worker_id} "
+              f"{'drained' if drain else 'removed'} "
+              f"(migrated={stats['migrated']} dropped={stats['dropped']})",
+              file=sys.stderr)
+        return stats
+
+    def _migrate_worker_outputs(self, tracker, h) -> tuple:
+        """Move one tracker's slots off a draining worker: the tracker
+        plans contiguous fetch runs under an epoch bump
+        (begin_migration), survivors pull the raw frames over the
+        shuffle plane (``migrate_slots`` RPC), and each successful copy
+        re-registers at the new epoch — source stragglers and late
+        duplicates are epoch-stale.  A run that fails stays owned by
+        the retiring worker and the caller's sweep routes it into
+        lineage."""
+        runs, dropped = tracker.begin_migration(h.worker_id,
+                                                faults=self._faults)
+        if not runs:
+            return 0, dropped
+        targets = [w for w in self.schedulable_workers()
+                   if w.worker_id != h.worker_id]
+        if not targets:
+            return 0, dropped
+        migrated = 0
+        for i, run in enumerate(runs):
+            target = targets[i % len(targets)]
+            try:
+                reply, _ = rpc_call(target.rpc_addr, "migrate_slots",
+                                    {"shuffle_id": tracker.shuffle_id,
+                                     "source": list(h.shuffle_addr),
+                                     "runs": [run]},
+                                    conf=self.conf,
+                                    timeout=self._drain_timeout)
+            except (RpcError, ConnectionError, OSError):
+                continue
+            if reply.get("error_kind"):
+                continue
+            tracker.register(target.worker_id, reply["shuffle"],
+                             reply["entries"])
+            migrated += len(reply["entries"])
+        if migrated:
+            get_registry().inc("map_outputs_migrated", migrated)
+        return migrated, dropped
+
+    # -- failure verdicts -------------------------------------------------
+    def _ping(self, h: WorkerHandle,
+              timeout: float | None = None) -> bool:
+        """One direct control-plane round-trip; True iff the worker's
+        RPC server answered."""
+        if h.rpc_addr is None:
+            return False
+        try:
+            reply, _ = rpc_call(h.rpc_addr, "ping", conf=self.conf,
+                                retries=0,
+                                timeout=timeout or self._probe_timeout)
+        except (RpcError, ConnectionError, OSError):
+            return False
+        return reply.get("worker_id") == h.worker_id
+
+    def _probe_worker(self, h: WorkerHandle) -> bool:
+        """Probe-before-death: one bounded RPC ping before a
+        heartbeat-timeout verdict.  A worker whose heartbeats stalled
+        (or were ignored) but whose RPC plane answers is NOT dead."""
+        get_registry().inc("cluster_death_probes")
+        if self._ping(h):
+            get_registry().inc("cluster_death_probe_saves")
+            return True
+        return False
+
+    def record_worker_failure(self, worker_id: str, reason: str) -> str:
+        """Dispatch-failure verdict for one worker.  With quarantine
+        disabled (the default) the worker is declared lost exactly as
+        before.  With ``quarantine.maxFailures`` > 0 the worker
+        accumulates strikes: a probe first separates a dead process
+        (lost) from a flaky one, and past the threshold the worker is
+        QUARANTINED — no new fragments, but its registered map outputs
+        stay servable — until probation re-admits it.  Returns the
+        verdict: ``lost`` | ``quarantined`` | ``tolerated``."""
+        h = self._handles.get(worker_id)
+        if h is None:
+            return "lost"
+        if self._quar_max <= 0:
+            self.mark_worker_lost(worker_id, reason)
+            return "lost"
+        if not self._ping(h):
+            self.mark_worker_lost(worker_id, f"{reason} (probe failed)")
+            return "lost"
+        h.failures += 1
+        if h.failures >= self._quar_max and h.quarantined_until is None:
+            h.quarantined_until = time.monotonic() + self._quar_probation
+            get_registry().inc("cluster_workers_quarantined")
+            print(f"cluster: worker {worker_id} quarantined after "
+                  f"{h.failures} consecutive failures: {reason}",
+                  file=sys.stderr)
+            return "quarantined"
+        return "tolerated"
+
+    def note_worker_success(self, worker_id: str) -> None:
+        """A fragment completed on the worker: reset its consecutive-
+        failure strike count (quarantine counts CONSECUTIVE failures)."""
+        h = self._handles.get(worker_id)
+        if h is not None:
+            h.failures = 0
+
     # -- views ----------------------------------------------------------
     def workers(self) -> list[WorkerHandle]:
         with self._lock:
@@ -270,6 +565,17 @@ class ClusterDriver:
     def live_workers(self) -> list[WorkerHandle]:
         with self._lock:
             return [h for h in self._handles.values() if h.alive]
+
+    def schedulable_workers(self) -> list[WorkerHandle]:
+        """Workers eligible for NEW fragments: alive, not draining, not
+        quarantined.  If quarantine would empty the pool the
+        quarantined workers stay schedulable — availability beats
+        purity (matching speculative execution's blacklist override)."""
+        with self._lock:
+            live = [h for h in self._handles.values()
+                    if h.alive and not h.draining]
+        ok = [h for h in live if h.quarantined_until is None]
+        return ok or live
 
     def worker_by_id(self, worker_id: str) -> WorkerHandle | None:
         return self._handles.get(worker_id)
@@ -299,10 +605,16 @@ class ClusterDriver:
 
     def _source(self) -> dict:
         out = {"workers_live": float(len(self.live_workers()))}
+        states: dict[str, int] = {}
         for h in self.workers():
+            states[h.state] = states.get(h.state, 0) + 1
             for k, v in self._flat(h.metrics).items():
                 if k.startswith(("cluster", "shuffle", "faults")):
                     out[f"worker.{h.worker_id}.{k}"] = float(v)
+        # cluster_workers{state=...} gauge family (obs/registry.py
+        # _LABELED rewrites cluster.workers.state.* into labels)
+        for st in ("alive", "draining", "quarantined", "lost", "retired"):
+            out[f"workers.state.{st}"] = float(states.get(st, 0))
         return out
 
     def worker_registry_deltas(self) -> dict:
@@ -344,11 +656,12 @@ class ClusterDriver:
                 except subprocess.TimeoutExpired:
                     pass
             h.alive = False
-            if h.proc.stdin is not None:
-                try:
-                    h.proc.stdin.close()
-                except OSError:
-                    pass
+            for stream in (h.proc.stdin, h.proc.stdout):
+                if stream is not None:
+                    try:
+                        stream.close()
+                    except OSError:
+                        pass
         self.rpc.close()
         get_registry().unregister_source("cluster")
         atexit.unregister(self.shutdown)

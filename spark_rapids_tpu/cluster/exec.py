@@ -32,11 +32,16 @@ from __future__ import annotations
 import copy
 import pickle
 import re
+import sys
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Iterator
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.cluster import (SPECULATION_ENABLED,
+                                      SPECULATION_MIN_RUNTIME,
+                                      SPECULATION_MULTIPLIER)
 from spark_rapids_tpu.cluster.worker import MAP_ID_STRIDE, scrub_worker_conf
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.obs.registry import get_registry
@@ -196,13 +201,23 @@ class ClusterMapOutputTracker:
         self._faults = ctx.cached(("fault_registry",),
                                   lambda: FaultRegistry.from_conf(ctx.conf))
         self._closed = False
+        # the driver weakly tracks live trackers so a graceful drain
+        # can migrate a retiring worker's slots (elastic membership)
+        reg_tracker = getattr(cluster, "register_tracker", None)
+        if callable(reg_tracker):
+            reg_tracker(self)
 
     # -- registration (dispatch rounds) ---------------------------------
     def register(self, worker_id: str, shuffle_addr, entries) -> None:
         """Fold one fragment reply's slot list in: a (pid, map_id) pair
         already present (a recovery recompute) is replaced in place so
         slot ORDER survives relocation; new pairs append and the
-        partition re-sorts by map id."""
+        partition re-sorts by map id.
+
+        Commit is FIRST-WRITER-WINS per epoch: a slot already live at
+        this epoch is never replaced, so a speculative duplicate (or a
+        drain straggler) re-offering the same map output is discarded —
+        the exactly-once guarantee behind speculation and migration."""
         with self._lock:
             self._shuffle_addr[worker_id] = tuple(shuffle_addr)
             dirty = set()
@@ -211,9 +226,14 @@ class ClusterMapOutputTracker:
                 cur = self._epochs.get(mid, 0)
                 if epoch < cur:
                     continue  # straggler from a pre-recovery attempt
-                self._epochs[mid] = int(epoch)
                 row = self._entries[pid]
                 old = next((e for e in row if e.map_id == mid), None)
+                if old is not None and not old.lost \
+                        and int(epoch) <= old.epoch:
+                    get_registry().inc(
+                        "cluster.stale_registrations_discarded")
+                    continue  # first writer already committed
+                self._epochs[mid] = int(epoch)
                 if old is not None:
                     old.worker_id = worker_id
                     old.wslot = int(wslot)
@@ -247,6 +267,66 @@ class ClusterMapOutputTracker:
                     if e.worker_id == worker_id:
                         e.lost = True
         return lost
+
+    # -- graceful-drain migration ---------------------------------------
+    def begin_migration(self, worker_id: str, faults=None):
+        """Plan the retiring worker's live slots as contiguous fetch
+        runs, each slot tagged with its NEXT epoch: the copies the
+        drain registers commit at that bumped epoch (register advances
+        ``_epochs`` on success), so a straggling write from the old
+        attempt — or a late speculative duplicate — is epoch-stale and
+        discarded.  The tracker's OWN epoch map is NOT advanced here: a
+        run that fails to migrate must still look lost at its old epoch
+        so lineage recovery accepts the loss report.  Returns ``(runs,
+        dropped)`` where each run is one ``migrate_slots`` RPC payload
+        and ``dropped`` counts slots withheld by
+        ``cluster.migrate.drop``.  A drop withholds the ENTIRE map
+        output, not just the one slot: epochs are tracked per map_id,
+        so migrating a map's other slots at epoch+1 while one slot
+        stays lost at the old epoch would make that slot's loss report
+        look stale forever (recovery filters on ``map_epoch <= lost
+        epoch``) and the reduce would spin without recomputing.
+        Withheld maps stay on the retiring worker at their old epoch
+        and route through lineage recovery instead."""
+        runs: list[dict] = []
+        dropped = 0
+        dropped_mids: set[int] = set()
+        with self._lock:
+            if faults is not None:
+                for pid, row in enumerate(self._entries):
+                    for e in row:
+                        if e.worker_id != worker_id or e.lost:
+                            continue
+                        if e.map_id not in dropped_mids and faults.check(
+                                "cluster.migrate.drop",
+                                shuffle=self.shuffle_id, part=pid,
+                                map=e.map_id) is not None:
+                            dropped_mids.add(e.map_id)
+            for pid, row in enumerate(self._entries):
+                keep = []
+                for e in row:
+                    if e.worker_id != worker_id or e.lost:
+                        continue
+                    if e.map_id in dropped_mids:
+                        dropped += 1
+                        continue
+                    keep.append(e)
+                # contiguous source-slot ranges fetch as one stream each
+                i, n = 0, len(keep)
+                while i < n:
+                    j = i + 1
+                    while j < n and keep[j].wslot == keep[j - 1].wslot + 1:
+                        j += 1
+                    seg = keep[i:j]
+                    runs.append({"pid": pid, "lo": seg[0].wslot,
+                                 "hi": seg[-1].wslot + 1,
+                                 "map_ids": [e.map_id for e in seg],
+                                 "rows": [e.rows for e in seg],
+                                 "epochs": [
+                                     self._epochs.get(e.map_id, 0) + 1
+                                     for e in seg]})
+                    i = j
+        return runs, dropped
 
     # -- ShuffleTransport SPI -------------------------------------------
     def write_partition(self, shuffle_id, map_id, part_id, batch,
@@ -315,31 +395,56 @@ class ClusterMapOutputTracker:
                     # the fetch below then fails for real and the
                     # DETECTION + recovery machinery runs unfaked
                     self.cluster.kill_worker(owner)
-        with self._lock:
-            snap = list(self._entries[part_id])[lo:hi]
-        lost = {e.map_id: e.epoch for e in snap if e.lost}
-        if lost:
-            raise MapOutputLostError(
-                shuffle_id, part_id, lost,
-                detail="slots invalidated pending recompute")
-        for worker_id, wlo, whi in _runs(snap):
-            addr = self._shuffle_addr[worker_id]
-            try:
-                yield from self._fetch_run(addr, part_id, wlo, whi)
-            except MapOutputLostError:
-                raise
-            except ShuffleFetchError as e:
-                handle = self.cluster.worker_by_id(worker_id)
-                if handle is not None:
-                    self.cluster.mark_worker_lost(
-                        worker_id, f"fetch failed: {e}")
-                all_lost = self.mark_worker_lost(worker_id)
-                if not all_lost:
-                    raise
+        delivered = 0
+        while True:
+            self.ctx.check_cancel()
+            with self._lock:
+                snap = list(self._entries[part_id])[lo:hi]
+            snap = snap[delivered:]
+            lost = {e.map_id: e.epoch for e in snap if e.lost}
+            if lost:
                 raise MapOutputLostError(
-                    shuffle_id, part_id, all_lost,
-                    detail=f"worker {worker_id} died mid-fetch: {e}"
-                ) from e
+                    shuffle_id, part_id, lost,
+                    detail="slots invalidated pending recompute")
+            if not snap:
+                return
+            resume = False
+            for worker_id, wlo, whi in _runs(snap):
+                addr = self._shuffle_addr[worker_id]
+                try:
+                    for batch in self._fetch_run(addr, part_id, wlo, whi):
+                        yield batch
+                        delivered += 1
+                except MapOutputLostError:
+                    raise
+                except ShuffleFetchError as e:
+                    # a graceful drain may have RELOCATED the remaining
+                    # slots while this reader streamed: if nothing
+                    # undelivered still lives on the failed worker,
+                    # resume from the new owners instead of declaring a
+                    # loss (the planned-scale-down copy, not a recompute)
+                    with self._lock:
+                        cur = list(self._entries[part_id])[lo:hi]
+                    undelivered = cur[delivered:]
+                    if undelivered and not any(
+                            x.worker_id == worker_id and not x.lost
+                            for x in undelivered):
+                        get_registry().inc("cluster.migrated_refetches")
+                        resume = True
+                        break
+                    handle = self.cluster.worker_by_id(worker_id)
+                    if handle is not None:
+                        self.cluster.mark_worker_lost(
+                            worker_id, f"fetch failed: {e}")
+                    all_lost = self.mark_worker_lost(worker_id)
+                    if not all_lost:
+                        raise
+                    raise MapOutputLostError(
+                        shuffle_id, part_id, all_lost,
+                        detail=f"worker {worker_id} died mid-fetch: {e}"
+                    ) from e
+            if not resume:
+                return
 
     def _fetch_run(self, addr, part_id, wlo, whi) -> Iterator:
         from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
@@ -617,6 +722,7 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
     from concurrent.futures import ThreadPoolExecutor
     from spark_rapids_tpu.cluster.rpc import RpcError, rpc_call
     reg = get_registry()
+    speculate = SPECULATION_ENABLED.get(ctx.conf.settings)
     pending = sorted(int(c) for c in cpids)
     max_rounds = max(4, 2 * len(cluster.workers()) + 2)
     rounds = 0
@@ -628,7 +734,7 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
                 f"shuffle {str(tracker.shuffle_id)[:12]}: fragment "
                 f"dispatch did not converge after {rounds - 1} rounds "
                 f"({len(pending)} partitions still unplaced)")
-        live = cluster.live_workers()
+        live = cluster.schedulable_workers()
         if not live:
             raise ClusterExecError(
                 f"shuffle {str(tracker.shuffle_id)[:12]}: no live "
@@ -641,6 +747,20 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
         tracer = ctx.tracer
 
         def run_one(wid: str, cps: list[int]):
+            if tracker._faults is not None:
+                act = tracker._faults.check(
+                    "cluster.worker.slow", worker=wid,
+                    shuffle=tracker.shuffle_id)
+                if act is not None:
+                    # a straggling executor, modelled driver-side so
+                    # speculation's duplicate has a real head start
+                    time.sleep(act.param("seconds", 2.0))
+                act = tracker._faults.check(
+                    "cluster.worker.flaky", worker=wid,
+                    shuffle=tracker.shuffle_id)
+                if act is not None:
+                    raise RpcError(
+                        f"injected fault: flaky worker {wid}")
             spec = {"exchange": clone, "num_parts": num_parts,
                     "cpids": cps, "conf": frag_conf}
             if tracer is not None:
@@ -652,45 +772,171 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
                                   if m // MAP_ID_STRIDE in set(cps)}
             blob = pickle.dumps(spec, protocol=pickle.HIGHEST_PROTOCOL)
             reg.inc("cluster.fragments_dispatched")
-            return rpc_call(handles[wid].rpc_addr, "run_fragment",
+            handle = handles.get(wid) or cluster.worker_by_id(wid)
+            return rpc_call(handle.rpc_addr, "run_fragment",
                             {"shuffle_id": str(tracker.shuffle_id)},
                             blob=blob, conf=ctx.conf,
                             faults=tracker._faults)[0]
 
-        results: dict[str, Any] = {}
-        with ThreadPoolExecutor(max_workers=len(assign)) as pool:
-            futs = {wid: pool.submit(run_one, wid, cps)
-                    for wid, cps in assign.items()}
-            for wid, fut in futs.items():
-                try:
-                    results[wid] = fut.result()
-                except (RpcError, ConnectionError, OSError) as e:
-                    results[wid] = e
         next_pending: list[int] = []
-        for wid, cps in assign.items():
-            res = results[wid]
-            if isinstance(res, Exception):
-                # control plane unreachable: the worker is gone; its
-                # partitions go back in the pool for the survivors
-                cluster.mark_worker_lost(wid, f"run_fragment RPC: {res}")
-                next_pending.extend(cps)
-                continue
-            spans = res.get("spans")
-            if tracer is not None and spans:
-                # merge the worker's spans (success OR structured
-                # failure) onto the driver timeline, one labelled lane
-                # per worker pid
-                tracer.ensure_lane(tracer.pid, "driver")
-                tracer.ensure_lane(int(spans["pid"]),
-                                   f"cluster worker {wid}")
-                tracer.ingest_wall(spans.get("events") or [])
-            kind = res.get("error_kind")
-            if kind:
-                _handle_fragment_loss(cluster, ctx, res)
-                next_pending.extend(cps)
-                continue
-            tracker.register(wid, res["shuffle"], res["entries"])
+        if speculate:
+            _dispatch_round_speculative(cluster, ctx, tracker, tracer,
+                                        assign, run_one, next_pending)
+        else:
+            results: dict[str, Any] = {}
+            with ThreadPoolExecutor(max_workers=len(assign)) as pool:
+                futs = {wid: pool.submit(run_one, wid, cps)
+                        for wid, cps in assign.items()}
+                for wid, fut in futs.items():
+                    try:
+                        results[wid] = fut.result()
+                    except (RpcError, ConnectionError, OSError) as e:
+                        results[wid] = e
+            for wid, cps in assign.items():
+                _consume_result(cluster, ctx, tracker, tracer, wid, cps,
+                                results[wid], next_pending)
         pending = sorted(next_pending)
+
+
+def _consume_result(cluster, ctx: ExecCtx, tracker, tracer, wid: str,
+                    cps: list, res, next_pending: list) -> None:
+    """Fold one fragment attempt's outcome into the round: register a
+    success, re-pool a structured failure (after driving upstream
+    recovery), and pass a transport failure through the cluster's
+    failure verdict (lost / quarantined / tolerated — all re-pool)."""
+    if isinstance(res, Exception):
+        # control plane unreachable or flaky: the verdict decides
+        # whether the worker is gone or just benched; either way its
+        # partitions go back in the pool
+        cluster.record_worker_failure(wid, f"run_fragment RPC: {res}")
+        next_pending.extend(cps)
+        return
+    spans = res.get("spans")
+    if tracer is not None and spans:
+        # merge the worker's spans (success OR structured
+        # failure) onto the driver timeline, one labelled lane
+        # per worker pid
+        tracer.ensure_lane(tracer.pid, "driver")
+        tracer.ensure_lane(int(spans["pid"]),
+                           f"cluster worker {wid}")
+        tracer.ingest_wall(spans.get("events") or [])
+    kind = res.get("error_kind")
+    if kind == "draining":
+        # a planned removal raced this dispatch: nobody died, the
+        # partitions simply move to the survivors next round
+        get_registry().inc("cluster.fragments_rejected_draining")
+        next_pending.extend(cps)
+        return
+    if kind:
+        _handle_fragment_loss(cluster, ctx, res)
+        next_pending.extend(cps)
+        return
+    cluster.note_worker_success(wid)
+    tracker.register(wid, res["shuffle"], res["entries"])
+
+
+def _dispatch_round_speculative(cluster, ctx: ExecCtx, tracker, tracer,
+                                assign, run_one, next_pending) -> None:
+    """One dispatch round with straggler speculation: every assignment
+    runs as before, but a single attempt whose wall time exceeds
+    ``speculation.multiplier`` × the round's running median gets a
+    DUPLICATE on another schedulable worker; the first completed
+    attempt per assignment wins and commits (the tracker's
+    first-writer-wins epoch check rejects the loser's slots — the
+    exactly-once guarantee).  Losers still running when the round
+    completes are abandoned to finish in the background."""
+    from concurrent.futures import ThreadPoolExecutor
+    from spark_rapids_tpu.cluster.rpc import RpcError
+    reg = get_registry()
+    s = ctx.conf.settings
+    mult = SPECULATION_MULTIPLIER.get(s)
+    min_rt = SPECULATION_MIN_RUNTIME.get(s)
+    pool = ThreadPoolExecutor(
+        max_workers=2 * len(assign) + 1,
+        thread_name_prefix="tpu-cluster-speculate")
+
+    def attempt(wid, cps):
+        def call():
+            try:
+                return run_one(wid, cps)
+            except (RpcError, ConnectionError, OSError) as e:
+                return e
+        return (wid, pool.submit(call), time.monotonic())
+
+    # key -> list of live attempts; first completion wins the key
+    attempts = {tuple(cps): [attempt(wid, cps)]
+                for wid, cps in assign.items()}
+    owner = {tuple(cps): wid for wid, cps in assign.items()}
+    walls: list[float] = []
+    done_keys: set = set()
+    try:
+        while len(done_keys) < len(attempts):
+            ctx.check_cancel()
+            time.sleep(0.02)
+            now = time.monotonic()
+            for key, atts in attempts.items():
+                if key in done_keys:
+                    continue
+                finished = [(w, f, t0) for (w, f, t0) in atts
+                            if f.done()]
+                winner = next(
+                    ((w, f, t0) for (w, f, t0) in finished
+                     if not isinstance(f.result(), Exception)
+                     and not f.result().get("error_kind")), None)
+                if winner is None and len(finished) == len(atts):
+                    # every attempt failed: consume one failure so the
+                    # partitions re-pool (and the loss is handled)
+                    w, f, t0 = finished[-1]
+                    _consume_result(cluster, ctx, tracker, tracer, w,
+                                    list(key), f.result(), next_pending)
+                    done_keys.add(key)
+                    continue
+                if winner is None:
+                    # still running: speculate when the sole attempt
+                    # has outlived the round's typical fragment
+                    if len(atts) == 1 and walls:
+                        import statistics
+                        med = statistics.median(walls)
+                        elapsed = now - atts[0][2]
+                        if elapsed > max(min_rt, mult * med):
+                            cand = [h for h in
+                                    cluster.schedulable_workers()
+                                    if h.worker_id not in
+                                    {w for (w, _, _) in atts}]
+                            if cand:
+                                tgt = cand[0].worker_id
+                                atts.append(attempt(tgt, list(key)))
+                                reg.inc("speculative_launched")
+                                print(f"cluster: speculating "
+                                      f"{list(key)} of "
+                                      f"{owner[key]} on {tgt}",
+                                      file=sys.stderr)
+                    continue
+                w, f, t0 = winner
+                wall = now - t0
+                walls.append(wall)
+                reg.observe("cluster.fragment.wall_seconds", wall)
+                _consume_result(cluster, ctx, tracker, tracer, w,
+                                list(key), f.result(), next_pending)
+                if len(atts) > 1:
+                    # a duplicate existed: exactly one attempt's work
+                    # is wasted (the loser's commit is epoch-rejected)
+                    reg.inc("speculative_wasted", len(atts) - 1)
+                    for (lw, lf, _) in atts:
+                        if lf is f or not lf.done():
+                            continue
+                        lres = lf.result()
+                        if not isinstance(lres, Exception) \
+                                and not lres.get("error_kind"):
+                            # commit the already-finished loser too:
+                            # first-writer-wins discards its slots
+                            tracker.register(lw, lres["shuffle"],
+                                             lres["entries"])
+                done_keys.add(key)
+    finally:
+        # abandon still-running losers; their late replies are never
+        # consumed and their slots are epoch-stale by construction
+        pool.shutdown(wait=False)
 
 
 def _handle_fragment_loss(cluster, ctx: ExecCtx, res: dict) -> None:

@@ -92,8 +92,16 @@ class WorkerRuntime:
         self._stop = threading.Event()
         self._runtime_ready = False
         self._runtime_lock = threading.Lock()
+        # graceful drain: once draining, new fragments are rejected with
+        # a structured reply (the driver re-pools them on survivors) and
+        # the driver polls _active down to zero before migrating slots
+        self._draining = False
+        self._active_lock = threading.Lock()
+        self._active_fragments = 0
         self.metrics = {"fragments_run": 0, "fragment_failures": 0,
-                        "map_batches_written": 0}
+                        "map_batches_written": 0,
+                        "fragments_rejected_draining": 0,
+                        "map_outputs_imported": 0}
         # tracers of fragments currently executing: the heartbeat drains
         # them mid-run so a long map stage streams spans to the driver
         # instead of batching them all on completion
@@ -107,6 +115,8 @@ class WorkerRuntime:
             {"ping": self._h_ping,
              "run_fragment": self._h_run_fragment,
              "release_shuffle": self._h_release_shuffle,
+             "drain": self._h_drain,
+             "migrate_slots": self._h_migrate_slots,
              "shutdown": self._h_shutdown},
             timeout=RPC_TIMEOUT.get(self.conf.settings),
             codec_name=RPC_COMPRESSION_CODEC.get(self.conf.settings))
@@ -124,6 +134,61 @@ class WorkerRuntime:
         self._stop.set()
         return ({"ok": True}, b"")
 
+    def _h_drain(self, payload: dict, blob: bytes):
+        """Enter (or poll) draining: stop accepting fragments and report
+        how many are still executing.  Idempotent — the driver calls it
+        repeatedly until ``active`` reaches zero."""
+        self._draining = True
+        with self._active_lock:
+            active = self._active_fragments
+        return ({"ok": True, "draining": True, "active": active}, b"")
+
+    def _h_migrate_slots(self, payload: dict, blob: bytes):
+        """Adopt a retiring peer's map-output slots: pull each run's
+        serialized frames over the shuffle plane and import them into
+        the local store under the driver-bumped epochs, then return the
+        same per-slot registration records a fragment reply carries so
+        the driver's tracker re-points atomically."""
+        from spark_rapids_tpu.shuffle.errors import ShuffleFetchError
+        from spark_rapids_tpu.shuffle.retry import fetch_remote_with_retry
+        sid = payload["shuffle_id"]
+        source = tuple(payload["source"])
+        imported: set[int] = set()
+        pids: set[int] = set()
+        try:
+            for run in payload["runs"]:
+                pid = int(run["pid"])
+                mids = [int(m) for m in run["map_ids"]]
+                rows = [int(r) for r in run["rows"]]
+                epochs = [int(e) for e in run["epochs"]]
+                frames = list(fetch_remote_with_retry(
+                    source, sid, pid, lo=int(run["lo"]),
+                    hi=int(run["hi"]), device=False, conf=self.conf,
+                    raw=True))
+                if len(frames) != len(mids):
+                    return ({"error_kind": "migrate_fetch",
+                             "error": f"migration run for shuffle {sid} "
+                                      f"part {pid} returned "
+                                      f"{len(frames)} frames, expected "
+                                      f"{len(mids)}"}, b"")
+                for mid, r, ep, raw in zip(mids, rows, epochs, frames):
+                    self.store.import_serialized(sid, mid, pid, raw,
+                                                 rows=r, epoch=ep)
+                    imported.add(mid)
+                    pids.add(pid)
+                    self.metrics["map_outputs_imported"] += 1
+        except ShuffleFetchError as e:
+            return ({"error_kind": "migrate_fetch", "error": str(e)}, b"")
+        entries = []
+        for pid in sorted(pids):
+            for wslot, (mid, size, rows, ep) in enumerate(
+                    self.store.slots_for(sid, pid)):
+                if mid in imported:
+                    entries.append([mid, pid, wslot, size, rows, ep])
+        return ({"ok": True, "entries": entries,
+                 "shuffle": list(self.shuffle_server.address),
+                 "imported": len(imported)}, b"")
+
     def _ensure_runtime(self) -> None:
         # first fragment pays JAX/runtime init, keeping READY fast
         with self._runtime_lock:
@@ -138,7 +203,23 @@ class WorkerRuntime:
         partitioned pieces into the local store.  Structured failure
         payloads (never error frames) let the driver distinguish a
         peer's data loss — which routes into lineage recovery — from
-        this worker's own fault."""
+        this worker's own fault.  A draining worker rejects the call
+        structurally so the driver re-pools the partitions on survivors
+        without treating the rejection as data loss."""
+        if self._draining:
+            self.metrics["fragments_rejected_draining"] += 1
+            return ({"error_kind": "draining",
+                     "error": f"worker {self.worker_id} is draining"},
+                    b"")
+        with self._active_lock:
+            self._active_fragments += 1
+        try:
+            return self._run_fragment(payload, blob)
+        finally:
+            with self._active_lock:
+                self._active_fragments -= 1
+
+    def _run_fragment(self, payload: dict, blob: bytes):
         from spark_rapids_tpu.cluster.exec import WorkerFetchFailed
         from spark_rapids_tpu.conf import TpuConf
         from spark_rapids_tpu.exec.core import ExecCtx

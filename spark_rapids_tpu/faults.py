@@ -123,6 +123,33 @@ Injection points wired today (site -> actions it interprets):
                         goes silent, so the heartbeat monitor declares
                         it dead after cluster.heartbeat.timeoutSeconds
                         and recovery reassigns its partitions.
+    cluster.worker.slow checked driver-side before each fragment RPC is
+                        sent (ctx: worker, shuffle; cluster/exec.py).
+                        Any action name works (use ``slow``); the
+                        dispatch thread sleeps ``seconds`` (default 2)
+                        before calling the worker, modelling a
+                        straggling executor so speculation
+                        (spark.rapids.cluster.speculation.enabled) can
+                        be driven deterministically.
+    cluster.worker.flaky
+                        checked driver-side before each fragment RPC is
+                        sent (ctx: worker, shuffle; cluster/exec.py).
+                        Any action name works (use ``flaky``); the
+                        dispatch fails with an RpcError as if the
+                        worker's control plane dropped the call —
+                        consecutive firings drive the quarantine
+                        machinery (quarantine.maxFailures) without
+                        killing the process, so its map outputs stay
+                        servable.
+    cluster.migrate.drop
+                        checked driver-side per slot while planning a
+                        graceful drain's map-output migration (ctx:
+                        shuffle, part, map; cluster/driver.py).  Any
+                        action name works (use ``drop``); the slot is
+                        excluded from migration and left on the
+                        retiring worker, so removal marks it lost and
+                        the reader's MapOutputLostError -> lineage
+                        fallback is exercised for real.
     cluster.rpc.drop    before each control-plane RPC send (ctx: op).
                         Any action name works (use ``drop``); the dial
                         fails with a ConnectionError the RPC retry
@@ -194,6 +221,9 @@ KNOWN_POINTS = frozenset({
     "admission.tenant.storm",
     "cluster.worker.dead",
     "cluster.worker.hang",
+    "cluster.worker.slow",
+    "cluster.worker.flaky",
+    "cluster.migrate.drop",
     "cluster.rpc.drop",
 })
 

@@ -144,12 +144,17 @@ class ObsHttpServer:
                 workers.append({
                     "worker_id": h.worker_id, "pid": h.pid,
                     "alive": h.alive, "lost_reason": h.lost_reason,
+                    "state": getattr(h, "state", None)
+                    or ("alive" if h.alive else "lost"),
                     "heartbeat_age_s": (
                         None if not h.last_heartbeat
                         else round(now - h.last_heartbeat, 3)),
                 })
             out["cluster"] = {"workers": workers}
-            if any(not w["alive"] for w in workers) \
+            # only UNPLANNED loss degrades readiness: a draining or
+            # retired worker is a planned scale-down, a quarantined one
+            # still serves its map outputs
+            if any(w["state"] == "lost" for w in workers) \
                     and out["status"] == "ok":
                 out["status"] = "degraded"
         return out

@@ -102,6 +102,8 @@ _LABELED = (
      "shuffle_peer_{leaf}", "peer"),
     (re.compile(r"^shuffle\.breaker\.(?P<val>.+)\.(?P<leaf>failures|open)$"),
      "shuffle_breaker_{leaf}", "peer"),
+    (re.compile(r"^cluster\.workers\.state\.(?P<val>.+)$"),
+     "cluster_workers", "state"),
 )
 
 

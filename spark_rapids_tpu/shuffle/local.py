@@ -146,6 +146,52 @@ class LocalShuffleTransport:
             return
         self.metrics["batches_written"] += 1
 
+    def import_serialized(self, shuffle_id: "int | str", map_id: int,
+                          part_id: int, raw: bytes, rows: int = 0,
+                          epoch: int | None = None) -> None:
+        """Store one already-serialized map-output batch (Arrow IPC
+        bytes) under an explicit epoch — the graceful-drain migration
+        path (cluster/worker.py _h_migrate_slots): a survivor pulls a
+        retiring peer's slots as wire bytes and adopts them without a
+        device round-trip.  The local epoch advances to the imported
+        one so a straggling write from the retiring attempt is
+        discarded, mirroring write_partition's stale-epoch rule."""
+        self.metrics["bytes_written"] += len(raw)
+        if self.codec is not None:
+            comp = self.codec.compress(raw)
+            self.metrics["bytes_compressed"] += len(comp)
+            item = ("bytes", comp, len(raw))
+        else:
+            item = ("bytes", raw, len(raw))
+        size = len(item[1])
+        with self._lock:
+            current = self._epochs.get((shuffle_id, map_id), 0)
+            eff = current if epoch is None else int(epoch)
+            if eff < current:
+                self.metrics["stale_writes_discarded"] += 1
+                return
+            self._epochs[(shuffle_id, map_id)] = eff
+            slots = self._store.setdefault((shuffle_id, part_id), [])
+            refill = next((s for s in slots
+                           if s.map_id == map_id and s.item is None),
+                          None)
+            if refill is not None:
+                refill.item = item
+                refill.epoch = eff
+                refill.size = size
+                refill.rows = rows
+                idx = slots.index(refill)
+                self._batch_sizes[(shuffle_id, part_id)][idx] = size
+            else:
+                slots.append(_Slot(map_id, eff, item, size, rows))
+                self._batch_sizes.setdefault((shuffle_id, part_id),
+                                             []).append(size)
+            self._sizes[(shuffle_id, part_id)] = \
+                self._sizes.get((shuffle_id, part_id), 0) + size
+            self._rows[(shuffle_id, part_id)] = \
+                self._rows.get((shuffle_id, part_id), 0) + rows
+        self.metrics["batches_written"] += 1
+
     def map_epoch(self, shuffle_id: "int | str", map_id: int) -> int:
         with self._lock:
             return self._epochs.get((shuffle_id, map_id), 0)

@@ -262,7 +262,7 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                             retry_wait: float | None = None,
                             backoff: float | None = None,
                             tracer=None, trace: dict | None = None,
-                            lifecycle=None) -> Iterator:
+                            lifecycle=None, raw: bool = False) -> Iterator:
     """Stream one reduce partition's batches, surviving transport
     failures: on a retryable error, reconnect with exponential backoff
     + jitter and resume at the last fully-delivered batch offset.
@@ -317,7 +317,7 @@ def fetch_remote_with_retry(address, shuffle_id: "int | str", part_id: int,
                                       max_frame=max_frame, timeout=timeout,
                                       sock_timeout=sock_timeout,
                                       checksum=checksum, faults=faults,
-                                      trace=trace):
+                                      trace=trace, raw=raw):
                 yield batch
                 delivered += 1
             breaker.record_success()

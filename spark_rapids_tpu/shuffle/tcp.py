@@ -543,7 +543,7 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                  timeout: float | None = None,
                  sock_timeout: float | None = None,
                  checksum: bool = True, faults=None,
-                 trace: dict | None = None) -> Iterable:
+                 trace: dict | None = None, raw: bool = False) -> Iterable:
     """Data plane: stream one reduce partition's batches from a peer
     (reference RapidsShuffleClient.scala: TransferRequest -> bounce
     buffers -> reassembled device buffers).  The wire codec and frame
@@ -552,7 +552,12 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
     (connect, send, or receive; 0 disables the deadline), a reset or
     mid-frame close, a frame failing its negotiated checksum — raises
     ShuffleTransportError (retryable; see shuffle/retry.py) instead of
-    wedging or poisoning the reduce task."""
+    wedging or poisoning the reduce task.
+
+    ``raw=True`` yields the decompressed Arrow IPC bytes of each slot
+    instead of deserialized batches — the graceful-drain migration path
+    relays a retiring worker's slots into a survivor's store without a
+    decode/re-encode round trip (cluster/worker.py)."""
     window = int(inflight_limit or TCP_INFLIGHT_LIMIT.default)
     tmo = _resolve_timeout(timeout)
     peer_label = ":".join(str(x) for x in tuple(address))
@@ -645,7 +650,8 @@ def fetch_remote(address, shuffle_id: "int | str", part_id: int, lo: int = 0,
                             f"compressed frame claims raw size {raw_size} "
                             f"> max frame {max_frame}")
                     frame = codec.decompress(frame[4:], raw_size)
-                yield deserialize_batch(frame, device=device)
+                yield frame if raw else deserialize_batch(frame,
+                                                          device=device)
                 index += 1
     except TimeoutError as e:
         raise ShuffleTransportError(

@@ -262,6 +262,57 @@ _CHAOS_CONF = {
 }
 
 
+# ---------------------------------------------------------------------------
+# membership churn hygiene: repeated scale-up/down leaks nothing
+# ---------------------------------------------------------------------------
+
+def _open_fds():
+    return len(os.listdir("/proc/self/fd"))
+
+
+def test_membership_churn_leaks_nothing():
+    """Three add/remove cycles on a live pool: every retired worker
+    process is reaped, every per-worker io thread exits, and the
+    driver's fd table returns to its pre-churn size (RPC sockets,
+    stdio pipes, shuffle connections all closed)."""
+    s = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.maxWorkers": "8"})
+    df = s.from_pydict(_mkdata(), SCHEMA, partitions=3, rows_per_batch=64)
+    agg = df.group_by("k").agg(Sum(col("v")).alias("sv"))
+    want = sorted(agg.collect())
+    drv = s._cluster()
+    fds0 = _open_fds()
+    retired = []
+    for _ in range(3):
+        wid = drv.add_worker()
+        assert sorted(agg.collect()) == want
+        drv.remove_worker(wid, drain=True)
+        retired.append(drv.worker_by_id(wid))
+    # processes reaped (no zombies), io threads joined
+    for h in retired:
+        assert h.proc.poll() is not None, \
+            f"churned worker {h.worker_id} still running"
+        assert h.io_thread is None or not h.io_thread.is_alive(), \
+            f"io thread for {h.worker_id} leaked"
+    assert not [t.name for t in threading.enumerate()
+                if t.name.startswith("tpu-cluster-io-")
+                and t.name.split("-")[-1] in
+                [h.worker_id for h in retired]]
+    # fd table settles back to the steady-state size (allow slack for
+    # lazily-opened shuffle client connections to the LIVE workers)
+    deadline = time.monotonic() + 5.0
+    while _open_fds() > fds0 + 4 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert _open_fds() <= fds0 + 4, \
+        f"fd leak across churn: {fds0} -> {_open_fds()}"
+    assert sorted(agg.collect()) == want
+    handles = drv.workers()
+    s.shutdown(drain=True)
+    for h in handles:
+        assert h.proc.poll() is not None, \
+            f"worker {h.worker_id} still running after shutdown"
+
+
 @pytest.mark.slow
 def test_tpch_worker_death_recovers_exact(tpch_dir):
     """q18 with a worker SIGKILLed mid-query: lineage recovery must

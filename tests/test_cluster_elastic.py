@@ -1,0 +1,392 @@
+"""Elastic membership chaos matrix: live scale-up/down, graceful drain
+with map-output migration, straggler speculation, flaky-worker
+quarantine, and probe-before-death (spark_rapids_tpu/cluster/).
+
+The reference engine rides Spark's dynamic allocation + speculative
+execution + executor blacklisting; here the driver owns all three
+directly: ``add_worker``/``remove_worker`` mutate the live pool with no
+restart, a draining worker streams its map outputs to survivors over
+the existing shuffle plane (tracker entries rewritten under an epoch
+bump — a planned scale-down costs a copy, not a recompute), fragments
+whose wall time exceeds ``speculation.multiplier`` x the running median
+are re-dispatched with exactly-once commit via epoch-stale rejection,
+and a worker past ``quarantine.maxFailures`` consecutive failures is
+benched (outputs still servable) until probation re-admits it.
+
+Every case asserts EXACT rows against a single-process oracle: the
+elasticity machinery must never change an answer, only where the bytes
+live.  Fast cases drive a pydict group-by; the q18 drain rides the
+split-table TPC-H fixture (slow, like tests/test_cluster.py's chaos
+paths).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.obs.registry import get_registry
+
+SCHEMA = T.Schema([
+    T.StructField("k", T.IntegerType(), True),
+    T.StructField("v", T.LongType(), True),
+])
+
+
+def _mkdata(n, seed):
+    rng = np.random.default_rng(seed)
+    return {"k": [int(x) for x in rng.integers(0, 997, n)],
+            "v": [int(x) for x in rng.integers(-1000, 1000, n)]}
+
+
+def _oracle(data, partitions, rows_per_batch=512):
+    s = TpuSession()
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=partitions,
+                           rows_per_batch=rows_per_batch)
+        return sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                      .collect())
+    finally:
+        s.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    """One shared dataset + single-process oracle for every pydict
+    case: a group-by sum's rows do not depend on partition count, so
+    each test picks its own fan-out against the same answer."""
+    data = _mkdata(20000, seed=21)
+    return data, _oracle(data, partitions=6)
+
+
+def _drain_on_first_fetch(monkeypatch, drv, victim):
+    """Retire ``victim`` synchronously at the reduce's FIRST map-output
+    fetch: every map output is registered, the tracker is open, and no
+    partition has been consumed — the canonical mid-query drain window,
+    hit deterministically instead of racing a poll thread against the
+    collect."""
+    import spark_rapids_tpu.cluster.exec as cexec
+    fired: dict = {}
+    orig = cexec.ClusterMapOutputTracker.fetch_partition
+
+    def hooked(self, shuffle_id, pid, lo=0, hi=None):
+        if not fired:
+            fired["ok"] = True
+            fired.update(drv.remove_worker(victim, drain=True))
+        return orig(self, shuffle_id, pid, lo, hi)
+
+    monkeypatch.setattr(cexec.ClusterMapOutputTracker, "fetch_partition",
+                        hooked)
+    return fired
+
+
+# ---------------------------------------------------------------------------
+# case 1: live scale-up — the next query picks the new worker up
+# ---------------------------------------------------------------------------
+
+def test_scale_up_next_query_uses_new_worker(dataset):
+    data, want = dataset
+    s = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.maxWorkers": "3",
+                    "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2"})
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+        assert sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                      .collect()) == want
+        drv = s._cluster()
+        before = get_registry().snapshot()
+        wid = drv.add_worker()
+        assert wid == "w2"
+        h = drv.worker_by_id(wid)
+        assert h.alive and not h.draining
+        # membership is a hard ceiling, not advisory
+        with pytest.raises(RuntimeError, match="maxWorkers"):
+            drv.add_worker()
+        # the NEXT query's dispatch snapshot includes w2 with no restart
+        assert sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                      .collect()) == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("cluster_workers_added", 0) == 1, d
+        # w2 heartbeats its own registry; wait for proof it ran fragments
+        deadline = time.monotonic() + 10.0
+        ran = 0
+        while time.monotonic() < deadline:
+            # object sources (the worker's metrics dict) export as gauges
+            ran = ((h.metrics or {}).get("gauges") or {}).get(
+                "cluster.worker.fragments_run", 0)
+            if ran >= 1:
+                break
+            time.sleep(0.1)
+        assert ran >= 1, "scaled-up worker never ran a fragment"
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# case 2 (fast twin of the q18 drain): mid-query retirement migrates,
+# never recomputes
+# ---------------------------------------------------------------------------
+
+def test_drain_mid_query_migrates_without_recompute(dataset, monkeypatch):
+    data, want = dataset
+    s = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2"})
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=8, rows_per_batch=512)
+        drv = s._cluster()
+        fired = _drain_on_first_fetch(monkeypatch, drv, "w1")
+        before = get_registry().snapshot()
+        got = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                     .collect())
+        assert fired.get("ok"), "drain never triggered mid-query"
+        assert got == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("map_outputs_migrated", 0) > 0, d
+        assert d.get("stage_recomputes", 0) == 0, d
+        assert d.get("cluster_workers_drained", 0) == 1, d
+        h = drv.worker_by_id("w1")
+        assert h.retired and not h.alive
+        assert h.proc.poll() is not None, "retired worker still running"
+        # retirement shows as planned in health, not as a loss
+        assert h.state == "retired" and h.lost_reason == "drained"
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# case 3: drain that LOSES a slot falls back to lineage — exactly once
+# ---------------------------------------------------------------------------
+
+def test_drain_with_migrate_drop_recomputes_exactly_once(dataset,
+                                                         monkeypatch):
+    data, want = dataset
+    s = TpuSession({
+        "spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2",
+        "spark.rapids.test.faults": "cluster.migrate.drop:drop,times=1",
+        "spark.rapids.shuffle.tcp.maxRetries": 1,
+        "spark.rapids.shuffle.tcp.retryWaitSeconds": 0.1,
+    })
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=8, rows_per_batch=512)
+        drv = s._cluster()
+        fired = _drain_on_first_fetch(monkeypatch, drv, "w1")
+        before = get_registry().snapshot()
+        got = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                     .collect())
+        assert fired.get("ok"), "drain never triggered mid-query"
+        assert got == want
+        # the drop withholds ONE whole map output (all its slots stay at
+        # the old epoch on the retiring worker); lineage recomputes that
+        # map task exactly once and everything else rides the migration
+        assert fired["dropped"] > 0 and fired["migrated"] > 0, fired
+        d = get_registry().delta(before)["counters"]
+        assert d.get("faults.injected.cluster.migrate.drop", 0) == 1, d
+        assert d.get("stage_recomputes", 0) == 1, d
+        assert d.get("map_outputs_migrated", 0) == fired["migrated"], d
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# case 4: straggler speculation — duplicate wins, exactly-once commit
+# ---------------------------------------------------------------------------
+
+def test_straggler_speculation_exact_rows(dataset):
+    data, want = dataset
+    s = TpuSession({
+        "spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.cluster.speculation.enabled": "true",
+        "spark.rapids.cluster.speculation.multiplier": "2.0",
+        "spark.rapids.cluster.speculation.minRuntimeSeconds": "0.2",
+        # the fault registry is per query: times=1 holds ONE worker's
+        # fragment for 3s in each query's dispatch round
+        "spark.rapids.test.faults":
+            "cluster.worker.slow:slow,seconds=2.0,worker=w1,times=1",
+    })
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+        # warm-up compiles both stages so the healthy worker's wall time
+        # seeds a tight speculation median
+        assert sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                      .collect()) == want
+        before = get_registry().snapshot()
+        t0 = time.monotonic()
+        got = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                     .collect())
+        wall = time.monotonic() - t0
+        assert got == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("faults.injected.cluster.worker.slow", 0) == 1, d
+        assert d.get("speculative_launched", 0) >= 1, d
+        # the duplicate — not lineage recovery — absorbed the straggler
+        assert d.get("stage_recomputes", 0) == 0, d
+        assert wall < 2.0, f"speculation did not beat the 2s straggler " \
+                           f"(wall={wall:.2f}s)"
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# case 5: flaky worker quarantined, outputs stay servable, probation
+# re-admits
+# ---------------------------------------------------------------------------
+
+def test_flaky_worker_quarantine_and_readmission(dataset):
+    data, want = dataset
+    s = TpuSession({
+        "spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.cluster.quarantine.maxFailures": "2",
+        "spark.rapids.cluster.quarantine.probationSeconds": "4.0",
+        "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2",
+        "spark.rapids.test.faults":
+            "cluster.worker.flaky:flaky,worker=w1,times=2",
+    })
+    try:
+        df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+        drv = s._cluster()
+        before = get_registry().snapshot()
+        got = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                     .collect())
+        assert got == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("faults.injected.cluster.worker.flaky", 0) == 2, d
+        assert d.get("cluster_workers_quarantined", 0) == 1, d
+        h = drv.worker_by_id("w1")
+        assert h.alive and h.quarantined_until is not None
+        assert h.state == "quarantined"
+        assert "w1" not in [w.worker_id for w in drv.schedulable_workers()]
+        # a quarantined worker gets no NEW fragments but its shuffle
+        # server still answers: a fresh query must stay exact while only
+        # w0 is schedulable
+        got2 = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                      .collect())
+        assert got2 == want
+        # probation elapses -> the monitor re-admits and resets failures
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if drv.worker_by_id("w1").quarantined_until is None:
+                break
+            time.sleep(0.1)
+        h = drv.worker_by_id("w1")
+        assert h.quarantined_until is None and h.alive and h.failures == 0
+        d = get_registry().delta(before)["counters"]
+        assert d.get("cluster_workers_readmitted", 0) == 1, d
+        assert "w1" in [w.worker_id for w in drv.schedulable_workers()]
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# case 6: heartbeat stall with a live RPC plane — the probe saves the
+# worker from a false death verdict
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_stall_probe_saves_worker(dataset):
+    data, want = dataset
+    s = TpuSession({
+        "spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2",
+        "spark.rapids.cluster.heartbeat.timeoutSeconds": "1.0",
+        # the driver DROPS w1's heartbeats; the worker itself stays live
+        "spark.rapids.test.faults": "cluster.worker.hang:hang,worker=w1",
+    })
+    try:
+        drv = s._cluster()
+        before = get_registry().snapshot()
+        deadline = time.monotonic() + 15.0
+        saves = 0
+        while time.monotonic() < deadline:
+            saves = get_registry().delta(before)["counters"].get(
+                "cluster_death_probe_saves", 0)
+            if saves >= 1:
+                break
+            time.sleep(0.1)
+        d = get_registry().delta(before)["counters"]
+        assert saves >= 1, f"probe never fired: {d}"
+        assert d.get("cluster_death_probes", 0) >= 1, d
+        h = drv.worker_by_id("w1")
+        assert h.alive and h.lost_reason is None, \
+            "probe-reachable worker was declared dead"
+        # the saved worker still computes: exact rows, zero recovery
+        df = s.from_pydict(data, SCHEMA, partitions=6, rows_per_batch=512)
+        got = sorted(df.group_by("k").agg(Sum(col("v")).alias("sv"))
+                     .collect())
+        assert got == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("stage_recomputes", 0) == 0, d
+        assert d.get("cluster_workers_lost", 0) == 0, d
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# min/max membership floors
+# ---------------------------------------------------------------------------
+
+def test_membership_floor_blocks_scale_down():
+    s = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.minWorkers": "2"})
+    try:
+        drv = s._cluster()
+        with pytest.raises(RuntimeError, match="minWorkers"):
+            drv.remove_worker("w1", drain=True)
+        with pytest.raises(KeyError):
+            drv.remove_worker("w99")
+        assert len([h for h in drv.workers() if h.alive]) == 2
+    finally:
+        s.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# the q18 drain (slow): mid-query retirement under a real TPC-H plan
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_elastic") / "sf001")
+    generate_tpch(d, sf=0.01)
+    _split_tables(d, ("lineitem", "orders", "customer"), parts=4)
+    return d
+
+
+def _split_tables(data_dir: str, tables, parts: int) -> None:
+    import pyarrow.parquet as pq
+    for table in tables:
+        path = os.path.join(data_dir, table, "part-0.parquet")
+        t = pq.read_table(path)
+        step = -(-t.num_rows // parts)
+        for i in range(parts):
+            pq.write_table(t.slice(i * step, step),
+                           os.path.join(data_dir, table,
+                                        f"part-{i}.parquet"))
+
+
+@pytest.mark.slow
+def test_tpch_q18_drain_mid_query_exact(tpch_dir, monkeypatch):
+    s0 = TpuSession()
+    want = sorted(build_tpch_query("q18", s0, tpch_dir).collect())
+    s0.shutdown()
+    s = TpuSession({"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.heartbeat.intervalSeconds": "0.2"})
+    try:
+        df = build_tpch_query("q18", s, tpch_dir)
+        drv = s._cluster()
+        fired = _drain_on_first_fetch(monkeypatch, drv, "w1")
+        before = get_registry().snapshot()
+        got = sorted(df.collect())
+        assert fired.get("ok"), "drain never triggered mid-q18"
+        assert got == want
+        d = get_registry().delta(before)["counters"]
+        assert d.get("map_outputs_migrated", 0) > 0, d
+        assert d.get("stage_recomputes", 0) == 0, d
+        h = drv.worker_by_id("w1")
+        assert h.retired and h.proc.poll() is not None
+    finally:
+        s.shutdown(drain=True)
