@@ -110,6 +110,14 @@ CLUSTER_LADDER = tuple(
                                    "1,2,4").split(",") if x.strip())
 CLUSTER_SF = float(os.environ.get("BENCH_CLUSTER_SF", "0.05"))
 CLUSTER_TIMEOUT_S = float(os.environ.get("BENCH_CLUSTER_TIMEOUT_S", "420"))
+# transactional CTAS write rung (WRITE metric): a q6-shaped CTAS
+# (lineitem under q6's filter, hive-partitioned by l_returnflag)
+# through the two-phase commit protocol (io/writer.py) — clean run for
+# the throughput number, then an io.write.* fault storm and a cluster
+# worker-death run, each of which must reproduce the clean run's
+# read-back row hash exactly.  CPU backend, like the cluster ladder.
+WRITE_SF = float(os.environ.get("BENCH_WRITE_SF", "0.1"))
+WRITE_TIMEOUT_S = float(os.environ.get("BENCH_WRITE_TIMEOUT_S", "300"))
 
 
 def _mesh_env(n_devices: int) -> dict:
@@ -475,6 +483,145 @@ def _cchild(n_workers: int, platform: str) -> None:
     os._exit(0)
 
 
+def _wchild(platform: str) -> None:
+    """One CTAS write rung: q6-shaped CTAS, clean + chaos, in one
+    killable child.  Prints a BENCH_REPORT line with the clean write's
+    wall/rows/bytes plus each chaos variant's hash verdict."""
+    import datetime
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.obs.registry import get_registry
+    from spark_rapids_tpu.session import TpuSession
+    sf = WRITE_SF
+    data = os.path.join(DATA_DIR, f"tpch_write_sf{sf:g}")
+    generate_tpch(data, sf=sf)
+    _split_tpch_tables(data, ("lineitem",), 4)
+
+    def ctas(conf, out):
+        sess = TpuSession(conf)
+        try:
+            li = sess.read_parquet(
+                os.path.join(data, "lineitem"),
+                columns=["l_returnflag", "l_extendedprice", "l_discount",
+                         "l_shipdate", "l_quantity"])
+            q6ish = li.where(
+                (col("l_shipdate") >= lit(datetime.date(1994, 1, 1)))
+                & (col("l_shipdate") < lit(datetime.date(1995, 1, 1)))
+                & (col("l_discount") >= lit(0.05))
+                & (col("l_discount") <= lit(0.07))
+                & (col("l_quantity") < lit(24.0)))
+            t0 = time.perf_counter()
+            stats = q6ish.write_parquet(out,
+                                        partition_by=["l_returnflag"])
+            return stats, time.perf_counter() - t0
+        finally:
+            sess.shutdown()
+
+    def row_hash(out):
+        import pyarrow.dataset as ds
+        t = ds.dataset(out, format="parquet",
+                       partitioning="hive").to_table()
+        t = t.select(sorted(t.column_names))
+        rows = sorted(zip(*(t.column(n).to_pylist()
+                            for n in t.column_names)), key=str)
+        h = hashlib.sha256()
+        for r in rows:
+            h.update(repr(r).encode())
+        return h.hexdigest()
+
+    base = tempfile.mkdtemp()
+    clean_out = os.path.join(base, "clean")
+    stats, wall = ctas({}, clean_out)
+    want = row_hash(clean_out)
+    out = {"ok": True, "sf": sf, "rows": stats.num_rows,
+           "files": stats.num_files, "bytes": stats.num_bytes,
+           "clean_wall_s": round(wall, 4),
+           "rows_per_s": round(stats.num_rows / max(wall, 1e-9), 1),
+           "read_back_hash": want[:16], "chaos": {}}
+    storms = {
+        "fault_storm": {"spark.rapids.test.faults":
+                        "io.write.partial:crash,times=2;"
+                        "io.write.commit.drop:drop,times=1;"
+                        "io.write.rename.fail:fail,times=1"},
+        "worker_death": {"spark.rapids.cluster.mode": "local[2]",
+                         "spark.rapids.test.faults":
+                         "cluster.worker.dead:dead,worker=w1,"
+                         "seconds=0.02,times=1"},
+    }
+    for name, conf in storms.items():
+        cdir = os.path.join(base, name)
+        before = get_registry().snapshot()
+        try:
+            _, cwall = ctas(conf, cdir)
+            delta = get_registry().delta(before)["counters"]
+            injected = sum(v for k, v in delta.items()
+                           if k.startswith("faults.injected."))
+            exact = row_hash(cdir) == want
+            out["chaos"][name] = {
+                "ok": exact and injected > 0, "exact": exact,
+                "faults_injected": injected, "wall_s": round(cwall, 4)}
+        except Exception as e:  # pragma: no cover - reported, not raised
+            out["chaos"][name] = {"ok": False, "error": str(e)[:300]}
+        out["ok"] = out["ok"] and out["chaos"][name]["ok"]
+    shutil.rmtree(base, ignore_errors=True)
+    print(_REPORT_PREFIX + json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _emit_write(rep: dict | None, error) -> None:
+    rec = {
+        "metric": f"tpch_ctas_write_sf{WRITE_SF:g}_cpu",
+        "value": float((rep or {}).get("rows_per_s") or 0.0),
+        "unit": "rows/s",
+        "report": rep or {},
+    }
+    if error:
+        rec["error"] = str(error)[:500]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _write_rung(deadline: float) -> None:
+    """Fourth metric line: the transactional CTAS write rung, its own
+    killable subprocess like every other ladder."""
+    budget = min(WRITE_TIMEOUT_S, deadline - time.monotonic())
+    if budget < 30:
+        _emit_write(None, "no budget for write rung")
+        return
+    cmd = [sys.executable, os.path.abspath(__file__), "--wchild", "cpu"]
+    rc, out, errout = _run_killable(
+        cmd, budget,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+    if rc is None:
+        _emit_write(None, f"write rung killed after {budget:.0f}s")
+        return
+    rep = None
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith(_REPORT_PREFIX):
+            try:
+                rep = json.loads(line[len(_REPORT_PREFIX):])
+            except json.JSONDecodeError:
+                pass
+            break
+    if rep is None:
+        tail = (errout or "")[-300:].replace("\n", " | ")
+        _emit_write(None, f"write rung rc={rc} no report; {tail}")
+        return
+    _emit_write(rep, None if rep.get("ok") else "write rung not exact")
+
+
 def _tchild(platform: str) -> None:
     """One killable multi-stream throughput run (the whole ladder lives
     in one child: rungs share the warm session-level caches, which is
@@ -785,6 +932,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--tchild":
         _tchild(sys.argv[2])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--wchild":
+        _wchild(sys.argv[2])
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--prewarm":
         _prewarm(float(sys.argv[2]) if len(sys.argv) > 2 else 0.1)
         return
@@ -855,6 +1005,13 @@ def main() -> None:
             "value": 0.0, "unit": "queries/hour",
             "error": f"throughput ladder crashed: {e}"}))
         sys.stdout.flush()
+    # fourth metric line: the transactional CTAS write rung (clean
+    # throughput + fault-storm/worker-death exactness)
+    w_deadline = time.monotonic() + WRITE_TIMEOUT_S
+    try:
+        _write_rung(w_deadline)
+    except Exception as e:  # pragma: no cover - rider must not gate
+        _emit_write(None, f"write rung crashed: {e}")
     sys.exit(rc)
 
 

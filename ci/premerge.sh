@@ -985,6 +985,119 @@ r = subprocess.run([sys.executable, "-c", code], capture_output=True,
 assert r.returncode == 0, r.stdout + r.stderr
 print("telemetry gate 3: port-off default imports nothing, no socket: ok")
 PY
+  echo "-- transactional write gate: CTAS exact under fault storm, no stray staging --"
+  # q6-shaped CTAS (lineitem under q6's filter, hive-partitioned) must
+  # produce the SAME read-back row hash across a clean run, an
+  # io.write.* fault storm, a cluster worker-death run, and a
+  # speculation-duplicate run — with every visible file listed in
+  # _MANIFEST.json and zero staging leftovers.  (The mid-write drain
+  # variant needs a monkeypatch hook and rides the unit suite:
+  # tests/test_write_chaos.py::test_drain_during_write_fences_and_completes.)
+  JAX_PLATFORMS=cpu python - <<'PY'
+import datetime, glob, hashlib, json, os, tempfile
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.session import TpuSession
+
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+
+# split lineitem into 4 part files so the write job has multiple tasks
+# and the cluster runs actually spread fragments over both workers
+import pyarrow.parquet as pq
+_t = pq.read_table(os.path.join(d, "lineitem", "part-0.parquet"))
+_step = -(-_t.num_rows // 4)
+for _i in range(4):
+    pq.write_table(_t.slice(_i * _step, _step),
+                   os.path.join(d, "lineitem", f"part-{_i}.parquet"))
+
+
+def ctas(conf, out):
+    sess = TpuSession(conf)
+    try:
+        li = sess.read_parquet(
+            os.path.join(d, "lineitem"),
+            columns=["l_returnflag", "l_extendedprice", "l_discount",
+                     "l_shipdate", "l_quantity"])
+        q6ish = li.where(
+            (col("l_shipdate") >= lit(datetime.date(1994, 1, 1)))
+            & (col("l_shipdate") < lit(datetime.date(1995, 1, 1)))
+            & (col("l_discount") >= lit(0.05))
+            & (col("l_discount") <= lit(0.07))
+            & (col("l_quantity") < lit(24.0)))
+        stats = q6ish.write_parquet(out, partition_by=["l_returnflag"])
+        return stats
+    finally:
+        if hasattr(sess, "shutdown"):
+            sess.shutdown()
+
+
+def row_hash(out):
+    import pyarrow.dataset as ds
+    t = ds.dataset(out, format="parquet", partitioning="hive").to_table()
+    t = t.select(sorted(t.column_names))
+    rows = sorted(zip(*(t.column(n).to_pylist()
+                        for n in t.column_names)), key=str)
+    h = hashlib.sha256()
+    for r in rows:
+        h.update(repr(r).encode())
+    return h.hexdigest()
+
+
+def check_committed(out):
+    man = json.load(open(os.path.join(out, "_MANIFEST.json")))
+    committed = {os.path.normpath(e["rel"]) for e in man["files"]}
+    visible = set()
+    for root, dirs, files in os.walk(out):
+        dirs[:] = [x for x in dirs if not x.startswith(("_", "."))]
+        for fn in files:
+            if not fn.startswith(("_", ".")):
+                visible.add(os.path.normpath(os.path.relpath(
+                    os.path.join(root, fn), out)))
+    assert visible == committed, (visible ^ committed)
+    assert not os.path.exists(os.path.join(out, "_staging"))
+
+
+base = tempfile.mkdtemp()
+clean = os.path.join(base, "clean")
+ctas({}, clean)
+want = row_hash(clean)
+check_committed(clean)
+
+STORMS = {
+    "faultstorm": {"spark.rapids.test.faults":
+                   "io.write.partial:crash,times=2;"
+                   "io.write.commit.drop:drop,times=1;"
+                   "io.write.rename.fail:fail,times=1"},
+    "workerdeath": {"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.test.faults":
+                    "cluster.worker.dead:dead,worker=w1,"
+                    "seconds=0.02,times=1"},
+    "speculation": {"spark.rapids.cluster.mode": "local[2]",
+                    "spark.rapids.cluster.speculation.enabled": "true",
+                    "spark.rapids.cluster.speculation.multiplier": "2.0",
+                    "spark.rapids.cluster.speculation."
+                    "minRuntimeSeconds": "0.2",
+                    "spark.rapids.test.faults":
+                    "cluster.worker.slow:slow,seconds=2.0,"
+                    "worker=w1,times=1"},
+}
+for name, conf in STORMS.items():
+    out = os.path.join(base, name)
+    before = get_registry().snapshot()
+    ctas(conf, out)
+    delta = get_registry().delta(before)["counters"]
+    injected = sum(v for k, v in delta.items()
+                   if k.startswith("faults.injected."))
+    assert injected > 0, f"{name}: storm never fired: {delta}"
+    assert row_hash(out) == want, f"{name}: read-back hash diverged"
+    check_committed(out)
+    print(f"write gate [{name}]: exact hash, {injected} faults injected, "
+          f"no orphans: ok")
+print("transactional write gate: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -122,6 +122,10 @@ class ClusterDriver:
         # shuffle): a graceful drain walks them to migrate the retiring
         # worker's slots; weak so a finished query's tracker vanishes
         self._trackers: "weakref.WeakSet" = weakref.WeakSet()
+        # live write-job commit coordinators (exec/write_exec.py): a
+        # drain or quarantine fences the worker in each so a straggler
+        # attempt finishing after removal cannot steal a task commit
+        self._write_coordinators: "weakref.WeakSet" = weakref.WeakSet()
         # query_id -> worker span events shipped on heartbeats, held
         # until the dispatching stage drains them into ITS tracer
         self._span_lock = threading.Lock()
@@ -337,6 +341,17 @@ class ClusterDriver:
         trackers vanish on their own."""
         self._trackers.add(tracker)
 
+    def register_write_coordinator(self, coordinator) -> None:
+        """Weakly track one write job's commit coordinator so planned
+        drains and quarantine verdicts can fence the affected worker's
+        future manifest registrations (abort-on-drain for in-flight
+        write attempts); committed/aborted jobs vanish on their own."""
+        self._write_coordinators.add(coordinator)
+
+    def _fence_write_coordinators(self, worker_id: str) -> None:
+        for coord in list(self._write_coordinators):
+            coord.fence_worker(worker_id)
+
     def add_worker(self) -> str:
         """Spawn one new worker into the live pool and wait for its
         READY handshake.  The next dispatch round's worker snapshot —
@@ -394,6 +409,10 @@ class ClusterDriver:
                     f"cannot remove {worker_id}: spark.rapids.cluster."
                     f"minWorkers={self._min_workers} would be violated")
             h.draining = True
+        # a draining worker's in-flight write attempts must not win a
+        # task commit after the worker is gone — fence it out of every
+        # live commit coordinator before touching map outputs
+        self._fence_write_coordinators(worker_id)
         stats = {"migrated": 0, "dropped": 0}
         if drain and h.alive:
             deadline = time.monotonic() + self._drain_timeout
@@ -544,6 +563,7 @@ class ClusterDriver:
         if h.failures >= self._quar_max and h.quarantined_until is None:
             h.quarantined_until = time.monotonic() + self._quar_probation
             get_registry().inc("cluster_workers_quarantined")
+            self._fence_write_coordinators(worker_id)
             print(f"cluster: worker {worker_id} quarantined after "
                   f"{h.failures} consecutive failures: {reason}",
                   file=sys.stderr)

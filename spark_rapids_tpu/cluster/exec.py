@@ -30,6 +30,7 @@ same rows, one process.
 from __future__ import annotations
 
 import copy
+import itertools
 import pickle
 import re
 import sys
@@ -556,17 +557,19 @@ class ClusterLineage:
 # fragment cloning
 # ---------------------------------------------------------------------------
 
-def _clone_fragment(exchange, ctx: ExecCtx):
-    """Clone the exchange + child subtree into a picklable fragment.
+def _clone_subtree(root, ctx: ExecCtx):
+    """Clone a plan subtree into a picklable fragment body.
 
     Upstream CLUSTER shuffles materialize now (recursively, via
     ``_shuffled`` -> this module again) and become
     WorkerShuffleReaderExec leaves; broadcasts materialize driver-side
     into StaticBroadcastExec blobs; stage boundaries resolve to their
-    adaptive replacement.  Returns None when the subtree cannot run in
-    a worker (a non-clusterable device exchange, or an upstream that
-    itself fell back in-process) — the caller falls back to the
-    classic in-process shuffle."""
+    adaptive replacement.  Returns (None, reason) when the subtree
+    cannot run in a worker (a non-clusterable device exchange, or an
+    upstream that itself fell back in-process) — the caller falls back
+    to the in-process path.  Shared by the shuffle map-side clone
+    (:func:`_clone_fragment`) and write fragments
+    (:func:`dispatch_write_fragments`)."""
     from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
                                                 BroadcastExchangeExec,
                                                 ShuffleExchangeExec)
@@ -638,9 +641,18 @@ def _clone_fragment(exchange, ctx: ExecCtx):
         memo[id(node)] = c
         return c
 
-    walked = walk(exchange.children[0])
+    walked = walk(root)
     if poison:
         return None, "; ".join(poison[:3])
+    return walked, None
+
+
+def _clone_fragment(exchange, ctx: ExecCtx):
+    """Clone the exchange + child subtree into a picklable map fragment
+    (see :func:`_clone_subtree` for the walk semantics)."""
+    walked, reason = _clone_subtree(exchange.children[0], ctx)
+    if walked is None:
+        return None, reason
     clone = copy.copy(exchange)
     clone._shuffle_id = exchange.shuffle_id  # pin: id(n) never crosses
     clone.children = (walked,)
@@ -726,6 +738,10 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
     pending = sorted(int(c) for c in cpids)
     max_rounds = max(4, 2 * len(cluster.workers()) + 2)
     rounds = 0
+    # every dispatch (retry round, speculative duplicate) carries a
+    # distinct attempt id, echoed in the worker's reply — duplicate
+    # attempts of one fragment are distinguishable at commit time
+    attempt_seq = itertools.count()
     while pending:
         ctx.check_cancel()
         rounds += 1
@@ -762,7 +778,8 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
                     raise RpcError(
                         f"injected fault: flaky worker {wid}")
             spec = {"exchange": clone, "num_parts": num_parts,
-                    "cpids": cps, "conf": frag_conf}
+                    "cpids": cps, "conf": frag_conf,
+                    "attempt": next(attempt_seq)}
             if tracer is not None:
                 # propagate the query/trace ids: the worker's fragment
                 # spans land under THIS query and ship back in the reply
@@ -799,11 +816,18 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
 
 
 def _consume_result(cluster, ctx: ExecCtx, tracker, tracer, wid: str,
-                    cps: list, res, next_pending: list) -> None:
+                    cps: list, res, next_pending: list,
+                    register=None) -> None:
     """Fold one fragment attempt's outcome into the round: register a
     success, re-pool a structured failure (after driving upstream
     recovery), and pass a transport failure through the cluster's
-    failure verdict (lost / quarantined / tolerated — all re-pool)."""
+    failure verdict (lost / quarantined / tolerated — all re-pool).
+
+    ``register`` overrides what a success commits: shuffle fragments
+    register map slots into ``tracker`` (the default); write fragments
+    register task-attempt manifests with the job's commit coordinator.
+    Either target applies its own first-writer-wins guard, so feeding
+    it a duplicate attempt is always safe."""
     if isinstance(res, Exception):
         # control plane unreachable or flaky: the verdict decides
         # whether the worker is gone or just benched; either way its
@@ -827,16 +851,29 @@ def _consume_result(cluster, ctx: ExecCtx, tracker, tracer, wid: str,
         get_registry().inc("cluster.fragments_rejected_draining")
         next_pending.extend(cps)
         return
+    if kind == "write_failed":
+        # the worker's write attempt itself failed (I/O error while
+        # staging): nothing visible happened — count a failure verdict
+        # and re-pool so the next round retries under a fresh attempt id
+        get_registry().inc("cluster.write_fragment_failures")
+        cluster.record_worker_failure(
+            wid, f"write fragment: {res.get('error')}")
+        next_pending.extend(cps)
+        return
     if kind:
         _handle_fragment_loss(cluster, ctx, res)
         next_pending.extend(cps)
         return
     cluster.note_worker_success(wid)
-    tracker.register(wid, res["shuffle"], res["entries"])
+    if register is not None:
+        register(wid, res)
+    else:
+        tracker.register(wid, res["shuffle"], res["entries"])
 
 
 def _dispatch_round_speculative(cluster, ctx: ExecCtx, tracker, tracer,
-                                assign, run_one, next_pending) -> None:
+                                assign, run_one, next_pending,
+                                register=None) -> None:
     """One dispatch round with straggler speculation: every assignment
     runs as before, but a single attempt whose wall time exceeds
     ``speculation.multiplier`` × the round's running median gets a
@@ -888,7 +925,8 @@ def _dispatch_round_speculative(cluster, ctx: ExecCtx, tracker, tracer,
                     # partitions re-pool (and the loss is handled)
                     w, f, t0 = finished[-1]
                     _consume_result(cluster, ctx, tracker, tracer, w,
-                                    list(key), f.result(), next_pending)
+                                    list(key), f.result(), next_pending,
+                                    register=register)
                     done_keys.add(key)
                     continue
                 if winner is None:
@@ -917,7 +955,8 @@ def _dispatch_round_speculative(cluster, ctx: ExecCtx, tracker, tracer,
                 walls.append(wall)
                 reg.observe("cluster.fragment.wall_seconds", wall)
                 _consume_result(cluster, ctx, tracker, tracer, w,
-                                list(key), f.result(), next_pending)
+                                list(key), f.result(), next_pending,
+                                register=register)
                 if len(atts) > 1:
                     # a duplicate existed: exactly one attempt's work
                     # is wasted (the loser's commit is epoch-rejected)
@@ -930,8 +969,12 @@ def _dispatch_round_speculative(cluster, ctx: ExecCtx, tracker, tracer,
                                 and not lres.get("error_kind"):
                             # commit the already-finished loser too:
                             # first-writer-wins discards its slots
-                            tracker.register(lw, lres["shuffle"],
-                                             lres["entries"])
+                            # (write path: its manifests)
+                            if register is not None:
+                                register(lw, lres)
+                            else:
+                                tracker.register(lw, lres["shuffle"],
+                                                 lres["entries"])
                 done_keys.add(key)
     finally:
         # abandon still-running losers; their late replies are never
@@ -1036,3 +1079,149 @@ def cluster_do_shuffle(cluster, exchange, ctx: ExecCtx, child):
                         conf_fingerprint(ctx.conf))))
     reg.inc("cluster.shuffles_clustered")
     return tracker
+
+
+# ---------------------------------------------------------------------------
+# write fragments (hooked from exec/write_exec.run_write_job)
+# ---------------------------------------------------------------------------
+
+def dispatch_write_fragments(cluster, ctx: ExecCtx, coordinator,
+                             write_node, tasks) -> bool:
+    """Run a write job's tasks as cluster write fragments: each worker
+    writes its assigned child partitions into private staging dirs under
+    the job's ``_staging`` tree and ships back one manifest per task,
+    which the driver-side ``coordinator`` arbitrates first-writer-wins.
+
+    Rounds mirror :func:`_dispatch_fragments` — failures/draining
+    replies re-pool onto survivors, upstream map loss drives lineage
+    recovery, and straggler speculation may run duplicate attempts
+    (each under its own attempt id; the coordinator discards the
+    loser's manifests).  A task is only considered placed once the
+    coordinator holds a winning manifest for it, so a dropped commit
+    message re-dispatches the task under a fresh attempt.
+
+    Returns False to signal the in-process fallback (no live workers,
+    unpicklable or poisoned fragment body); the caller then runs the
+    same attempt/commit protocol on the driver."""
+    from concurrent.futures import ThreadPoolExecutor
+    from spark_rapids_tpu.cluster.rpc import RpcError, rpc_call
+    reg = get_registry()
+    if not cluster.live_workers():
+        reg.inc("cluster.write_fallback_inprocess")
+        return False
+    walked, reason = _clone_subtree(write_node.children[0], ctx)
+    if walked is None:
+        reg.inc("cluster.write_fallback_inprocess")
+        ctx.trace_event("cluster.write_fallback", "cluster",
+                        job=coordinator.job_id, reason=reason)
+        return False
+    try:
+        pickle.dumps(walked, protocol=pickle.HIGHEST_PROTOCOL)
+    # enginelint: disable=RL001 (fallback to the in-process write path is the handled outcome; the counter + trace event record it)
+    except Exception:  # noqa: BLE001 - any unpicklable node falls back
+        reg.inc("cluster.fragment_unpicklable")
+        reg.inc("cluster.write_fallback_inprocess")
+        ctx.trace_event("cluster.write_fallback", "cluster",
+                        job=coordinator.job_id,
+                        reason="fragment not picklable")
+        return False
+    cluster.register_write_coordinator(coordinator)
+    faults = coordinator.faults
+    frag_conf = scrub_worker_conf(dict(ctx.conf.settings))
+    speculate = SPECULATION_ENABLED.get(ctx.conf.settings)
+    wspec = {"path": coordinator.path, "fmt": write_node.fmt,
+             "partition_by": list(write_node.partition_by),
+             "options": dict(write_node.options),
+             "job_id": coordinator.job_id}
+    tracer = ctx.tracer
+    tasks = sorted(int(t) for t in tasks)
+    pending = list(tasks)
+    max_rounds = max(4, 2 * len(cluster.workers()) + 2)
+    rounds = 0
+    with ctx.trace_span("cluster.write_stage", "cluster",
+                        job=coordinator.job_id, tasks=len(tasks),
+                        workers=len(cluster.live_workers())):
+        while pending:
+            ctx.check_cancel()
+            rounds += 1
+            if rounds > max_rounds:
+                raise ClusterExecError(
+                    f"write job {coordinator.job_id}: fragment dispatch "
+                    f"did not converge after {rounds - 1} rounds "
+                    f"({len(pending)} tasks without a committed attempt)")
+            live = cluster.schedulable_workers()
+            if not live:
+                raise ClusterExecError(
+                    f"write job {coordinator.job_id}: no live workers "
+                    "left to run write fragments")
+            _refresh_readers(walked, ctx)
+            assign = _assign_cpids(pending, live,
+                                   _locality(walked, max(pending) + 1))
+            handles = {h.worker_id: h for h in live}
+
+            def run_one(wid: str, cps: list[int]):
+                if faults is not None:
+                    act = faults.check("cluster.worker.slow", worker=wid,
+                                       job=coordinator.job_id)
+                    if act is not None:
+                        time.sleep(act.param("seconds", 2.0))
+                    act = faults.check("cluster.worker.flaky", worker=wid,
+                                       job=coordinator.job_id)
+                    if act is not None:
+                        raise RpcError(
+                            f"injected fault: flaky worker {wid}")
+                    act = faults.check("cluster.worker.dead", worker=wid,
+                                       job=coordinator.job_id)
+                    if act is not None and len(cluster.live_workers()) > 1:
+                        # kill the worker PROCESS shortly after dispatch
+                        # so it dies mid-write: its partial attempt dirs
+                        # stay in staging, never visible
+                        t = threading.Timer(act.param("seconds", 0.15),
+                                            cluster.kill_worker,
+                                            args=[wid])
+                        t.daemon = True
+                        t.start()
+                attempts = {int(c): coordinator.next_attempt(int(c))
+                            for c in cps}
+                spec = {"plan": walked, "write": wspec, "cpids": cps,
+                        "attempts": attempts, "conf": frag_conf}
+                if tracer is not None:
+                    spec["trace"] = tracer.trace_header()
+                blob = pickle.dumps(spec,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                reg.inc("cluster.write_fragments_dispatched")
+                handle = handles.get(wid) or cluster.worker_by_id(wid)
+                return rpc_call(handle.rpc_addr, "run_write_fragment",
+                                {"job_id": coordinator.job_id},
+                                blob=blob, conf=ctx.conf,
+                                faults=faults)[0]
+
+            def register(wid: str, res: dict) -> None:
+                for m in res.get("manifests") or ():
+                    coordinator.register(m)
+
+            next_pending: list[int] = []
+            if speculate:
+                _dispatch_round_speculative(cluster, ctx, None, tracer,
+                                            assign, run_one, next_pending,
+                                            register=register)
+            else:
+                results: dict[str, Any] = {}
+                with ThreadPoolExecutor(max_workers=len(assign)) as pool:
+                    futs = {wid: pool.submit(run_one, wid, cps)
+                            for wid, cps in assign.items()}
+                    for wid, fut in futs.items():
+                        try:
+                            results[wid] = fut.result()
+                        except (RpcError, ConnectionError, OSError) as e:
+                            results[wid] = e
+                for wid, cps in assign.items():
+                    _consume_result(cluster, ctx, None, tracer, wid, cps,
+                                    results[wid], next_pending,
+                                    register=register)
+            # re-pool from the coordinator, the single source of truth:
+            # a task stays pending until a manifest actually WON —
+            # covering both failed attempts and commit messages the
+            # io.write.commit.drop point swallowed
+            pending = coordinator.missing(tasks)
+    return True

@@ -101,7 +101,10 @@ class WorkerRuntime:
         self.metrics = {"fragments_run": 0, "fragment_failures": 0,
                         "map_batches_written": 0,
                         "fragments_rejected_draining": 0,
-                        "map_outputs_imported": 0}
+                        "map_outputs_imported": 0,
+                        "write_fragments_run": 0,
+                        "write_tasks_staged": 0,
+                        "write_fragment_failures": 0}
         # tracers of fragments currently executing: the heartbeat drains
         # them mid-run so a long map stage streams spans to the driver
         # instead of batching them all on completion
@@ -114,6 +117,7 @@ class WorkerRuntime:
         self.rpc = RpcServer(
             {"ping": self._h_ping,
              "run_fragment": self._h_run_fragment,
+             "run_write_fragment": self._h_run_write_fragment,
              "release_shuffle": self._h_release_shuffle,
              "drain": self._h_drain,
              "migrate_slots": self._h_migrate_slots,
@@ -290,6 +294,102 @@ class WorkerRuntime:
                     entries.append([mid, pid, wslot, size, rows, ep])
         return ({"ok": True, "entries": entries,
                  "shuffle": list(self.shuffle_server.address),
+                 "attempt": spec.get("attempt", 0),
+                 **self._spans_field(tracer)}, b"")
+
+    def _h_run_write_fragment(self, payload: dict, blob: bytes):
+        """Execute one WRITE fragment: run the shipped plan subtree's
+        assigned child partitions and stage each task's files into its
+        private attempt directory under the job's ``_staging`` tree,
+        replying with one manifest per task for the driver's commit
+        coordinator to arbitrate.  Nothing here touches the final
+        directory — a worker death mid-write leaves only staging
+        garbage.  Draining workers reject structurally, like
+        ``run_fragment``."""
+        if self._draining:
+            self.metrics["fragments_rejected_draining"] += 1
+            return ({"error_kind": "draining",
+                     "error": f"worker {self.worker_id} is draining"},
+                    b"")
+        with self._active_lock:
+            self._active_fragments += 1
+        try:
+            return self._run_write_fragment(payload, blob)
+        finally:
+            with self._active_lock:
+                self._active_fragments -= 1
+
+    def _run_write_fragment(self, payload: dict, blob: bytes):
+        from spark_rapids_tpu.cluster.exec import WorkerFetchFailed
+        from spark_rapids_tpu.conf import TpuConf
+        from spark_rapids_tpu.exec.core import ExecCtx
+        from spark_rapids_tpu.io.writer import (staging_attempt_dir,
+                                                write_task_attempt)
+        from spark_rapids_tpu.shuffle.errors import MapOutputLostError
+        self._ensure_runtime()
+        spec = pickle.loads(blob)
+        plan = spec["plan"]
+        w = spec["write"]
+        cpids = [int(c) for c in spec["cpids"]]
+        attempts = {int(k): int(v) for k, v in spec["attempts"].items()}
+        conf = TpuConf(scrub_worker_conf(spec.get("conf") or
+                                         self.conf.settings))
+        self.metrics["write_fragments_run"] += 1
+        hdr = spec.get("trace") or None
+        tracer = None
+        manifests: list[dict] = []
+        try:
+            with ExecCtx(backend="device", conf=conf) as ctx:
+                if hdr:
+                    ctx.cache["query_id"] = hdr["query_id"]
+                tracer = ctx.tracer
+                if tracer is not None:
+                    if hdr and hdr.get("trace_id"):
+                        tracer.trace_id = hdr["trace_id"]
+                    with self._tracer_lock:
+                        self._live_tracers.append(tracer)
+                with ctx.trace_span("worker.write_fragment", "cluster",
+                                    worker_id=self.worker_id,
+                                    job=w["job_id"], cpids=list(cpids)):
+                    for cpid in cpids:
+                        attempt = attempts[cpid]
+                        adir = staging_attempt_dir(
+                            w["path"], w["job_id"], cpid, attempt)
+                        # faults=None: fault plans are driver-side only
+                        # (scrub_worker_conf strips them from the spec)
+                        manifests.append(write_task_attempt(
+                            plan, ctx, cpid, adir, w["fmt"],
+                            w["partition_by"], w["options"],
+                            job_id=w["job_id"], attempt=attempt,
+                            worker=self.worker_id))
+                        self.metrics["write_tasks_staged"] += 1
+        except WorkerFetchFailed as e:
+            self.metrics["write_fragment_failures"] += 1
+            return ({"error": str(e), "error_kind": "peer_fetch",
+                     "peer": list(e.address),
+                     "lost_sid": e.shuffle_id,
+                     **self._spans_field(tracer)}, b"")
+        except MapOutputLostError as e:
+            self.metrics["write_fragment_failures"] += 1
+            return ({"error": str(e), "error_kind": "map_lost",
+                     "lost_sid": e.shuffle_id, "part": e.part_id,
+                     "lost": {str(k): v for k, v in e.lost.items()},
+                     "observed_empty": e.observed_empty,
+                     **self._spans_field(tracer)}, b"")
+        except OSError as e:
+            # the staging write itself failed (disk, quota): nothing
+            # visible happened; the driver re-pools under a new attempt
+            self.metrics["write_fragment_failures"] += 1
+            return ({"error": str(e), "error_kind": "write_failed",
+                     **self._spans_field(tracer)}, b"")
+        finally:
+            if tracer is not None:
+                with self._tracer_lock:
+                    try:
+                        self._live_tracers.remove(tracer)
+                    except ValueError:
+                        pass
+        return ({"ok": True, "manifests": manifests,
                  **self._spans_field(tracer)}, b"")
 
     def _spans_field(self, tracer) -> dict:

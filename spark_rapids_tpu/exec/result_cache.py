@@ -56,6 +56,7 @@ Counters (obs/registry.py): ``result_cache_hits`` / ``_misses`` /
 """
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import zlib
@@ -66,6 +67,7 @@ from spark_rapids_tpu.exec.recovery import conf_fingerprint
 from spark_rapids_tpu.obs.registry import get_registry
 
 __all__ = ["ResultCache", "get_result_cache", "maybe_cache",
+           "invalidate_output_paths",
            "RESULT_CACHE_ENABLED", "RESULT_CACHE_MAX_BYTES"]
 
 RESULT_CACHE_ENABLED = bool_conf(
@@ -350,6 +352,39 @@ class ResultCache:
             self._entries.clear()
             self._bytes = 0
 
+    def invalidate_paths(self, root: str) -> int:
+        """Drop every entry whose key references a file under ``root``
+        (a committed write job just replaced files there).  Input
+        snapshots fingerprint (path, size, mtime_ns) — sufficient for
+        external edits, but a commit's atomic renames can land inside
+        the snapshot's mtime granularity, so the write plane invalidates
+        explicitly.  Keys are nested tuples whose scan components carry
+        absolute file paths; any string component under ``root`` marks
+        the entry stale (both result keys, via their plan snapshot, and
+        fragment keys, via the scan snapshot)."""
+        root = os.path.abspath(root)
+        prefix = root + os.sep
+
+        def touches(obj) -> bool:
+            if isinstance(obj, str):
+                if obj == root or obj.startswith(prefix):
+                    return True
+                if os.sep in obj:  # relative scan path: resolve first
+                    a = os.path.abspath(obj)
+                    return a == root or a.startswith(prefix)
+                return False
+            if isinstance(obj, tuple):
+                return any(touches(x) for x in obj)
+            return False
+
+        with self._lock:
+            stale = [k for k in self._entries if touches(k)]
+            for k in stale:
+                self._drop_locked(k)
+        if stale:
+            get_registry().inc("result_cache_invalidated", len(stale))
+        return len(stale)
+
     # -- internals (all under self._lock) ----------------------------------
 
     def _store_locked(self, e: _Entry) -> None:
@@ -414,3 +449,14 @@ def maybe_cache(conf) -> "ResultCache | None":
     cache = get_result_cache()
     cache.max_bytes = RESULT_CACHE_MAX_BYTES.get(settings)
     return cache
+
+
+def invalidate_output_paths(path: str) -> int:
+    """Write-plane hook: after a job commit replaces files under
+    ``path``, drop every cached entry that scanned them.  A no-op when
+    the cache was never instantiated (nothing can be stale)."""
+    with _CACHE_LOCK:
+        cache = _CACHE
+    if cache is None:
+        return 0
+    return cache.invalidate_paths(path)

@@ -1,0 +1,135 @@
+"""Physical write sink: CreateDataWriteExec + the write job driver.
+
+Reference: GpuDataWritingCommandExec / GpuFileFormatWriter — the plan
+root that runs a side-effecting directory write and returns no rows.
+This is the engine's first side-effecting operator, so exactly-once is
+owned here: every task attempt stages privately, the
+WriteCommitCoordinator (io/writer.py) arbitrates first-writer-wins per
+task, and the job either commits atomically or aborts leaving only
+garbage-collectable staging dirs.  With a cluster attached the tasks
+run as write fragments on workers (cluster/exec.py
+dispatch_write_fragments) under the same coordinator; otherwise the
+driver runs them in-process with the same attempt/commit protocol.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.faults import FaultRegistry, InjectedFault
+from spark_rapids_tpu.io.writer import (WRITE_CLUSTER_ENABLED,
+                                        WRITE_STAGING_GC, WRITE_STAGING_TTL,
+                                        WRITE_TASK_MAX_ATTEMPTS,
+                                        WriteCommitCoordinator,
+                                        WriteCommitError, WriteStats,
+                                        gc_staging, stats_from_manifest,
+                                        write_task_attempt)
+
+__all__ = ["CreateDataWriteExec", "run_write_job"]
+
+
+class CreateDataWriteExec(PlanNode):
+    """Plan-root sink that writes its child to ``path`` and yields no
+    batches.  ``collect()`` on a write returns no rows; the job's
+    :class:`WriteStats` land on :attr:`stats` after execution."""
+
+    def __init__(self, child: PlanNode, path: str, fmt: str = "parquet",
+                 partition_by=None, options=None):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.partition_by = list(partition_by or [])
+        self.options = dict(options or {})
+        self.stats: WriteStats | None = None
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self, ctx: ExecCtx) -> int:
+        # the whole job runs as one driver-side "partition": task
+        # fan-out happens inside run_write_job (cluster dispatch or the
+        # in-process loop), not through the collect pipeline
+        return 1
+
+    def partition_iter(self, ctx: ExecCtx, pid: int):
+        self.stats = run_write_job(self, ctx)
+        yield from ()
+
+
+def run_write_job(node: CreateDataWriteExec, ctx: ExecCtx) -> WriteStats:
+    """Execute a write job end-to-end: GC stale staging, run every task
+    to a committed manifest (cluster or in-process), commit atomically,
+    invalidate caches that scanned the replaced files.  Any failure or
+    cancellation aborts the job — staging is dropped and nothing
+    becomes visible."""
+    child = node.children[0]
+    conf = ctx.conf
+    faults = ctx.cached(("fault_registry",),
+                        lambda: FaultRegistry.from_conf(conf))
+    os.makedirs(node.path, exist_ok=True)
+    job_id = uuid.uuid4().hex[:8]
+    if conf.get(WRITE_STAGING_GC):
+        gc_staging(node.path, conf.get(WRITE_STAGING_TTL), keep_job=job_id)
+    coord = WriteCommitCoordinator(node.path, node.fmt, job_id,
+                                   faults=faults, conf=conf)
+    tasks = list(range(child.num_partitions(ctx)))
+    committed = False
+    try:
+        clustered = False
+        cluster = ctx.cache.get("cluster")
+        if cluster is not None and conf.get(WRITE_CLUSTER_ENABLED):
+            from spark_rapids_tpu.cluster.exec import \
+                dispatch_write_fragments
+            clustered = dispatch_write_fragments(cluster, ctx, coord, node,
+                                                 tasks)
+        if not clustered:
+            _run_local_tasks(node, ctx, coord, tasks, faults)
+        missing = coord.missing(tasks)
+        if missing:
+            raise WriteCommitError(
+                f"write job {job_id}: no committed attempt for tasks "
+                f"{missing}")
+        manifest = coord.commit_job(schema=None if node.partition_by
+                                    else child.output_schema.to_arrow(),
+                                    options=node.options)
+        committed = True
+    finally:
+        if not committed:
+            coord.abort_job()
+    from spark_rapids_tpu.exec.result_cache import invalidate_output_paths
+    invalidate_output_paths(node.path)
+    return stats_from_manifest(manifest)
+
+
+def _run_local_tasks(node: CreateDataWriteExec, ctx: ExecCtx,
+                     coord: WriteCommitCoordinator, tasks, faults) -> None:
+    """In-process task loop: each task gets up to ``maxAttempts``
+    attempts; a failed attempt (mid-write death, dropped commit
+    message) leaves its staging dir for GC and retries under a fresh
+    attempt id."""
+    max_attempts = max(1, int(ctx.conf.get(WRITE_TASK_MAX_ATTEMPTS)))
+    for task in tasks:
+        for _ in range(max_attempts):
+            ctx.check_cancel()
+            attempt = coord.next_attempt(task)
+            try:
+                m = write_task_attempt(
+                    node.children[0], ctx, task,
+                    coord.attempt_dir(task, attempt), node.fmt,
+                    node.partition_by, node.options, job_id=coord.job_id,
+                    attempt=attempt, faults=faults)
+            except (InjectedFault, OSError):
+                # task attempt died mid-write (injected crash or real
+                # I/O failure): its partial staging dir stays behind
+                # for GC; retry under the next attempt id
+                from spark_rapids_tpu.obs.registry import get_registry
+                get_registry().inc("write.task_attempt_failures")
+                continue
+            if coord.register(m):
+                break
+        if not coord.has_winner(task):
+            raise WriteCommitError(
+                f"write task {task} failed after {max_attempts} attempts")

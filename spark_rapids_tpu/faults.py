@@ -163,6 +163,33 @@ Injection points wired today (site -> actions it interprets):
                         a deterministic per-tenant admission storm for
                         chaos tests to prove other tenants' queries
                         still flow (no cross-tenant starvation).
+    io.write.partial    after each file a write task attempt finishes
+                        (ctx: task, attempt, worker, file;
+                        io/writer.py write_task_attempt).  Action
+                        ``crash`` raises InjectedFault so the attempt
+                        dies mid-write leaving a partial private
+                        staging dir; action ``truncate`` first shears
+                        the just-written file to half its bytes —
+                        garbage that must never become visible and that
+                        a later attempt must not be confused by.
+    io.write.commit.drop
+                        on manifest registration at the driver's write
+                        commit coordinator (ctx: task, attempt, worker;
+                        io/writer.py WriteCommitCoordinator.register).
+                        Any action name works (use ``drop``); the
+                        attempt's commit message is treated as lost in
+                        flight — no winner is recorded, the task is
+                        re-attempted, and the orphaned attempt's files
+                        stay in staging for GC.
+    io.write.rename.fail
+                        per staging->final rename during job commit
+                        (ctx: file; io/writer.py
+                        WriteCommitCoordinator._rename).  Any action
+                        name works (use ``fail``); the rename raises
+                        OSError, exercising the commit retry ladder
+                        and — once retries are exhausted — the
+                        roll-back path that un-renames every already
+                        published file.
 
 Trigger keys (all optional):
 
@@ -225,6 +252,9 @@ KNOWN_POINTS = frozenset({
     "cluster.worker.flaky",
     "cluster.migrate.drop",
     "cluster.rpc.drop",
+    "io.write.partial",
+    "io.write.commit.drop",
+    "io.write.rename.fail",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a

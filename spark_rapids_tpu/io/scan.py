@@ -83,11 +83,21 @@ def _expand_paths(paths) -> list[str]:
     out: list[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(
-                f for f in _glob.glob(os.path.join(p, "**", "*"),
-                                      recursive=True)
-                if os.path.isfile(f) and not os.path.basename(f).startswith(
-                    ("_", "."))))
+            for f in sorted(_glob.glob(os.path.join(p, "**", "*"),
+                                       recursive=True)):
+                if not os.path.isfile(f):
+                    continue
+                # hidden-component filter applies to the WHOLE relative
+                # path, not just the basename: files under `_staging/`
+                # (io/writer.py task-attempt dirs) or `_metadata/` trees
+                # must be invisible to scans — uncommitted attempts are
+                # not data (reference Spark's HadoopFsRelation hidden-
+                # file convention)
+                rel = os.path.relpath(f, p)
+                if any(part.startswith(("_", "."))
+                       for part in rel.split(os.sep)):
+                    continue
+                out.append(f)
         else:
             out.append(p)
     return out
@@ -148,6 +158,12 @@ class FileScanExec(PlanNode):
                  pushdown: Expression | None = None,
                  string_width: int | None = None):
         super().__init__([])
+        #: directory roots among the requested paths — kept so the
+        #: optional commit-manifest CRC verification (verifyCrcOnScan)
+        #: knows where a ``_MANIFEST.json`` could live
+        self._roots = [p for p in
+                       ([paths] if isinstance(paths, str) else list(paths))
+                       if os.path.isdir(p)]
         self._files = _expand_paths(paths)
         if not self._files:
             raise FileNotFoundError(f"no input files in {paths}")
@@ -268,7 +284,24 @@ class FileScanExec(PlanNode):
             out.append((f, st.st_size, st.st_mtime_ns))
         return tuple(out)
 
+    def _maybe_verify_manifests(self, ctx: ExecCtx) -> None:
+        """When ``spark.rapids.io.write.transactional.verifyCrcOnScan``
+        is on, recompute each scanned output directory's committed-file
+        CRCs against its ``_MANIFEST.json`` before reading — a paranoia
+        tier that turns silent post-commit corruption into a
+        WriteIntegrityError.  Verified once per (exec, directory)."""
+        from spark_rapids_tpu.io.writer import (MANIFEST_NAME,
+                                                WRITE_VERIFY_CRC_ON_SCAN,
+                                                verify_manifest)
+        if not WRITE_VERIFY_CRC_ON_SCAN.get(ctx.conf.settings):
+            return
+        for root in self._roots:
+            if os.path.exists(os.path.join(root, MANIFEST_NAME)):
+                ctx.cached(("scan_crc_verified", os.path.abspath(root)),
+                           lambda r=root: verify_manifest(r, full=True))
+
     def partition_iter(self, ctx: ExecCtx, pid: int) -> Iterator:
+        self._maybe_verify_manifests(ctx)
         files = self._partition_files(ctx, pid)
         mode = READER_TYPE[self.format_name].get(ctx.conf.settings)
         rbs = self._decode_iter(ctx, files, mode)

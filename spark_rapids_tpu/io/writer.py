@@ -11,16 +11,88 @@ host-encode mirror of the host-decode scan path.
 """
 from __future__ import annotations
 
+import json
 import os
+import shutil
+import threading
+import time
 import uuid
+import zlib
 from dataclasses import dataclass, field
 from typing import Iterator, Sequence
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.conf import bool_conf, float_conf, int_conf
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
 from spark_rapids_tpu.host.batch import HostBatch
 
-__all__ = ["write_parquet", "write_orc", "write_csv", "WriteStats"]
+__all__ = ["write_parquet", "write_orc", "write_csv", "WriteStats",
+           "WriteCommitCoordinator", "WriteCommitError",
+           "WriteIntegrityError", "write_task_attempt", "verify_manifest",
+           "staging_attempt_dir", "gc_staging", "MANIFEST_NAME",
+           "STAGING_DIR"]
+
+#: job-commit manifest written atomically next to the data files
+MANIFEST_NAME = "_MANIFEST.json"
+#: per-job staging subtree under the output directory; `_`-prefixed so
+#: directory scans never see attempt files (Spark `_temporary` analog)
+STAGING_DIR = "_staging"
+
+WRITE_TRANSACTIONAL = bool_conf(
+    "spark.rapids.io.write.transactional.enabled", True,
+    "Route DataFrame writes through the transactional write plane: "
+    "task attempts write to private staging directories, a "
+    "first-writer-wins commit coordinator picks one attempt per task, "
+    "and an atomic rename-based job commit publishes the files plus a "
+    "_MANIFEST.json. Off = legacy direct in-place writer (no "
+    "exactly-once guarantee under retries/speculation).")
+
+WRITE_CLUSTER_ENABLED = bool_conf(
+    "spark.rapids.io.write.cluster.enabled", True,
+    "With cluster mode on, dispatch write tasks to workers as write "
+    "fragments (each worker writes its partitions into staging and "
+    "ships back manifests). Off = the driver runs every write task "
+    "in-process even when a cluster is attached.")
+
+WRITE_TASK_MAX_ATTEMPTS = int_conf(
+    "spark.rapids.io.write.task.maxAttempts", 4,
+    "Maximum attempts per write task before the job aborts. Each "
+    "retry gets a fresh attempt id and a fresh staging directory; "
+    "failed attempts leave only garbage-collectable staging files.")
+
+WRITE_RENAME_RETRIES = int_conf(
+    "spark.rapids.io.write.commit.renameRetries", 2,
+    "Extra retries for each staging->final rename during job commit "
+    "before the commit rolls back and the job aborts.")
+
+WRITE_STAGING_GC = bool_conf(
+    "spark.rapids.io.write.staging.gc.enabled", True,
+    "Garbage-collect leftover _staging/<job> trees from previous "
+    "crashed or aborted jobs (older than the TTL) when a new write "
+    "job starts on the same output directory.")
+
+WRITE_STAGING_TTL = float_conf(
+    "spark.rapids.io.write.staging.gc.ttlSeconds", 0.0,
+    "Minimum age in seconds before a leftover staging tree is "
+    "garbage-collected by a later job on the same directory. 0 = any "
+    "staging tree not owned by the running job is collected.")
+
+WRITE_VERIFY_CRC_ON_SCAN = bool_conf(
+    "spark.rapids.io.write.verifyCrcOnScan", False,
+    "On scans of a directory carrying a _MANIFEST.json, recompute each "
+    "manifest file's CRC32 before reading and fail the scan on "
+    "mismatch (read-back footer verification; costs one extra pass "
+    "over the files).")
+
+
+class WriteCommitError(RuntimeError):
+    """Job-level write/commit failure (task attempts exhausted, rename
+    failure after retries, commit after abort)."""
+
+
+class WriteIntegrityError(RuntimeError):
+    """Committed output failed read-back verification (missing file,
+    size or CRC mismatch against _MANIFEST.json)."""
 
 
 @dataclass
@@ -200,3 +272,412 @@ def write_csv(plan: PlanNode, path: str, ctx: ExecCtx | None = None,
               stats: WriteStats | None = None) -> list[str]:
     return _write(plan, path, "csv", ctx, partition_by=partition_by,
                   stats=stats)
+
+
+# ---------------------------------------------------------------------------
+# Transactional write plane: task-attempt staging + manifest + job commit.
+#
+# Two-phase protocol (reference: Spark's HadoopMapReduceCommitProtocol
+# under GpuFileFormatWriter; here attempt-granular because the cluster
+# runtime speculates and re-dispatches fragments):
+#
+#   1. every task ATTEMPT writes its files into a private staging dir
+#      ``<out>/_staging/<job>/task-NNNNN-aNN/`` and produces a manifest
+#      (relative paths, rows, bytes, per-file CRC32 of the on-disk
+#      bytes — read back after write, so the manifest attests what the
+#      filesystem actually holds);
+#   2. the driver-side WriteCommitCoordinator accepts the FIRST manifest
+#      per task (first-writer-wins, the map-output tracker's epoch-guard
+#      discipline) and discards duplicates from speculation / retries /
+#      drain re-dispatch;
+#   3. job commit renames each winning file into place (os.replace —
+#      atomic on POSIX), publishes ``_MANIFEST.json`` via tmp+replace,
+#      drops ``_SUCCESS``, and removes the staging tree.
+#
+# Any crash before step 3 completes leaves only `_`-prefixed paths
+# (staging dirs, tmp manifest) that scans never see and a later job
+# garbage-collects — never visible partial output.
+# ---------------------------------------------------------------------------
+
+
+def staging_attempt_dir(path: str, job_id: str, task: int,
+                        attempt: int) -> str:
+    """Private staging directory for one task attempt."""
+    return os.path.join(path, STAGING_DIR, job_id,
+                        f"task-{task:05d}-a{attempt:02d}")
+
+
+def _file_crc32(fname: str) -> int:
+    crc = 0
+    with open(fname, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def write_task_attempt(plan: PlanNode, ctx: ExecCtx, task: int,
+                       attempt_dir: str, fmt: str,
+                       partition_by: Sequence[str] | None, options: dict,
+                       *, job_id: str, attempt: int, worker: str = "driver",
+                       faults=None) -> dict:
+    """Run ONE task attempt: write partition ``task`` of ``plan`` into
+    ``attempt_dir`` and return its manifest.  Runs on the driver or on a
+    cluster worker; nothing here touches the final directory.
+
+    The ``io.write.partial`` fault point fires after each file is
+    written (a ``truncate`` action first shears the file) and raises —
+    simulating a task death mid-write that leaves a partial staging dir
+    behind."""
+    import pyarrow as pa
+    from spark_rapids_tpu.faults import InjectedFault
+    from spark_rapids_tpu.obs.registry import get_registry
+
+    schema = plan.output_schema.to_arrow()
+    options = dict(options or {})
+    manifest = {"task": int(task), "attempt": int(attempt),
+                "worker": worker, "files": [], "partitions": []}
+
+    def emit(table, rel: str) -> None:
+        fname = os.path.join(attempt_dir, rel)
+        os.makedirs(os.path.dirname(fname), exist_ok=True)
+        _write_table(table, fname, fmt, **options)
+        if faults is not None:
+            act = faults.check("io.write.partial", task=task,
+                               attempt=attempt, worker=worker,
+                               file=os.path.basename(rel))
+            if act is not None:
+                if act.action == "truncate":
+                    with open(fname, "r+b") as f:
+                        f.truncate(max(1, os.path.getsize(fname) // 2))
+                raise InjectedFault(
+                    f"io.write.partial: task {task} attempt {attempt} "
+                    f"died after {rel}")
+        manifest["files"].append({
+            "rel": rel, "rows": int(table.num_rows),
+            "bytes": os.path.getsize(fname), "crc32": _file_crc32(fname)})
+
+    batches = list(_arrow_batches(plan, ctx, task))
+    base = f"part-{task:05d}-{job_id}-a{attempt:02d}.{fmt}"
+    if not partition_by:
+        if batches:
+            emit(pa.Table.from_batches(batches, schema=schema), base)
+    elif batches:
+        import pyarrow.compute as _pc
+        names = plan.output_schema.names
+        missing = [c for c in partition_by if c not in names]
+        if missing:
+            raise ValueError(f"partitionBy columns not in output: {missing}")
+        data_cols = [n for n in names if n not in partition_by]
+        if not data_cols:
+            raise ValueError("partitionBy cannot cover every column")
+        table = pa.Table.from_batches(batches, schema=schema)
+        keys = [table.column(c) for c in partition_by]
+        combos = pa.Table.from_arrays(keys, names=list(partition_by)) \
+            .group_by(list(partition_by)).aggregate([]).to_pylist()
+        for combo in combos:
+            mask = None
+            for c in partition_by:
+                v = combo[c]
+                column = table.column(c)
+                if v is None:
+                    cm = _pc.is_null(column)
+                elif isinstance(v, float) and v != v:
+                    cm = _pc.is_nan(column)
+                else:
+                    cm = _pc.equal(column, pa.scalar(v))
+                mask = cm if mask is None else _pc.and_(mask, cm)
+            part = table.filter(mask).select(data_cols)
+            reldir = os.path.join(*(f"{c}={_partition_dir_value(combo[c])}"
+                                    for c in partition_by))
+            manifest["partitions"].append(reldir)
+            emit(part, os.path.join(reldir, base))
+    reg = get_registry()
+    reg.inc("write.task_attempts")
+    reg.inc("write.files_staged", len(manifest["files"]))
+    reg.inc("write.rows_staged",
+            sum(f["rows"] for f in manifest["files"]))
+    return manifest
+
+
+def verify_manifest(path: str, full: bool = False) -> dict:
+    """Read-back verification of a committed directory against its
+    ``_MANIFEST.json``: every manifest file must exist with the recorded
+    size; with ``full`` the CRC32 is recomputed over the on-disk bytes
+    (catches torn/corrupted writes the size check misses).  Returns the
+    parsed manifest; raises :class:`WriteIntegrityError` on mismatch."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    for ent in manifest.get("files", ()):
+        fname = os.path.join(path, ent["rel"])
+        try:
+            size = os.path.getsize(fname)
+        except OSError as e:
+            raise WriteIntegrityError(
+                f"manifest file missing: {fname}") from e
+        if size != ent["bytes"]:
+            raise WriteIntegrityError(
+                f"size mismatch for {fname}: manifest {ent['bytes']}, "
+                f"on disk {size}")
+        if full and _file_crc32(fname) != ent["crc32"]:
+            raise WriteIntegrityError(f"CRC32 mismatch for {fname}")
+    return manifest
+
+
+def gc_staging(path: str, ttl_s: float = 0.0, keep_job: str | None = None)\
+        -> int:
+    """Remove leftover staging trees under ``path/_staging`` older than
+    ``ttl_s`` (crashed/aborted jobs), returning the number collected."""
+    root = os.path.join(path, STAGING_DIR)
+    try:
+        jobs = os.listdir(root)
+    except OSError:
+        return 0
+    now = time.time()
+    collected = 0
+    for j in jobs:
+        if j == keep_job:
+            continue
+        jdir = os.path.join(root, j)
+        try:
+            if now - os.stat(jdir).st_mtime < ttl_s:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(jdir, ignore_errors=True)
+        collected += 1
+    if collected:
+        from spark_rapids_tpu.obs.registry import get_registry
+        get_registry().inc("write.staging_dirs_gced", collected)
+    try:
+        os.rmdir(root)  # only succeeds when empty
+    except OSError:
+        pass
+    return collected
+
+
+class WriteCommitCoordinator:
+    """Driver-side commit arbiter for one write job.
+
+    ``register`` applies the same first-writer-wins guard the cluster
+    map-output tracker uses for shuffle registrations: the first
+    manifest per task wins, every later attempt (speculative duplicate,
+    retry of a task whose commit message was dropped, drain
+    re-dispatch) is discarded.  Workers being drained or quarantined
+    are fenced — their future registrations are rejected so a straggler
+    finishing after its host was removed cannot steal a commit.
+
+    ``commit_job`` publishes winners by atomic rename and rolls back
+    (un-renames) on any failure, so the output directory is only ever
+    observed fully-committed or untouched."""
+
+    def __init__(self, path: str, fmt: str, job_id: str | None = None,
+                 faults=None, conf=None):
+        self.path = os.path.abspath(path)
+        self.fmt = fmt
+        self.job_id = job_id or uuid.uuid4().hex[:8]
+        self.staging_root = os.path.join(self.path, STAGING_DIR,
+                                         self.job_id)
+        self.faults = faults
+        self._conf = conf
+        self._lock = threading.Lock()
+        self._winners: dict[int, dict] = {}
+        self._next_attempt: dict[int, int] = {}
+        self._fenced: set[str] = set()
+        self.committed = False
+        self.aborted = False
+
+    # -- attempt bookkeeping -------------------------------------------
+    def next_attempt(self, task: int) -> int:
+        """Allocate the next attempt id for a task (satellite: attempt
+        ids are threaded into every dispatch so duplicates are
+        distinguishable at commit time)."""
+        with self._lock:
+            a = self._next_attempt.get(task, 0)
+            self._next_attempt[task] = a + 1
+            return a
+
+    def attempt_dir(self, task: int, attempt: int) -> str:
+        return staging_attempt_dir(self.path, self.job_id, task, attempt)
+
+    # -- commit arbitration --------------------------------------------
+    def register(self, manifest: dict) -> bool:
+        """First-writer-wins: record ``manifest`` as its task's winner
+        unless one exists (or its worker is fenced / the job already
+        resolved).  Returns whether this attempt won."""
+        from spark_rapids_tpu.obs.registry import get_registry
+        reg = get_registry()
+        task = int(manifest["task"])
+        worker = str(manifest.get("worker") or "")
+        if self.faults is not None:
+            act = self.faults.check("io.write.commit.drop", task=task,
+                                    attempt=manifest.get("attempt"),
+                                    worker=worker)
+            if act is not None:
+                # the attempt's commit message is lost in flight: the
+                # coordinator behaves as if it never arrived, the task
+                # shows no winner, and the runtime re-attempts it
+                reg.inc("write.commit_msgs_dropped")
+                return False
+        with self._lock:
+            if self.committed or self.aborted:
+                reg.inc("write.attempts_discarded")
+                return False
+            if worker and worker in self._fenced:
+                reg.inc("write.attempts_fenced")
+                return False
+            if task in self._winners:
+                reg.inc("write.attempts_discarded")
+                return False
+            self._winners[task] = manifest
+        reg.inc("write.attempts_won")
+        return True
+
+    def has_winner(self, task: int) -> bool:
+        with self._lock:
+            return task in self._winners
+
+    def missing(self, tasks) -> list[int]:
+        with self._lock:
+            return sorted(t for t in tasks if t not in self._winners)
+
+    def winner(self, task: int) -> dict | None:
+        with self._lock:
+            return self._winners.get(task)
+
+    def fence_worker(self, worker_id: str) -> None:
+        """Reject all future registrations from ``worker_id`` (called
+        when its worker is drained or quarantined mid-job)."""
+        with self._lock:
+            self._fenced.add(worker_id)
+
+    # -- job commit / abort --------------------------------------------
+    def _rename(self, src: str, dst: str) -> None:
+        retries = 0
+        if self._conf is not None:
+            retries = int(self._conf.get(WRITE_RENAME_RETRIES))
+        last: Exception | None = None
+        for _ in range(retries + 1):
+            if self.faults is not None:
+                act = self.faults.check("io.write.rename.fail",
+                                        file=os.path.basename(dst))
+                if act is not None:
+                    last = OSError(
+                        f"io.write.rename.fail: injected rename failure "
+                        f"for {dst}")
+                    from spark_rapids_tpu.obs.registry import get_registry
+                    get_registry().inc("write.rename_retries")
+                    continue
+            try:
+                os.replace(src, dst)
+                return
+            except OSError as e:
+                last = e
+                from spark_rapids_tpu.obs.registry import get_registry
+                get_registry().inc("write.rename_retries")
+        raise WriteCommitError(
+            f"rename {src} -> {dst} failed after {retries + 1} "
+            f"tries") from last
+
+    def commit_job(self, schema=None, options: dict | None = None) -> dict:
+        """Atomically publish the winning attempts.  Renames every
+        winner file into the final directory, writes ``_MANIFEST.json``
+        (tmp + os.replace) and ``_SUCCESS``, then GCs staging.  On any
+        failure every completed rename is rolled back before the error
+        propagates — the directory never holds a partial commit."""
+        from spark_rapids_tpu.obs.registry import get_registry
+        reg = get_registry()
+        t0 = time.perf_counter()
+        with self._lock:
+            if self.aborted:
+                raise WriteCommitError("commit after abort")
+            winners = dict(self._winners)
+        files_out: list[dict] = []
+        partitions: list[str] = []
+        renamed: list[tuple[str, str]] = []
+        seen_dirs: set[str] = set()
+        try:
+            for task in sorted(winners):
+                m = winners[task]
+                adir = self.attempt_dir(task, int(m["attempt"]))
+                for ent in m["files"]:
+                    src = os.path.join(adir, ent["rel"])
+                    dst = os.path.join(self.path, ent["rel"])
+                    d = os.path.dirname(dst)
+                    os.makedirs(d, exist_ok=True)
+                    if d != self.path and d not in seen_dirs:
+                        seen_dirs.add(d)
+                        partitions.append(os.path.relpath(d, self.path))
+                    self._rename(src, dst)
+                    renamed.append((src, dst))
+                    files_out.append(dict(ent))
+            if not files_out and schema is not None:
+                # empty result: emit one schema-bearing empty part file
+                # (Spark's write protocol) so the output stays readable —
+                # staged first, renamed in, like every other file
+                rel = f"part-00000-{self.job_id}.{self.fmt}"
+                os.makedirs(self.staging_root, exist_ok=True)
+                src = os.path.join(self.staging_root, rel)
+                _write_table(schema.empty_table(), src, self.fmt,
+                             **(options or {}))
+                ent = {"rel": rel, "rows": 0,
+                       "bytes": os.path.getsize(src),
+                       "crc32": _file_crc32(src)}
+                self._rename(src, os.path.join(self.path, rel))
+                renamed.append((src, os.path.join(self.path, rel)))
+                files_out.append(ent)
+            manifest = {
+                "version": 1, "job_id": self.job_id, "format": self.fmt,
+                "files": files_out, "partitions": sorted(set(partitions)),
+                "num_rows": sum(f["rows"] for f in files_out),
+                "num_bytes": sum(f["bytes"] for f in files_out)}
+            os.makedirs(self.staging_root, exist_ok=True)
+            tmp = os.path.join(self.staging_root, MANIFEST_NAME + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            os.replace(tmp, os.path.join(self.path, MANIFEST_NAME))
+        except BaseException:
+            for src, dst in reversed(renamed):
+                try:
+                    os.replace(dst, src)
+                except OSError:
+                    pass
+            reg.inc("write.jobs_commit_failed")
+            raise
+        with self._lock:
+            self.committed = True
+        open(os.path.join(self.path, "_SUCCESS"), "w").close()
+        shutil.rmtree(self.staging_root, ignore_errors=True)
+        try:
+            os.rmdir(os.path.join(self.path, STAGING_DIR))
+        except OSError:
+            pass
+        reg.inc("write.jobs_committed")
+        reg.inc("write.files_committed", len(files_out))
+        reg.inc("write.rows_committed", manifest["num_rows"])
+        reg.inc("write.bytes_committed", manifest["num_bytes"])
+        reg.observe("write.commit_seconds", time.perf_counter() - t0)
+        return manifest
+
+    def abort_job(self) -> None:
+        """Drop the job: no files become visible, staging is removed."""
+        from spark_rapids_tpu.obs.registry import get_registry
+        with self._lock:
+            if self.committed or self.aborted:
+                return
+            self.aborted = True
+        shutil.rmtree(self.staging_root, ignore_errors=True)
+        try:
+            os.rmdir(os.path.join(self.path, STAGING_DIR))
+        except OSError:
+            pass
+        get_registry().inc("write.jobs_aborted")
+
+
+def stats_from_manifest(manifest: dict) -> WriteStats:
+    return WriteStats(num_files=len(manifest["files"]),
+                      num_rows=manifest["num_rows"],
+                      num_bytes=manifest["num_bytes"],
+                      partitions=list(manifest.get("partitions", ())))

@@ -17,7 +17,7 @@ from spark_rapids_tpu.expr.core import Expression
 __all__ = ["LogicalPlan", "Scan", "Project", "Filter", "Aggregate", "Join",
            "Sort", "Limit", "Union", "Window", "Repartition", "Expand",
            "Generate", "MapInPandas", "FlatMapGroupsInPandas",
-           "AggregateInPandas", "FlatMapCoGroupsInPandas"]
+           "AggregateInPandas", "FlatMapCoGroupsInPandas", "DataWrite"]
 
 
 class LogicalPlan:
@@ -241,6 +241,28 @@ class FlatMapCoGroupsInPandas(LogicalPlan):
 class Repartition(LogicalPlan):
     num_partitions: int
     keys: list  # empty = round robin
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+
+@dataclass
+class DataWrite(LogicalPlan):
+    """Directory-write sink: CTAS/INSERT analog (reference
+    GpuDataWritingCommandExec over GpuParquetFileFormat).  ``fmt`` is the
+    file format name, ``path`` the output directory, ``partition_by``
+    hive-style partition column names, ``options`` format writer
+    options."""
+    fmt: str
+    path: str
+    partition_by: list
+    options: dict
     child: LogicalPlan
 
     @property

@@ -226,6 +226,13 @@ def lower(node: L.LogicalPlan, conf: TpuConf) -> PlannedNode:
             node.fn, node.out_schema, lc.exec_node, rc.exec_node)
         return PlannedNode(ex, list(node.left_keys) + list(node.right_keys),
                            [lc, rc])
+    if isinstance(node, L.DataWrite):
+        from spark_rapids_tpu.exec.write_exec import CreateDataWriteExec
+        c = lower(node.child, conf)
+        ex = CreateDataWriteExec(c.exec_node, node.path, node.fmt,
+                                 partition_by=node.partition_by,
+                                 options=node.options)
+        return PlannedNode(ex, [], [c])
     raise TypeError(f"cannot lower {node!r}")
 
 
@@ -994,6 +1001,14 @@ class TpuOverrides:
            any(isinstance(f.data_type, T.MapType)
                for ch in ex.children for f in ch.output_schema):
             meta.will_not_work("map columns are host-only")
+        # the write sink consumes its child's batches directly (Arrow
+        # encode is host-side either way) — it follows the child's
+        # backend so no transition lands between child and sink, and a
+        # device child keeps the cluster runtime attached to the job
+        from spark_rapids_tpu.exec.write_exec import CreateDataWriteExec
+        if isinstance(ex, CreateDataWriteExec) and any(
+                ch.backend != "device" for ch in meta.children):
+            meta.will_not_work("write sink follows its host child")
         if isinstance(ex, WindowExec):
             from spark_rapids_tpu.expr import aggregates as A
             for w, dt in zip(ex._wexprs, ex._out_dtypes):

@@ -694,17 +694,35 @@ class DataFrame:
 
     def write_parquet(self, path: str, partition_by=None, **kw):
         """Directory write (Spark protocol).  ``partition_by`` enables
-        hive-style dynamic-partition output; returns WriteStats."""
-        from spark_rapids_tpu.io import write_parquet
-        from spark_rapids_tpu.io.writer import WriteStats
-        ov, meta = self._overridden()
-        stats = WriteStats()
+        hive-style dynamic-partition output; returns WriteStats.
+
+        With ``spark.rapids.io.write.transactional.enabled`` (the
+        default) the write runs as a planned :class:`CreateDataWriteExec`
+        job under the two-phase task-attempt commit protocol — through
+        the cluster runtime when one is attached — and the committed
+        directory carries ``_MANIFEST.json`` + ``_SUCCESS``.  Off =
+        the legacy direct in-place writer (no exactly-once guarantee
+        under retries)."""
+        from spark_rapids_tpu.io.writer import (WRITE_TRANSACTIONAL,
+                                                WriteStats)
         if isinstance(partition_by, str):
             partition_by = [partition_by]
-        with ExecCtx(backend=meta.backend, conf=self._s.conf) as ctx:
-            write_parquet(meta.exec_node, path, ctx=ctx,
-                          partition_by=partition_by, stats=stats, **kw)
-        return stats
+        if not self._s.conf.get(WRITE_TRANSACTIONAL):
+            from spark_rapids_tpu.io import write_parquet
+            ov, meta = self._overridden()
+            stats = WriteStats()
+            with ExecCtx(backend=meta.backend, conf=self._s.conf) as ctx:
+                write_parquet(meta.exec_node, path, ctx=ctx,
+                              partition_by=partition_by, stats=stats, **kw)
+            return stats
+        wdf = DataFrame(self._s, L.DataWrite(
+            "parquet", path, list(partition_by or []), dict(kw),
+            self._plan))
+        ov, meta = wdf._overridden()
+        # logical=None: a side-effecting job must execute — it never
+        # serves from (or populates) the result cache
+        self._s._run_query(meta.exec_node, meta.backend, logical=None)
+        return meta.exec_node.stats
 
     # -- internals -----------------------------------------------------
     def _schema_names(self) -> list[str]:
