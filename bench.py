@@ -5,6 +5,7 @@ Prints one JSON line per metric:
   {"metric": "tpch_multichip_scaling_sf...", "value": N, "ladder": [...]}
   {"metric": "tpch_cluster_scaling_sf...", "value": N, "ladder": [...]}
   {"metric": "tpch_multistream_qph_sf...", "value": N, "ladder": [...]}
+  {"metric": "tpch_storm_p99_slo_sf...", "value": N, "report": {...}}
 
 The cluster line is the driver/worker runtime ladder
 (spark_rapids_tpu/cluster): q6 + q3 at 1/2/4 local worker processes
@@ -17,6 +18,14 @@ The third line is the serving-tier THROUGHPUT ladder
 tenant streams through ONE session, distinct query permutations per
 stream, warm queries-per-hour per rung with cache-hit and fairness
 counters, every stream's rows verified against the host oracle.
+
+The storm line is the CONTROL-PLANE rung
+(spark_rapids_tpu/bench/storm.py): web/etl/batch tenants share one
+bottlenecked session; every fixed admission configuration in a
+maxConcurrent x workers grid misses at least one self-calibrated p99
+SLO, while the closed loop (spark.rapids.control.enabled=true) meets
+the served tenants' SLOs by shedding exactly the storm tenant.  value
+= min(slo/p99) over served tenants in the closed-loop run.
 
 The second line is the pod-scale device-count ladder: TPC-H q6, q3,
 q13 and q18 at 1/2/4/8 mesh devices
@@ -118,6 +127,17 @@ CLUSTER_TIMEOUT_S = float(os.environ.get("BENCH_CLUSTER_TIMEOUT_S", "420"))
 # read-back row hash exactly.  CPU backend, like the cluster ladder.
 WRITE_SF = float(os.environ.get("BENCH_WRITE_SF", "0.1"))
 WRITE_TIMEOUT_S = float(os.environ.get("BENCH_WRITE_TIMEOUT_S", "300"))
+# mixed-tenant STORM rung (control-plane metric): web/etl/batch tenants
+# share one bottlenecked session; a fixed admission grid is swept with
+# the control plane OFF, then the closed loop runs with it ON.  value =
+# min(slo/p99) over the served tenants in the closed-loop run (>1 means
+# every served SLO met, with margin) — and the report carries the whole
+# grid, so the claim "no fixed config serves what the closed loop
+# serves" is inspectable.  CPU backend: admission/SLO dynamics are
+# host-side, like the cluster ladder.
+STORM_SF = float(os.environ.get("BENCH_STORM_SF", "0.01"))
+STORM_DURATION_S = float(os.environ.get("BENCH_STORM_DURATION_S", "5"))
+STORM_TIMEOUT_S = float(os.environ.get("BENCH_STORM_TIMEOUT_S", "420"))
 
 
 def _mesh_env(n_devices: int) -> dict:
@@ -622,6 +642,70 @@ def _write_rung(deadline: float) -> None:
     _emit_write(rep, None if rep.get("ok") else "write rung not exact")
 
 
+def _schild(platform: str) -> None:
+    """One killable mixed-tenant storm run: the whole grid plus the
+    closed loop live in one child so every rung shares one warm
+    compile cache and the comparison is apples-to-apples."""
+    import jax
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.runtime import enable_compilation_cache
+    enable_compilation_cache()
+    from spark_rapids_tpu.bench.storm import run_storm
+    sf = STORM_SF
+    rep = run_storm(os.path.join(DATA_DIR, f"tpch_sf{sf:g}"), sf,
+                    duration_s=STORM_DURATION_S)
+    print(_REPORT_PREFIX + json.dumps(rep))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _storm_rung(deadline: float) -> None:
+    """Fifth metric line: the mixed-tenant storm — does the closed
+    control loop serve SLOs that no fixed configuration can?"""
+    rec = {
+        "metric": f"tpch_storm_p99_slo_sf{STORM_SF:g}_cpu",
+        "value": 0.0,
+        "unit": "x",
+    }
+    budget = min(STORM_TIMEOUT_S, deadline - time.monotonic())
+    if budget < 60:
+        rec["error"] = "no budget for storm rung"
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        return
+    cmd = [sys.executable, os.path.abspath(__file__), "--schild", "cpu"]
+    rc, out, errout = _run_killable(
+        cmd, budget,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or None)
+    rep = None
+    if rc is not None:
+        for line in reversed(out.splitlines()):
+            line = line.strip()
+            if line.startswith(_REPORT_PREFIX):
+                try:
+                    rep = json.loads(line[len(_REPORT_PREFIX):])
+                except json.JSONDecodeError:
+                    pass
+                break
+    if rep is None:
+        tail = (errout or "")[-300:].replace("\n", " | ")
+        rec["error"] = (f"storm rung killed after {budget:.0f}s"
+                        if rc is None else
+                        f"storm rung rc={rc} no report; {tail}")
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        return
+    rec["value"] = float(rep.get("closed_slo_margin") or 0.0)
+    rec["ok"] = bool(rep.get("ok"))
+    rec["report"] = rep
+    if rep.get("error"):
+        rec["error"] = str(rep["error"])[:500]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 def _tchild(platform: str) -> None:
     """One killable multi-stream throughput run (the whole ladder lives
     in one child: rungs share the warm session-level caches, which is
@@ -935,6 +1019,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--wchild":
         _wchild(sys.argv[2])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--schild":
+        _schild(sys.argv[2])
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--prewarm":
         _prewarm(float(sys.argv[2]) if len(sys.argv) > 2 else 0.1)
         return
@@ -1012,6 +1099,17 @@ def main() -> None:
         _write_rung(w_deadline)
     except Exception as e:  # pragma: no cover - rider must not gate
         _emit_write(None, f"write rung crashed: {e}")
+    # fifth metric line: the mixed-tenant storm — the closed control
+    # loop vs a fixed admission grid under the same self-calibrated SLOs
+    s_deadline = time.monotonic() + STORM_TIMEOUT_S
+    try:
+        _storm_rung(s_deadline)
+    except Exception as e:  # pragma: no cover - rider must not gate
+        print(json.dumps({
+            "metric": f"tpch_storm_p99_slo_sf{STORM_SF:g}_cpu",
+            "value": 0.0, "unit": "x",
+            "error": f"storm rung crashed: {e}"}))
+        sys.stdout.flush()
     sys.exit(rc)
 
 

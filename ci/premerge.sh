@@ -643,7 +643,7 @@ ac2 = AdmissionController(max_concurrent=0)
 for i in range(3):
     ac2.admit(f"h{i}", tenant="hog")
 ac2.admit("q0", tenant="quiet")
-ac2.pressure_hook = lambda: "memory pressure: premerge"
+ac2.pressure_hook = lambda tenant: "memory pressure: premerge"
 try:
     ac2.admit("h3", tenant="hog")
     raise SystemExit("over-quota tenant was not pressure-shed")
@@ -1097,6 +1097,74 @@ for name, conf in STORMS.items():
     print(f"write gate [{name}]: exact hash, {injected} faults injected, "
           f"no orphans: ok")
 print("transactional write gate: ok")
+PY
+  echo "-- self-driving control gate: off-path inert, storm shed targeted --"
+  # two halves.  OFF: spark.rapids.control.enabled=false must be
+  # byte-identical to the static engine — same plans, same confs after
+  # a run, and the control package never even imports.  ON: a reduced
+  # mixed-tenant storm (single-worker grid) where every fixed config
+  # misses a served tenant's SLO that the closed loop meets, shedding
+  # ONLY the storm tenant.
+  JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.session import TpuSession
+
+import os, tempfile, threading
+d = os.path.join(tempfile.mkdtemp(), "tpch")
+generate_tpch(d, sf=0.01)
+
+# -- OFF: the disabled path is the static engine, byte for byte ------
+assert "spark_rapids_tpu.control" not in sys.modules, \
+    "control package imported before any session asked for it"
+def run_off(conf):
+    s = TpuSession(conf)
+    try:
+        df = build_tpch_query("q3", s, d)
+        plan = df.explain()
+        rows = df.collect(tenant="gate")
+        return plan, rows, dict(s.conf.settings)
+    finally:
+        s.shutdown()
+static = run_off({})
+disabled = run_off({"spark.rapids.control.enabled": "false"})
+assert static[0] == disabled[0], "explain drifted with control disabled"
+assert static[1] == disabled[1], "rows drifted with control disabled"
+assert disabled[2] == {"spark.rapids.control.enabled": "false"}, \
+    f"disabled control mutated session confs: {disabled[2]}"
+assert "spark_rapids_tpu.control" not in sys.modules, \
+    "control package imported on the DISABLED path"
+assert not [t.name for t in threading.enumerate()
+            if t.name == "control-loop"], "control thread on disabled path"
+print("control gate [off]: plans, rows, imports identical: ok")
+
+# -- ON: reduced storm; the loop must beat every fixed rung ----------
+# one retry: the storm scores wall-clock p99s, and a noisy CI host
+# can push a served tenant a few percent over its margin — a real
+# control-plane regression fails BOTH attempts
+from spark_rapids_tpu.bench.storm import run_storm
+for attempt in (1, 2):
+    rep = run_storm(d, 0.01, grid=((2, 1), (8, 1)), duration_s=4.0,
+                    generate=False)
+    if rep["ok"]:
+        break
+    print(f"control gate [storm]: attempt {attempt} failed: "
+          f"{rep.get('error')}")
+assert rep["ok"], f"storm gate failed: {rep.get('error')}"
+assert rep["all_fixed_missed"] and rep["storm_tenant_shed"] \
+    and rep["served_tenants_clean"]
+cl = rep["closed"]
+assert not cl["missed"], f"closed loop missed {cl['missed']}"
+shed = [t for t, i in cl["tenants"].items() if i["shed"]]
+assert shed == ["batch"], f"shed set {shed} != ['batch']"
+# the controller's thread dies with its session
+assert not [t.name for t in threading.enumerate()
+            if t.name == "control-loop" and t.is_alive()], \
+    "control-loop thread leaked past shutdown"
+print(f"control gate [storm]: fixed grid missed everywhere, closed "
+      f"loop margin {rep['closed_slo_margin']}x, only batch shed: ok")
 PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

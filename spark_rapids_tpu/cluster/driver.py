@@ -597,6 +597,27 @@ class ClusterDriver:
         ok = [h for h in live if h.quarantined_until is None]
         return ok or live
 
+    def drain_candidate(self) -> str | None:
+        """The worker a scale-down should retire: the NEWEST
+        schedulable one (last joined, so the least map output to
+        migrate and the least warm compile cache to throw away), or
+        None when retiring anyone would drop the pool below
+        minWorkers.  The control plane's fleet rule calls this so
+        scale-down policy lives with the membership ledger, not in the
+        controller."""
+        with self._lock:
+            live = [h for h in self._handles.values()
+                    if h.alive and not h.draining]
+            if len(live) <= self._min_workers:
+                return None
+
+            def join_order(h):
+                # worker ids are "w<N>" with N monotonically assigned
+                wid = h.worker_id
+                return int(wid[1:]) if wid[1:].isdigit() else -1
+
+            return max(live, key=join_order).worker_id
+
     def worker_by_id(self, worker_id: str) -> WorkerHandle | None:
         return self._handles.get(worker_id)
 

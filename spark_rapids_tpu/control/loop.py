@@ -1,0 +1,590 @@
+"""The control loop: one daemon thread that turns telemetry into
+actuations.
+
+Each tick reads ONE registry snapshot, diffs it against the previous
+tick, and merges the delta into a sliding window
+(``control.windowTicks``) so percentile signals are computed over a
+few seconds of traffic instead of one twitchy interval.  The pure
+rules (rules.py) derive bounded decisions from those signals; this
+module owns every side effect — the admission cap, the tenant-shed
+map behind the pressure hook, the governor watermark overrides, the
+fleet calls, and the plan router — plus the audit surface (decision
+deque for ``/control``, ``control.decision`` trace spans, registry
+counters).
+
+Chaos points (faults.py): ``control.signal.stale`` freezes the tick's
+registry snapshot at the previous one — the loop must keep deriving
+sane decisions from stale signals; ``control.actuate.drop`` loses a
+derived decision before actuation — harmless because every decision
+is re-derived from fresh signals next tick (never replayed from a
+queue).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+from spark_rapids_tpu.control import (
+    CONTROL_ADMISSION_ENABLED, CONTROL_ADMISSION_MAX,
+    CONTROL_ADMISSION_MIN, CONTROL_FLEET_COOLDOWN, CONTROL_FLEET_DOWN_TICKS,
+    CONTROL_FLEET_ENABLED, CONTROL_FLEET_UP_TICKS, CONTROL_GOVERNOR_ENABLED,
+    CONTROL_INTERVAL, CONTROL_QUEUE_WAIT_TARGET, CONTROL_ROUTE_ENABLED,
+    CONTROL_ROUTE_EXPRESS_WALL, CONTROL_ROUTE_MIN_SAMPLES,
+    CONTROL_SLO_RECOVERY_TICKS, CONTROL_SLO_VIOLATION_TICKS,
+    CONTROL_SPILL_P99_TARGET, CONTROL_WATERMARK_MIN_HIGH,
+    CONTROL_WATERMARK_STEP, CONTROL_WINDOW_TICKS, parse_tenant_slos)
+from spark_rapids_tpu.control.rules import (Decision, FleetRule, SloTracker,
+                                            WatermarkRule, aimd_admission)
+from spark_rapids_tpu.obs.registry import (get_registry,
+                                           histogram_percentile,
+                                           merge_histogram_snapshots)
+
+__all__ = ["ControlLoop"]
+
+
+def _merge_window(window, name: str) -> "dict | None":
+    """Merge one histogram's deltas across the sliding window."""
+    snaps = [d["histograms"][name] for d in window
+             if name in d.get("histograms", {})]
+    if not snaps:
+        return None
+    return functools.reduce(merge_histogram_snapshots, snaps)
+
+
+def _sum_window(window, name: str) -> float:
+    return sum(d.get("counters", {}).get(name, 0) for d in window)
+
+
+class ControlLoop:
+    """Driver-side controller bound to one :class:`TpuSession`.
+
+    Construction wires the actuation surfaces (admission pressure
+    hook) but moves nothing until :meth:`start`; :meth:`stop` joins
+    the thread and RESTORES every knob it touched — a stopped
+    controller leaves the engine on its static confs."""
+
+    def __init__(self, session):
+        self.session = session
+        settings = session.conf.settings
+        self.interval = max(0.05, CONTROL_INTERVAL.get(settings))
+        self.window_ticks = max(1, CONTROL_WINDOW_TICKS.get(settings))
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._tick_count = 0
+        self._prev_snapshot: "dict | None" = None
+        self._window: deque = deque(maxlen=self.window_ticks)
+        self.decisions: deque = deque(maxlen=32)
+        self._lock = threading.Lock()
+
+        # chaos: same registry style as the admission controller's —
+        # inert (None) unless spark.rapids.test.faults names a plan
+        from spark_rapids_tpu.faults import FaultRegistry
+        self.faults = FaultRegistry.from_conf(session.conf)
+
+        # control.decision spans live on a dedicated tracer lane,
+        # bounded like every query tracer
+        from spark_rapids_tpu.obs.trace import Tracer
+        self.tracer = Tracer(query_id="control", max_events=4096)
+
+        # -- admission actuation surface --------------------------------
+        self.admission = session._admission_controller()
+        self._base_cap = self.admission.max_concurrent
+        self.admission_enabled = CONTROL_ADMISSION_ENABLED.get(settings)
+        self.min_cap = CONTROL_ADMISSION_MIN.get(settings)
+        self.max_cap = CONTROL_ADMISSION_MAX.get(settings)
+        self.queue_wait_target = CONTROL_QUEUE_WAIT_TARGET.get(settings)
+
+        # -- per-tenant SLOs + shed via the existing pressure hook ------
+        violation_ticks = CONTROL_SLO_VIOLATION_TICKS.get(settings)
+        self.slo = SloTracker(
+            parse_tenant_slos(settings),
+            violation_ticks=violation_ticks,
+            recovery_ticks=CONTROL_SLO_RECOVERY_TICKS.get(settings),
+            # sheds are rate-limited to one per flushed window: after a
+            # shed, every p99 in the sliding window was measured under
+            # the PRE-shed regime for window_ticks more ticks — a
+            # second shed on that evidence would punish the first
+            # shed's victims
+            shed_cooldown_ticks=self.window_ticks + violation_ticks)
+        self._prev_hook = self.admission.pressure_hook
+        # the bound method is captured ONCE: attribute access creates a
+        # fresh bound-method object each time, so stop()'s identity
+        # check must compare against the exact object installed here
+        self._installed_hook = self._pressure_hook
+        self.admission.pressure_hook = self._installed_hook
+
+        # -- governor watermark rule ------------------------------------
+        self.watermark: "WatermarkRule | None" = None
+        self._governor = None
+        if CONTROL_GOVERNOR_ENABLED.get(settings):
+            from spark_rapids_tpu.memory.governor import (GOVERNOR_ENABLED,
+                                                          GOVERNOR_HIGH_WM,
+                                                          GOVERNOR_LOW_WM,
+                                                          get_governor)
+            if GOVERNOR_ENABLED.get(settings):
+                self._governor = get_governor()
+                self.watermark = WatermarkRule(
+                    base_high=GOVERNOR_HIGH_WM.get(settings),
+                    base_low=GOVERNOR_LOW_WM.get(settings),
+                    spill_p99_target=CONTROL_SPILL_P99_TARGET.get(settings),
+                    step=CONTROL_WATERMARK_STEP.get(settings),
+                    min_high=CONTROL_WATERMARK_MIN_HIGH.get(settings))
+
+        # -- fleet sizing -----------------------------------------------
+        self.fleet: "FleetRule | None" = None
+        if CONTROL_FLEET_ENABLED.get(settings):
+            self.fleet = FleetRule(
+                min_workers=int(settings.get(
+                    "spark.rapids.cluster.minWorkers", 1)),
+                max_workers=int(settings.get(
+                    "spark.rapids.cluster.maxWorkers", 0)),
+                up_ticks=CONTROL_FLEET_UP_TICKS.get(settings),
+                down_ticks=CONTROL_FLEET_DOWN_TICKS.get(settings),
+                cooldown_s=CONTROL_FLEET_COOLDOWN.get(settings))
+
+        # -- history-driven plan routing --------------------------------
+        self.route_enabled = CONTROL_ROUTE_ENABLED.get(settings)
+        self.express_wall = CONTROL_ROUTE_EXPRESS_WALL.get(settings)
+        self.route_min_samples = CONTROL_ROUTE_MIN_SAMPLES.get(settings)
+        self._history_index = None
+        self._history_path: "str | None" = None
+        hist_dir = settings.get("spark.rapids.obs.history.dir")
+        if self.route_enabled and hist_dir:
+            import os
+            from spark_rapids_tpu.obs.history import (HISTORY_FILE,
+                                                      HistoryIndex)
+            self._history_index = HistoryIndex()
+            self._history_path = os.path.join(str(hist_dir), HISTORY_FILE)
+        # fingerprint -> overrides dict (LRU): the route audit trail —
+        # a fingerprint is logged as a decision only when its route
+        # CHANGES, not once per query
+        self._routes: OrderedDict = OrderedDict()
+
+    # -- pressure-hook composition -------------------------------------
+
+    def _pressure_hook(self, tenant: str = "default") -> "str | None":
+        """The composed admission pressure hook: an SLO-shed tenant
+        gets its shed reason (and only that tenant — neighbors see
+        None, so ``admission_pressure_spared`` stays clean for them);
+        everything else defers to whatever hook was installed before
+        (the memory governor's).  The shed reason is a
+        :class:`TargetedShed` so admission rejects unconditionally —
+        this hook already did the tenant targeting, and the over-share
+        spare would re-admit the victim the moment its running queries
+        drained.  Delegated (global-pressure) reasons stay plain
+        strings and keep their spare semantics."""
+        from spark_rapids_tpu.exec.lifecycle import TargetedShed
+        reason = self.slo.shed.get(tenant)
+        if reason:
+            return TargetedShed(reason)
+        prev = self._prev_hook
+        return prev(tenant) if prev is not None else None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="control-loop", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the loop and RESTORE every actuated knob to its static
+        conf value: cap, watermark overrides, pressure hook, sheds.
+        Idempotent."""
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        self._export_trace()
+        with self._lock:
+            self.slo.shed.clear()
+            if self.admission.pressure_hook is self._installed_hook:
+                self.admission.pressure_hook = self._prev_hook
+            if self.admission_enabled and \
+                    self.admission.max_concurrent != self._base_cap:
+                self.admission.set_max_concurrent(self._base_cap)
+            if self._governor is not None:
+                self._governor.set_watermark_overrides(None, None)
+
+    def _export_trace(self) -> None:
+        """Write the controller's decision lane as trace_control.json
+        next to the query traces — the loop has no ExecCtx.close() to
+        piggyback on, so export happens once, at stop."""
+        from spark_rapids_tpu.obs.trace import TRACE_DIR, TRACE_ENABLED
+        settings = self.session.conf.settings
+        out_dir = TRACE_DIR.get(settings)
+        if not out_dir or not TRACE_ENABLED.get(settings) \
+                or not self.tracer.events_snapshot(last=1):
+            return
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            self.tracer.export(os.path.join(out_dir,
+                                            "trace_control.json"))
+        # enginelint: disable=RL001 (trace export is best-effort teardown: a full disk must not turn shutdown into a crash)
+        except Exception:
+            get_registry().inc("control_trace_export_errors")
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _run(self) -> None:
+        reg = get_registry()
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self.tick()
+            # enginelint: disable=RL001 (the control loop must outlive any one bad tick; the error is counted and the next tick re-derives from fresh signals)
+            except Exception:
+                reg.inc("control_tick_errors")
+
+    # -- the tick -------------------------------------------------------
+
+    def tick(self) -> "list[Decision]":
+        """One control round: signals -> rules -> actuations.  Public
+        so tests can drive the loop deterministically without the
+        thread."""
+        reg = get_registry()
+        self._tick_count += 1
+        stale = self.faults is not None and self.faults.check(
+            "control.signal.stale", tick=self._tick_count) is not None
+        if stale and self._prev_snapshot is not None:
+            # frozen signal: diff the previous snapshot against itself
+            # (an empty delta) — the rules see "no movement", which
+            # must decay toward no-ops, never oscillate
+            snap = self._prev_snapshot
+            reg.inc("control_signal_stale")
+        else:
+            snap = reg.snapshot()
+        prev, self._prev_snapshot = self._prev_snapshot, snap
+        if prev is None:
+            # first tick is baseline-only: the registry is process-wide
+            # and its all-time cumulative counters are not "movement in
+            # this interval" — a controller attached to a long-lived
+            # process must not read the whole uptime as a burst of load
+            delta = {"counters": {}, "histograms": {}}
+        else:
+            delta = _delta_between(snap, prev)
+        self._window.append(delta)
+        signals = self._signals()
+        decisions = self._decide(signals)
+        applied = []
+        for d in decisions:
+            if self.faults is not None and self.faults.check(
+                    "control.actuate.drop", rule=d.rule,
+                    action=d.action) is not None:
+                # the decision is lost before actuation — next tick
+                # re-derives it from fresh signals (idempotence is the
+                # recovery story, not an actuation queue)
+                d.dropped = True
+                reg.inc("control_decisions_dropped")
+                self._record(d)
+                continue
+            t0 = time.perf_counter()
+            self._actuate(d)
+            self.tracer.complete(
+                "control.decision", "control", t0, time.perf_counter(),
+                rule=d.rule, action=d.action, reason=d.reason,
+                **{k: v for k, v in d.detail.items()
+                   if isinstance(v, (int, float, str, bool))})
+            self._record(d)
+            applied.append(d)
+        self._export_gauges(reg)
+        return applied
+
+    def _signals(self) -> dict:
+        adm = self.admission
+        window = list(self._window)
+        qw = _merge_window(window, "admission.queue_wait_seconds")
+        # the offender-vs-victim discriminator for the SLO shed must
+        # LEAD, not lag: completed-query sums only show a heavy storm
+        # after its queries finish (minutes late for minute-long
+        # queries), by which time the fast victims dominate the
+        # completions and would take the blame.  The admission
+        # controller's per-tenant backlog (running + queued, right
+        # now) attributes demand the moment it arrives.
+        stats = adm.tenant_stats()
+        tenant_p99 = {}
+        tenant_load = {}
+        tenant_pressure = {}
+        for tenant in self.slo.slos:
+            h = _merge_window(window,
+                              f"query.tenant.{tenant}.e2e_seconds")
+            tenant_p99[tenant] = histogram_percentile(h, 99)
+            st = stats.get(tenant) or {}
+            tenant_load[tenant] = float(
+                st.get("active", 0) + st.get("queued", 0))
+            # windowed rejections: a shed tenant still hammering
+            # admission must not be restored on its (forced) silence
+            tenant_pressure[tenant] = _sum_window(
+                window, f"admission.tenant.{tenant}.rejected")
+        spill = _merge_window(window, "spill.io_seconds")
+        return {
+            "queue_wait_p99": histogram_percentile(qw, 99),
+            "tenant_p99": tenant_p99,
+            "tenant_load": tenant_load,
+            "tenant_pressure": tenant_pressure,
+            "spill_p99": histogram_percentile(spill, 99),
+            "grant_waits": _sum_window(window, "governor_grant_waits"),
+            "grant_timeouts": _sum_window(window,
+                                          "governor_grant_timeouts"),
+            "governor_sheds": _sum_window(window, "governor_shed_queries"),
+            "active": adm.active,
+            "queued": adm.queued,
+        }
+
+    def _decide(self, signals: dict) -> "list[Decision]":
+        out: list[Decision] = []
+        # SLO first: its violation streaks feed AIMD's congestion input
+        out.extend(self.slo.observe(signals["tenant_p99"],
+                                    signals["tenant_load"],
+                                    signals["tenant_pressure"]))
+        congested = (signals["grant_timeouts"] > 0
+                     or signals["governor_sheds"] > 0
+                     or self.slo.any_violating())
+        if self.admission_enabled:
+            d = aimd_admission(
+                self.admission.max_concurrent,
+                queue_wait_p99=signals["queue_wait_p99"],
+                congested=congested, active=signals["active"],
+                min_cap=self.min_cap, max_cap=self.max_cap,
+                queue_wait_target=self.queue_wait_target)
+            if d is not None:
+                out.append(d)
+        if self.watermark is not None:
+            d = self.watermark.observe(
+                spill_p99=signals["spill_p99"],
+                grant_timeouts=signals["grant_timeouts"],
+                grant_waits=signals["grant_waits"])
+            if d is not None:
+                out.append(d)
+        cluster = getattr(self.session, "_cluster_handle", None)
+        if self.fleet is not None and cluster is not None:
+            overloaded = (self.slo.any_violating()
+                          or (signals["queued"] > 0
+                              and signals["queue_wait_p99"] is not None
+                              and signals["queue_wait_p99"]
+                              > self.queue_wait_target))
+            idle = not self.slo.any_violating() and \
+                signals["queued"] == 0
+            d = self.fleet.observe(
+                worker_count=len(cluster.schedulable_workers()),
+                overloaded=overloaded, idle=idle)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def _actuate(self, d: Decision) -> None:
+        if d.rule == "admission":
+            self.admission.set_max_concurrent(int(d.detail["to"]))
+            d.applied = True
+        elif d.rule == "slo":
+            # shed/restore actuate through the pressure hook reading
+            # self.slo.shed — the tracker already flipped the map, so
+            # the "actuation" is making that state visible/auditable
+            d.applied = True
+        elif d.rule == "governor" and self._governor is not None:
+            wm = self.watermark
+            self._governor.set_watermark_overrides(wm.high, wm.low)
+            d.applied = True
+        elif d.rule == "fleet":
+            cluster = getattr(self.session, "_cluster_handle", None)
+            if cluster is None:
+                return
+            # enginelint: disable=RL001 (a failed scale actuation is counted and re-derived next tick; it must not kill the loop)
+            try:
+                if d.action == "add_worker":
+                    d.detail["worker_id"] = cluster.add_worker()
+                else:
+                    wid = cluster.drain_candidate()
+                    if wid is None:
+                        d.reason += " (no drainable worker)"
+                        return
+                    d.detail["worker_id"] = wid
+                    d.detail.update(cluster.remove_worker(wid, drain=True))
+                d.applied = True
+            # enginelint: disable=RL001 (control loop runs outside any query: a failed fleet actuation is recorded on the decision and re-derived next tick; no lifecycle exception can transit this thread)
+            except Exception as e:
+                d.reason += f" (actuation failed: {e})"
+                get_registry().inc("control_fleet_errors")
+
+    def _record(self, d: Decision) -> None:
+        reg = get_registry()
+        reg.inc("control_decisions")
+        reg.inc(f"control.decision.{d.rule}.{d.action}")
+        with self._lock:
+            self.decisions.append(d)
+
+    def _export_gauges(self, reg) -> None:
+        reg.set_gauge("control.ticks", self._tick_count)
+        reg.set_gauge("control.admission.max_concurrent",
+                      self.admission.max_concurrent)
+        reg.set_gauge("control.tenants.shed", len(self.slo.shed))
+        if self.watermark is not None:
+            reg.set_gauge("control.governor.high_watermark",
+                          self.watermark.high)
+
+    # -- history-driven plan routing ------------------------------------
+
+    def route_for(self, logical) -> "dict | None":
+        """Conf overrides for one plan (or None = run as configured).
+
+        Looks the plan's fingerprint up in the bounded in-memory
+        history index: enough FINISHED samples below the express
+        threshold routes single-chip with the AQE stage machinery
+        skipped; a fingerprint observed under several mesh shapes
+        routes to the fastest median.  Pure lookup — never reads the
+        history file on the query path (the index refreshes at tick
+        cadence)."""
+        idx = self._history_index
+        if idx is None or logical is None:
+            return None
+        self._refresh_index()
+        fp = self._fingerprint(logical)
+        if fp is None:
+            return None
+        stats = idx.lookup(fp)
+        if stats is None or stats["samples"] < self.route_min_samples:
+            return None
+        overrides: dict = {}
+        reason = ""
+        wall = stats["median_wall_s"]
+        if wall is not None and wall < self.express_wall:
+            overrides = {
+                "spark.rapids.tpu.mesh.deviceCount": "1",
+                "spark.sql.adaptive.enabled": "false",
+                "spark.rapids.control.express": "true",
+            }
+            reason = (f"median wall {wall:.3f}s < express threshold "
+                      f"{self.express_wall:g}s over {stats['samples']} "
+                      "runs: single chip, no stage machinery")
+        elif len(stats["by_mesh"]) > 1:
+            best = min(stats["by_mesh"].items(),
+                       key=lambda kv: kv[1]["median_wall_s"])
+            overrides = {
+                "spark.rapids.tpu.mesh.deviceCount": str(best[0])}
+            reason = (f"fastest observed mesh shape is {best[0]} "
+                      f"devices (median {best[1]['median_wall_s']:.3f}s "
+                      f"across shapes {sorted(stats['by_mesh'])})")
+        if not overrides:
+            return None
+        reg = get_registry()
+        reg.inc("control_routes")
+        reg.inc("control.route.express" if "spark.rapids.control.express"
+                in overrides else "control.route.mesh")
+        prev = self._routes.get(fp)
+        self._routes[fp] = overrides
+        self._routes.move_to_end(fp)
+        while len(self._routes) > 256:
+            self._routes.popitem(last=False)
+        if prev != overrides:
+            d = Decision("route",
+                         "express" if "spark.rapids.control.express"
+                         in overrides else "mesh",
+                         reason, detail={"fingerprint": fp,
+                                         "overrides": dict(overrides)})
+            d.applied = True
+            self._record(d)
+        return overrides
+
+    def _refresh_index(self) -> None:
+        idx, path = self._history_index, self._history_path
+        if idx is None or path is None:
+            return
+        # rate-limited by the index itself (stat + mtime/inode check);
+        # the in-process fast path is session._record_history calling
+        # note_entry() directly, so refresh only matters for history
+        # written by OTHER processes sharing the directory
+        idx.refresh_from(path)
+
+    def note_history_entry(self, entry: dict) -> None:
+        """In-process fast path: the session just appended a history
+        entry — index it without waiting for a file re-read."""
+        idx = self._history_index
+        if idx is not None:
+            idx.note_entry(entry)
+
+    def _fingerprint(self, logical) -> "str | None":
+        # enginelint: disable=RL001 (routing is best-effort: an unfingerprintable plan simply runs as configured)
+        try:
+            from spark_rapids_tpu.exec.compile_cache import fingerprint
+            from spark_rapids_tpu.exec.result_cache import _plan_part
+            try:
+                return fingerprint(_plan_part(logical))
+            # enginelint: disable=RL001 (same fallback the history recorder uses for in-memory scans)
+            except Exception:
+                return fingerprint(repr(logical))
+        # enginelint: disable=RL001 (routing is advisory: an unfingerprintable plan routes nowhere, it must never fail the query being planned)
+        except Exception:
+            return None
+
+    # -- the /control surface -------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            decisions = [d.to_dict() for d in self.decisions]
+        out = {
+            "running": self.running,
+            "interval_s": self.interval,
+            "ticks": self._tick_count,
+            "admission": {
+                "enabled": self.admission_enabled,
+                "max_concurrent": self.admission.max_concurrent,
+                "base_max_concurrent": self._base_cap,
+                "bounds": [self.min_cap, self.max_cap],
+            },
+            "slo": self.slo.status(),
+            "shed_tenants": dict(self.slo.shed),
+            "decisions": decisions,
+        }
+        if self.watermark is not None:
+            out["governor"] = {
+                "high_watermark": self.watermark.high,
+                "low_watermark": self.watermark.low,
+                "base_high_watermark": self.watermark.base_high,
+                "at_base": self.watermark.at_base(),
+            }
+        if self.fleet is not None:
+            cluster = getattr(self.session, "_cluster_handle", None)
+            out["fleet"] = {
+                "workers": (None if cluster is None
+                            else len(cluster.schedulable_workers())),
+                "bounds": [self.fleet.min_workers,
+                           self.fleet.max_workers],
+                "cooldown_s": self.fleet.cooldown_s,
+            }
+        if self._history_index is not None:
+            out["route"] = {
+                "express_wall_s": self.express_wall,
+                "min_samples": self.route_min_samples,
+                "indexed_fingerprints": len(self._history_index),
+            }
+        return out
+
+
+def _delta_between(cur: dict, prev: "dict | None") -> dict:
+    """Counter/histogram movement between two raw snapshots (the
+    registry's ``delta`` re-snapshots internally, which would defeat
+    the frozen-signal fault — so the loop diffs snapshots it already
+    holds)."""
+    from spark_rapids_tpu.obs.registry import delta_histogram_snapshot
+    before_c = (prev or {}).get("counters", {})
+    counters = {}
+    for k, v in cur.get("counters", {}).items():
+        d = v - before_c.get(k, 0)
+        if d:
+            counters[k] = d
+    before_h = (prev or {}).get("histograms", {})
+    hists = {}
+    for k, snap in cur.get("histograms", {}).items():
+        d = delta_histogram_snapshot(snap, before_h.get(k))
+        if d is not None:
+            hists[k] = d
+    return {"counters": counters, "histograms": hists}

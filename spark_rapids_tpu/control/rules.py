@@ -1,0 +1,384 @@
+"""Pure control rules: signals in, bounded decisions out.
+
+Every rule here is engine-free — inputs are plain numbers/dicts the
+loop derives from registry snapshot deltas, outputs are
+:class:`Decision` values (or None).  tests/test_control.py drives each
+rule against synthetic snapshots with no session, no threads, and no
+jax; the loop (loop.py) owns the only side effects.
+
+Design invariants shared by every rule:
+
+* **bounded** — every output is clamped to explicit limits
+  (min/maxConcurrent, min high watermark, min/maxWorkers); no rule can
+  walk a knob to infinity however bad the signals get.
+* **hysteresis** — state-changing decisions (shed, scale) require N
+  consecutive ticks of the same signal; one noisy delta never flips a
+  tenant or a fleet.
+* **idempotent** — a decision is derived from the CURRENT signals, not
+  from "what I did last tick", so a dropped actuation
+  (control.actuate.drop) is simply re-derived next tick and applying
+  the same decision twice is a no-op.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["Decision", "aimd_admission", "SloTracker", "WatermarkRule",
+           "FleetRule"]
+
+
+class Decision:
+    """One control actuation: what rule, what it did, and why.  The
+    loop traces each as a ``control.decision`` span and keeps the last
+    32 for the ``/control`` endpoint."""
+
+    __slots__ = ("rule", "action", "detail", "reason", "applied",
+                 "dropped", "unix_s")
+
+    def __init__(self, rule: str, action: str, reason: str,
+                 detail: "dict | None" = None):
+        self.rule = rule
+        self.action = action
+        self.reason = reason
+        self.detail = dict(detail or {})
+        self.applied = False
+        self.dropped = False
+        self.unix_s = time.time()
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "action": self.action,
+                "reason": self.reason, "detail": self.detail,
+                "applied": self.applied, "dropped": self.dropped,
+                "unix_s": round(self.unix_s, 3)}
+
+    def __repr__(self) -> str:
+        return (f"Decision({self.rule}:{self.action} {self.detail} — "
+                f"{self.reason})")
+
+
+def aimd_admission(cap: int, *, queue_wait_p99: "float | None",
+                   congested: bool, active: int, min_cap: int,
+                   max_cap: int,
+                   queue_wait_target: float) -> "Decision | None":
+    """AIMD on the admission cap.
+
+    Congestion (a grant timeout, a governor shed, or an SLO violation
+    in the window) halves the cap — multiplicative decrease, the TCP
+    move: back off fast when the engine is visibly hurting.  A healthy
+    engine whose queue-wait p99 exceeds the target gains ONE slot —
+    additive increase: queries are waiting on admission while nothing
+    downstream is saturated, so concurrency is the binding constraint.
+
+    ``cap <= 0`` means unbounded: the rule leaves it alone until the
+    first congestion signal, at which point the current active count is
+    the best available estimate of a sane ceiling to halve from.
+    """
+    min_cap = max(1, int(min_cap))
+    max_cap = max(min_cap, int(max_cap))
+    if cap <= 0:
+        if not congested:
+            return None
+        new = max(min_cap, min(max_cap, max(active, 2 * min_cap) // 2))
+        return Decision(
+            "admission", "bound", detail={"from": 0, "to": new},
+            reason="congestion under an unbounded cap: bounding at "
+                   f"half the active set ({active} running)")
+    if congested and cap > min_cap:
+        new = max(min_cap, cap // 2)
+        return Decision(
+            "admission", "decrease", detail={"from": cap, "to": new},
+            reason="congestion signal in window (grant stall / "
+                   "governor shed / SLO violation)")
+    if not congested and queue_wait_p99 is not None \
+            and queue_wait_p99 > queue_wait_target and cap < max_cap:
+        return Decision(
+            "admission", "increase", detail={"from": cap, "to": cap + 1},
+            reason=f"queue-wait p99 {queue_wait_p99:.3f}s > target "
+                   f"{queue_wait_target:g}s with a healthy engine")
+    return None
+
+
+class SloTracker:
+    """Per-tenant p99-vs-SLO bookkeeping with shed/restore hysteresis.
+
+    ``observe`` takes {tenant: observed p99 or None (no traffic)} for
+    one window and returns the decisions that fired this tick.  A
+    tenant is shed only after ``violation_ticks`` CONSECUTIVE
+    violating windows, and restored only after ``recovery_ticks``
+    consecutive healthy (or silent) ones — so a single straggler
+    neither sheds a tenant nor whipsaws one back and forth.
+
+    Offender targeting: under one tenant's storm EVERY tenant's p99
+    blows up — the victims violate their SLOs because of the
+    offender's queueing, and shedding them too would be collateral
+    damage.  So when ``observe`` is given per-tenant demand
+    (``tenant_load``, e.g. summed end-to-end seconds in the window), a
+    violating tenant is shed only while its demand is at/above its
+    fair share of the total — the offender by construction (the
+    max-demand violator always qualifies, since max >= mean).  Without
+    load data every violator qualifies.
+
+    Restore gating: when given per-tenant rejection pressure
+    (``tenant_pressure``, windowed rejected counts), a shed tenant
+    whose arrivals are still being rejected accrues no recovery ticks
+    — its p99 is quiet only BECAUSE it is shed, and restoring it
+    would readmit the storm and re-violate within ticks (a shed/
+    restore duty cycle that leaks the storm onto everyone else).
+    Recovery starts once the tenant actually backs off.
+
+    The same pressure feed gates NEW sheds: while any shed tenant is
+    still hammering admission, the system has not settled into the
+    post-shed regime — surviving tenants' p99 windows still hold
+    samples that queued behind the offender's in-flight queries (their
+    completions land AFTER the shed), so evidence against them is
+    contaminated by construction.  No second tenant is shed until
+    every already-shed tenant's windowed rejections reach zero."""
+
+    def __init__(self, slos: "dict[str, float]",
+                 violation_ticks: int = 3, recovery_ticks: int = 3,
+                 shed_cooldown_ticks: int = 0):
+        self.slos = {t: float(s) for t, s in slos.items() if s and s > 0}
+        self.violation_ticks = max(1, int(violation_ticks))
+        self.recovery_ticks = max(1, int(recovery_ticks))
+        #: rate limit: ticks after a shed during which no FURTHER
+        #: tenant may be shed — long enough (the loop passes
+        #: window_ticks + violation_ticks) that the sliding window has
+        #: flushed every p99 measured under the pre-shed regime, so a
+        #: second shed can only fire on post-shed evidence
+        self.shed_cooldown_ticks = max(0, int(shed_cooldown_ticks))
+        self._cooldown = 0
+        self._violating: dict[str, int] = {}   # consecutive bad ticks
+        self._healthy: dict[str, int] = {}     # consecutive good ticks
+        self.shed: dict[str, str] = {}         # tenant -> shed reason
+        self.last_p99: dict[str, "float | None"] = {}
+
+    @staticmethod
+    def _over_fair_share(tenant: str,
+                         tenant_load: "dict[str, float] | None") -> bool:
+        if tenant_load is None:
+            return True
+        loaded = {t: v for t, v in tenant_load.items() if v and v > 0}
+        total = sum(loaded.values())
+        if total <= 0:
+            return True
+        return loaded.get(tenant, 0.0) >= total / len(loaded)
+
+    def observe(self, tenant_p99: "dict[str, float | None]",
+                tenant_load: "dict[str, float] | None" = None,
+                tenant_pressure: "dict[str, float] | None" = None
+                ) -> "list[Decision]":
+        out: list[Decision] = []
+        if self._cooldown > 0:
+            self._cooldown -= 1
+        # post-shed regime not settled while any shed tenant still
+        # hammers admission; see class docstring
+        settling = any((tenant_pressure or {}).get(t, 0) > 0
+                       for t in self.shed)
+        for tenant, slo in self.slos.items():
+            p99 = tenant_p99.get(tenant)
+            self.last_p99[tenant] = p99
+            violating = p99 is not None and p99 > slo
+            if violating and (tenant in self.shed or
+                              self._over_fair_share(tenant, tenant_load)):
+                self._violating[tenant] = \
+                    self._violating.get(tenant, 0) + 1
+                self._healthy[tenant] = 0
+            elif violating:
+                # a VICTIM: violating, but not driving the load.  Its
+                # streak must not accrue — otherwise it sheds the
+                # instant the offender's demand drains from the window
+                # and its own stale-high p99 briefly makes it the
+                # biggest remaining load.  Not healthy either: a
+                # victim's suffering still signals congestion upstream.
+                self._violating[tenant] = 0
+                self._healthy[tenant] = 0
+            else:
+                if tenant in self.shed and \
+                        (tenant_pressure or {}).get(tenant, 0) > 0:
+                    # shed, quiet p99 — but still hammering admission
+                    # (windowed rejections > 0).  Restoring now would
+                    # readmit the storm and re-violate within ticks:
+                    # the duty-cycle oscillation this gate exists to
+                    # prevent.  Recovery starts when the tenant backs
+                    # off.
+                    self._healthy[tenant] = 0
+                else:
+                    self._healthy[tenant] = \
+                        self._healthy.get(tenant, 0) + 1
+                self._violating[tenant] = 0
+            if tenant not in self.shed and self._cooldown == 0 and \
+                    not settling and \
+                    self._violating[tenant] >= self.violation_ticks:
+                reason = (f"tenant {tenant!r} p99 {p99:.3f}s > SLO "
+                          f"{slo:g}s for {self._violating[tenant]} "
+                          "ticks: shedding its over-share")
+                self.shed[tenant] = reason
+                self._cooldown = self.shed_cooldown_ticks
+                # a shed is a regime change: every OTHER tenant's
+                # violation streak was measured under the pre-shed
+                # regime (queueing behind this offender), so those
+                # streaks restart from fresh windows — without this, a
+                # victim sheds moments later on evidence that the shed
+                # itself just invalidated
+                for other in self.slos:
+                    if other != tenant and other not in self.shed:
+                        self._violating[other] = 0
+                out.append(Decision(
+                    "slo", "shed", reason,
+                    detail={"tenant": tenant, "p99_s": round(p99, 4),
+                            "slo_s": slo}))
+            elif tenant in self.shed and \
+                    self._healthy[tenant] >= self.recovery_ticks:
+                del self.shed[tenant]
+                out.append(Decision(
+                    "slo", "restore",
+                    reason=f"tenant {tenant!r} back under its "
+                           f"{slo:g}s SLO for "
+                           f"{self._healthy[tenant]} ticks",
+                    detail={"tenant": tenant,
+                            "p99_s": None if p99 is None
+                            else round(p99, 4), "slo_s": slo}))
+        return out
+
+    def any_violating(self) -> bool:
+        """True while any SLO'd tenant is in a violating streak (even
+        a 1-tick one) — the congestion input to AIMD and the fleet
+        rule."""
+        return any(n > 0 for n in self._violating.values())
+
+    def status(self) -> dict:
+        """Per-tenant SLO table for the /control endpoint."""
+        return {t: {"slo_s": slo,
+                    "p99_s": self.last_p99.get(t),
+                    "violating_ticks": self._violating.get(t, 0),
+                    "shed": t in self.shed}
+                for t, slo in self.slos.items()}
+
+
+class WatermarkRule:
+    """Adapt the governor's high/low spill watermarks to the observed
+    spill tier.
+
+    A slow tier (spill-I/O p99 over target, or any grant timeout in
+    the window) steps the high watermark DOWN one notch: spilling
+    starts earlier, so grant waiters stop piling up behind I/O that
+    cannot keep pace.  Only after ``heal_ticks`` consecutive healthy
+    windows does it step back UP toward the conf value — never above
+    it (the conf is the operator's ceiling, adaptation only retreats
+    from it).  The low watermark tracks the high one at the conf's
+    own high-low gap."""
+
+    def __init__(self, base_high: float, base_low: float,
+                 spill_p99_target: float = 0.25, step: float = 0.05,
+                 min_high: float = 0.50, heal_ticks: int = 5):
+        self.base_high = float(base_high)
+        self.base_low = float(base_low)
+        self.gap = max(0.05, self.base_high - self.base_low)
+        self.target = float(spill_p99_target)
+        self.step = max(0.005, float(step))
+        self.min_high = min(float(min_high), self.base_high)
+        self.heal_ticks = max(1, int(heal_ticks))
+        self.high = self.base_high
+        self._healthy = 0
+
+    def observe(self, *, spill_p99: "float | None",
+                grant_timeouts: int,
+                grant_waits: int) -> "Decision | None":
+        slow = (grant_timeouts > 0
+                or (spill_p99 is not None and spill_p99 > self.target))
+        if slow:
+            self._healthy = 0
+            new = max(self.min_high, round(self.high - self.step, 4))
+            if new >= self.high:
+                return None
+            old, self.high = self.high, new
+            return Decision(
+                "governor", "lower", detail={
+                    "high_from": old, "high_to": new,
+                    "low_to": round(max(0.05, new - self.gap), 4)},
+                reason="slow spill tier "
+                       f"(spill p99={'-' if spill_p99 is None else format(spill_p99, '.3f')}s, "
+                       f"{grant_timeouts} grant timeouts, "
+                       f"{grant_waits} grant waits in window)")
+        self._healthy += 1
+        if self.high < self.base_high and self._healthy >= self.heal_ticks:
+            self._healthy = 0
+            old = self.high
+            self.high = min(self.base_high, round(self.high + self.step, 4))
+            return Decision(
+                "governor", "raise", detail={
+                    "high_from": old, "high_to": self.high,
+                    "low_to": round(max(0.05, self.high - self.gap), 4)},
+                reason=f"spill tier healthy for {self.heal_ticks} "
+                       "ticks: stepping back toward the conf "
+                       f"watermark {self.base_high:g}")
+        return None
+
+    @property
+    def low(self) -> float:
+        return round(max(0.05, self.high - self.gap), 4)
+
+    def at_base(self) -> bool:
+        return self.high >= self.base_high
+
+
+class FleetRule:
+    """Hysteresis + cooldown around add_worker/remove_worker.
+
+    ``overloaded`` (an SLO violation, or queued arrivals piling up)
+    for ``up_ticks`` consecutive ticks asks for one worker; ``idle``
+    (no violation, empty queue) for ``down_ticks`` asks to drain one.
+    Both directions respect min/max bounds and share one cooldown —
+    a spawn costs seconds and a drain migrates map outputs, so the
+    fleet must never flap at tick rate.  The caller applies the
+    decision; this rule only ever asks for a SINGLE worker per
+    actuation, so a lost actuation re-derives harmlessly."""
+
+    def __init__(self, min_workers: int = 1, max_workers: int = 0,
+                 up_ticks: int = 3, down_ticks: int = 10,
+                 cooldown_s: float = 30.0):
+        self.min_workers = max(1, int(min_workers))
+        # max_workers=0 mirrors the cluster conf: unbounded
+        self.max_workers = int(max_workers)
+        self.up_ticks = max(1, int(up_ticks))
+        self.down_ticks = max(1, int(down_ticks))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._over = 0
+        self._idle = 0
+        self._last_actuation: "float | None" = None
+
+    def observe(self, *, worker_count: int, overloaded: bool,
+                idle: bool, now: "float | None" = None
+                ) -> "Decision | None":
+        now = time.monotonic() if now is None else now
+        if overloaded:
+            self._over += 1
+            self._idle = 0
+        elif idle:
+            self._idle += 1
+            self._over = 0
+        else:
+            self._over = 0
+            self._idle = 0
+        in_cooldown = (self._last_actuation is not None
+                       and now - self._last_actuation < self.cooldown_s)
+        if self._over >= self.up_ticks and not in_cooldown and \
+                (self.max_workers <= 0
+                 or worker_count < self.max_workers):
+            self._over = 0
+            self._last_actuation = now
+            return Decision(
+                "fleet", "add_worker",
+                detail={"from": worker_count, "to": worker_count + 1},
+                reason=f"overloaded for {self.up_ticks} ticks "
+                       "(SLO violation or sustained backlog)")
+        if self._idle >= self.down_ticks and not in_cooldown and \
+                worker_count > self.min_workers:
+            self._idle = 0
+            self._last_actuation = now
+            return Decision(
+                "fleet", "remove_worker",
+                detail={"from": worker_count, "to": worker_count - 1},
+                reason=f"idle for {self.down_ticks} ticks "
+                       "(no violation, empty queue)")
+        return None

@@ -195,6 +195,18 @@ class QueryRejected(QueryLifecycleError):
     never started, so there is nothing to unwind."""
 
 
+class TargetedShed(str):
+    """A pressure-hook reason that already NAMES its victim: the hook
+    returned it for this tenant specifically (the control plane's SLO
+    shed), so admission must reject without the over-share spare.  A
+    plain-``str`` reason keeps the global-pressure semantics — a
+    tenant running below its weighted share is spared, because the
+    pressure is someone else's doing.  Without this distinction a
+    targeted shed can never hold: the moment the victim's running
+    queries drain, its active count is below share and every new
+    arrival is spared straight back in."""
+
+
 # -- per-query handle ------------------------------------------------------
 
 class QueryLifecycle:
@@ -219,6 +231,16 @@ class QueryLifecycle:
         self._started_at: "float | None" = None
         self._deadline: "float | None" = None
         self._cancel_reason = "cancelled"
+        # stamped by AdmissionController.admit on the admitted path;
+        # the control loop's per-tenant SLOs are end-to-end (queue wait
+        # + wall), so admission latency must ride along with the
+        # lifecycle to the terminal observation
+        self.queue_wait_s: "float | None" = None
+        # set by control-enabled sessions only: emits the
+        # query.tenant.<t>.e2e_seconds histogram at the terminal
+        # transition.  Off by default so a static engine's counter set
+        # stays byte-identical with the control plane disabled.
+        self.observe_e2e = False
 
     @classmethod
     def from_conf(cls, query_id: str, conf, timeout: "float | None" = None,
@@ -259,6 +281,9 @@ class QueryLifecycle:
         reg = get_registry()
         reg.observe("query.wall_seconds", wall)
         reg.observe(f"query.tenant.{self.tenant}.wall_seconds", wall)
+        if self.observe_e2e:
+            reg.observe(f"query.tenant.{self.tenant}.e2e_seconds",
+                        wall + (self.queue_wait_s or 0.0))
 
     def finish(self) -> bool:
         """RUNNING -> FINISHED (no-op once terminal)."""
@@ -574,12 +599,17 @@ class AdmissionController:
             # checked OUTSIDE the condition (the hook takes the
             # governor's own lock) and before queueing: a query shed
             # for memory pressure never occupied a queue slot.  Only
-            # the over-share tenant absorbs the shed.
-            reason = hook()
+            # the over-share tenant absorbs the shed.  The hook sees
+            # the tenant so a tenant-scoped policy (the control
+            # plane's SLO shed) can target exactly one tenant while
+            # returning None for its neighbors.
+            reason = hook(tenant)
             if reason:
-                if self._tenant_over_share(tenant):
+                if isinstance(reason, TargetedShed) or \
+                        self._tenant_over_share(tenant):
                     raise self._reject(reg, tenant, query_id, reason)
                 reg.inc("admission_pressure_spared")
+                reg.inc(f"admission.tenant.{tenant}.pressure_spared")
         with self._cond:
             st = self._tenant_locked(tenant)
             if self._shutdown:
@@ -587,16 +617,20 @@ class AdmissionController:
                                    "session is shutting down")
             if self.max_concurrent <= 0:
                 self._admitted_locked(st, query_id)
-                reg.observe("admission.queue_wait_seconds",
-                            time.monotonic() - t_admit)
+                waited = time.monotonic() - t_admit
+                reg.observe("admission.queue_wait_seconds", waited)
+                if lifecycle is not None:
+                    lifecycle.queue_wait_s = waited
                 return
             if self._active < self.max_concurrent \
                     and not any(t.queue for t in self._tenants.values()) \
                     and (st.max_concurrent <= 0
                          or st.active < st.max_concurrent):
                 self._admitted_locked(st, query_id)
-                reg.observe("admission.queue_wait_seconds",
-                            time.monotonic() - t_admit)
+                waited = time.monotonic() - t_admit
+                reg.observe("admission.queue_wait_seconds", waited)
+                if lifecycle is not None:
+                    lifecycle.queue_wait_s = waited
                 return
             if len(st.queue) >= self.max_queued:
                 raise self._reject(
@@ -629,8 +663,11 @@ class AdmissionController:
                         st.queue.remove(me)
                         self._admitted_locked(st, query_id)
                         admitted = True
+                        waited = time.monotonic() - t_admit
                         reg.observe("admission.queue_wait_seconds",
-                                    time.monotonic() - t_admit)
+                                    waited)
+                        if lifecycle is not None:
+                            lifecycle.queue_wait_s = waited
                         return
                     rem = None if deadline is None \
                         else deadline - time.monotonic()
@@ -653,6 +690,16 @@ class AdmissionController:
                         pass
                     # the selection may have changed: wake the queue
                     self._cond.notify_all()
+
+    def set_max_concurrent(self, n: int) -> None:
+        """Retune the global cap at runtime (the control plane's AIMD
+        actuation).  Raising it wakes the queue so newly-legal waiters
+        admit immediately; lowering it never evicts running queries —
+        the active set just drains below the new cap before anyone
+        else admits."""
+        with self._cond:
+            self.max_concurrent = int(n)
+            self._cond.notify_all()
 
     def release(self, tenant: str = "default") -> None:
         """One admitted query finished (success, failure, or cancel):

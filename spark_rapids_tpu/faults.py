@@ -190,6 +190,25 @@ Injection points wired today (site -> actions it interprets):
                         and — once retries are exhausted — the
                         roll-back path that un-renames every already
                         published file.
+    control.signal.stale
+                        per control-loop tick (ctx: tick;
+                        control/loop.py ControlLoop.tick).  Any action
+                        name works (use ``stale``); the tick reads a
+                        FROZEN copy of the previous registry snapshot
+                        instead of a fresh one — an empty delta, as if
+                        the metrics pipeline wedged.  Chaos tests
+                        assert the rules decay to no-ops on frozen
+                        signals instead of oscillating.
+    control.actuate.drop
+                        per derived control decision, before actuation
+                        (ctx: rule, action; control/loop.py
+                        ControlLoop.tick).  Any action name works (use
+                        ``drop``); the decision is lost in flight —
+                        never applied, recorded with dropped=true.
+                        Safe by design: decisions are idempotent and
+                        re-derived from fresh signals next tick, so a
+                        dropped actuation only delays convergence by
+                        one interval.
 
 Trigger keys (all optional):
 
@@ -255,6 +274,8 @@ KNOWN_POINTS = frozenset({
     "io.write.partial",
     "io.write.commit.drop",
     "io.write.rename.fail",
+    "control.signal.stale",
+    "control.actuate.drop",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a

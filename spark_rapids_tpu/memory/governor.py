@@ -165,7 +165,38 @@ class MemoryGovernor:
         # unpinned, rebuildable — evicted before any query is wounded
         # or load-shed
         self._cache_ref = None
+        # control-plane watermark overrides (None = static conf): the
+        # register() conf refresh below would silently clobber an
+        # adapted watermark on the next query, so overrides are
+        # re-applied after every refresh
+        self._wm_override: "tuple[float, float] | None" = None
         get_registry().register_source("governor", self._source)
+
+    def set_watermark_overrides(self, high: "float | None",
+                                low: "float | None") -> None:
+        """Control-plane actuation: pin the high/low spill watermarks
+        to adapted values that survive the per-query conf refresh in
+        :meth:`register`.  ``(None, None)`` clears the override — the
+        next register() restores the static conf values (the
+        controller calls that on stop(), so a stopped control plane
+        leaves no residue).  Waiters are woken: a lowered watermark
+        may make spilling (and therefore grants) possible right now."""
+        with self._cond:
+            if high is None or low is None:
+                self._wm_override = None
+            else:
+                self._wm_override = (float(high), float(low))
+                self._high_wm, self._low_wm = self._wm_override
+            self._bg_wake.set()
+            self._cond.notify_all()
+
+    def watermarks(self) -> dict:
+        """Current effective watermarks (+ whether the control plane
+        has them overridden) for the /control endpoint."""
+        with self._cond:
+            return {"high": self._high_wm, "low": self._low_wm,
+                    "shed": self._shed_wm,
+                    "overridden": self._wm_override is not None}
 
     def register_cache(self, cache) -> None:
         """Bind the process-wide result/fragment cache as the first
@@ -200,6 +231,9 @@ class MemoryGovernor:
         self._shed_hold = GOVERNOR_SHED_HOLD.get(settings)
         self._grant_timeout = GOVERNOR_GRANT_TIMEOUT.get(settings)
         self._poll_s = max(GOVERNOR_POLL_MS.get(settings), 1) / 1000.0
+        ov = self._wm_override
+        if ov is not None:
+            self._high_wm, self._low_wm = ov
         with self._cond:
             st = _QueryState(query_id, self._seq, catalog, lifecycle)
             # a catalog garbage-collected without close() (leaked by
@@ -436,11 +470,16 @@ class MemoryGovernor:
         if frac >= self._high_wm:
             self._bg_wake.set()
 
-    def admission_pressure(self) -> str | None:
+    def admission_pressure(self, tenant: "str | None" = None
+                           ) -> str | None:
         """AdmissionController pressure hook: a reason string when new
         admissions should be shed (aggregate occupancy has sat above
         shedWatermark for shedHoldSeconds), else None.  Reading is
-        cheap — admission already takes a lock of its own."""
+        cheap — admission already takes a lock of its own.  Memory
+        pressure is tenant-blind (``tenant`` is accepted for the hook
+        signature; per-tenant targeting lives in the control plane's
+        composed hook) — the controller's over-share gate decides who
+        absorbs the shed."""
         with self._cond:
             self._update_pressure_locked()
             over = self._over_since

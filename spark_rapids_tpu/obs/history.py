@@ -21,14 +21,18 @@ with NO engine imports at all.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
+import statistics
 import threading
+import time
 
 from spark_rapids_tpu.conf import ConfEntry, register
 
-__all__ = ["HISTORY_DIR", "HISTORY_MAX", "QueryHistoryLog", "history_log",
-           "read_entries", "read_history_tail", "HISTORY_FILE"]
+__all__ = ["HISTORY_DIR", "HISTORY_MAX", "HistoryIndex", "QueryHistoryLog",
+           "history_log", "read_entries", "read_history_tail",
+           "HISTORY_FILE"]
 
 HISTORY_DIR = register(ConfEntry(
     "spark.rapids.obs.history.dir", "",
@@ -108,20 +112,44 @@ class QueryHistoryLog:
 
 def read_entries(path: str, last: int | None = None) -> list[dict]:
     """Parse the log, newest last; torn/garbage lines are skipped (a
-    crash mid-append must not poison forensics of every other query)."""
+    crash mid-append must not poison forensics of every other query).
+
+    Rotation-tolerant: ``_rotate_locked`` swaps the file out with
+    ``os.replace`` while readers may be mid-iteration.  The swap is
+    atomic but a read that STRADDLES it returns a mix of a
+    half-consumed old inode and nothing of the new one — so the inode
+    is compared before and after the read, and a read whose file was
+    replaced underneath it retries against the fresh file (bounded
+    retries: under pathological rotation churn the last read wins,
+    torn or not, rather than spinning)."""
     out: list[dict] = []
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except ValueError:
-                    continue
-    except FileNotFoundError:
-        return []
+    for _attempt in range(4):
+        try:
+            st_before = os.stat(path)
+        except OSError:
+            return []
+        out = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        try:
+            st_after = os.stat(path)
+        except OSError:
+            # rotated away (or the dir vanished) right after the read:
+            # what was read is the newest complete view there was
+            break
+        if (st_after.st_ino, st_after.st_dev) == \
+                (st_before.st_ino, st_before.st_dev):
+            break
     return out if last is None else out[-last:]
 
 
@@ -137,6 +165,113 @@ def read_history_tail(directory: str, last: int = 16) -> list[dict]:
                      "submitted_unix_s", "plan_fingerprint", "error")
                     if e.get(k) is not None})
     return out
+
+
+class HistoryIndex:
+    """Bounded in-memory fingerprint → wall-time index over the
+    history log, so plan routing is a dict lookup on the query path
+    instead of a ``query_history.jsonl`` re-read per query.
+
+    Two feeds: :meth:`note_entry` (the in-process fast path — the
+    session indexes each entry as it appends it) and
+    :meth:`refresh_from` (rebuild from the file when its identity
+    changed — history written by OTHER processes sharing the
+    directory, or a rotation).  ``refresh_from`` is stat-gated and
+    rate-limited, and a rebuild REPLACES the index, so the two feeds
+    never double-count an entry.  LRU-bounded on fingerprints and
+    sample-bounded per fingerprint: a long-lived driver seeing
+    unbounded distinct plans stays at a fixed footprint."""
+
+    def __init__(self, max_fingerprints: int = 512,
+                 max_samples: int = 32,
+                 min_refresh_s: float = 1.0):
+        self.max_fingerprints = max(1, int(max_fingerprints))
+        self.max_samples = max(1, int(max_samples))
+        self.min_refresh_s = float(min_refresh_s)
+        self._lock = threading.Lock()
+        # fp -> deque of (wall_s, mesh_devices), LRU order
+        self._fps: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._file_id: "tuple | None" = None
+        self._last_refresh: "float | None" = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fps)
+
+    def note_entry(self, entry: dict) -> None:
+        """Index one history entry (only FINISHED runs teach the
+        router — a failed or cancelled wall says nothing about the
+        plan's true cost)."""
+        with self._lock:
+            self._note_locked(entry)
+
+    def _note_locked(self, entry: dict) -> None:
+        fp = entry.get("plan_fingerprint")
+        if not fp or entry.get("state") != "FINISHED":
+            return
+        wall = entry.get("wall_s")
+        if not isinstance(wall, (int, float)) or wall < 0:
+            return
+        try:
+            mesh = int(entry.get("mesh_devices") or 1)
+        except (TypeError, ValueError):
+            mesh = 1
+        dq = self._fps.get(fp)
+        if dq is None:
+            dq = self._fps[fp] = collections.deque(
+                maxlen=self.max_samples)
+        dq.append((float(wall), mesh))
+        self._fps.move_to_end(fp)
+        while len(self._fps) > self.max_fingerprints:
+            self._fps.popitem(last=False)
+
+    def refresh_from(self, path: str) -> bool:
+        """Rebuild from the log file iff its identity (inode + size +
+        mtime) moved since the last look, at most every
+        ``min_refresh_s``.  Returns True when a rebuild happened."""
+        now = time.monotonic()
+        with self._lock:
+            if self._last_refresh is not None and \
+                    now - self._last_refresh < self.min_refresh_s:
+                return False
+            self._last_refresh = now
+            try:
+                st = os.stat(path)
+                file_id = (st.st_ino, st.st_dev, st.st_size,
+                           st.st_mtime_ns)
+            except OSError:
+                file_id = None
+            if file_id == self._file_id:
+                return False
+            self._file_id = file_id
+        entries = read_entries(path)  # outside the lock: file I/O
+        with self._lock:
+            self._fps.clear()
+            for e in entries:
+                self._note_locked(e)
+        return True
+
+    def lookup(self, fingerprint: str) -> "dict | None":
+        """Observed-wall stats for one plan fingerprint, or None if it
+        was never (successfully) seen: total samples, overall median
+        wall, and a per-mesh-shape breakdown."""
+        with self._lock:
+            dq = self._fps.get(fingerprint)
+            if not dq:
+                return None
+            self._fps.move_to_end(fingerprint)
+            samples = list(dq)
+        by_mesh: dict = {}
+        for wall, mesh in samples:
+            by_mesh.setdefault(mesh, []).append(wall)
+        return {
+            "samples": len(samples),
+            "median_wall_s": statistics.median(w for w, _m in samples),
+            "by_mesh": {m: {"samples": len(ws),
+                            "median_wall_s": statistics.median(ws)}
+                        for m, ws in by_mesh.items()},
+        }
 
 
 _logs: dict[tuple[str, int], QueryHistoryLog] = {}

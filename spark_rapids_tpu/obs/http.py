@@ -14,6 +14,10 @@ same operational surface as three read-only routes:
   on readiness, not liveness.
 * ``/queries`` — the in-flight query table (query_id -> lifecycle
   state/tenant/tenant wall so far), the live analog of the history log.
+* ``/control`` — the self-driving control plane's learned state
+  (current admission cap, adapted governor watermarks, per-tenant SLO
+  status, last 32 decisions), or ``{"enabled": false}`` when the
+  control loop is off.
 
 Security: binds 127.0.0.1 ONLY.  The registry carries operational
 detail (tenant names, peer addresses, plan fingerprints) that must not
@@ -76,9 +80,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200 if body["status"] == "ok" else 503, body)
             elif path == "/queries":
                 self._json(200, srv.queries())
+            elif path == "/control":
+                self._json(200, srv.control())
             else:
-                self._reply(404, b"not found: /metrics /healthz /queries\n",
-                            "text/plain")
+                self._reply(404,
+                            b"not found: /metrics /healthz /queries "
+                            b"/control\n", "text/plain")
         except BrokenPipeError:  # scraper hung up mid-reply
             pass
         # enginelint: disable=RL001 (endpoint must never kill the engine)
@@ -157,6 +164,29 @@ class ObsHttpServer:
             if any(w["state"] == "lost" for w in workers) \
                     and out["status"] == "ok":
                 out["status"] = "degraded"
+        control = getattr(s, "_control", None)
+        if control is not None:
+            shed = dict(control.slo.shed)
+            if shed:
+                # a shed tenant is a PLANNED partial outage: the
+                # engine is protecting everyone else's SLO, so
+                # readiness degrades with the tenant NAMED rather
+                # than flipping hard-down
+                out["shed_tenants"] = sorted(shed)
+                if out["status"] == "ok":
+                    out["status"] = "degraded"
+        return out
+
+    def control(self) -> dict:
+        """The /control body: learned knob values, per-tenant SLO
+        table, and the last 32 decisions — or a stub when the control
+        plane is off (the endpoint must answer either way so
+        dashboards can probe for it)."""
+        control = getattr(self._session, "_control", None)
+        if control is None:
+            return {"enabled": False}
+        out = control.status()
+        out["enabled"] = True
         return out
 
     def queries(self) -> dict:

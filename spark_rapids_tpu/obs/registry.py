@@ -92,10 +92,14 @@ _SAN = re.compile(r"[^a-zA-Z0-9_]")
 #: per tenant/point/peer.  (pattern, family template, label name) —
 #: ``val`` is the label value, ``leaf`` the trailing metric leaf.
 _LABELED = (
-    (re.compile(r"^admission\.tenant\.(?P<val>.+)\.(?P<leaf>admitted|rejected)$"),
+    (re.compile(r"^admission\.tenant\.(?P<val>.+)\.(?P<leaf>admitted|rejected|pressure_spared)$"),
      "admission_tenant_{leaf}", "tenant"),
-    (re.compile(r"^query\.tenant\.(?P<val>.+)\.(?P<leaf>wall_seconds)$"),
+    (re.compile(r"^query\.tenant\.(?P<val>.+)\.(?P<leaf>wall_seconds|e2e_seconds)$"),
      "query_tenant_{leaf}", "tenant"),
+    (re.compile(r"^control\.decision\.(?P<val>.+)$"),
+     "control_decisions_by_rule", "decision"),
+    (re.compile(r"^control\.route\.(?P<val>.+)$"),
+     "control_routes_by_kind", "kind"),
     (re.compile(r"^faults\.injected\.(?P<val>.+)$"),
      "faults_injected", "point"),
     (re.compile(r"^shuffle\.peer\.(?P<val>.+)\.(?P<leaf>[A-Za-z0-9_]+)$"),

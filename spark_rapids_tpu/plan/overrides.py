@@ -647,6 +647,16 @@ class TpuOverrides:
         leaf — fusion later hides the operators above it inside a
         FusedStageExec, but the scan object itself stays shared, so the
         captured reference remains live."""
+        # express lane: the control plane routed this plan below its
+        # learned wall threshold — the AQE stage machinery (boundary
+        # insertion + runtime re-planning) costs more than re-planning
+        # could save on a sub-threshold query.  Raw settings read: the
+        # marker is stamped by control/loop.py, but planning must not
+        # import the control package (it may be disabled/absent).
+        if str(self.conf.settings.get(
+                "spark.rapids.control.express", "")).lower() \
+                in ("true", "1", "yes"):
+            return
         from spark_rapids_tpu.exec.exchange import ADAPTIVE_ENABLED
         if not self.conf.get(ADAPTIVE_ENABLED):
             return

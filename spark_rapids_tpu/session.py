@@ -48,12 +48,23 @@ class TpuSession:
         self._admission = None       # built lazily from the live conf
         self._cluster_handle = None  # ClusterDriver, lazily spawned
         self._http = None            # ObsHttpServer when the conf is on
+        self._control = None         # ControlLoop when the conf is on
         # raw-settings gated: with the port conf absent/0 (the default)
         # obs.http is never imported (premerge asserts sys.modules)
         port = self.conf.settings.get("spark.rapids.obs.http.port")
         if port and int(port) > 0:
             from spark_rapids_tpu.obs.http import ObsHttpServer
             self._http = ObsHttpServer(self, int(port))
+        # raw-settings gated like http/history/cluster: with
+        # control.enabled unset (the default) the control package is
+        # never imported — plans, confs, and counters stay
+        # byte-identical to the static engine (premerge asserts it)
+        if str(self.conf.settings.get(
+                "spark.rapids.control.enabled", "")).lower() \
+                in ("true", "1", "yes"):
+            from spark_rapids_tpu.control import ControlLoop
+            self._control = ControlLoop(self)
+            self._control.start()
 
     # -- query lifecycle (exec/lifecycle.py) ---------------------------
     def _admission_controller(self):
@@ -123,6 +134,13 @@ class TpuSession:
         closes its own ExecCtx: shuffle TCP servers stop, catalogs
         close (spill files unlinked), the DeviceSemaphore is released
         in full."""
+        # control loop first: a controller actuating knobs while the
+        # session tears them down would race, and stop() restores every
+        # adapted knob to its static conf value (no thread survives
+        # shutdown — premerge asserts it)
+        control, self._control = self._control, None
+        if control is not None:
+            control.stop()
         self._admission_controller().begin_shutdown()
         if not drain:
             self.cancel_all()
@@ -154,9 +172,27 @@ class TpuSession:
                 self._lc_cond.wait(rem if rem is not None else 1.0)
         return True
 
+    def _routed_conf(self, logical) -> TpuConf:
+        """The conf this plan should run under: the session conf, plus
+        the control plane's history-learned routing overrides (mesh
+        shape, express lane) when the controller is on and has enough
+        samples for this plan's fingerprint.  With control disabled
+        this IS ``self.conf`` — same object, zero divergence."""
+        control = self._control
+        if control is None or logical is None:
+            return self.conf
+        overrides = control.route_for(logical)
+        if not overrides:
+            return self.conf
+        conf = self.conf
+        for k, v in overrides.items():
+            conf = conf.set(k, v)
+        return conf
+
     def _run_query(self, node, backend: str,
                    timeout: float | None = None, logical=None,
-                   tenant: str | None = None) -> list[tuple]:
+                   tenant: str | None = None,
+                   conf: "TpuConf | None" = None) -> list[tuple]:
         """Result-cache lookup -> admission -> lifecycle registration
         -> execution -> cleanup for one collect.  The lifecycle is
         registered in ``_live`` BEFORE admission so a cancel reaches a
@@ -171,10 +207,16 @@ class TpuSession:
         import uuid
         from spark_rapids_tpu.exec.lifecycle import (QueryLifecycle,
                                                      QueryLifecycleError)
+        if conf is None:
+            conf = self.conf
         admission = self._admission_controller()
         query_id = uuid.uuid4().hex[:16]
-        lc = QueryLifecycle.from_conf(query_id, self.conf,
+        lc = QueryLifecycle.from_conf(query_id, conf,
                                       timeout=timeout, tenant=tenant)
+        # the control plane's per-tenant SLOs are end-to-end (queue
+        # wait + wall): only control-enabled sessions emit the extra
+        # e2e histogram, so a static engine's counter set is untouched
+        lc.observe_e2e = self._control is not None
         with self._lc_cond:
             self._live[query_id] = lc
         admitted = False
@@ -185,7 +227,8 @@ class TpuSession:
             admitted = True
             lc.start()
             try:
-                out = self._execute_collect(node, backend, query_id, lc)
+                out = self._execute_collect(node, backend, query_id, lc,
+                                            conf)
             except QueryLifecycleError:
                 raise
             except BaseException:
@@ -216,12 +259,15 @@ class TpuSession:
             key = None
             if logical is not None and not admission.shutting_down:
                 from spark_rapids_tpu.exec.result_cache import maybe_cache
-                rcache = maybe_cache(self.conf)
+                rcache = maybe_cache(conf)
                 if rcache is not None:
                     # backend is part of the key: the host oracle must
                     # never be served a device run's rows (differential
-                    # testing would silently compare a cache to itself)
-                    key = rcache.result_key(logical, backend, self.conf)
+                    # testing would silently compare a cache to itself).
+                    # The ROUTED conf is part of the key too — an
+                    # express-routed run and a full-mesh run of the
+                    # same logical plan are different computations.
+                    key = rcache.result_key(logical, backend, conf)
             if key is None:
                 out = run()
             else:
@@ -235,7 +281,7 @@ class TpuSession:
         finally:
             if hist_dir:
                 self._record_history(lc, node, logical, err,
-                                     hist_before, submitted)
+                                     hist_before, submitted, conf)
             with self._lc_cond:
                 self._live.pop(query_id, None)
                 self._lc_cond.notify_all()
@@ -243,7 +289,8 @@ class TpuSession:
                 admission.release(tenant=lc.tenant)
 
     def _record_history(self, lc, node, logical, err,
-                        before: dict, submitted: float) -> None:
+                        before: dict, submitted: float,
+                        conf: "TpuConf | None" = None) -> None:
         """Append this query's terminal record to the history log
         (obs/history.py).  Forensics must never fail the query: any
         error here is swallowed after best-effort assembly."""
@@ -262,6 +309,8 @@ class TpuSession:
                 state = "REJECTED" if isinstance(err, QueryRejected) \
                     else ("FAILED" if err is not None else state)
             started = lc._started_at
+            if conf is None:
+                conf = self.conf
             delta = get_registry().delta(before)
             counters = delta.get("counters", {})
             entry: dict = {
@@ -284,6 +333,12 @@ class TpuSession:
                               if k.startswith(("aqe", "result_cache",
                                                "fragment_cache",
                                                "compile_count"))},
+                # the mesh shape this run executed under (the ROUTED
+                # conf when control routing rewrote it) — what the
+                # HistoryIndex learns per-shape walls from
+                "mesh_devices": max(1, int(conf.settings.get(
+                    "spark.rapids.tpu.mesh.deviceCount", 0) or 0)),
+                "control_route": conf is not self.conf,
             }
             if logical is not None:
                 from spark_rapids_tpu.exec.compile_cache import fingerprint
@@ -312,20 +367,28 @@ class TpuSession:
                     "terminal": bool(getattr(err, "terminal", False)),
                 }
             log.append(entry)
+            control = self._control
+            if control is not None:
+                # in-process fast path: index the entry now instead of
+                # waiting for the file-watch refresh at tick cadence
+                control.note_history_entry(entry)
         # enginelint: disable=RL001 (history recording must never mask the query's own outcome; the real error already propagated to the caller)
         except Exception:
             pass
 
-    def _execute_collect(self, node, backend: str, query_id: str, lc):
+    def _execute_collect(self, node, backend: str, query_id: str, lc,
+                         conf: "TpuConf | None" = None):
         # the executor-entry chokepoint: a result-cache hit never gets
         # here, so a zero delta on this counter across a repeated query
         # PROVES the executor was untouched (CI serving gate)
         from spark_rapids_tpu.obs.registry import get_registry
         get_registry().inc("queries_executed")
         lc.executed = True  # vs a result-cache hit, which never gets here
+        if conf is None:
+            conf = self.conf
 
         def make_ctx(be: str) -> ExecCtx:
-            ctx = ExecCtx(backend=be, conf=self.conf)
+            ctx = ExecCtx(backend=be, conf=conf)
             lc.ctx = ctx  # history records explain_analyze post-run
             ctx.cache["query_id"] = query_id
             ctx.cache["lifecycle"] = lc
@@ -339,12 +402,12 @@ class TpuSession:
             return ctx
 
         if backend != "device":
-            return collect_host(node, self.conf, ctx=make_ctx("host"))
+            return collect_host(node, conf, ctx=make_ctx("host"))
         from spark_rapids_tpu.conf import FALLBACK_ON_DEVICE_ERROR
-        if not self.conf.get(FALLBACK_ON_DEVICE_ERROR):
-            return collect_device(node, self.conf, ctx=make_ctx("device"))
+        if not conf.get(FALLBACK_ON_DEVICE_ERROR):
+            return collect_device(node, conf, ctx=make_ctx("device"))
         try:
-            return collect_device(node, self.conf, ctx=make_ctx("device"))
+            return collect_device(node, conf, ctx=make_ctx("device"))
         except Exception as e:  # noqa: BLE001 - opt-in resilience path
             # a cancelled/deadline-exceeded (or otherwise terminal)
             # query must NOT be resurrected on the host engine
@@ -359,7 +422,7 @@ class TpuSession:
                 f"device execution failed ({type(e).__name__}: {e}); "
                 "re-running on the host engine per "
                 "spark.rapids.sql.fallbackOnDeviceError", RuntimeWarning)
-            return collect_host(node, self.conf, ctx=make_ctx("host"))
+            return collect_host(node, conf, ctx=make_ctx("host"))
 
     # -- sources -------------------------------------------------------
     def read_parquet(self, path, columns=None, **kw) -> "DataFrame":
@@ -634,11 +697,15 @@ class DataFrame:
         identical query over unchanged inputs may be served from the
         process-wide result cache (``spark.rapids.sql.resultCache.*``)
         without touching the executor."""
-        ov, meta = self._overridden()
+        # control-plane routing: with the controller on, a repeated
+        # plan may run under a history-learned conf (express lane /
+        # best mesh shape); otherwise this is self._s.conf unchanged
+        conf = self._s._routed_conf(self._plan)
+        ov, meta = self._overridden(conf=conf)
         backend = "device" if meta.backend == "device" else "host"
         return self._s._run_query(meta.exec_node, backend,
                                   timeout=timeout, logical=self._plan,
-                                  tenant=tenant)
+                                  tenant=tenant, conf=conf)
 
     def to_arrow(self):
         import pyarrow as pa
@@ -731,13 +798,16 @@ class DataFrame:
     def _col_or_expr(self, e):
         return col(e) if isinstance(e, str) else e
 
-    def _planned(self) -> PlannedNode:
+    def _planned(self, conf: "TpuConf | None" = None) -> PlannedNode:
         from spark_rapids_tpu.plan.maps import decompose_maps
-        return lower(decompose_maps(self._plan, self._s.conf), self._s.conf)
+        conf = self._s.conf if conf is None else conf
+        return lower(decompose_maps(self._plan, conf), conf)
 
-    def _overridden(self, quiet: bool = False):
-        meta = self._planned()
-        ov = TpuOverrides(self._s.conf)
+    def _overridden(self, quiet: bool = False,
+                    conf: "TpuConf | None" = None):
+        conf = self._s.conf if conf is None else conf
+        meta = self._planned(conf=conf)
+        ov = TpuOverrides(conf)
         ov.prepare(meta, explain=not quiet)
         return ov, meta
 

@@ -511,7 +511,7 @@ def test_pressure_shed_hits_over_share_tenant_only():
     for i in range(3):
         ac.admit(f"h{i}", tenant="hog")
     ac.admit("q0", tenant="quiet")
-    ac.pressure_hook = lambda: "memory pressure: test"
+    ac.pressure_hook = lambda tenant: "memory pressure: test"
     # hog holds 3 of 4 slots at equal weight: over its share -> shed
     with pytest.raises(QueryRejected, match="memory pressure"):
         ac.admit("h3", tenant="hog")
@@ -525,7 +525,7 @@ def test_pressure_shed_hits_over_share_tenant_only():
     # share, so pressure sheds it — identical to the pre-tenant gate
     ac2 = AdmissionController(max_concurrent=0)
     ac2.admit("a", tenant="default")
-    ac2.pressure_hook = lambda: "memory pressure: test"
+    ac2.pressure_hook = lambda tenant: "memory pressure: test"
     with pytest.raises(QueryRejected):
         ac2.admit("b", tenant="default")
 

@@ -561,3 +561,143 @@ def test_telemetry_confs_registered():
     conf = TpuConf({"spark.rapids.obs.history.maxEntries": "7"})
     from spark_rapids_tpu.obs.history import HISTORY_MAX
     assert HISTORY_MAX.get(conf.settings) == 7
+
+
+# ---------------------------------------------------------------------------
+# percentile edge cases (the control loop consumes these directly)
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentile_empty_and_none_delta():
+    reg = MetricsRegistry()
+    _observe_all(reg, "h", [0.1, 0.2])
+    snap = reg.snapshot()["histograms"]["h"]
+    # an unmoved window collapses to None; the percentile of that must
+    # be None, not 0.0 — "no signal" and "instant queries" are
+    # different control inputs
+    assert histogram_percentile(delta_histogram_snapshot(snap, snap),
+                                99) is None
+    assert histogram_percentile(None, 99) is None
+    assert histogram_percentile({}, 50) is None
+
+
+def test_histogram_percentile_single_bucket_interpolates():
+    reg = MetricsRegistry()
+    # every observation lands in ONE bucket: all percentiles must stay
+    # inside that bucket's bounds and remain monotone in q
+    _observe_all(reg, "h", [0.3] * 10)
+    snap = reg.snapshot()["histograms"]["h"]
+    le = snap["le"]
+    i = next(i for i, c in enumerate(snap["counts"]) if c)
+    lo = le[i - 1] if i > 0 else 0.0
+    hi = le[i] if i < len(le) else le[-1]
+    ps = [histogram_percentile(snap, q) for q in (1, 50, 99, 100)]
+    assert ps == sorted(ps)
+    for p in ps:
+        assert lo <= p <= hi
+
+
+def test_histogram_percentile_overflow_bucket_reports_edge():
+    reg = MetricsRegistry()
+    # beyond the largest bound: the +Inf bucket has no upper edge, so
+    # the estimate must clamp to the largest finite bound, not invent
+    # a number
+    _observe_all(reg, "h", [1e9])
+    snap = reg.snapshot()["histograms"]["h"]
+    assert histogram_percentile(snap, 99) == max(snap["le"])
+
+
+# ---------------------------------------------------------------------------
+# history index (plan-routing feed)
+# ---------------------------------------------------------------------------
+
+def test_history_index_only_finished_runs_teach():
+    from spark_rapids_tpu.obs.history import HistoryIndex
+    idx = HistoryIndex()
+    idx.note_entry({"plan_fingerprint": "fp", "state": "FAILED",
+                    "wall_s": 9.0})
+    idx.note_entry({"plan_fingerprint": "fp", "state": "CANCELLED",
+                    "wall_s": 9.0})
+    idx.note_entry({"plan_fingerprint": "fp", "state": "FINISHED",
+                    "wall_s": "not-a-number"})
+    idx.note_entry({"state": "FINISHED", "wall_s": 1.0})  # no fp
+    assert idx.lookup("fp") is None
+    idx.note_entry({"plan_fingerprint": "fp", "state": "FINISHED",
+                    "wall_s": 0.5})
+    got = idx.lookup("fp")
+    assert got["samples"] == 1
+    assert got["median_wall_s"] == pytest.approx(0.5)
+
+
+def test_history_index_mesh_breakdown_and_bounds():
+    from spark_rapids_tpu.obs.history import HistoryIndex
+    idx = HistoryIndex(max_fingerprints=2, max_samples=3)
+    for wall, mesh in [(1.0, 1), (2.0, 1), (0.2, 4), (0.4, 4)]:
+        idx.note_entry({"plan_fingerprint": "a", "state": "FINISHED",
+                        "wall_s": wall, "mesh_devices": mesh})
+    got = idx.lookup("a")
+    # max_samples=3 keeps only the newest 3 of the 4
+    assert got["samples"] == 3
+    assert got["by_mesh"][4]["samples"] == 2
+    assert got["by_mesh"][4]["median_wall_s"] == pytest.approx(0.3)
+    # LRU bound on fingerprints: touching "a" via lookup keeps it
+    # alive while "b" then "c" arrive — "b" is the one evicted
+    idx.note_entry({"plan_fingerprint": "b", "state": "FINISHED",
+                    "wall_s": 1.0})
+    idx.lookup("a")
+    idx.note_entry({"plan_fingerprint": "c", "state": "FINISHED",
+                    "wall_s": 1.0})
+    assert len(idx) == 2
+    assert idx.lookup("b") is None
+    assert idx.lookup("a") is not None
+
+
+def test_history_index_refresh_replaces_no_double_count(tmp_path):
+    from spark_rapids_tpu.obs.history import (HistoryIndex,
+                                              QueryHistoryLog)
+    log = QueryHistoryLog(str(tmp_path))
+    idx = HistoryIndex(min_refresh_s=0.0)
+    entry = {"plan_fingerprint": "fp", "state": "FINISHED",
+             "wall_s": 1.0, "query_id": "q0"}
+    log.append(entry)
+    idx.note_entry(entry)           # in-process fast path
+    assert idx.refresh_from(log.path) is True   # file identity is new
+    # the rebuild REPLACED the index — the entry fed both ways still
+    # counts once
+    assert idx.lookup("fp")["samples"] == 1
+    # unchanged file: stat-gated, no rebuild
+    assert idx.refresh_from(log.path) is False
+    # a second process appends: identity moves, rebuild picks it up
+    log.append({"plan_fingerprint": "fp", "state": "FINISHED",
+                "wall_s": 3.0, "query_id": "q1"})
+    assert idx.refresh_from(log.path) is True
+    assert idx.lookup("fp")["samples"] == 2
+
+
+def test_history_reader_retries_across_rotation(tmp_path, monkeypatch):
+    """A read that straddles ``os.replace`` rotation must come back
+    with one consistent generation of the file, never a torn mix: the
+    reader compares the inode before/after and retries on the fresh
+    file."""
+    from spark_rapids_tpu.obs import history
+    log = history.QueryHistoryLog(str(tmp_path), max_entries=100)
+    for i in range(6):
+        log.append({"query_id": f"old{i}"})
+    real_open = open
+    raced = {"done": False}
+
+    def racing_open(path, *a, **kw):
+        f = real_open(path, *a, **kw)
+        if not raced["done"] and str(path) == log.path:
+            raced["done"] = True
+            # rotation swaps the file out while this reader holds the
+            # old inode (rewrite + os.replace, same as _rotate_locked)
+            tmp = log.path + ".tmp"
+            with real_open(tmp, "w") as t:
+                for i in range(3):
+                    t.write(json.dumps({"query_id": f"new{i}"}) + "\n")
+            os.replace(tmp, log.path)
+        return f
+
+    monkeypatch.setattr(history, "open", racing_open, raising=False)
+    ids = [e["query_id"] for e in history.read_entries(log.path)]
+    assert ids == ["new0", "new1", "new2"]
