@@ -7,7 +7,7 @@ the CI image carries no jsonschema package, and the gate must not grow a
 dependency just to check its own output.
 
 Usage:
-    python scripts/validate_obs.py <trace|metrics|bundle|history|histogram> <file.json> ...
+    python scripts/validate_obs.py <trace|metrics|bundle|history|histogram|profile> <file.json> ...
 
 Exit 0 when every file validates; 1 with a path-qualified error line per
 violation otherwise.  Also importable: ``validate(instance, schema)``

@@ -80,6 +80,11 @@ def _collect_rows(df, backend: str, plan=None, metrics_out: dict | None = None,
                     cluster.worker_registry_deltas()
             obs_out["plan_analyzed"] = explain_analyze(
                 plan, ctx).splitlines()
+            prof = ctx.cache.get("profiler")
+            if prof is not None:
+                # cost-attribution artifact (obs/profile.py): the same
+                # schema-checked document the profile dir export writes
+                obs_out["profile"] = prof.artifact()
         return out
 
 

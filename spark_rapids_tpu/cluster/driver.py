@@ -225,7 +225,40 @@ class ClusterDriver:
         spans = payload.get("spans")
         if spans:
             self.buffer_spans(spans.get("events") or [])
+        self._fold_worker_costs(wid, payload)
         return ({"ok": True}, b"")
+
+    def _fold_worker_costs(self, wid: str, payload: dict) -> None:
+        """Fold heartbeat-shipped worker metering deltas / HBM samples
+        into the driver's books (obs/profile.py, obs/metering.py).
+        Raw-conf gated so a disabled driver never imports the profiler
+        modules, whatever a worker ships."""
+        metering = payload.get("metering")
+        hbm = payload.get("profile_hbm")
+        if not metering and not hbm:
+            return
+        raw = self.conf.settings.get("spark.rapids.obs.profile.enabled")
+        if raw is None or str(raw).lower() not in ("true", "1", "yes"):
+            return
+        try:
+            if metering:
+                from spark_rapids_tpu.obs.metering import get_meter
+                meter = get_meter()
+                tenants = metering.get("tenants")
+                if tenants:
+                    meter.merge_delta({"tenants": tenants})
+                # worker totals stay under the per-worker ledger, OUT
+                # of this process's conservation cross-check: each
+                # process conserves its own books
+                totals = metering.get("totals")
+                if totals:
+                    meter.ingest_worker(wid, totals)
+            if hbm:
+                from spark_rapids_tpu.obs.profile import ingest_worker_hbm
+                ingest_worker_hbm(wid, hbm)
+        # enginelint: disable=RL001 (cost folding must never fail a heartbeat)
+        except Exception:
+            pass
 
     # -- trace aggregation ----------------------------------------------
     def buffer_spans(self, events: list) -> None:

@@ -442,6 +442,21 @@ class WorkerRuntime:
                 spans = self._drain_live_spans()
                 if spans is not None:
                     payload["spans"] = spans
+                # cost-attribution shipping: only when the profiler /
+                # meter are already live in THIS process (sys.modules
+                # gate — a disabled worker never imports them here)
+                import sys as _sys
+                if "spark_rapids_tpu.obs.metering" in _sys.modules:
+                    from spark_rapids_tpu.obs.metering import get_meter
+                    delta = get_meter().drain_delta()
+                    if delta is not None:
+                        payload["metering"] = delta
+                if "spark_rapids_tpu.obs.profile" in _sys.modules:
+                    from spark_rapids_tpu.obs.profile import \
+                        drain_hbm_for_shipping
+                    hbm = drain_hbm_for_shipping()
+                    if hbm:
+                        payload["profile_hbm"] = hbm
                 rpc_call(self.driver, "heartbeat", payload,
                          conf=self.conf, retries=0, timeout=5.0)
             except (ConnectionError, OSError):

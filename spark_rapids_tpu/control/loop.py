@@ -488,8 +488,21 @@ class ControlLoop:
             d = Decision("route",
                          "express" if "spark.rapids.control.express"
                          in overrides else "mesh",
-                         reason, detail={"fingerprint": fp,
-                                         "overrides": dict(overrides)})
+                         reason, detail={
+                             "fingerprint": fp,
+                             "overrides": dict(overrides),
+                             # the metering evidence behind the call
+                             # (None until the history carries
+                             # cost-attribution data, obs/profile.py)
+                             "evidence": {
+                                 "samples": stats["samples"],
+                                 "median_wall_s": round(wall, 6)
+                                 if wall is not None else None,
+                                 "median_rows":
+                                     stats.get("median_rows"),
+                                 "median_device_s":
+                                     stats.get("median_device_s"),
+                             }})
             d.applied = True
             self._record(d)
         return overrides

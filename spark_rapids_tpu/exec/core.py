@@ -305,6 +305,24 @@ class ExecCtx:
         with self._lock:
             return self.cache.setdefault("tracer", t)
 
+    @property
+    def profiler(self):
+        """Per-query cost-attribution profiler (obs/profile.py), or
+        None when profiling is off.  Mirrors :attr:`tracer`: the
+        disabled check reads the RAW conf string so the default path
+        never imports obs.profile/obs.metering (ci/premerge.sh asserts
+        sys.modules stays clean)."""
+        with self._lock:
+            if "profiler" in self.cache:
+                return self.cache["profiler"]
+        raw = self.conf.settings.get("spark.rapids.obs.profile.enabled")
+        p = None
+        if raw is not None and str(raw).lower() in ("true", "1", "yes"):
+            from spark_rapids_tpu.obs.profile import QueryProfiler
+            p = QueryProfiler(self.query_id, self.conf, ctx=self)
+        with self._lock:
+            return self.cache.setdefault("profiler", p)
+
     def trace_span(self, name: str, cat: str = "query", *,
                    parent_id=None, **args):
         """Context manager opening a span (yields it for annotate());
@@ -326,6 +344,16 @@ class ExecCtx:
         BufferCatalog (spilled disk files, host arena) if created; last,
         export the query trace when a trace dir is configured."""
         from spark_rapids_tpu.shuffle import ShuffleTransport
+        with self._lock:
+            prof = self.cache.get("profiler")
+        if prof is not None:
+            # BEFORE the catalog pop (spill totals are captured off it)
+            # and BEFORE trace export (counter tracks must land in it)
+            try:
+                prof.finalize(self)
+            # enginelint: disable=RL001 (profile finalize is best-effort teardown; the query already finished)
+            except Exception:
+                pass
         with self._lock:
             tkeys = [k for k, v in self.cache.items()
                      if isinstance(v, ShuffleTransport)]
@@ -433,10 +461,12 @@ class PlanNode:
             m = ctx.metrics_for(self)
             label = type(self).__name__
             tracer = ctx.tracer
+            prof = ctx.profiler
             it = _impl(self, ctx, pid)
             first_t0 = None
             batches = 0
             rows = 0
+            active = 0.0
             # enginelint: disable=RL004 (driven by next(it); terminates with the child iterator and propagates its exceptions)
             while True:
                 t0 = time.perf_counter()
@@ -447,8 +477,10 @@ class PlanNode:
                         batch = next(it)
                 except StopIteration:
                     break
-                m.add("totalTime", time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                m.add("totalTime", dt)
                 m.add("numOutputBatches", 1)
+                active += dt
                 batches += 1
                 if not ctx.is_device:
                     m.add("numOutputRows", batch.num_rows)
@@ -459,12 +491,21 @@ class PlanNode:
                         m.add("numOutputRows", kr)
                         rows += kr
                 yield batch
-            if tracer is not None and first_t0 is not None:
-                # dur is wall clock first-pull -> exhaustion (includes
-                # consumer suspension; the active time is in totalTime)
-                tracer.complete(label, "operator", first_t0,
-                                time.perf_counter(), node=label,
-                                partition=pid, batches=batches, rows=rows)
+            if first_t0 is not None:
+                if tracer is not None:
+                    # dur is wall clock first-pull -> exhaustion
+                    # (includes consumer suspension; the active time is
+                    # in totalTime)
+                    tracer.complete(label, "operator", first_t0,
+                                    time.perf_counter(), node=label,
+                                    partition=pid, batches=batches,
+                                    rows=rows)
+                if prof is not None:
+                    # one bounded record per (operator, partition) —
+                    # never per-batch work (the <3% overhead budget)
+                    prof.record_op(self, label, active,
+                                   time.perf_counter() - first_t0,
+                                   batches, rows, pid)
 
         timed_partition_iter.__wrapped__ = impl
         cls.partition_iter = timed_partition_iter

@@ -441,6 +441,16 @@ class FileScanExec(PlanNode):
 
     def _decode_iter(self, ctx: ExecCtx, files: list[str], mode: str):
         batch_rows = _effective_batch_rows(self._schema, ctx.conf.settings)
+        try:
+            # process-wide scan-volume counter (mirrors the shuffle
+            # plane's shuffle.fetch.bytes): on-disk bytes this partition
+            # is about to decode, metered per tenant by obs/metering
+            from spark_rapids_tpu.obs.registry import get_registry
+            get_registry().inc("scan.bytes", float(
+                sum(os.path.getsize(p) for p in files)))
+        # enginelint: disable=RL001 (accounting must never fail a scan)
+        except Exception:
+            pass
         if mode == "MULTITHREADED" and len(files) > 1:
             # prefetch pool: decode next files while current is consumed,
             # bounded to a numThreads-file window so host memory stays

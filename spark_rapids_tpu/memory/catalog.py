@@ -266,6 +266,14 @@ class BufferCatalog:
         self._reg_source = get_registry().register_object_source(
             f"catalog.{id(self):x}", self)
 
+    def occupancy(self) -> dict:
+        """Device-tier occupancy alone (no per-entry walk): the cheap
+        high-rate probe the HBM occupancy sampler (obs/profile.py)
+        reads when no governor ledger is available."""
+        with self._lock:
+            return {"device_used": self.device_used,
+                    "device_limit": self.device_limit}
+
     def tier_occupancy(self) -> dict:
         """Buffers/bytes currently registered per spill tier — the
         at-a-glance memory picture diagnostics bundles carry."""

@@ -580,6 +580,27 @@ class MemoryGovernor:
                 }
             return out
 
+    def occupancy_sample(self) -> dict:
+        """One compact occupancy snapshot in a SINGLE lock acquisition:
+        total device bytes, per-query ledger bytes, and the effective
+        watermark position.  The cost-attribution plane's HBM sampler
+        (obs/profile.py) polls this at tens of Hz, so it must not take
+        the condition lock four separate times the way composing
+        ``query_stats``+``watermarks``+``reserved_bytes`` would."""
+        with self._cond:
+            return {
+                "device_bytes_total": self._total_locked(),
+                "reserved_bytes": sum(s.reserved_bytes
+                                      for s in self._states.values()),
+                "budget_bytes": self._budget,
+                "per_query": {s.query_id: s.device_bytes
+                              for s in self._states.values()},
+                "watermarks": {"high": self._high_wm, "low": self._low_wm,
+                               "shed": self._shed_wm,
+                               "overridden":
+                                   self._wm_override is not None},
+            }
+
     def _source(self) -> dict:
         """MetricsRegistry pull source: aggregate + per-query gauges
         (bounded — entries exist only while their query runs)."""

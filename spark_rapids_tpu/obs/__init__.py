@@ -22,6 +22,13 @@ headless engine unifies its equivalents here:
 * ``obs.history``  — append-only JSONL query history log with atomic
   rotation (``spark.rapids.obs.history.dir``), browsed offline by
   ``python -m tools.history``.
+* ``obs.profile``  — cost-attribution plane: per-operator device/wall
+  attribution (fused/mesh members included), HBM occupancy timeline,
+  collapsed-stack flamegraphs + Perfetto counter tracks
+  (``spark.rapids.obs.profile.enabled``).
+* ``obs.metering`` — per-tenant / per-fingerprint resource metering
+  (device-seconds, HBM-byte-seconds, bytes) with a conservation
+  cross-check, served at ``/tenants``.
 
 Import discipline: the hot path must stay obs-free when observability is
 disabled, so this package __init__ resolves submodule attributes LAZILY
@@ -33,10 +40,14 @@ from __future__ import annotations
 
 __all__ = ["Tracer", "MetricsRegistry", "get_registry",
            "query_metrics_snapshot", "maybe_emit_bundle",
-           "ObsHttpServer", "QueryHistoryLog", "history_log"]
+           "ObsHttpServer", "QueryHistoryLog", "history_log",
+           "QueryProfiler", "TenantMeter", "get_meter"]
 
 _LAZY = {
     "Tracer": ("spark_rapids_tpu.obs.trace", "Tracer"),
+    "QueryProfiler": ("spark_rapids_tpu.obs.profile", "QueryProfiler"),
+    "TenantMeter": ("spark_rapids_tpu.obs.metering", "TenantMeter"),
+    "get_meter": ("spark_rapids_tpu.obs.metering", "get_meter"),
     "MetricsRegistry": ("spark_rapids_tpu.obs.registry", "MetricsRegistry"),
     "get_registry": ("spark_rapids_tpu.obs.registry", "get_registry"),
     "query_metrics_snapshot": ("spark_rapids_tpu.obs.registry",

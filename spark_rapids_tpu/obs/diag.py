@@ -28,8 +28,10 @@ DIAG_DIR = register(ConfEntry(
     "spark.rapids.obs.diagnostics.dir", "",
     "When set, a query failure emits a bounded diagnostic bundle "
     "(diag_<query_id>_<unix-ms>.json: annotated plan, metrics snapshot, "
-    "last span events, fault config + fired log, catalog tier occupancy) "
-    "into this directory. Empty (default): no bundle, no overhead."))
+    "last span events, fault config + fired log, catalog tier occupancy, "
+    "plus the profiler's operator cost table / HBM tail and the current "
+    "metering books when profiling is on) into this directory. Empty "
+    "(default): no bundle, no overhead."))
 DIAG_MAX_SPAN_EVENTS = register(ConfEntry(
     "spark.rapids.obs.diagnostics.maxSpanEvents", 256,
     "How many trailing span events a diagnostic bundle carries.",
@@ -163,6 +165,28 @@ def maybe_emit_bundle(ctx, plan, error, out_dir: str) -> str | None:
 
         bundle["span_events"] = (tracer.events_snapshot(last=max_ev)
                                  if tracer is not None else [])
+        try:
+            # where the time and HBM actually went before death: the
+            # profiler's operator cost table + HBM tail and the current
+            # metering books.  Read off ctx.cache (never the lazy
+            # property) so a disabled-profile failure does not import
+            # the profiler modules here
+            prof = ctx.cache.get("profiler") \
+                if isinstance(getattr(ctx, "cache", None), dict) else None
+            if prof is not None:
+                bundle["profile"] = {
+                    **prof.history_blob(),
+                    "hbm_tail": prof.hbm_timeline(last=64),
+                }
+                from .metering import get_meter
+                meter = get_meter()
+                bundle["metering"] = {
+                    "tenants": meter.snapshot()["tenants"],
+                    "totals": meter.totals(),
+                }
+        # enginelint: disable=RL001 (profile/metering view is best-effort; section omitted)
+        except Exception:
+            pass
         bundle["faults"] = _fault_view(ctx)
         bundle["catalog"] = _catalog_view(ctx)
         bundle["lifecycle"] = _lifecycle_view(ctx)

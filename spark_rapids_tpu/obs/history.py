@@ -189,7 +189,9 @@ class HistoryIndex:
         self.max_samples = max(1, int(max_samples))
         self.min_refresh_s = float(min_refresh_s)
         self._lock = threading.Lock()
-        # fp -> deque of (wall_s, mesh_devices), LRU order
+        # fp -> deque of (wall_s, mesh_devices, rows_processed,
+        # device_seconds), LRU order; rows/device_s are 0 when the
+        # entry predates the cost-attribution plane (PR 19)
         self._fps: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._file_id: "tuple | None" = None
@@ -217,11 +219,20 @@ class HistoryIndex:
             mesh = int(entry.get("mesh_devices") or 1)
         except (TypeError, ValueError):
             mesh = 1
+        try:
+            rows = int(entry.get("rows_processed") or 0)
+        except (TypeError, ValueError):
+            rows = 0
+        metering = entry.get("metering")
+        try:
+            dev = float((metering or {}).get("device_seconds") or 0.0)
+        except (TypeError, ValueError):
+            dev = 0.0
         dq = self._fps.get(fp)
         if dq is None:
             dq = self._fps[fp] = collections.deque(
                 maxlen=self.max_samples)
-        dq.append((float(wall), mesh))
+        dq.append((float(wall), mesh, rows, dev))
         self._fps.move_to_end(fp)
         while len(self._fps) > self.max_fingerprints:
             self._fps.popitem(last=False)
@@ -255,7 +266,10 @@ class HistoryIndex:
     def lookup(self, fingerprint: str) -> "dict | None":
         """Observed-wall stats for one plan fingerprint, or None if it
         was never (successfully) seen: total samples, overall median
-        wall, and a per-mesh-shape breakdown."""
+        wall, a per-mesh-shape breakdown, and — when the history
+        carries cost-attribution data — median rows processed (the
+        /queries progress denominator) and median metered
+        device-seconds."""
         with self._lock:
             dq = self._fps.get(fingerprint)
             if not dq:
@@ -263,11 +277,16 @@ class HistoryIndex:
             self._fps.move_to_end(fingerprint)
             samples = list(dq)
         by_mesh: dict = {}
-        for wall, mesh in samples:
+        for wall, mesh, _rows, _dev in samples:
             by_mesh.setdefault(mesh, []).append(wall)
+        rows = [r for _w, _m, r, _d in samples if r > 0]
+        devs = [d for _w, _m, _r, d in samples if d > 0]
         return {
             "samples": len(samples),
-            "median_wall_s": statistics.median(w for w, _m in samples),
+            "median_wall_s": statistics.median(
+                w for w, _m, _r, _d in samples),
+            "median_rows": statistics.median(rows) if rows else None,
+            "median_device_s": statistics.median(devs) if devs else None,
             "by_mesh": {m: {"samples": len(ws),
                             "median_wall_s": statistics.median(ws)}
                         for m, ws in by_mesh.items()},

@@ -13,11 +13,20 @@ same operational surface as three read-only routes:
   Returns 503 once the session begins shutdown — load balancers drain
   on readiness, not liveness.
 * ``/queries`` — the in-flight query table (query_id -> lifecycle
-  state/tenant/tenant wall so far), the live analog of the history log.
+  state/tenant/tenant wall so far), the live analog of the history log;
+  with profiling on each row also carries rows-processed,
+  percent-complete, and ETA against the plan's history medians.
 * ``/control`` — the self-driving control plane's learned state
   (current admission cap, adapted governor watermarks, per-tenant SLO
   status, last 32 decisions), or ``{"enabled": false}`` when the
   control loop is off.
+* ``/profile`` — the cost-attribution plane (obs/profile.py): HBM
+  occupancy timeline and per-fingerprint operator cost tables, or
+  ``{"enabled": false}`` with ``spark.rapids.obs.profile.enabled``
+  unset (the profiler modules are never imported then).
+* ``/tenants`` — per-tenant resource metering (device-seconds,
+  HBM-byte-seconds, shuffle/spill/scan bytes, compile-seconds) with
+  the tenant-sums-vs-process-totals conservation cross-check.
 
 Security: binds 127.0.0.1 ONLY.  The registry carries operational
 detail (tenant names, peer addresses, plan fingerprints) that must not
@@ -82,10 +91,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, srv.queries())
             elif path == "/control":
                 self._json(200, srv.control())
+            elif path == "/profile":
+                self._json(200, srv.profile())
+            elif path == "/tenants":
+                self._json(200, srv.tenants())
             else:
                 self._reply(404,
                             b"not found: /metrics /healthz /queries "
-                            b"/control\n", "text/plain")
+                            b"/control /profile /tenants\n",
+                            "text/plain")
         except BrokenPipeError:  # scraper hung up mid-reply
             pass
         # enginelint: disable=RL001 (endpoint must never kill the engine)
@@ -189,20 +203,80 @@ class ObsHttpServer:
         out["enabled"] = True
         return out
 
+    # -- cost-attribution plane (obs/profile.py, raw-conf gated) -------
+    def _profile_on(self) -> bool:
+        raw = self._session.conf.settings.get(
+            "spark.rapids.obs.profile.enabled")
+        return raw is not None and str(raw).lower() in ("true", "1",
+                                                        "yes")
+
+    def _progress_index(self):
+        """The HistoryIndex live progress reads its medians from: the
+        control loop's (already fed in-process) when the controller is
+        on, else a session-owned one refreshed from the history file.
+        None when there is no history to compare against."""
+        s = self._session
+        control = getattr(s, "_control", None)
+        idx = getattr(control, "_history_index", None) \
+            if control is not None else None
+        if idx is not None:
+            return idx
+        hist_dir = s.conf.settings.get("spark.rapids.obs.history.dir")
+        if not hist_dir:
+            return None
+        from spark_rapids_tpu.obs.history import HISTORY_FILE, \
+            HistoryIndex
+        import os
+        idx = getattr(s, "_progress_hist_index", None)
+        if idx is None:
+            idx = s._progress_hist_index = HistoryIndex()
+        idx.refresh_from(os.path.join(hist_dir, HISTORY_FILE))
+        return idx
+
+    def profile(self) -> dict:
+        """The /profile body: HBM occupancy timeline, per-fingerprint
+        operator cost tables, live per-query device-seconds — or
+        ``{"enabled": false}`` when profiling is off (the endpoint
+        answers either way; the profile module is only imported when
+        the conf is on)."""
+        if not self._profile_on():
+            return {"enabled": False}
+        from spark_rapids_tpu.obs.profile import profile_view
+        return profile_view(self._session)
+
+    def tenants(self) -> dict:
+        """The /tenants body: per-tenant and per-fingerprint usage
+        plus the conservation cross-check — or ``{"enabled": false}``
+        when profiling is off."""
+        if not self._profile_on():
+            return {"enabled": False}
+        from spark_rapids_tpu.obs.metering import get_meter
+        meter = get_meter()
+        out = meter.snapshot()
+        out["conservation"] = meter.conservation()
+        out["enabled"] = True
+        return out
+
     def queries(self) -> dict:
         s = self._session
         with s._lc_cond:
             live = dict(s._live)
         now = time.monotonic()
+        prof_on = self._profile_on()
+        idx = self._progress_index() if prof_on else None
         out = {}
         for qid, lc in live.items():
             started = lc._started_at
-            out[qid] = {
+            row = {
                 "state": lc.state,
                 "tenant": lc.tenant,
                 "wall_s": (None if started is None
                            else round(now - started, 3)),
             }
+            if prof_on:
+                from spark_rapids_tpu.obs.profile import live_progress
+                row.update(live_progress(lc, idx))
+            out[qid] = row
         return {"active": out, "count": len(out)}
 
     def close(self) -> None:

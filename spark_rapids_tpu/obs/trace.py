@@ -185,6 +185,25 @@ class Tracer:
                        **args}}
         self._push(ev)
 
+    def counter(self, name: str, *, t: "float | None" = None,
+                wall_t: "float | None" = None, **values):
+        """Record a Perfetto counter sample (ph="C"): ``values`` are
+        the numeric series plotted as a stacked counter track.  ``t``
+        is a perf_counter timestamp; ``wall_t`` an absolute
+        ``time.time()`` one (the HBM sampler's clock) rebased onto this
+        tracer's origin; neither = now.  The cost-attribution plane
+        (obs/profile.py) merges HBM occupancy and per-operator
+        device-seconds lanes into the query trace through this."""
+        if wall_t is not None:
+            ts = (wall_t - self._wall_origin) * 1e6
+        else:
+            ts = self._ts_us(time.perf_counter() if t is None else t)
+        ev = {"name": name, "cat": "counter", "ph": "C", "ts": ts,
+              "pid": self.pid, "tid": 0,
+              "args": {**self._base_args(next(self._ids), None),
+                       **values}}
+        self._push(ev)
+
     def set_query_state(self, state: str) -> None:
         """Record the query's terminal lifecycle state (exec/lifecycle)."""
         self.query_state = state

@@ -253,6 +253,38 @@ class TpuSession:
             from spark_rapids_tpu.obs.registry import get_registry
             hist_before = get_registry().snapshot()
             submitted = _time.time()
+        # raw-settings gated like trace/history: with profile.enabled
+        # unset (the default) obs.profile/obs.metering are never
+        # imported (premerge asserts sys.modules)
+        prof_on = str(conf.settings.get(
+            "spark.rapids.obs.profile.enabled", "")).lower() \
+            in ("true", "1", "yes")
+        if prof_on and hist_before is None:
+            from spark_rapids_tpu.obs.registry import get_registry
+            hist_before = get_registry().snapshot()
+        if prof_on:
+            # the meter's registry baseline must predate THIS query's
+            # counter movement (queries_executed incs at executor entry,
+            # before the first profiler would lazily build the meter) or
+            # conservation undercounts the first profiled run
+            from spark_rapids_tpu.obs.metering import get_meter
+            get_meter()
+        if (hist_dir or prof_on) and logical is not None:
+            # stash the plan fingerprint on the lifecycle NOW so the
+            # live /queries view can map this run to its history
+            # medians (percent-complete / ETA) while it executes
+            # enginelint: disable=RL001 (fingerprinting is best-effort observability; an unfingerprintable plan still runs)
+            try:
+                from spark_rapids_tpu.exec.compile_cache import fingerprint
+                from spark_rapids_tpu.exec.result_cache import _plan_part
+                try:
+                    lc.plan_fingerprint = fingerprint(_plan_part(logical))
+                # enginelint: disable=RL001 (repr fallback mirrors _record_history's fingerprint path)
+                except Exception:
+                    lc.plan_fingerprint = fingerprint(repr(logical))
+            # enginelint: disable=RL001 (fingerprinting is routing metadata; a plan that defeats it still runs)
+            except Exception:
+                pass
         err: BaseException | None = None
         try:
             rcache = None
@@ -279,18 +311,61 @@ class TpuSession:
             err = e
             raise
         finally:
+            metered = None
+            if prof_on:
+                metered = self._meter_query(lc, hist_before, conf)
             if hist_dir:
                 self._record_history(lc, node, logical, err,
-                                     hist_before, submitted, conf)
+                                     hist_before, submitted, conf,
+                                     metered=metered)
             with self._lc_cond:
                 self._live.pop(query_id, None)
                 self._lc_cond.notify_all()
             if admitted:
                 admission.release(tenant=lc.tenant)
 
+    def _meter_query(self, lc, before: "dict | None",
+                     conf: "TpuConf | None") -> "dict | None":
+        """Charge one finished run to its tenant + fingerprint
+        (obs/metering.py): device/HBM usage from the query's own
+        profiler, byte metrics from its registry delta.  Returns the
+        usage dict for the history entry, or None when the run never
+        built a profiler (cache hit, pre-admission failure).  Metering
+        must never fail the query."""
+        # enginelint: disable=RL001 (metering is best-effort accounting; the query's own outcome already propagated)
+        try:
+            import time as _time
+            ctx = getattr(lc, "ctx", None)
+            prof = None if ctx is None else ctx.cache.get("profiler")
+            if prof is None:
+                return None
+            from spark_rapids_tpu.obs.metering import get_meter
+            from spark_rapids_tpu.obs.profile import get_store
+            from spark_rapids_tpu.obs.registry import get_registry
+            usage = prof.usage()
+            counters = {} if before is None else \
+                get_registry().delta(before).get("counters", {})
+            usage["shuffle_bytes"] = float(
+                counters.get("shuffle.fetch.bytes", 0.0))
+            usage["scan_bytes"] = float(counters.get("scan.bytes", 0.0))
+            usage["compile_seconds"] = float(
+                counters.get("compile_wall_s", 0.0))
+            fp = getattr(lc, "plan_fingerprint", None)
+            get_meter().charge(lc.tenant or "default", fp, usage)
+            if fp:
+                started = lc._started_at
+                wall = None if started is None \
+                    else _time.monotonic() - started
+                get_store().note(fp, prof.operators(), wall_s=wall)
+            return usage
+        # enginelint: disable=RL001 (metering must never fail a finished query; unmetered beats broken)
+        except Exception:
+            return None
+
     def _record_history(self, lc, node, logical, err,
                         before: dict, submitted: float,
-                        conf: "TpuConf | None" = None) -> None:
+                        conf: "TpuConf | None" = None,
+                        metered: "dict | None" = None) -> None:
         """Append this query's terminal record to the history log
         (obs/history.py).  Forensics must never fail the query: any
         error here is swallowed after best-effort assembly."""
@@ -340,7 +415,9 @@ class TpuSession:
                     "spark.rapids.tpu.mesh.deviceCount", 0) or 0)),
                 "control_route": conf is not self.conf,
             }
-            if logical is not None:
+            if getattr(lc, "plan_fingerprint", None):
+                entry["plan_fingerprint"] = lc.plan_fingerprint
+            elif logical is not None:
                 from spark_rapids_tpu.exec.compile_cache import fingerprint
                 from spark_rapids_tpu.exec.result_cache import _plan_part
                 try:
@@ -351,8 +428,25 @@ class TpuSession:
                     # in-memory scans have no stable scan_fingerprint;
                     # the structural repr is identity enough for diffing
                     entry["plan_fingerprint"] = fingerprint(repr(logical))
+            if metered is not None:
+                entry["metering"] = {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in metered.items()}
             ctx = getattr(lc, "ctx", None)
             if ctx is not None:
+                # rows actually emitted, summed across operators — the
+                # denominator the live /queries progress view compares
+                # its in-flight sum against (HistoryIndex median_rows)
+                try:
+                    entry["rows_processed"] = int(sum(
+                        m.values.get("numOutputRows", 0.0)
+                        for m in list(ctx.metrics.values())))
+                # enginelint: disable=RL001 (metrics race is benign; the entry ships without a row count)
+                except Exception:
+                    pass
+                prof = ctx.cache.get("profiler")
+                if prof is not None:
+                    entry["profile"] = prof.history_blob()
                 try:
                     from spark_rapids_tpu.plan.overrides import \
                         explain_analyze

@@ -7,13 +7,17 @@ must work on a laptop against a log scp'd off a serving box where the
 engine (and jax) are not installed.
 
     python -m tools.history [--dir DIR] list [-n N]
-    python -m tools.history [--dir DIR] show QUERY_ID
+    python -m tools.history [--dir DIR] show QUERY_ID [--profile]
     python -m tools.history [--dir DIR] diff QUERY_ID1 QUERY_ID2
+    python -m tools.history [--dir DIR] top [-n N]
 
 ``list`` prints the newest entries (state, tenant, wall, when); ``show``
-pretty-prints one entry (query_id prefix match, newest wins); ``diff``
+pretty-prints one entry (query_id prefix match, newest wins) —
+``--profile`` renders its stored operator cost table instead; ``diff``
 compares two queries' analyzed plans (unified diff) and registry deltas
-— the "why did the same query get slow" tool.
+— the "why did the same query get slow" tool; ``top`` ranks plan
+fingerprints by median wall and flags regressions (recent median
+drifted >2x vs the prior window).
 """
 from __future__ import annotations
 
@@ -86,13 +90,111 @@ def cmd_list(entries: list[dict], n: int) -> int:
     return 0
 
 
-def cmd_show(entries: list[dict], qid: str) -> int:
+def cmd_show(entries: list[dict], qid: str,
+             profile: bool = False) -> int:
     e = _find(entries, qid)
+    if profile:
+        return _show_profile(e)
     plan = e.pop("plan_analyzed", None)
     print(json.dumps(e, indent=2, sort_keys=True))
     if plan:
         print("\n-- analyzed plan " + "-" * 40)
         print(plan)
+    return 0
+
+
+def _show_profile(e: dict) -> int:
+    """Render the stored operator cost table (entry["profile"], written
+    by obs/profile.py when spark.rapids.obs.profile.enabled was on):
+    top-level operators by device seconds, attributed members indented
+    under their container."""
+    prof = e.get("profile")
+    if not prof:
+        print(f"query {e.get('query_id')} has no stored profile "
+              "(was spark.rapids.obs.profile.enabled on?)")
+        return 1
+    ops = prof.get("operators") or {}
+    meter = e.get("metering") or {}
+    print(f"query_id={e.get('query_id')}  state={e.get('state')}  "
+          f"wall={_fmt_wall(e)}")
+    print(f"device_seconds={prof.get('device_seconds')}  "
+          f"hbm_byte_seconds={prof.get('hbm_byte_seconds')}"
+          + (f"  metered_device_s={meter.get('device_seconds')}"
+             if meter else ""))
+    print(f"\n{'operator':<44} {'device_s':>10} {'wall_s':>10} "
+          f"{'batches':>8} {'rows':>12}")
+    tops = sorted((e2 for e2 in ops.values() if not e2.get("parent")),
+                  key=lambda e2: -float(e2.get("device_s", 0.0)))
+    kids: dict = {}
+    for e2 in ops.values():
+        par = e2.get("parent")
+        if par:
+            kids.setdefault(par, []).append(e2)
+
+    def line(e2: dict, indent: str = "") -> None:
+        print(f"{indent + str(e2.get('op', '?')):<44} "
+              f"{float(e2.get('device_s', 0.0)):>10.6f} "
+              f"{float(e2.get('wall_s', 0.0)):>10.6f} "
+              f"{int(e2.get('batches', 0)):>8d} "
+              f"{int(e2.get('rows', 0)):>12d}")
+
+    for t in tops:
+        line(t)
+        # a container's key is its label; members carry it as parent
+        label = next((k for k, v in ops.items() if v is t), None)
+        for m in sorted(kids.get(label, []),
+                        key=lambda e2: -float(e2.get("device_s", 0.0))):
+            line(m, indent="  ")
+    return 0
+
+
+def _median(xs: list) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def cmd_top(entries: list[dict], n: int) -> int:
+    """Slowest plan fingerprints by median wall over FINISHED runs,
+    regression-flagged when the recent half's median drifted >2x vs
+    the prior half (needs >=2 samples in each half)."""
+    groups: dict = {}
+    for e in entries:
+        fp = e.get("plan_fingerprint")
+        if not fp or e.get("state") != "FINISHED":
+            continue
+        w = e.get("wall_s")
+        if not isinstance(w, (int, float)) or w < 0:
+            continue
+        g = groups.setdefault(fp, {"walls": [], "devs": [],
+                                   "tenants": set(), "last": e})
+        g["walls"].append(float(w))
+        g["last"] = e
+        g["tenants"].add(str(e.get("tenant") or "default"))
+        dev = (e.get("metering") or {}).get("device_seconds")
+        if isinstance(dev, (int, float)):
+            g["devs"].append(float(dev))
+    if not groups:
+        print("no FINISHED fingerprinted entries in the log")
+        return 0
+    rows = []
+    for fp, g in groups.items():
+        walls = g["walls"]  # log order == time order
+        half = len(walls) // 2
+        regressed = False
+        if half >= 2:
+            prior, recent = walls[:half], walls[half:]
+            regressed = _median(recent) > 2.0 * _median(prior)
+        rows.append((_median(walls), fp, g, regressed))
+    rows.sort(key=lambda r: -r[0])
+    print(f"{'fingerprint':<18} {'runs':>5} {'median':>9} "
+          f"{'device_s':>9} {'tenants':<16} flag")
+    for med, fp, g, regressed in rows[:n]:
+        dev = f"{_median(g['devs']):.4f}" if g["devs"] else "-"
+        flag = "REGRESSED(>2x)" if regressed else ""
+        print(f"{fp[:16]:<18} {len(g['walls']):>5} {med:>8.3f}s "
+              f"{dev:>9} {','.join(sorted(g['tenants']))[:16]:<16} "
+              f"{flag}")
     return 0
 
 
@@ -145,15 +247,23 @@ def main(argv=None) -> int:
     pl.add_argument("-n", type=int, default=20)
     ps = sub.add_parser("show", help="one entry in full")
     ps.add_argument("query_id")
+    ps.add_argument("--profile", action="store_true",
+                    help="render the stored operator cost table")
     pd = sub.add_parser("diff", help="compare two queries")
     pd.add_argument("query_id_a")
     pd.add_argument("query_id_b")
+    pt = sub.add_parser("top",
+                        help="slowest fingerprints by median wall, "
+                             "regressions flagged")
+    pt.add_argument("-n", type=int, default=10)
     args = p.parse_args(argv)
     entries = _read(args.dir)
     if args.cmd == "list":
         return cmd_list(entries, args.n)
     if args.cmd == "show":
-        return cmd_show(entries, args.query_id)
+        return cmd_show(entries, args.query_id, profile=args.profile)
+    if args.cmd == "top":
+        return cmd_top(entries, args.n)
     return cmd_diff(entries, args.query_id_a, args.query_id_b)
 
 
