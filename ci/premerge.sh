@@ -1316,6 +1316,178 @@ assert not [t.name for t in threading.enumerate()
 print(f"control gate [storm]: fixed grid missed everywhere, closed "
       f"loop margin {rep['closed_slo_margin']}x, only batch shed: ok")
 PY
+  echo "-- driver failover gate: mid-q18 SIGKILL -> journal recovery, write roll-forward, off-path inert --"
+  # three halves.  CRASH: a real driver process is SIGKILLed on its
+  # first reduce-side fetch of q18; recovery from the write-ahead
+  # journal must re-attach BOTH lingering workers and re-serve the
+  # exact rows with zero recompute of journaled map outputs.  WRITE:
+  # a SIGKILL mid-commit rolls FORWARD to exactly one _SUCCESS and no
+  # _staging residue.  OFF: journal disabled is byte-identical plans,
+  # zero journal I/O, and cluster/journal.py never imports.
+  JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, subprocess, sys, tempfile
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.session import TpuSession
+
+base = tempfile.mkdtemp(prefix="tpu-failover-gate-")
+d = os.path.join(base, "tpch")
+generate_tpch(d, sf=0.01)
+# multi-partition scans so the planner inserts REAL shuffle exchanges
+# (single-partition q18 never touches the cluster shuffle plane)
+for table in ("lineitem", "orders", "customer"):
+    t = pq.read_table(os.path.join(d, table, "part-0.parquet"))
+    step = -(-t.num_rows // 4)
+    for i in range(4):
+        pq.write_table(t.slice(i * step, step),
+                       os.path.join(d, table, f"part-{i}.parquet"))
+
+s = TpuSession()
+want = sorted(map(tuple, build_tpch_query("q18", s, d).collect()))
+s.shutdown()
+assert "spark_rapids_tpu.cluster.journal" not in sys.modules, \
+    "cluster/journal.py imported in single-process mode"
+
+DRIVER = r'''
+import json, sys
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.session import TpuSession
+conf = json.loads(sys.argv[1]); d = sys.argv[2]; mode = sys.argv[3]
+s = TpuSession(conf)
+df = build_tpch_query("q18", s, d)
+if mode == "write":
+    df.write_parquet(sys.argv[4])
+else:
+    df.collect()
+s.shutdown()
+print("CLEAN_EXIT", flush=True)
+'''
+
+def run_driver(conf, *extra):
+    # stderr to a FILE: the workers inherit the driver's stderr, and a
+    # captured pipe would block this gate for the whole linger window
+    with tempfile.TemporaryFile(mode="w+") as ef:
+        p = subprocess.run([sys.executable, "-c", DRIVER,
+                            json.dumps(conf), d, *extra],
+                           stdout=subprocess.PIPE, stderr=ef,
+                           text=True, timeout=240)
+        ef.seek(0)
+        p.stderr = ef.read()
+    return p
+
+def worker_pids(jdir):
+    from spark_rapids_tpu.cluster.journal import ClusterJournal
+    st = ClusterJournal.replay(jdir)
+    return [w["pid"] for w in st.workers.values() if w.get("pid")]
+
+def kill_stragglers(pids):
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+jdir = os.path.join(base, "journal")
+conf = {"spark.rapids.cluster.mode": "local[2]",
+        "spark.rapids.cluster.journal.dir": jdir,
+        "spark.rapids.cluster.driver.reattachGraceSeconds": "90"}
+
+# -- 1) SIGKILL mid-q18, recover, exact rows, zero recompute ---------
+crashed = run_driver({**conf, "spark.rapids.test.faults":
+                      "cluster.driver.crash:kill,point=shuffle_read"},
+                     "collect")
+assert crashed.returncode == -signal.SIGKILL, \
+    f"driver survived: rc={crashed.returncode} {crashed.stderr[-2000:]}"
+assert "CLEAN_EXIT" not in crashed.stdout
+from spark_rapids_tpu.cluster.driver import ClusterDriver
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.obs.registry import get_registry
+pids = worker_pids(jdir)
+try:
+    driver = ClusterDriver.recover(TpuConf(conf), jdir)
+    info = dict(driver.recovery_info)
+    assert info["workers_reattached"] == 2, info
+    s = TpuSession(conf).attach_cluster(driver)
+    before = get_registry().snapshot()
+    got = sorted(map(tuple, build_tpch_query("q18", s, d).collect()))
+    delta = get_registry().delta(before)["counters"]
+    s.shutdown()
+    assert got == want, "recovered q18 rows diverged from oracle"
+    assert delta.get("map_outputs_recomputed", 0) == 0, delta
+finally:
+    kill_stragglers(pids)
+print("failover gate 1: mid-q18 SIGKILL -> 2 reattached, exact rows, "
+      "0 journaled outputs recomputed: ok")
+
+# -- 2) SIGKILL mid-write-commit rolls FORWARD -----------------------
+jdir2 = os.path.join(base, "journal2")
+out = os.path.join(base, "out")
+conf2 = {**conf, "spark.rapids.cluster.journal.dir": jdir2}
+crashed = run_driver({**conf2, "spark.rapids.test.faults":
+                      "cluster.driver.crash:kill,point=write.commit"},
+                     "write", out)
+assert crashed.returncode == -signal.SIGKILL, crashed.stderr[-2000:]
+assert not os.path.exists(os.path.join(out, "_SUCCESS"))
+pids = worker_pids(jdir2)
+try:
+    drv = ClusterDriver.recover(TpuConf(conf2), jdir2)
+    info2 = dict(drv.recovery_info)
+    drv.shutdown()
+    assert info2["write_rollforward"] == 1, info2
+    assert info2["write_rollback"] == 0, info2
+    names = os.listdir(out)
+    assert names.count("_SUCCESS") == 1, names
+    assert "_staging" not in names, names
+finally:
+    kill_stragglers(pids)
+print("failover gate 2: mid-commit SIGKILL -> rolled forward, one "
+      "_SUCCESS, no _staging residue: ok")
+PY
+  # -- 3) journal disabled: identical plans, zero journal I/O --------
+  # fresh interpreter so sys.modules proves the DISABLED path never
+  # imports cluster/journal.py even in cluster mode
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, sys, tempfile
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.bench.tpch_gen import generate_tpch
+from spark_rapids_tpu.bench.tpch_queries import build_tpch_query
+from spark_rapids_tpu.session import TpuSession
+
+base = tempfile.mkdtemp(prefix="tpu-failover-off-")
+d = os.path.join(base, "tpch")
+generate_tpch(d, sf=0.01)
+for table in ("lineitem", "orders", "customer"):
+    t = pq.read_table(os.path.join(d, table, "part-0.parquet"))
+    step = -(-t.num_rows // 4)
+    for i in range(4):
+        pq.write_table(t.slice(i * step, step),
+                       os.path.join(d, table, f"part-{i}.parquet"))
+jdir = os.path.join(base, "never-touched")
+
+off = {"spark.rapids.cluster.mode": "local[2]",
+       "spark.rapids.cluster.journal.enabled": "false",
+       "spark.rapids.cluster.journal.dir": jdir}
+s = TpuSession(off)
+plan_off = build_tpch_query("q18", s, d).explain()
+s.shutdown()
+assert "spark_rapids_tpu.cluster.journal" not in sys.modules, \
+    "journal module imported with journaling DISABLED"
+assert not os.path.exists(jdir), "disabled journal still did I/O"
+
+on = {"spark.rapids.cluster.mode": "local[2]",
+      "spark.rapids.cluster.journal.dir": os.path.join(base, "j")}
+s = TpuSession(on)
+plan_on = build_tpch_query("q18", s, d).explain()
+s.shutdown()
+assert plan_off == plan_on, "journal changed the plan"
+print("failover gate 3: journal-off plans byte-identical, zero "
+      "journal I/O, module never imported: ok")
+PY
   echo "-- multichip dryrun (8 virtual devices) --"
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -152,6 +152,46 @@ QUARANTINE_PROBATION = float_conf(
     "(reference: spark.blacklist.timeout)",
     check=lambda v: v > 0, check_doc="must be > 0")
 
+JOURNAL_ENABLED = bool_conf(
+    "spark.rapids.cluster.journal.enabled", True,
+    "Write-ahead cluster journal (cluster/journal.py): the driver "
+    "durably records worker membership, map-output registrations, "
+    "write-commit decisions, and dispatch frontiers so a crashed "
+    "driver can be rebuilt with ClusterDriver.recover() and resume "
+    "queries against lingering workers without recomputing journaled "
+    "map outputs. Only consulted in cluster mode — single-process "
+    "sessions never import the journal. Disabling it restores the "
+    "pre-journal driver byte for byte (a driver crash is then a "
+    "cluster-wide reset). (reference: spark.deploy.recoveryMode)")
+
+JOURNAL_DIR = register(ConfEntry(
+    "spark.rapids.cluster.journal.dir",
+    "",
+    "Directory holding the cluster journal (journal.log + "
+    "journal.snapshot). Empty (default): a throwaway temp directory, "
+    "removed on clean shutdown — recovery across driver processes "
+    "needs an explicit, stable path shared by the dead and the "
+    "recovering driver. (reference: spark.deploy.recoveryDirectory)"))
+
+JOURNAL_MAX_BYTES = int_conf(
+    "spark.rapids.cluster.journal.maxBytes", 4 << 20,
+    "Journal log size that triggers snapshot compaction: the replayed "
+    "state is written as one snapshot record (tmp + fsync + rename) "
+    "and the log restarts empty, so replay cost stays bounded however "
+    "long the driver lives. replay(snapshot + tail) is equivalent to "
+    "replay(full log) by construction.",
+    check=lambda v: v >= 4096, check_doc="must be >= 4096")
+
+REATTACH_GRACE = float_conf(
+    "spark.rapids.cluster.driver.reattachGraceSeconds", 0.0,
+    "How long a worker lingers after losing its driver (stdin EOF): "
+    "it pauses fragment dispatch but keeps its RPC and shuffle "
+    "servers up so a recovered driver can RECONNECT and resume "
+    "queries against the surviving map outputs; past the grace the "
+    "worker self-terminates (no orphans). 0 (default): the worker "
+    "exits immediately on driver loss, the pre-journal behavior.",
+    check=lambda v: v >= 0, check_doc="must be >= 0")
+
 
 def parse_cluster_mode(conf) -> int:
     """Number of workers requested by spark.rapids.cluster.mode:

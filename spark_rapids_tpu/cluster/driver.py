@@ -37,15 +37,18 @@ _MAX_SPAN_QUERIES = 16
 
 from spark_rapids_tpu.cluster import (DEATH_PROBE_TIMEOUT, DRAIN_TIMEOUT,
                                       HEARTBEAT_INTERVAL,
-                                      HEARTBEAT_TIMEOUT, MAX_WORKERS,
-                                      MIN_WORKERS,
+                                      HEARTBEAT_TIMEOUT, JOURNAL_DIR,
+                                      JOURNAL_ENABLED, JOURNAL_MAX_BYTES,
+                                      MAX_WORKERS, MIN_WORKERS,
                                       QUARANTINE_MAX_FAILURES,
                                       QUARANTINE_PROBATION,
                                       RPC_COMPRESSION_CODEC,
                                       WORKER_STARTUP_TIMEOUT,
                                       parse_cluster_mode)
-from spark_rapids_tpu.cluster.rpc import RpcError, RpcServer, rpc_call
-from spark_rapids_tpu.cluster.worker import READY_PREFIX
+from spark_rapids_tpu.cluster.rpc import (RpcError, RpcServer, rpc_call,
+                                          set_caller_epoch)
+from spark_rapids_tpu.cluster.worker import MAP_ID_STRIDE, READY_PREFIX
+from spark_rapids_tpu.faults import crash_point
 from spark_rapids_tpu.obs.registry import get_registry
 
 
@@ -92,6 +95,47 @@ class WorkerHandle:
         return "alive"
 
 
+class _ReattachedProc:
+    """Popen-shaped shim over a worker this driver did NOT spawn (a
+    lingering worker re-attached during recovery): liveness via signal
+    0, kill via os.kill.  stdin/stdout are None — the recovered driver
+    holds no pipe to the process, so driver-loss detection on the
+    worker side runs over heartbeats instead of stdin EOF."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.returncode: int | None = None
+        self.stdin = None
+        self.stdout = None
+
+    def poll(self) -> int | None:
+        if self.returncode is None:
+            try:
+                os.kill(self.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                self.returncode = -9
+        return self.returncode
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("reattached-worker",
+                                                timeout)
+            time.sleep(0.05)
+        return self.returncode
+
+    def send_signal(self, sig) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        self.send_signal(signal.SIGKILL)
+
+
 class ClusterDriver:
     """Spawns and supervises the ``local[N]`` worker pool for one
     TpuSession (the scheduler/heartbeat half of the reference's driver
@@ -99,12 +143,31 @@ class ClusterDriver:
     ClusterMapOutputTracker)."""
 
     def __init__(self, conf):
-        from spark_rapids_tpu.faults import FaultRegistry
-        self.conf = conf
         n = parse_cluster_mode(conf)
         if n <= 0:
             raise ValueError("ClusterDriver requires cluster.mode="
                              "local[N] with N >= 1")
+        self._init_common(conf)
+        self._next_worker = n
+        if self.journal is not None:
+            self.journal.append("driver_start", epoch=self.epoch)
+        try:
+            for i in range(n):
+                self._spawn(f"w{i}")
+            self._await_ready()
+        except BaseException:
+            self.shutdown()
+            raise
+        for h in self.workers():
+            self._journal_worker_ready(h)
+        self._finish_init()
+        get_registry().inc("cluster.workers_spawned", n)
+
+    def _init_common(self, conf) -> None:
+        """State shared by a fresh __init__ and recover(): everything
+        up to (but not including) worker membership."""
+        from spark_rapids_tpu.faults import FaultRegistry
+        self.conf = conf
         self._faults = FaultRegistry.from_conf(conf)
         s = conf.settings
         self._hb_timeout = HEARTBEAT_TIMEOUT.get(s)
@@ -117,7 +180,6 @@ class ClusterDriver:
         self._lock = threading.Lock()
         self._handles: dict[str, WorkerHandle] = {}
         self._hang_ignored: set[str] = set()
-        self._next_worker = n
         # live ClusterMapOutputTrackers (one per in-flight cluster
         # shuffle): a graceful drain walks them to migrate the retiring
         # worker's slots; weak so a finished query's tracker vanishes
@@ -132,23 +194,320 @@ class ClusterDriver:
         self._pending_spans: "dict[str, deque]" = {}
         self._closed = threading.Event()
         self._io_threads: list[threading.Thread] = []
+        #: cluster epoch: bumped on every recovery, folded into RPC
+        #: caller identity and journaled so stale-attempt fencing stays
+        #: correct across a restart
+        self.epoch = 1
+        #: reconciled-but-unclaimed shuffles from a recovery (sid ->
+        #: claimable record); always empty on a fresh driver, so
+        #: claim_resume() is an unconditional no-op there
+        self._recovered: dict = {}
+        #: /healthz driver-recovery block; None on a fresh driver
+        self.recovery_info: dict | None = None
+        self.journal = None
+        self._journal_tmp: str | None = None
         self.rpc = RpcServer(
             {"heartbeat": self._h_heartbeat},
             codec_name=RPC_COMPRESSION_CODEC.get(conf.settings))
-        try:
-            for i in range(n):
-                self._spawn(f"w{i}")
-            self._await_ready()
-        except BaseException:
-            self.shutdown()
-            raise
+        self._open_journal()
+        set_caller_epoch(self.epoch)
+
+    def _open_journal(self) -> None:
+        """Open the write-ahead cluster journal (lazy import: with the
+        journal disabled — or in single-process mode, which never
+        builds a driver — cluster/journal.py is never imported)."""
+        if not JOURNAL_ENABLED.get(self.conf.settings):
+            return
+        d = JOURNAL_DIR.get(self.conf.settings)
+        if not d:
+            import tempfile
+            d = tempfile.mkdtemp(prefix="tpu-cluster-journal-")
+            # throwaway journal: removed on clean shutdown (recovery
+            # across processes needs an explicit journal.dir)
+            self._journal_tmp = d
+        from spark_rapids_tpu.cluster.journal import ClusterJournal
+        self.journal = ClusterJournal(
+            d, JOURNAL_MAX_BYTES.get(self.conf.settings),
+            faults=self._faults)
+
+    def _finish_init(self) -> None:
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True,
                                          name="tpu-cluster-monitor")
         self._monitor.start()
         get_registry().register_source("cluster", self._source)
-        get_registry().inc("cluster.workers_spawned", n)
         atexit.register(self.shutdown)
+
+    def _journal_worker_ready(self, h: WorkerHandle) -> None:
+        if self.journal is not None and h.rpc_addr is not None:
+            self.journal.append(
+                "worker_ready", wid=h.worker_id, pid=h.pid,
+                rpc=list(h.rpc_addr), shuffle=list(h.shuffle_addr))
+
+    # -- crash recovery --------------------------------------------------
+    @classmethod
+    def recover(cls, conf, journal_dir: str | None = None) \
+            -> "ClusterDriver":
+        """Rebuild a crashed driver from its journal: replay the
+        journaled state, bump the cluster epoch, RECONNECT to every
+        lingering worker (spawning replacements for the rest),
+        reconcile what the workers actually hold against the journaled
+        map-output tracker, and roll interrupted write commits forward
+        or back.  Queries then resume via :meth:`claim_resume` instead
+        of recomputing journaled-complete map outputs."""
+        from spark_rapids_tpu.cluster.journal import ClusterJournal
+        n = parse_cluster_mode(conf)
+        if n <= 0:
+            raise ValueError("ClusterDriver.recover requires "
+                             "cluster.mode=local[N] with N >= 1")
+        d = journal_dir or JOURNAL_DIR.get(conf.settings)
+        if not d:
+            raise ValueError(
+                "ClusterDriver.recover needs a journal directory "
+                "(spark.rapids.cluster.journal.dir or journal_dir=)")
+        state = ClusterJournal.replay(d)
+        self = cls.__new__(cls)
+        if journal_dir and not JOURNAL_DIR.get(conf.settings):
+            # _open_journal must land on the SAME directory we replayed
+            conf = type(conf)({**conf.settings,
+                               "spark.rapids.cluster.journal.dir": d})
+        self._init_common(conf)
+        if self.journal is None:
+            self.rpc.close()
+            raise ValueError("ClusterDriver.recover requires "
+                             "spark.rapids.cluster.journal.enabled=true")
+        self.epoch = state.epoch + 1
+        set_caller_epoch(self.epoch)
+        journaled_idx = [int(w[1:]) for w in state.workers
+                        if w[1:].isdigit()]
+        self._next_worker = max(journaled_idx + [n - 1]) + 1
+        self.journal.append("driver_start", epoch=self.epoch)
+        reattached = replaced = 0
+        inventories: dict = {}
+        try:
+            for wid, w in state.workers.items():
+                if w.get("status") != "alive" or not w.get("rpc"):
+                    continue
+                try:
+                    reply, _ = rpc_call(
+                        tuple(w["rpc"]), "reconnect",
+                        {"driver": list(self.rpc.address),
+                         "epoch": self.epoch},
+                        conf=self.conf, retries=0, timeout=10.0)
+                    ok = reply.get("worker_id") == wid
+                except (RpcError, ConnectionError, OSError):
+                    ok = False
+                if not ok:
+                    self.journal.append("worker_gone", wid=wid,
+                                        reason="reconnect failed")
+                    continue
+                h = WorkerHandle(wid, _ReattachedProc(int(reply["pid"])))
+                h.pid = int(reply["pid"])
+                h.rpc_addr = tuple(reply["rpc"])
+                h.shuffle_addr = tuple(reply["shuffle"])
+                h.alive = True
+                h.last_heartbeat = time.monotonic()
+                h.ready.set()
+                with self._lock:
+                    self._handles[wid] = h
+                inventories[wid] = reply.get("inventory") or {}
+                self._journal_worker_ready(h)
+                reattached += 1
+                print(f"cluster: worker {wid} re-attached "
+                      f"(pid {h.pid})", file=sys.stderr)
+            # replacements restore the pool to local[N] strength; they
+            # hold none of the journaled outputs, so reconciliation
+            # drops anything the journal pinned to the workers they
+            # replace
+            while len(self._handles) < n:
+                with self._lock:
+                    wid = f"w{self._next_worker}"
+                    self._next_worker += 1
+                self._spawn(wid)
+                replaced += 1
+            self._await_ready()
+        except BaseException:
+            self.shutdown()
+            raise
+        for h in self.workers():
+            if not isinstance(h.proc, _ReattachedProc):
+                self._journal_worker_ready(h)
+        dropped = self._reconcile(state, inventories)
+        rollfwd, rollback = self._recover_write_jobs(state)
+        self.recovery_info = {
+            "recovered_at": time.time(), "epoch": self.epoch,
+            "workers_reattached": reattached,
+            "workers_replaced": replaced,
+            "shuffles_recovered": len(self._recovered),
+            "entries_dropped": dropped,
+            "journal_truncated_records": state.truncated_records,
+            "write_rollforward": rollfwd, "write_rollback": rollback}
+        self._finish_init()
+        reg = get_registry()
+        reg.inc("cluster.drivers_recovered")
+        reg.inc("cluster.workers_reattached", reattached)
+        if replaced:
+            reg.inc("cluster.workers_spawned", replaced)
+        print(f"cluster: driver recovered at epoch {self.epoch} "
+              f"(reattached={reattached} replaced={replaced} "
+              f"shuffles={len(self._recovered)} dropped={dropped} "
+              f"write_fwd={rollfwd} write_back={rollback})",
+              file=sys.stderr)
+        return self
+
+    def _reconcile(self, state, inventories: dict) -> int:
+        """Cross-check the journaled map-output tracker against what
+        the re-attached workers actually hold.  A journaled entry is
+        CONFIRMED iff its owner re-attached and still holds a live slot
+        at the journaled index with the journaled map id at >= the
+        journaled epoch; anything else is dropped with a targeted epoch
+        bump — never a full recompute.  A journaled-done child
+        partition survives only if every journaled entry of it
+        survived.  Returns the dropped-entry count."""
+        dropped = 0
+        for sid, st in state.shuffles.items():
+            entries: dict = {}
+            epochs = dict(st["epochs"])
+            surviving: set = set()
+            invalidated: dict = {}
+            for (pid, mid), v in st["entries"].items():
+                wid, wslot, size, rows, epoch = v
+                rowset = (inventories.get(wid, {}).get(sid, {})
+                          .get(str(pid))) or ()
+                hit = any(int(r[0]) == wslot and int(r[1]) == mid
+                          and int(r[4]) >= epoch for r in rowset)
+                if hit:
+                    entries.setdefault(wid, []).append(
+                        [mid, pid, wslot, size, rows, epoch])
+                    surviving.add((pid, mid))
+                else:
+                    dropped += 1
+                    # targeted invalidation: the epoch bump fences any
+                    # pre-crash straggler of this map output
+                    epochs[mid] = max(epochs.get(mid, 0), epoch) + 1
+                    invalidated[mid] = epochs[mid]
+            ent_by_cpid: dict = {}
+            surv_by_cpid: dict = {}
+            for (pid, mid) in st["entries"]:
+                ent_by_cpid.setdefault(mid // MAP_ID_STRIDE,
+                                       set()).add((pid, mid))
+            for (pid, mid) in surviving:
+                surv_by_cpid.setdefault(mid // MAP_ID_STRIDE,
+                                        set()).add((pid, mid))
+            done = {c for c in st["done"]
+                    if ent_by_cpid.get(c, set())
+                    <= surv_by_cpid.get(c, set())}
+            if invalidated and self.journal is not None:
+                self.journal.append("map_invalidate", sid=sid,
+                                    epochs={str(m): e for m, e
+                                            in invalidated.items()})
+            self._recovered[sid] = {
+                "fp": st["fp"], "num_parts": st["num_parts"],
+                "ncpids": st["ncpids"], "conf_fp": st["conf_fp"],
+                "entries": entries, "done": done, "epochs": epochs}
+        if dropped:
+            get_registry().inc("cluster.journal.entries_dropped", dropped)
+        return dropped
+
+    def _recover_write_jobs(self, state) -> tuple:
+        """Resolve write jobs the crash interrupted: a job whose full
+        rename plan was journaled (write_commit_begin) rolls FORWARD —
+        each rename re-executed idempotently, manifest and _SUCCESS
+        published, staging removed; a job without one rolls BACK to
+        staging (nothing visible was renamed... the plan is journaled
+        before the first rename runs).  Never double-commits: a
+        journaled write_commit_done means everything already landed."""
+        import shutil
+        from spark_rapids_tpu.io.writer import MANIFEST_NAME, STAGING_DIR
+        rollfwd = rollback = 0
+        for job, j in state.write_jobs.items():
+            if j["committed"] or j["aborted"]:
+                continue
+            path = j["path"]
+            if not path:
+                continue
+            staging = os.path.join(path, STAGING_DIR, job)
+            if j["commit"] is not None:
+                for src, dst in j["commit"]["renames"]:
+                    try:
+                        if os.path.exists(dst):
+                            continue  # this rename already ran pre-crash
+                        if os.path.exists(src):
+                            os.makedirs(os.path.dirname(dst),
+                                        exist_ok=True)
+                            os.replace(src, dst)
+                    except OSError:
+                        pass
+                man = j["commit"].get("manifest")
+                mpath = os.path.join(path, MANIFEST_NAME)
+                if man and not os.path.exists(mpath):
+                    tmp = mpath + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(man, f, indent=1, sort_keys=True)
+                    os.replace(tmp, mpath)
+                open(os.path.join(path, "_SUCCESS"), "w").close()
+                shutil.rmtree(staging, ignore_errors=True)
+                try:
+                    os.rmdir(os.path.join(path, STAGING_DIR))
+                except OSError:
+                    pass
+                self.journal.append("write_commit_done", job=job)
+                get_registry().inc("write.jobs_rolled_forward")
+                rollfwd += 1
+            else:
+                # no rename plan was journaled, so nothing is visible:
+                # drop staging, the query re-runs the write cleanly
+                shutil.rmtree(staging, ignore_errors=True)
+                try:
+                    os.rmdir(os.path.join(path, STAGING_DIR))
+                except OSError:
+                    pass
+                self.journal.append("write_abort", job=job)
+                get_registry().inc("write.jobs_rolled_back")
+                rollback += 1
+        return rollfwd, rollback
+
+    def claim_resume(self, fp: str, new_sid, num_parts: int,
+                     ncpids: int, conf_fp: str) -> dict | None:
+        """Hand a recovered shuffle's surviving state to a resuming
+        query: match on the restart-stable fragment fingerprint (+
+        shape + conf fingerprint), re-key the held slots on every
+        owning worker under the query's fresh shuffle id
+        (``alias_shuffle``), and return ``{entries, addrs, done,
+        epochs}`` for tracker seeding.  None when nothing matches — a
+        fresh driver always returns None."""
+        with self._lock:
+            sid = next((s for s, r in self._recovered.items()
+                        if r["fp"] == fp and r["num_parts"] == num_parts
+                        and r["ncpids"] == ncpids
+                        and r["conf_fp"] == conf_fp), None)
+            if sid is None:
+                return None
+            rec = self._recovered.pop(sid)
+        entries: dict = {}
+        addrs: dict = {}
+        done = set(rec["done"])
+        for wid, ents in rec["entries"].items():
+            h = self.worker_by_id(wid)
+            ok = h is not None and h.alive
+            if ok:
+                try:
+                    rpc_call(h.rpc_addr, "alias_shuffle",
+                             {"old": sid, "new": new_sid},
+                             conf=self.conf, retries=0, timeout=10.0)
+                except (RpcError, ConnectionError, OSError):
+                    ok = False
+            if not ok:
+                # the holder died between reconcile and claim: its
+                # child partitions are no longer complete
+                for e in ents:
+                    done.discard(e[0] // MAP_ID_STRIDE)
+                continue
+            entries[wid] = ents
+            addrs[wid] = list(h.shuffle_addr)
+        get_registry().inc("cluster.shuffles_resumed")
+        return {"entries": entries, "addrs": addrs,
+                "done": sorted(done), "epochs": rec["epochs"]}
 
     # -- spawn ----------------------------------------------------------
     def _spawn(self, worker_id: str) -> None:
@@ -307,6 +666,10 @@ class ClusterDriver:
         while not self._closed.wait(interval):
             now = time.monotonic()
             for h in self.live_workers():
+                if self._closed.is_set():
+                    # shutdown started mid-sweep: stop issuing death
+                    # verdicts against workers being retired on purpose
+                    break
                 if h.draining:
                     # planned removal in progress: remove_worker owns
                     # this handle's fate; the death verdict must not
@@ -343,6 +706,11 @@ class ClusterDriver:
         observe ``alive`` flipping and surface the worker's slots as
         MapOutputLostError on the next fetch."""
         with self._lock:
+            if self._closed.is_set():
+                # shutdown owns the pool now; a concurrent death
+                # verdict here could start output migration against a
+                # worker shutdown is already retiring
+                return
             h = self._handles.get(worker_id)
             if h is None or not h.alive:
                 return
@@ -353,6 +721,9 @@ class ClusterDriver:
         except OSError:
             pass
         get_registry().inc("cluster_workers_lost")
+        if self.journal is not None:
+            self.journal.append("worker_gone", wid=worker_id,
+                                reason=reason)
         print(f"cluster: worker {worker_id} lost: {reason}",
               file=sys.stderr)
 
@@ -416,6 +787,7 @@ class ClusterDriver:
         reg = get_registry()
         reg.inc("cluster_workers_added")
         reg.inc("cluster.workers_spawned")
+        self._journal_worker_ready(h)
         print(f"cluster: worker {wid} added", file=sys.stderr)
         return wid
 
@@ -429,6 +801,8 @@ class ClusterDriver:
         lost so readers fall into lineage recovery.  Returns
         ``{"migrated": n, "dropped": n}``."""
         with self._lock:
+            if self._closed.is_set():
+                raise RuntimeError("cluster driver is shut down")
             h = self._handles.get(worker_id)
             if h is None:
                 raise KeyError(f"unknown worker {worker_id!r}")
@@ -446,6 +820,7 @@ class ClusterDriver:
         # task commit after the worker is gone — fence it out of every
         # live commit coordinator before touching map outputs
         self._fence_write_coordinators(worker_id)
+        crash_point(self._faults, "drain", worker=worker_id)
         stats = {"migrated": 0, "dropped": 0}
         if drain and h.alive:
             deadline = time.monotonic() + self._drain_timeout
@@ -505,6 +880,10 @@ class ClusterDriver:
                     pass
         get_registry().inc("cluster_workers_drained" if drain
                            else "cluster_workers_removed")
+        if self.journal is not None:
+            self.journal.append(
+                "worker_gone", wid=worker_id,
+                reason="drained" if drain else "removed")
         print(f"cluster: worker {worker_id} "
               f"{'drained' if drain else 'removed'} "
               f"(migrated={stats['migrated']} dropped={stats['dropped']})",
@@ -583,6 +962,8 @@ class ClusterDriver:
         QUARANTINED — no new fragments, but its registered map outputs
         stay servable — until probation re-admits it.  Returns the
         verdict: ``lost`` | ``quarantined`` | ``tolerated``."""
+        if self._closed.is_set():
+            return "tolerated"
         h = self._handles.get(worker_id)
         if h is None:
             return "lost"
@@ -737,5 +1118,14 @@ class ClusterDriver:
                     except OSError:
                         pass
         self.rpc.close()
+        if getattr(self, "journal", None) is not None:
+            self.journal.close()
+            self.journal = None
+        if getattr(self, "_journal_tmp", None):
+            # implicit (mkdtemp) journals die with a clean shutdown —
+            # there is nothing to recover; explicit journal.dir stays
+            import shutil
+            shutil.rmtree(self._journal_tmp, ignore_errors=True)
+            self._journal_tmp = None
         get_registry().unregister_source("cluster")
         atexit.unregister(self.shutdown)

@@ -30,6 +30,7 @@ same rows, one process.
 from __future__ import annotations
 
 import copy
+import hashlib
 import itertools
 import pickle
 import re
@@ -45,6 +46,7 @@ from spark_rapids_tpu.cluster import (SPECULATION_ENABLED,
                                       SPECULATION_MULTIPLIER)
 from spark_rapids_tpu.cluster.worker import MAP_ID_STRIDE, scrub_worker_conf
 from spark_rapids_tpu.exec.core import ExecCtx, PlanNode
+from spark_rapids_tpu.faults import crash_point
 from spark_rapids_tpu.obs.registry import get_registry
 from spark_rapids_tpu.shuffle.errors import (MapOutputLostError,
                                              ShuffleFetchError)
@@ -202,6 +204,10 @@ class ClusterMapOutputTracker:
         self._faults = ctx.cached(("fault_registry",),
                                   lambda: FaultRegistry.from_conf(ctx.conf))
         self._closed = False
+        # write-ahead cluster journal (cluster/journal.py) when the
+        # driver has one: registrations, invalidations, and the close
+        # are journaled so a restarted driver can resume this shuffle
+        self._journal = None
         # the driver weakly tracks live trackers so a graceful drain
         # can migrate a retiring worker's slots (elastic membership)
         reg_tracker = getattr(cluster, "register_tracker", None)
@@ -248,6 +254,12 @@ class ClusterMapOutputTracker:
                     dirty.add(pid)
             for pid in dirty:
                 self._entries[pid].sort(key=lambda e: e.map_id)
+        if self._journal is not None and entries:
+            self._journal.append(
+                "map_register", sid=str(self.shuffle_id), wid=worker_id,
+                shuffle=list(shuffle_addr),
+                entries=[[int(m), int(p), int(w), int(s), int(r), int(e)]
+                         for m, p, w, s, r, e in entries])
 
     def entries_owned_by(self, worker_id: str) -> dict[int, int]:
         """Live map ids (with current epochs) whose slots sit on the
@@ -358,6 +370,10 @@ class ClusterMapOutputTracker:
                     if e.map_id in wanted:
                         e.lost = True
                         e.epoch = new_epochs[e.map_id]
+        if self._journal is not None and new_epochs:
+            self._journal.append(
+                "map_invalidate", sid=str(self.shuffle_id),
+                epochs={str(m): e for m, e in new_epochs.items()})
         return new_epochs
 
     def partition_sizes(self, shuffle_id) -> dict[int, int]:
@@ -383,6 +399,8 @@ class ClusterMapOutputTracker:
         round relocates everything it held (reference: one
         FetchFailed fails the stage once per lost executor, not once
         per missing block)."""
+        crash_point(self._faults, "shuffle_read",
+                    shuffle=str(shuffle_id)[:12], part=part_id)
         if self._faults is not None:
             with self._lock:
                 snap = list(self._entries[part_id])[lo:hi]
@@ -489,6 +507,11 @@ class ClusterMapOutputTracker:
         if self._closed:
             return
         self._closed = True
+        if self._journal is not None:
+            # a closed shuffle is not resumable: drop it from the
+            # journaled state so compaction forgets it
+            self._journal.append("shuffle_close",
+                                 sid=str(self.shuffle_id))
         from spark_rapids_tpu.cluster.rpc import rpc_call
         with self._lock:
             workers = list(self._shuffle_addr)
@@ -734,6 +757,7 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
     from concurrent.futures import ThreadPoolExecutor
     from spark_rapids_tpu.cluster.rpc import RpcError, rpc_call
     reg = get_registry()
+    journal = getattr(tracker, "_journal", None)
     speculate = SPECULATION_ENABLED.get(ctx.conf.settings)
     pending = sorted(int(c) for c in cpids)
     max_rounds = max(4, 2 * len(cluster.workers()) + 2)
@@ -745,6 +769,8 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
     while pending:
         ctx.check_cancel()
         rounds += 1
+        crash_point(tracker._faults, "dispatch", round=rounds,
+                    shuffle=str(tracker.shuffle_id)[:12])
         if rounds > max_rounds:
             raise ClusterExecError(
                 f"shuffle {str(tracker.shuffle_id)[:12]}: fragment "
@@ -812,7 +838,17 @@ def _dispatch_fragments(cluster, ctx: ExecCtx, tracker, clone,
             for wid, cps in assign.items():
                 _consume_result(cluster, ctx, tracker, tracer, wid, cps,
                                 results[wid], next_pending)
+        round_pending = pending
         pending = sorted(next_pending)
+        if journal is not None:
+            # the dispatch frontier is journaled per round so a
+            # restarted driver resumes from the last completed
+            # partitions instead of re-running the whole stage
+            newly_done = sorted(set(round_pending) - set(pending))
+            if newly_done:
+                journal.append("frontier",
+                               sid=str(tracker.shuffle_id),
+                               done=newly_done)
 
 
 def _consume_result(cluster, ctx: ExecCtx, tracker, tracer, wid: str,
@@ -1024,6 +1060,34 @@ def _handle_fragment_loss(cluster, ctx: ExecCtx, res: dict) -> None:
 # entry point (hooked from ShuffleExchangeExec._do_shuffle_device)
 # ---------------------------------------------------------------------------
 
+#: collapse object ids and other hex runs out of node descriptions:
+#: shuffle/plan ids embed ``id(node)``, which never survives a driver
+#: restart, so resume matching must hash the fragment's SHAPE instead
+_UNSTABLE_HEX = re.compile(r"0x[0-9a-fA-F]+|[0-9a-f]{8,}")
+
+
+def _stable_fragment_fp(clone) -> str:
+    """Restart-stable identity of a map fragment: a digest over the
+    clone subtree's node types, hex-scrubbed descriptions, and output
+    schemas.  Two plans of the same query in different driver
+    processes produce the same fingerprint even though their shuffle
+    ids differ — the key the journal uses to hand a recovered
+    shuffle's surviving map outputs to the resumed query."""
+    h = hashlib.sha1()
+
+    def walk(node):
+        h.update(type(node).__name__.encode())
+        h.update(_UNSTABLE_HEX.sub("#", node.node_desc()).encode())
+        h.update(repr(node.output_schema).encode())
+        h.update(b"(")
+        for c in getattr(node, "children", None) or ():
+            walk(c)
+        h.update(b")")
+
+    walk(clone)
+    return h.hexdigest()
+
+
 def cluster_do_shuffle(cluster, exchange, ctx: ExecCtx, child):
     """Materialize one cluster-tagged exchange's map side across the
     worker pool.  Returns the registered ClusterMapOutputTracker, or
@@ -1056,11 +1120,54 @@ def cluster_do_shuffle(cluster, exchange, ctx: ExecCtx, child):
                         reason="fragment not picklable")
         return None
     tracker = ClusterMapOutputTracker(cluster, ctx, sid, n)
+    pending = list(range(ncpids))
+    resume_epochs = None
+    journal = getattr(cluster, "journal", None)
+    if journal is not None:
+        fp = _stable_fragment_fp(clone)
+        jconf_fp = conf_fingerprint(frag_conf)
+        # a recovered driver may hold this exact fragment's surviving
+        # map outputs under the OLD shuffle id: claim them (workers
+        # re-key their slots to the new id) before opening the new
+        # journal record, then seed the tracker and shrink the
+        # dispatch frontier to what was actually lost
+        claim = None
+        claimer = getattr(cluster, "claim_resume", None)
+        if callable(claimer):
+            claim = claimer(fp, str(sid), n, ncpids, jconf_fp)
+        journal.append("shuffle_open", sid=str(sid), fp=fp,
+                       num_parts=n, ncpids=ncpids, conf_fp=jconf_fp)
+        tracker._journal = journal
+        if claim is not None:
+            tracker._epochs.update({int(m): int(e) for m, e
+                                    in claim["epochs"].items()})
+            seeded = 0
+            for wid, ents in claim["entries"].items():
+                tracker.register(wid, tuple(claim["addrs"][wid]), ents)
+                seeded += len(ents)
+            done = set(int(c) for c in claim["done"])
+            if done:
+                journal.append("frontier", sid=str(sid),
+                               done=sorted(done))
+            pending = [c for c in pending if c not in done]
+            resume_epochs = {int(m): int(e) for m, e
+                             in claim["epochs"].items()} or None
+            reg.inc("cluster.map_outputs_resumed", seeded)
+            ctx.trace_event("cluster.resume", "cluster",
+                            shuffle=str(sid)[:12], seeded=seeded,
+                            done=len(done),
+                            recomputing=len(pending))
+            lc = getattr(ctx, "lifecycle", None)
+            if lc is not None and hasattr(lc, "annotations"):
+                lc.annotations.setdefault("cluster.resumed", []).append(
+                    {"shuffle": str(sid)[:12], "map_outputs": seeded,
+                     "partitions_done": len(done),
+                     "partitions_recomputing": len(pending)})
     with ctx.trace_span("cluster.map_stage", "cluster",
                         shuffle=str(sid)[:12], partitions=ncpids,
                         workers=len(cluster.live_workers())):
         _dispatch_fragments(cluster, ctx, tracker, clone, n,
-                            list(range(ncpids)), frag_conf)
+                            pending, frag_conf, epochs=resume_epochs)
     tracer = ctx.tracer
     if tracer is not None:
         # spans a long fragment streamed back on heartbeats MID-run

@@ -19,11 +19,14 @@ flakiness degrades to retries, not query failure.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 
 from spark_rapids_tpu.cluster import (RPC_COMPRESSION_CODEC,
                                       RPC_MAX_RETRIES, RPC_TIMEOUT)
@@ -41,6 +44,28 @@ _MAX_RPC_CTRL = 8 << 20
 #: blob frames carry pickled fragments / broadcast batches
 _MAX_RPC_BLOB = 2 << 30
 _RAW_LEN = struct.Struct(">Q")
+
+#: idempotency identity of THIS process's outgoing calls: every
+#: ``rpc_call`` carries ``(caller, seq)`` where caller folds in the
+#: process id and its cluster epoch.  All retry attempts of one logical
+#: call share one key, so a server that already RAN the handler (reply
+#: lost in flight) replays the recorded reply instead of re-executing a
+#: non-idempotent op — a retried ``run_fragment`` executes once.
+_CALLER_ID = uuid.uuid4().hex[:12]
+_SEQ = itertools.count(1)
+_caller_epoch = 0
+
+#: replies remembered per server for replay-dedup; heartbeats churn
+#: through this quickly but a retry lands within a handful of calls
+_REPLAY_CACHE_SIZE = 256
+
+
+def set_caller_epoch(epoch: int) -> None:
+    """Fold the driver's cluster epoch into this process's RPC caller
+    identity: a recovered driver's calls carry a NEW caller id, so a
+    worker's replay cache can never serve it a dead driver's reply."""
+    global _caller_epoch
+    _caller_epoch = int(epoch)
 
 
 class RpcError(ConnectionError):
@@ -115,7 +140,13 @@ class RpcServer:
         self._handlers = dict(handlers)
         self._codec_name = codec_name
         self.metrics = {"rpc_requests": 0, "rpc_errors": 0,
-                        "rpc_bytes_in": 0, "rpc_bytes_out": 0}
+                        "rpc_bytes_in": 0, "rpc_bytes_out": 0,
+                        "rpc_replays_deduped": 0}
+        # (caller, seq) -> recorded reply frames; a retried call whose
+        # handler already ran gets the SAME reply bytes back instead of
+        # a second execution
+        self._replay_lock = threading.Lock()
+        self._replay: OrderedDict = OrderedDict()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind, port))
@@ -163,6 +194,24 @@ class RpcServer:
                 self.metrics["rpc_requests"] += 1
                 self.metrics["rpc_bytes_in"] += len(body) + len(blob)
                 op = req.get("op", "")
+                idem = req.get("idem") or None
+                key = ((idem["caller"], idem["seq"])
+                       if isinstance(idem, dict) and "caller" in idem
+                       and "seq" in idem else None)
+                if key is not None:
+                    with self._replay_lock:
+                        frames = self._replay.get(key)
+                        if frames is not None:
+                            self._replay.move_to_end(key)
+                    if frames is not None:
+                        # the handler already ran for this logical call
+                        # (the reply was lost in flight): resend the
+                        # recorded reply, never re-execute
+                        self.metrics["rpc_replays_deduped"] += 1
+                        get_registry().inc("cluster.rpc.replays_deduped")
+                        for tag2, data2 in frames:
+                            _send_frame(conn, tag2, data2)
+                        return
                 fn = self._handlers.get(op)
                 try:
                     if fn is None:
@@ -172,8 +221,9 @@ class RpcServer:
                 # enginelint: disable=RL001 (failure is surfaced to the peer as an error frame, not swallowed)
                 except Exception as e:  # noqa: BLE001 - sent to peer
                     self.metrics["rpc_errors"] += 1
-                    _send_frame(conn, _TAG_ERROR,
-                                f"{type(e).__name__}: {e}".encode())
+                    err = f"{type(e).__name__}: {e}".encode()
+                    self._remember(key, [(_TAG_ERROR, err)])
+                    _send_frame(conn, _TAG_ERROR, err)
                     return
                 header: dict = {"ok": True, "payload": reply,
                                 "has_blob": bool(reply_blob)}
@@ -182,12 +232,26 @@ class RpcServer:
                     wire, fields = _pack_blob(reply_blob, self._codec_name)
                     header.update(fields)
                 out = json.dumps(header).encode()
-                _send_frame(conn, _TAG_JSON, out)
+                frames = [(_TAG_JSON, out)]
                 if wire:
-                    _send_frame(conn, _TAG_DATA, wire)
+                    frames.append((_TAG_DATA, wire))
+                self._remember(key, frames)
+                for tag2, data2 in frames:
+                    _send_frame(conn, tag2, data2)
                 self.metrics["rpc_bytes_out"] += len(out) + len(wire)
         except (ConnectionError, OSError):
             pass
+
+    def _remember(self, key, frames) -> None:
+        """Record one handler outcome (success or error frame alike —
+        both mean the handler RAN) for replay dedup, bounded LRU."""
+        if key is None:
+            return
+        with self._replay_lock:
+            self._replay[key] = frames
+            self._replay.move_to_end(key)
+            while len(self._replay) > _REPLAY_CACHE_SIZE:
+                self._replay.popitem(last=False)
 
     def close(self) -> None:
         self._closed.set()
@@ -225,6 +289,11 @@ def rpc_call(address, op: str, payload: dict | None = None,
     codec_name = RPC_COMPRESSION_CODEC.get(settings)
     reg = get_registry()
     host, port = address
+    # ONE idempotency key for every retry attempt of this logical call:
+    # if an earlier attempt's handler ran but the reply was lost, the
+    # server's replay cache answers the retry without re-executing
+    idem = {"caller": f"{_CALLER_ID}.e{_caller_epoch}",
+            "seq": next(_SEQ)}
     last: Exception | None = None
     for attempt in range(retries + 1):
         if faults is not None:
@@ -239,7 +308,7 @@ def rpc_call(address, op: str, payload: dict | None = None,
         try:
             t0 = time.perf_counter()
             out = _call_once(host, port, op, payload, blob, codec_name,
-                             timeout)
+                             timeout, idem)
             reg.observe("cluster.rpc.round_trip_seconds",
                         time.perf_counter() - t0)
             return out
@@ -253,9 +322,11 @@ def rpc_call(address, op: str, payload: dict | None = None,
 
 
 def _call_once(host, port, op, payload, blob, codec_name,
-               timeout) -> tuple[dict, bytes]:
+               timeout, idem=None) -> tuple[dict, bytes]:
     req: dict = {"op": op, "payload": payload or {},
                  "has_blob": bool(blob)}
+    if idem is not None:
+        req["idem"] = idem
     wire = b""
     if blob:
         wire, fields = _pack_blob(blob, codec_name)

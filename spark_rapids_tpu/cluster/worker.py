@@ -98,13 +98,22 @@ class WorkerRuntime:
         self._draining = False
         self._active_lock = threading.Lock()
         self._active_fragments = 0
+        # driver-loss linger: after stdin EOF (driver gone) the worker
+        # can keep its RPC + shuffle servers alive for a grace window so
+        # a recovered driver re-attaches; dispatch is paused meanwhile
+        self._linger_lock = threading.Lock()
+        self._lingering = False
+        self._linger_timer: threading.Timer | None = None
+        self._reattach_epoch = 0
         self.metrics = {"fragments_run": 0, "fragment_failures": 0,
                         "map_batches_written": 0,
                         "fragments_rejected_draining": 0,
                         "map_outputs_imported": 0,
                         "write_fragments_run": 0,
                         "write_tasks_staged": 0,
-                        "write_fragment_failures": 0}
+                        "write_fragment_failures": 0,
+                        "linger_entered": 0, "linger_expired": 0,
+                        "driver_reattached": 0, "shuffles_aliased": 0}
         # tracers of fragments currently executing: the heartbeat drains
         # them mid-run so a long map stage streams spans to the driver
         # instead of batching them all on completion
@@ -121,6 +130,8 @@ class WorkerRuntime:
              "release_shuffle": self._h_release_shuffle,
              "drain": self._h_drain,
              "migrate_slots": self._h_migrate_slots,
+             "reconnect": self._h_reconnect,
+             "alias_shuffle": self._h_alias_shuffle,
              "shutdown": self._h_shutdown},
             timeout=RPC_TIMEOUT.get(self.conf.settings),
             codec_name=RPC_COMPRESSION_CODEC.get(self.conf.settings))
@@ -193,6 +204,58 @@ class WorkerRuntime:
                  "shuffle": list(self.shuffle_server.address),
                  "imported": len(imported)}, b"")
 
+    # -- driver-loss linger / re-attach ---------------------------------
+    def begin_linger(self, grace: float) -> None:
+        """Driver gone (stdin EOF): pause dispatch but keep the RPC and
+        shuffle servers up for ``grace`` seconds so a recovered driver
+        can RECONNECT and resume against the surviving map outputs.
+        Past the grace the worker self-terminates — the linger window,
+        not process lifetime, bounds orphan risk."""
+        with self._linger_lock:
+            if self._lingering or self._stop.is_set():
+                return
+            self._lingering = True
+            self.metrics["linger_entered"] += 1
+            self._linger_timer = threading.Timer(grace, self._linger_expired)
+            self._linger_timer.daemon = True
+            self._linger_timer.start()
+
+    def _linger_expired(self) -> None:
+        with self._linger_lock:
+            if not self._lingering:
+                return  # a reconnect raced the timer and won
+            self.metrics["linger_expired"] += 1
+        self._stop.set()
+
+    def _h_reconnect(self, payload: dict, blob: bytes):
+        """RECONNECT handshake from a recovered driver: cancel the
+        linger deadline, re-route heartbeats to the new driver address,
+        adopt its journal epoch, and reply with a full inventory of the
+        map-output slots this worker still holds so the driver can
+        reconcile them against the journaled tracker."""
+        with self._linger_lock:
+            if self._linger_timer is not None:
+                self._linger_timer.cancel()
+                self._linger_timer = None
+            self._lingering = False
+            self.driver = tuple(payload["driver"])
+            self._reattach_epoch = int(payload.get("epoch", 0))
+            self.metrics["driver_reattached"] += 1
+        return ({"worker_id": self.worker_id, "pid": os.getpid(),
+                 "rpc": list(self.rpc.address),
+                 "shuffle": list(self.shuffle_server.address),
+                 "epoch": self._reattach_epoch,
+                 "inventory": self.store.shuffle_inventory()}, b"")
+
+    def _h_alias_shuffle(self, payload: dict, blob: bytes):
+        """Re-key a held shuffle's slots under a new shuffle id: a
+        recovered driver's replanned query carries a fresh (per-process)
+        shuffle id for the same exchange, and claiming the journaled
+        outputs means renaming them in every holder's store."""
+        moved = self.store.alias_shuffle(payload["old"], payload["new"])
+        self.metrics["shuffles_aliased"] += 1
+        return ({"ok": True, "moved": moved}, b"")
+
     def _ensure_runtime(self) -> None:
         # first fragment pays JAX/runtime init, keeping READY fast
         with self._runtime_lock:
@@ -210,10 +273,14 @@ class WorkerRuntime:
         this worker's own fault.  A draining worker rejects the call
         structurally so the driver re-pools the partitions on survivors
         without treating the rejection as data loss."""
-        if self._draining:
+        if self._draining or self._lingering:
+            # a lingering worker rejects exactly like a draining one:
+            # its map outputs stay servable but no new work lands until
+            # a driver completes the RECONNECT handshake
             self.metrics["fragments_rejected_draining"] += 1
             return ({"error_kind": "draining",
-                     "error": f"worker {self.worker_id} is draining"},
+                     "error": f"worker {self.worker_id} is "
+                              f"{'lingering' if self._lingering else 'draining'}"},
                     b"")
         with self._active_lock:
             self._active_fragments += 1
@@ -306,10 +373,11 @@ class WorkerRuntime:
         directory — a worker death mid-write leaves only staging
         garbage.  Draining workers reject structurally, like
         ``run_fragment``."""
-        if self._draining:
+        if self._draining or self._lingering:
             self.metrics["fragments_rejected_draining"] += 1
             return ({"error_kind": "draining",
-                     "error": f"worker {self.worker_id} is draining"},
+                     "error": f"worker {self.worker_id} is "
+                              f"{'lingering' if self._lingering else 'draining'}"},
                     b"")
         with self._active_lock:
             self._active_fragments += 1
@@ -432,8 +500,14 @@ class WorkerRuntime:
         self._hb_thread.start()
 
     def _hb_loop(self) -> None:
+        from spark_rapids_tpu.cluster import REATTACH_GRACE
         from spark_rapids_tpu.cluster.rpc import rpc_call
         from spark_rapids_tpu.obs.registry import get_registry
+        # a RE-ATTACHED worker has no stdin pipe to the new driver, so a
+        # second driver loss is detected by heartbeat silence instead:
+        # grace seconds of consecutive failed beats re-enter linger
+        grace = REATTACH_GRACE.get(self.conf.settings)
+        misses = 0
         while not self._stop.wait(self._hb_interval):
             try:
                 payload = {"worker_id": self.worker_id,
@@ -459,10 +533,15 @@ class WorkerRuntime:
                         payload["profile_hbm"] = hbm
                 rpc_call(self.driver, "heartbeat", payload,
                          conf=self.conf, retries=0, timeout=5.0)
+                misses = 0
             except (ConnectionError, OSError):
                 # driver unreachable: keep trying — the driver's timeout
                 # is the authority on whether this worker is dead
-                pass
+                misses += 1
+                if (grace > 0 and self._reattach_epoch > 0
+                        and not self._lingering
+                        and misses * self._hb_interval >= grace):
+                    self.begin_linger(grace)
 
     def wait(self) -> None:
         self._stop.wait()
@@ -489,12 +568,21 @@ def main() -> int:
     rt.start_heartbeat()
     # orphan reaper: the driver holds our stdin pipe open for its whole
     # life, so EOF here means the driver process is GONE (even SIGKILL,
-    # which skips its shutdown RPCs) — exit instead of lingering as an
-    # orphan shuffle server
+    # which skips its shutdown RPCs).  With reattachGraceSeconds > 0 the
+    # worker LINGERS instead of exiting — dispatch paused, shuffle
+    # outputs servable — so a recovered driver can RECONNECT; past the
+    # grace it self-terminates.  Grace 0 (default) is the pre-journal
+    # behavior: exit immediately, never orphan.
+    from spark_rapids_tpu.cluster import REATTACH_GRACE
+    grace = REATTACH_GRACE.get(rt.conf.settings)
+
     def _watch_stdin() -> None:
         while sys.stdin.readline():
             pass
-        rt._stop.set()
+        if grace > 0:
+            rt.begin_linger(grace)
+        else:
+            rt._stop.set()
     threading.Thread(target=_watch_stdin, daemon=True,
                      name="tpu-cluster-stdin").start()
     rt.wait()

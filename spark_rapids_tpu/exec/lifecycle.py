@@ -241,6 +241,10 @@ class QueryLifecycle:
         # transition.  Off by default so a static engine's counter set
         # stays byte-identical with the control plane disabled.
         self.observe_e2e = False
+        # free-form execution annotations (e.g. cluster resume facts
+        # from a recovered driver) surfaced on /queries and in history
+        # records; empty for the overwhelming majority of queries
+        self.annotations: dict = {}
 
     @classmethod
     def from_conf(cls, query_id: str, conf, timeout: "float | None" = None,

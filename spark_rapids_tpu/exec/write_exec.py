@@ -80,6 +80,14 @@ def run_write_job(node: CreateDataWriteExec, ctx: ExecCtx) -> WriteStats:
     try:
         clustered = False
         cluster = ctx.cache.get("cluster")
+        journal = getattr(cluster, "journal", None)
+        if journal is not None:
+            # write decisions are driver state a crash cannot recompute:
+            # journal the job open so recovery can roll an interrupted
+            # commit forward (or an uncommitted job back to staging)
+            coord.journal = journal
+            journal.append("write_start", job=job_id,
+                           path=coord.path, fmt=node.fmt)
         if cluster is not None and conf.get(WRITE_CLUSTER_ENABLED):
             from spark_rapids_tpu.cluster.exec import \
                 dispatch_write_fragments

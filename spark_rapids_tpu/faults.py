@@ -209,6 +209,37 @@ Injection points wired today (site -> actions it interprets):
                         re-derived from fresh signals next tick, so a
                         dropped actuation only delays convergence by
                         one interval.
+    cluster.driver.crash
+                        named driver-death points, all routed through
+                        ``faults.crash_point`` (ctx: point, plus
+                        site-specific keys like round or job).  Any
+                        action name works (use ``kill``); the DRIVER
+                        process SIGKILLs itself on the spot — no
+                        cleanup, no atexit, exactly an OOM-killed or
+                        power-cut driver.  Filter on ``point=`` to pick
+                        the death site: ``dispatch`` (top of a fragment
+                        dispatch round, cluster/exec.py), ``shuffle_read``
+                        (first reduce-side fetch, cluster/exec.py),
+                        ``write.commit`` (mid-rename during job commit,
+                        io/writer.py), ``drain`` (mid graceful drain,
+                        cluster/driver.py).  Recovery tests pair it
+                        with reattachGraceSeconds + journal.dir and
+                        rebuild via ClusterDriver.recover().
+    cluster.journal.torn
+                        after a journal group-commit writes its batch
+                        (cluster/journal.py).  Any action name works
+                        (use ``torn``); the freshly appended tail is
+                        sheared mid-record, as if the process died
+                        inside the write syscall — replay must heal the
+                        torn tail back to the last intact record.
+    cluster.journal.fsync.fail
+                        on the journal's group-commit fsync
+                        (cluster/journal.py).  Any action name works
+                        (use ``fail``); the fsync raises OSError.  The
+                        journal ABSORBS the failure — counts
+                        journal_fsync_failures and degrades to
+                        flush-only durability — rather than failing
+                        the query.
 
 Trigger keys (all optional):
 
@@ -243,7 +274,7 @@ import threading
 from spark_rapids_tpu.conf import TEST_FAULTS, TEST_FAULTS_SEED
 
 __all__ = ["FaultRegistry", "FaultRule", "FaultAction", "InjectedFault",
-           "KNOWN_POINTS"]
+           "KNOWN_POINTS", "crash_point"]
 
 #: every injection point wired into the engine (the module docstring
 #: documents each).  enginelint RL005 cross-checks this registry against
@@ -276,6 +307,9 @@ KNOWN_POINTS = frozenset({
     "io.write.rename.fail",
     "control.signal.stale",
     "control.actuate.drop",
+    "cluster.driver.crash",
+    "cluster.journal.torn",
+    "cluster.journal.fsync.fail",
 })
 
 #: keys with registry-level meaning; everything else in a rule is a
@@ -355,6 +389,20 @@ class FaultAction:
         return float(self.params.get(key, default))
 
 
+def crash_point(faults, point: str, **ctx) -> None:
+    """Driver-death injection site: when a ``cluster.driver.crash``
+    rule matches (``point=`` filters pick the site), SIGKILL the
+    CURRENT process — no cleanup, no atexit, the same instant death as
+    an OOM-killed driver.  One shared helper so enginelint sees exactly
+    one call site for the point."""
+    if faults is None:
+        return
+    if faults.check("cluster.driver.crash", point=point, **ctx) is not None:
+        import os
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 class FaultRegistry:
     """Parsed fault plan + firing state.  Thread-safe: the TCP server
     checks points from its per-connection threads."""
@@ -380,7 +428,7 @@ class FaultRegistry:
             return None
         return cls(spec, TEST_FAULTS_SEED.get(settings))
 
-    def check(self, point: str, **ctx) -> FaultAction | None:
+    def check(self, point: str, /, **ctx) -> FaultAction | None:
         """Called by an injection site; returns the action to perform
         when a rule on this point matches and its trigger fires."""
         with self._lock:

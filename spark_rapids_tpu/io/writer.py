@@ -488,6 +488,12 @@ class WriteCommitCoordinator:
         self._fenced: set[str] = set()
         self.committed = False
         self.aborted = False
+        #: optional cluster journal (cluster/journal.py), set by the
+        #: write-job runner when a journaling driver is attached: wins
+        #: and the commit rename plan are journaled so a driver crash
+        #: mid-commit rolls FORWARD (all renames were durable before the
+        #: first one ran) instead of double-committing or losing files
+        self.journal = None
 
     # -- attempt bookkeeping -------------------------------------------
     def next_attempt(self, task: int) -> int:
@@ -532,6 +538,9 @@ class WriteCommitCoordinator:
                 reg.inc("write.attempts_discarded")
                 return False
             self._winners[task] = manifest
+        if self.journal is not None:
+            self.journal.append("write_win", job=self.job_id, task=task,
+                                manifest=manifest)
         reg.inc("write.attempts_won")
         return True
 
@@ -587,6 +596,7 @@ class WriteCommitCoordinator:
         (tmp + os.replace) and ``_SUCCESS``, then GCs staging.  On any
         failure every completed rename is rolled back before the error
         propagates — the directory never holds a partial commit."""
+        from spark_rapids_tpu.faults import crash_point
         from spark_rapids_tpu.obs.registry import get_registry
         reg = get_registry()
         t0 = time.perf_counter()
@@ -594,47 +604,61 @@ class WriteCommitCoordinator:
             if self.aborted:
                 raise WriteCommitError("commit after abort")
             winners = dict(self._winners)
+        # phase 1 — PLAN: the complete rename list and the manifest are
+        # computed before any rename executes, so the journal's
+        # write_commit_begin record is a true write-ahead log: a driver
+        # crash anywhere in phase 2 can roll the commit FORWARD from the
+        # journal alone (renames are idempotent: done -> dst exists)
         files_out: list[dict] = []
         partitions: list[str] = []
-        renamed: list[tuple[str, str]] = []
+        plan: list[tuple[str, str]] = []
         seen_dirs: set[str] = set()
-        try:
-            for task in sorted(winners):
-                m = winners[task]
-                adir = self.attempt_dir(task, int(m["attempt"]))
-                for ent in m["files"]:
-                    src = os.path.join(adir, ent["rel"])
-                    dst = os.path.join(self.path, ent["rel"])
-                    d = os.path.dirname(dst)
-                    os.makedirs(d, exist_ok=True)
-                    if d != self.path and d not in seen_dirs:
-                        seen_dirs.add(d)
-                        partitions.append(os.path.relpath(d, self.path))
-                    self._rename(src, dst)
-                    renamed.append((src, dst))
-                    files_out.append(dict(ent))
-            if not files_out and schema is not None:
-                # empty result: emit one schema-bearing empty part file
-                # (Spark's write protocol) so the output stays readable —
-                # staged first, renamed in, like every other file
-                rel = f"part-00000-{self.job_id}.{self.fmt}"
-                os.makedirs(self.staging_root, exist_ok=True)
-                src = os.path.join(self.staging_root, rel)
-                _write_table(schema.empty_table(), src, self.fmt,
-                             **(options or {}))
-                ent = {"rel": rel, "rows": 0,
-                       "bytes": os.path.getsize(src),
-                       "crc32": _file_crc32(src)}
-                self._rename(src, os.path.join(self.path, rel))
-                renamed.append((src, os.path.join(self.path, rel)))
-                files_out.append(ent)
-            manifest = {
-                "version": 1, "job_id": self.job_id, "format": self.fmt,
-                "files": files_out, "partitions": sorted(set(partitions)),
-                "num_rows": sum(f["rows"] for f in files_out),
-                "num_bytes": sum(f["bytes"] for f in files_out)}
+        for task in sorted(winners):
+            m = winners[task]
+            adir = self.attempt_dir(task, int(m["attempt"]))
+            for ent in m["files"]:
+                plan.append((os.path.join(adir, ent["rel"]),
+                             os.path.join(self.path, ent["rel"])))
+                files_out.append(dict(ent))
+        if not files_out and schema is not None:
+            # empty result: emit one schema-bearing empty part file
+            # (Spark's write protocol) so the output stays readable —
+            # staged first, renamed in, like every other file
+            rel = f"part-00000-{self.job_id}.{self.fmt}"
             os.makedirs(self.staging_root, exist_ok=True)
-            tmp = os.path.join(self.staging_root, MANIFEST_NAME + ".tmp")
+            src = os.path.join(self.staging_root, rel)
+            _write_table(schema.empty_table(), src, self.fmt,
+                         **(options or {}))
+            plan.append((src, os.path.join(self.path, rel)))
+            files_out.append({"rel": rel, "rows": 0,
+                              "bytes": os.path.getsize(src),
+                              "crc32": _file_crc32(src)})
+        for _, dst in plan:
+            d = os.path.dirname(dst)
+            if d != self.path and d not in seen_dirs:
+                seen_dirs.add(d)
+                partitions.append(os.path.relpath(d, self.path))
+        manifest = {
+            "version": 1, "job_id": self.job_id, "format": self.fmt,
+            "files": files_out, "partitions": sorted(set(partitions)),
+            "num_rows": sum(f["rows"] for f in files_out),
+            "num_bytes": sum(f["bytes"] for f in files_out)}
+        if self.journal is not None:
+            self.journal.append("write_commit_begin", job=self.job_id,
+                                renames=[[s, d] for s, d in plan],
+                                manifest=manifest)
+        # phase 2 — EXECUTE; a soft failure still rolls back in-process
+        # (the directory is never observed partially committed), while a
+        # hard crash leaves the journaled plan for recovery
+        renamed: list[tuple[str, str]] = []
+        try:
+            for src, dst in plan:
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                self._rename(src, dst)
+                renamed.append((src, dst))
+                crash_point(self.faults, "write.commit", job=self.job_id,
+                            file=os.path.basename(dst))
+            tmp = os.path.join(self.path, MANIFEST_NAME + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1, sort_keys=True)
             os.replace(tmp, os.path.join(self.path, MANIFEST_NAME))
@@ -654,6 +678,10 @@ class WriteCommitCoordinator:
             os.rmdir(os.path.join(self.path, STAGING_DIR))
         except OSError:
             pass
+        if self.journal is not None:
+            # AFTER the staging rmtree: recovery's roll-forward of a
+            # missing write_commit_done also re-cleans staging
+            self.journal.append("write_commit_done", job=self.job_id)
         reg.inc("write.jobs_committed")
         reg.inc("write.files_committed", len(files_out))
         reg.inc("write.rows_committed", manifest["num_rows"])
@@ -673,6 +701,8 @@ class WriteCommitCoordinator:
             os.rmdir(os.path.join(self.path, STAGING_DIR))
         except OSError:
             pass
+        if self.journal is not None:
+            self.journal.append("write_abort", job=self.job_id)
         get_registry().inc("write.jobs_aborted")
 
 

@@ -172,6 +172,13 @@ class ObsHttpServer:
                         else round(now - h.last_heartbeat, 3)),
                 })
             out["cluster"] = {"workers": workers}
+            out["cluster"]["epoch"] = getattr(cluster, "epoch", 1)
+            recovery = getattr(cluster, "recovery_info", None)
+            if recovery is not None:
+                # this driver was rebuilt from its write-ahead journal
+                # (cluster/journal.py): surface what the recovery
+                # re-attached, replaced, and salvaged
+                out["cluster"]["recovery"] = recovery
             # only UNPLANNED loss degrades readiness: a draining or
             # retired worker is a planned scale-down, a quarantined one
             # still serves its map outputs

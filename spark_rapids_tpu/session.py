@@ -103,6 +103,22 @@ class TpuSession:
                 self._cluster_handle = ClusterDriver(self.conf)
             return self._cluster_handle
 
+    def attach_cluster(self, driver) -> "TpuSession":
+        """Adopt an already-built ClusterDriver — the crash-recovery
+        entry point: ``ClusterDriver.recover(conf, journal_dir)``
+        rebuilds the pool from the write-ahead journal, then the new
+        session attaches it instead of spawning fresh workers, so
+        resumed queries can claim the recovered map outputs.  The
+        session owns the driver from here (session.shutdown tears it
+        down)."""
+        with self._lc_cond:
+            if self._cluster_handle is not None \
+                    and self._cluster_handle is not driver:
+                raise RuntimeError(
+                    "session already has a cluster attached")
+            self._cluster_handle = driver
+        return self
+
     def active_queries(self) -> list[str]:
         """query_ids currently admitted and running."""
         with self._lc_cond:

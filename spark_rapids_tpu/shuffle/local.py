@@ -279,6 +279,46 @@ class LocalShuffleTransport:
             return [(s.map_id, s.size, s.rows, s.epoch)
                     for s in self._store.get((shuffle_id, part_id), ())]
 
+    def shuffle_inventory(self) -> dict:
+        """Everything this store still holds, slot-indexed:
+        ``{shuffle_id: {part_id: [(slot_idx, map_id, size, rows,
+        epoch), ...]}}`` for LIVE slots only (invalidated holes keep
+        their index so slot addressing matches the registrations the
+        dead driver journaled).  This is the RECONNECT handshake's
+        payload: a recovered driver reconciles it against the journal
+        and re-seeds its map-output tracker from what actually
+        survived."""
+        with self._lock:
+            out: dict = {}
+            for (sid, pid), slots in self._store.items():
+                rows = [(idx, s.map_id, s.size, s.rows, s.epoch)
+                        for idx, s in enumerate(slots)
+                        if s.item is not None]
+                if rows:
+                    out.setdefault(sid, {})[pid] = rows
+            return out
+
+    def alias_shuffle(self, old_sid, new_sid) -> int:
+        """Re-key every slot of ``old_sid`` under ``new_sid`` (a
+        recovered driver's replanned query carries a fresh per-process
+        shuffle id for the same exchange; claiming the journaled map
+        outputs renames them in place — no copy, no device traffic).
+        Returns the number of partitions moved."""
+        moved = 0
+        with self._lock:
+            for (sid, pid) in [k for k in self._store if k[0] == old_sid]:
+                self._store[(new_sid, pid)] = self._store.pop((sid, pid))
+                self._sizes[(new_sid, pid)] = self._sizes.pop(
+                    (sid, pid), 0)
+                self._rows[(new_sid, pid)] = self._rows.pop((sid, pid), 0)
+                self._batch_sizes[(new_sid, pid)] = \
+                    self._batch_sizes.pop((sid, pid), [])
+                moved += 1
+            for (sid, mid) in [k for k in self._epochs
+                               if k[0] == old_sid]:
+                self._epochs[(new_sid, mid)] = self._epochs.pop((sid, mid))
+        return moved
+
     def _slice_or_lost(self, shuffle_id, part_id, lo, hi) -> list[_Slot]:
         """Snapshot the requested slot slice, raising MapOutputLostError
         naming EVERY lost map output in it (recovery recomputes them all

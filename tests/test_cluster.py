@@ -125,6 +125,62 @@ def test_rpc_drop_fault_absorbed_by_retries():
         srv.close()
 
 
+def test_rpc_retry_with_same_idem_key_executes_once():
+    """A dropped-then-retried non-idempotent op must execute ONCE.
+
+    ``rpc_call`` mints one ``(caller, seq)`` key per LOGICAL call and
+    reuses it across retry attempts; the server's replay cache answers
+    the retry with the recorded reply instead of re-running the
+    handler.  This drives ``_call_once`` directly with the same key —
+    byte-for-byte what the retry loop sends after a reply is lost in
+    flight — and then with a fresh key to prove dedup doesn't bleed
+    across logical calls."""
+    from spark_rapids_tpu.cluster.rpc import RpcServer, _call_once
+    runs = {"n": 0}
+
+    def run_fragment(payload, blob):
+        runs["n"] += 1
+        return {"ran": runs["n"], "frag": payload.get("frag")}, b"out"
+
+    srv = RpcServer({"run_fragment": run_fragment})
+    try:
+        before = get_registry().snapshot()
+        host, port = srv.address
+        idem = {"caller": "test-caller.e1", "seq": 7}
+        first, blob1 = _call_once(host, port, "run_fragment",
+                                  {"frag": 3}, b"", None, 10.0,
+                                  idem=idem)
+        # the reply "was lost": the client retries the SAME logical call
+        second, blob2 = _call_once(host, port, "run_fragment",
+                                   {"frag": 3}, b"", None, 10.0,
+                                   idem=idem)
+        assert runs["n"] == 1, "retried run_fragment executed twice"
+        assert second == first and blob2 == blob1 == b"out"
+        assert srv.metrics["rpc_replays_deduped"] == 1
+        d = get_registry().delta(before)["counters"]
+        assert d.get("cluster.rpc.replays_deduped", 0) == 1, d
+        # a NEW logical call (fresh seq) is not deduped
+        third, _ = _call_once(host, port, "run_fragment", {"frag": 4},
+                              b"", None, 10.0,
+                              idem={"caller": "test-caller.e1",
+                                    "seq": 8})
+        assert runs["n"] == 2 and third["frag"] == 4
+        # a retried call whose handler FAILED replays the error too —
+        # the failure side effect also happened exactly once
+        from spark_rapids_tpu.cluster.rpc import RpcHandlerError
+        boom = {"caller": "test-caller.e1", "seq": 9}
+        srv._handlers["kaboom"] = lambda p, b: (_ for _ in ()).throw(
+            ValueError("no such fragment"))
+        for _ in range(2):
+            with pytest.raises(RpcHandlerError, match="no such fragment"):
+                _call_once(host, port, "kaboom", {}, b"", None, 10.0,
+                           idem=boom)
+        assert srv.metrics["rpc_errors"] == 1
+        assert srv.metrics["rpc_replays_deduped"] == 2
+    finally:
+        srv.close()
+
+
 def test_parse_cluster_mode():
     from spark_rapids_tpu.cluster import parse_cluster_mode
     assert parse_cluster_mode(TpuConf({})) == 0
